@@ -1,0 +1,25 @@
+"""ddp_tpu — a TPU-native distributed data-parallel training framework.
+
+A ground-up JAX/XLA re-design of the capability surface of
+``zahmedy/PyTorch-Distributed-Data-Parallel-DDP-Trainer`` (the reference):
+multi-process SPMD launch, process-group init/teardown with backend
+selection, data-parallel training with gradient all-reduce, per-rank
+deterministic data sharding with per-epoch shuffling, rank-0
+checkpointing, and latest-checkpoint auto-resume — expressed as
+``jax.distributed`` + ``Mesh`` + ``shard_map``/``pjit`` + ``lax.pmean``
++ Orbax, not as a port of the reference's torch/c10d architecture.
+
+Layer map (mirrors SURVEY.md §1, re-homed for TPU):
+
+  L5  CLI / launcher       train.py (repo root)
+  L4  Orchestration        ddp_tpu.train.trainer
+  L3  Models / Data        ddp_tpu.models / ddp_tpu.data
+  L2  Runtime              ddp_tpu.runtime (dist context, mesh)
+  L1  Native               XLA:TPU compiler, ICI collectives, Pallas
+                           kernels (ddp_tpu.ops), C++ data plane
+"""
+
+__version__ = "0.1.0"
+
+from ddp_tpu.runtime.dist import DistContext, setup, cleanup  # noqa: F401
+from ddp_tpu.runtime.mesh import make_mesh  # noqa: F401

@@ -1,0 +1,11 @@
+"""Data layer: dataset readers, deterministic sharding, device loaders.
+
+Replaces the reference's torchvision + DistributedSampler + DataLoader
+stack (``data.py``) with a torch-free pipeline: raw IDX/binary readers,
+a pure-function shard sampler with exact DistributedSampler semantics,
+and a double-buffered device-sharded loader.
+"""
+
+from ddp_tpu.data.sampler import ShardSampler  # noqa: F401
+from ddp_tpu.data.loader import ShardedLoader, Batch  # noqa: F401
+from ddp_tpu.data import mnist  # noqa: F401

@@ -1,0 +1,124 @@
+"""Input pipeline: per-process sharded batching with device prefetch.
+
+Capability parity with ``data.py:21-25`` (``DataLoader(num_workers=2,
+pin_memory=True)`` over a ``DistributedSampler``), redesigned for the
+TPU execution model:
+
+- The reference overlaps host decode with compute via worker
+  subprocesses and pins host memory for async H2D copies. Here the
+  equivalent is double-buffered ``jax.device_put``: batch ``i+1`` is
+  dispatched to the devices while batch ``i``'s step runs — JAX
+  transfers are async, so one Python thread suffices where torch needs
+  a worker pool.
+- Each *process* materializes only its shard (``ShardSampler`` with
+  ``num_shards = process_count``); the global array is assembled from
+  process-local shards with ``make_array_from_process_local_data``, so
+  no host ever holds the global batch — this is what makes the same
+  loader multi-host-correct where the reference's per-rank DataLoader
+  pattern is.
+- uint8 images travel to the device; the float conversion (ToTensor's
+  /255) happens inside the jitted step on the MXU-adjacent VPU, saving
+  4× host→device bandwidth.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.data.sampler import ShardSampler
+from ddp_tpu.runtime.mesh import data_axes
+
+
+class Batch(NamedTuple):
+    images: jax.Array  # [B, H, W, C] uint8, sharded over the data axes
+    labels: jax.Array  # [B] int32, sharded over the data axes
+
+
+class ShardedLoader:
+    """Deterministic, epoch-reshuffled, device-sharded batch stream."""
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        mesh: Mesh,
+        global_batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+    ):
+        self.mesh = mesh
+        self.global_batch_size = global_batch_size
+        procs = jax.process_count()
+        if global_batch_size % procs:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by {procs} processes"
+            )
+        shard_count = int(np.prod([mesh.shape[a] for a in data_axes(mesh)]))
+        if global_batch_size % shard_count:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{shard_count} data-parallel shards"
+            )
+        self.local_batch_size = global_batch_size // procs
+        self.images = images
+        self.labels = labels
+        # Shard the *sample stream* by process; device-level sharding of
+        # each assembled batch is handled by the sharding spec below.
+        self.sampler = ShardSampler(
+            num_examples=len(images),
+            num_shards=procs,
+            shard_id=jax.process_index(),
+            shuffle=shuffle,
+            seed=seed,
+        )
+        spec = P(data_axes(self.mesh))
+        self._img_sharding = NamedSharding(mesh, spec)
+        self._lbl_sharding = NamedSharding(mesh, spec)
+
+    def steps_per_epoch(self) -> int:
+        # The final partial batch is always dropped: SPMD steps need
+        # static shapes, and re-padding mid-epoch isn't worth a
+        # recompile for <1 batch of data (the reference's DataLoader
+        # keeps it, at 60000/64 a 0.05% difference per epoch).
+        return self.sampler.shard_size // self.local_batch_size
+
+    def _host_batches(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        idx = self.sampler.shard_indices(epoch)
+        lb = self.local_batch_size
+        n_full = len(idx) // lb
+        for b in range(n_full):
+            sel = idx[b * lb : (b + 1) * lb]
+            yield self.images[sel], self.labels[sel]
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Batches for ``epoch``, prefetched one step ahead.
+
+        ``epoch`` plays the role of ``sampler.set_epoch(epoch)`` at
+        train_ddp.py:193 — same data order on re-runs, reshuffled per
+        epoch.
+        """
+
+        def put(img_np: np.ndarray, lbl_np: np.ndarray) -> Batch:
+            if jax.process_count() == 1:
+                return Batch(
+                    jax.device_put(img_np, self._img_sharding),
+                    jax.device_put(lbl_np, self._lbl_sharding),
+                )
+            return Batch(
+                jax.make_array_from_process_local_data(self._img_sharding, img_np),
+                jax.make_array_from_process_local_data(self._lbl_sharding, lbl_np),
+            )
+
+        pending: Batch | None = None
+        for img_np, lbl_np in self._host_batches(epoch):
+            nxt = put(img_np, lbl_np)  # async dispatch — overlaps prior step
+            if pending is not None:
+                yield pending
+            pending = nxt
+        if pending is not None:
+            yield pending

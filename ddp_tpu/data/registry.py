@@ -1,0 +1,47 @@
+"""Dataset registry: name → (train split, test split) loaders."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ddp_tpu.data.mnist import Split
+
+_LOADERS: dict[str, Callable[..., tuple[Split, Split]]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _LOADERS[name] = fn
+        return fn
+
+    return deco
+
+
+def load_dataset(
+    name: str,
+    root: str = "./data",
+    *,
+    allow_synthetic: bool = False,
+    synthetic_size: int | None = None,
+) -> tuple[Split, Split]:
+    if name not in _LOADERS:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(_LOADERS)}")
+    return _LOADERS[name](
+        root, allow_synthetic=allow_synthetic, synthetic_size=synthetic_size
+    )
+
+
+@register("mnist")
+def _mnist(root, *, allow_synthetic, synthetic_size):
+    from ddp_tpu.data import mnist
+
+    train = mnist.load(
+        root, "train", allow_synthetic=allow_synthetic, synthetic_size=synthetic_size
+    )
+    test = mnist.load(
+        root,
+        "test",
+        allow_synthetic=allow_synthetic,
+        synthetic_size=(synthetic_size // 6 if synthetic_size else None),
+    )
+    return train, test

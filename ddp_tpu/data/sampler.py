@@ -1,0 +1,77 @@
+"""Deterministic per-process data sharding.
+
+Capability parity with ``torch.utils.data.DistributedSampler`` as the
+reference uses it (``data.py:16-19`` with ``shuffle=True``, plus
+``sampler.set_epoch(epoch)`` at ``train_ddp.py:193``). The semantics
+reproduced exactly (SURVEY.md §2b N10):
+
+- per-epoch reshuffle seeded by ``seed + epoch`` (torch's
+  ``g.manual_seed(self.seed + self.epoch)``),
+- pad the shuffled index list to a multiple of ``num_shards`` by
+  wrapping from its start (torch: ``indices += indices[:pad]``),
+- shard ``r`` takes the strided slice ``indices[r::num_shards]``,
+
+so each epoch every sample is seen exactly once (padding duplicates
+aside), shards are disjoint, and all shards have equal length. The
+permutation itself comes from JAX's threefry PRNG rather than torch's
+Mersenne generator — the *semantics* are the contract, not torch's
+bitstream.
+
+Unlike the reference this is a pure function of (epoch, shard) — no
+mutable ``set_epoch`` state — so it can run inside jit and on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSampler:
+    """Index plan for one shard of a dataset across an epoch."""
+
+    num_examples: int
+    num_shards: int
+    shard_id: int
+    shuffle: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        if not 0 <= self.shard_id < self.num_shards:
+            raise ValueError(f"shard_id {self.shard_id} not in [0,{self.num_shards})")
+
+    @property
+    def total_size(self) -> int:
+        """Dataset size padded up to a multiple of num_shards."""
+        per = -(-self.num_examples // self.num_shards)  # ceil div
+        return per * self.num_shards
+
+    @property
+    def shard_size(self) -> int:
+        return self.total_size // self.num_shards
+
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        """Global index order for ``epoch`` (before shard slicing)."""
+        if self.shuffle:
+            key = jax.random.key(self.seed + epoch)
+            perm = np.asarray(
+                jax.random.permutation(key, self.num_examples, independent=False)
+            )
+        else:
+            perm = np.arange(self.num_examples)
+        pad = self.total_size - self.num_examples
+        if pad:
+            perm = np.concatenate([perm, perm[:pad]])
+        return perm
+
+    def shard_indices(self, epoch: int) -> np.ndarray:
+        """This shard's sample indices for ``epoch`` (strided slice)."""
+        return self.epoch_indices(epoch)[self.shard_id :: self.num_shards]
+
+    def num_batches(self, batch_size: int, drop_last: bool = True) -> int:
+        if drop_last:
+            return self.shard_size // batch_size
+        return -(-self.shard_size // batch_size)

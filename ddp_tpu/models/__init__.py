@@ -1,0 +1,37 @@
+"""Model zoo.
+
+The reference ships one model (SimpleCNN, ``model.py``); the driver's
+extension configs (BASELINE.json) add ResNet-18/CIFAR-10, ViT-Tiny/
+CIFAR-100 (bf16 attention path) and ResNet-50/ImageNet. All are defined
+here in Flax with NHWC layout and registered by name so the CLI can
+select them.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ddp_tpu.models.cnn import SimpleCNN
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(ctor):
+        _REGISTRY[name] = ctor
+        return ctor
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def available() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+register("simple_cnn")(SimpleCNN)

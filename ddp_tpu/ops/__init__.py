@@ -1,0 +1,6 @@
+"""Custom TPU ops (Pallas kernels).
+
+The reference's kernel layer is ATen C++ (SURVEY.md §2b N5); on TPU the
+XLA compiler covers it, and this package holds Pallas kernels for ops
+where hand-tiling beats XLA's schedule.
+"""

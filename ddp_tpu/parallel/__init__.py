@@ -1,0 +1,18 @@
+"""Parallelism layer: DDP today; TP/FSDP/sequence axes by design.
+
+The reference implements exactly one strategy — synchronous data
+parallelism (SURVEY.md §2c). This package provides it as a compiled
+SPMD step (``ddp.py``) over a mesh whose extra axes (``model``,
+``fsdp``, ``seq``, ``pipe`` — see runtime.mesh) keep tensor, sharded-
+optimizer, sequence/ring-attention, and pipeline parallelism reachable
+without restructuring the trainer.
+"""
+
+from ddp_tpu.parallel.ddp import (  # noqa: F401
+    TrainState,
+    StepMetrics,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+    replicate_state,
+)

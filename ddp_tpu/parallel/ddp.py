@@ -1,0 +1,177 @@
+"""Data-parallel train step: the reference's DDP capability, compiled.
+
+What torch smears across four runtime systems — the DDP wrapper's
+constructor broadcast (train_ddp.py:34), the C++ reducer's bucketed
+all-reduce firing inside ``loss.backward()`` (train_ddp.py:199,
+SURVEY.md §2b N4), the autograd engine, and the optimizer step
+(train_ddp.py:200) — is here ONE jitted SPMD function:
+
+    forward → xent loss → grad → ``lax.pmean(grads, data_axes)`` → SGD
+
+expressed with ``jax.shard_map`` over the mesh so the gradient
+all-reduce is an explicit, visible collective that XLA lowers onto ICI
+and overlaps with backward compute (the reducer's job, done by the
+compiler). Params live replicated on device across steps; the batch
+arrives sharded on the ``data``/``fsdp`` axes.
+
+Division semantics match DDP: gradients are *averaged* over the world
+(pmean = psum ÷ world_size), so loss scale is independent of device
+count.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.runtime.mesh import data_axes
+
+
+class TrainState(NamedTuple):
+    """Replicated training state (params + optimizer + step counter).
+
+    The analogue of the reference's (model.state_dict(), opt.state_dict())
+    pair that its checkpoints carry (train_ddp.py:205-209).
+    """
+
+    step: jax.Array  # int32 scalar
+    params: Any  # pytree
+    opt_state: Any  # optax state pytree
+
+
+class StepMetrics(NamedTuple):
+    loss: jax.Array
+    accuracy: jax.Array
+
+
+def create_train_state(
+    model, optimizer: optax.GradientTransformation, sample_input, *, seed: int = 0
+) -> TrainState:
+    """Initialize params identically on every process.
+
+    The same PRNG key everywhere replaces DDP's rank-0 parameter
+    broadcast at wrap time (train_ddp.py:34): replicas are identical by
+    construction, no collective needed.
+    """
+    params = model.init(jax.random.key(seed), sample_input)["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )
+
+
+def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
+    """ToTensor parity (data.py:13): uint8 → float / 255, nothing else.
+
+    Runs on-device inside the step so the pipeline ships uint8.
+    """
+    if images.dtype == jnp.uint8:
+        images = images.astype(compute_dtype) / jnp.asarray(255.0, compute_dtype)
+    return images.astype(compute_dtype)
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
+    """Build the compiled DDP train step for ``mesh``.
+
+    Returns ``step(state, images, labels) -> (state, metrics)`` where
+    ``images``/``labels`` are sharded over the data axes and ``state``
+    is replicated. ``compute_dtype=jnp.bfloat16`` gives mixed precision:
+    bf16 activations/grads on the MXU, fp32 master params and update.
+    """
+    axes = data_axes(mesh)
+    batch_spec = P(axes)
+
+    def per_shard_step(state: TrainState, images, labels):
+        def loss_fn(params):
+            x = _preprocess(images, compute_dtype)
+            if compute_dtype != jnp.float32:
+                params_c = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+            else:
+                params_c = params
+            logits = model.apply({"params": params_c}, x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        # THE all-reduce: the entire job of DDP's C++ reducer
+        # (SURVEY.md §2b N4) is this one line. pmean = psum / world.
+        grads = lax.pmean(grads, axes)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        correct = (jnp.argmax(logits, -1) == labels).sum()
+        metrics = StepMetrics(
+            loss=lax.pmean(loss, axes),
+            accuracy=lax.psum(correct, axes) / (labels.shape[0] * _world(mesh, axes)),
+        )
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    sharded = jax.shard_map(
+        per_shard_step,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(
+    model, mesh: Mesh, *, compute_dtype=jnp.float32
+) -> Callable[..., tuple[jax.Array, jax.Array]]:
+    """Compiled eval step → (weighted correct count, weighted loss sum).
+
+    ``weights`` (0/1 per example) mask the wraparound padding that fills
+    the final partial batch, so totals are exact over any split size.
+    The reference has no eval loop at all (SURVEY.md §5 metrics); this
+    closes that gap so the 99%-accuracy north star is measurable.
+    """
+    axes = data_axes(mesh)
+    batch_spec = P(axes)
+
+    def per_shard(params, images, labels, weights):
+        x = _preprocess(images, compute_dtype)
+        logits = model.apply({"params": params}, x).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
+        return lax.psum(correct, axes), lax.psum((loss * weights).sum(), axes)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), batch_spec, batch_spec, batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Place state on the mesh, replicated — explicit device residency."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
+def _world(mesh: Mesh, axes) -> int:
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n

@@ -1,0 +1,12 @@
+"""Runtime layer: distributed context, backend selection, device mesh.
+
+TPU-native replacement for the reference's process-group runtime
+(``utils.py:5-19`` — ``setup``/``cleanup`` over c10d) and its implicit
+launcher (``train_ddp.py:222-224`` ``torch.multiprocessing.spawn``):
+JAX runs one process per *host* (each process owns all local chips),
+rendezvous is ``jax.distributed.initialize`` instead of env:// TCP, and
+collectives are compiled into the program instead of called at runtime.
+"""
+
+from ddp_tpu.runtime.dist import DistContext, setup, cleanup  # noqa: F401
+from ddp_tpu.runtime.mesh import make_mesh, MeshSpec  # noqa: F401

@@ -1,0 +1,108 @@
+"""Device-mesh construction — the scale-out geometry of the framework.
+
+The reference's only geometry is a flat rank list (world_size processes,
+c10d communicator over all of them). The TPU-native shape is an N-D
+``jax.sharding.Mesh`` whose axes name the parallelism strategies; XLA
+lowers collectives onto ICI (intra-slice) / DCN (inter-slice) from axis
+placement alone.
+
+Axis vocabulary (fixed across the framework):
+
+- ``data``  — data parallelism: batch sharded, params replicated,
+              gradient all-reduce (the reference's entire capability,
+              SURVEY.md §2c).
+- ``fsdp``  — parameter/optimizer sharding (ZeRO-style) on top of data
+              parallelism.
+- ``model`` — tensor parallelism within layers.
+- ``seq``   — sequence/context parallelism (ring attention).
+- ``pipe``  — pipeline stages.
+
+A 1-D ``('data',)`` mesh over all chips reproduces DDP exactly; the
+other axes exist so the same train step scales without restructuring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Mapping, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; -1 on at most one axis means "all the rest".
+
+    ``MeshSpec()`` (all defaults) is pure DDP: every device on ``data``.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    pipe: int = 1
+
+    def resolve(self, num_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        unknown = [a for a, s in sizes.items() if s == -1]
+        if len(unknown) > 1:
+            raise ValueError(f"at most one -1 axis, got {unknown}")
+        known = math.prod(s for s in sizes.values() if s != -1)
+        if unknown:
+            if num_devices % known:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[unknown[0]] = num_devices // known
+        elif known != num_devices:
+            raise ValueError(f"mesh {sizes} needs {known} devices, have {num_devices}")
+        return sizes
+
+
+def make_mesh(
+    spec: MeshSpec | Mapping[str, int] | None = None,
+    *,
+    devices: Sequence | None = None,
+):
+    """Build a ``jax.sharding.Mesh`` from a logical spec.
+
+    Uses ``mesh_utils.create_device_mesh`` when possible so axis order
+    maps onto the physical ICI torus (innermost axes get the
+    fastest-varying/nearest chips); falls back to a plain reshape for
+    emulated CPU devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if spec is None:
+        spec = MeshSpec()
+    elif isinstance(spec, Mapping):
+        spec = MeshSpec(**dict(spec))
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+
+    if devices[0].platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            mesh_devices = mesh_utils.create_device_mesh(shape, devices=devices)
+            return Mesh(mesh_devices, AXIS_ORDER)
+        except Exception:  # non-standard topology: fall through to reshape
+            pass
+    return Mesh(np.asarray(devices).reshape(shape), AXIS_ORDER)
+
+
+def data_axes(mesh) -> tuple[str, ...]:
+    """Axes over which the batch is sharded and grads are averaged.
+
+    ``fsdp`` participates in batch sharding (each fsdp group sees
+    different data) — so DDP gradient reduction runs over both. Only
+    axes the mesh actually has are returned, so hand-built meshes
+    (e.g. ``Mesh(devices, ('data',))``) work too.
+    """
+    return tuple(a for a in ("data", "fsdp") if a in mesh.shape)

@@ -1,0 +1,5 @@
+"""Training orchestration layer (the reference's L4)."""
+
+from ddp_tpu.train.config import TrainConfig  # noqa: F401
+from ddp_tpu.train.trainer import Trainer  # noqa: F401
+from ddp_tpu.train.checkpoint import CheckpointManager  # noqa: F401

@@ -1,0 +1,115 @@
+"""Checkpoint save / discovery / resume — the reference's biggest subsystem.
+
+Contract parity with train_ddp.py (≈136 of its 227 trainer lines,
+SURVEY.md §5):
+
+- save once per epoch into ``./checkpoints`` with the epoch number in
+  the path (train_ddp.py:204-209);
+- on startup, discover the latest checkpoint and resume from
+  ``epoch + 1`` (train_ddp.py:49-89), from-scratch when none exists;
+- restore must leave *every* process with identical state — the
+  reference hand-rolls a 130-line byte-level broadcast protocol for
+  this (train_ddp.py:100-186); Orbax restore is collective by design,
+  so the protocol collapses into one call.
+
+Deliberate divergences from the reference's literal behavior (its
+*intent* per README.md:47, with its verified defects fixed —
+SURVEY.md §2a #8):
+
+- optimizer state IS restored (the reference reads ``ckpt["optimizer"]``
+  at train_ddp.py:88 and silently drops it);
+- "latest" means highest epoch number, not newest st_ctime
+  (train_ddp.py:57) — ctime ordering breaks under copy/restore of the
+  checkpoint dir;
+- saves are atomic (Orbax commit-dir protocol), so a crash mid-save
+  can't leave a corrupt "latest" for discovery to trip on;
+- the broadcast-resume protocol's four bugs (missing src, stale local
+  num_keys, undefined model_state off rank 0, dropped optimizer state)
+  have no analogue here by construction.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ddp_tpu.parallel.ddp import TrainState
+
+logger = logging.getLogger("ddp_tpu")
+
+
+class CheckpointManager:
+    """Per-epoch checkpoints with latest-epoch auto-resume."""
+
+    def __init__(
+        self,
+        directory: str = "./checkpoints",
+        *,
+        max_to_keep: int | None = None,
+        async_save: bool = True,
+    ):
+        self._dir = os.path.abspath(directory)
+        opts = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            create=True,
+            enable_async_checkpointing=async_save,
+            step_prefix="epoch",
+        )
+        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    def latest_epoch(self) -> int | None:
+        """Discovery: the reference's "latest file in ./checkpoints"."""
+        return self._mgr.latest_step()
+
+    def save(self, epoch: int, state: TrainState) -> None:
+        """Save ``{params, opt_state, step}`` for ``epoch``.
+
+        Collective: every process calls it; Orbax elects writers — the
+        multi-host-safe version of the reference's ``if rank == 0:
+        torch.save(...)`` (train_ddp.py:204).
+        """
+        self._mgr.save(epoch, args=ocp.args.StandardSave(state._asdict()))
+
+    def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:
+        """Restore → (state, epoch). ``state_like`` supplies the tree
+        structure/shardings (its values are discarded)."""
+        if epoch is None:
+            epoch = self.latest_epoch()
+            if epoch is None:
+                raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
+        restored = self._mgr.restore(epoch, args=ocp.args.StandardRestore(abstract))
+        return TrainState(**restored), epoch
+
+    def restore_or_init(
+        self, state: TrainState
+    ) -> tuple[TrainState, int]:
+        """The auto-resume entry: (state, start_epoch).
+
+        Mirrors train_ddp.py:49-89's flag dance — resume from latest
+        epoch + 1 when a checkpoint exists, else epoch 0 fresh.
+        """
+        latest = self.latest_epoch()
+        if latest is None:
+            logger.info("No checkpoint found — starting from scratch")
+            return state, 0
+        restored, epoch = self.restore(state, latest)
+        logger.info("Resumed from checkpoint epoch %d", epoch)
+        return restored, epoch + 1
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,80 @@
+"""Run configuration — the reference's CLI surface plus its hard-codes.
+
+Parity: ``--epochs`` (default 10) and ``--batch_size`` (default 32,
+per data shard) match train_ddp.py:216-218 exactly. Everything the
+reference hard-codes becomes a named field with the reference value as
+default: lr=0.01 (train_ddp.py:41), checkpoint dir ``./checkpoints``
+(train_ddp.py:53), data root ``./data`` (data.py:11), log interval 100
+(train_ddp.py:201). The ``--world_size`` flag README.md:72 advertises
+but never implements exists here as ``--num_devices`` (how many devices
+to use; -1 = all).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # Reference CLI (train_ddp.py:216-218)
+    epochs: int = 10
+    batch_size: int = 32  # per data-parallel shard, like per-rank bs=32
+
+    # Reference hard-codes, surfaced
+    lr: float = 0.01  # train_ddp.py:41
+    momentum: float = 0.0  # SGD(lr=0.01) → momentum 0
+    checkpoint_dir: str = "./checkpoints"  # train_ddp.py:53
+    data_root: str = "./data"  # data.py:11
+    log_interval: int = 100  # train_ddp.py:201
+    seed: int = 0
+    shuffle: bool = True  # data.py:18
+
+    # Framework knobs (no reference analogue)
+    model: str = "simple_cnn"
+    dataset: str = "mnist"
+    backend: str | None = None  # None = auto (tpu if present else cpu)
+    num_devices: int = -1  # devices on the data axis; -1 = all
+    emulate_devices: int | None = None  # N virtual CPU devices (dev box)
+    compute_dtype: str = "float32"  # "bfloat16" for mixed precision
+    eval_every: int = 1  # epochs between test-split evals (0 = only final)
+    max_checkpoints: int | None = None  # None = keep all, like the reference
+    synthetic_data: bool = False  # offline fallback dataset
+    synthetic_size: int | None = None
+    profile_dir: str | None = None  # jax.profiler trace output
+
+    @classmethod
+    def parser(cls) -> argparse.ArgumentParser:
+        p = argparse.ArgumentParser(description="TPU-native DDP trainer")
+        p.add_argument("--epochs", type=int, default=cls.epochs)
+        p.add_argument("--batch_size", type=int, default=cls.batch_size)
+        p.add_argument("--lr", type=float, default=cls.lr)
+        p.add_argument("--momentum", type=float, default=cls.momentum)
+        p.add_argument("--checkpoint_dir", default=cls.checkpoint_dir)
+        p.add_argument("--data_root", default=cls.data_root)
+        p.add_argument("--log_interval", type=int, default=cls.log_interval)
+        p.add_argument("--seed", type=int, default=cls.seed)
+        p.add_argument("--no_shuffle", action="store_true")
+        p.add_argument("--model", default=cls.model)
+        p.add_argument("--dataset", default=cls.dataset)
+        p.add_argument("--backend", default=None, choices=(None, "tpu", "cpu"))
+        p.add_argument("--num_devices", type=int, default=cls.num_devices)
+        p.add_argument("--emulate_devices", type=int, default=None)
+        p.add_argument(
+            "--compute_dtype", default=cls.compute_dtype,
+            choices=("float32", "bfloat16"),
+        )
+        p.add_argument("--eval_every", type=int, default=cls.eval_every)
+        p.add_argument("--max_checkpoints", type=int, default=None)
+        p.add_argument("--synthetic_data", action="store_true")
+        p.add_argument("--synthetic_size", type=int, default=None)
+        p.add_argument("--profile_dir", default=None)
+        return p
+
+    @classmethod
+    def from_args(cls, argv=None) -> "TrainConfig":
+        ns = cls.parser().parse_args(argv)
+        kwargs = vars(ns)
+        kwargs["shuffle"] = not kwargs.pop("no_shuffle")
+        return cls(**kwargs)

@@ -1,0 +1,245 @@
+"""Training orchestration — the ``ddp_train`` body (train_ddp.py:17-212).
+
+Same observable flow as the reference's only framework function:
+setup → model → data → optimizer → auto-resume → epoch/batch loop with
+process-0 loss logging every ``log_interval`` batches → per-epoch
+checkpoint → cleanup. Plus what the reference lacks but its north star
+requires: a test-split eval loop (accuracy) and step/throughput metrics.
+
+Architectural difference, on purpose: the reference's hot loop crosses
+Python→C++ per op and syncs on a collective each backward; here the
+whole step (forward, backward, all-reduce, update) is one compiled XLA
+program, and the Python loop just feeds it batches and reads metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddp_tpu.data.loader import ShardedLoader
+from ddp_tpu.data.registry import load_dataset
+from ddp_tpu.models import get_model
+from ddp_tpu.parallel.ddp import (
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+    replicate_state,
+)
+from ddp_tpu.runtime import dist
+from ddp_tpu.runtime.mesh import MeshSpec, data_axes, make_mesh
+from ddp_tpu.train.checkpoint import CheckpointManager
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.utils.logging import setup_logging
+
+logger = logging.getLogger("ddp_tpu")
+
+
+@dataclasses.dataclass
+class EpochStats:
+    epoch: int
+    mean_loss: float
+    seconds: float
+    images_per_sec: float
+
+
+class Trainer:
+    def __init__(self, config: TrainConfig, ctx: dist.DistContext | None = None):
+        self.config = config
+        self.ctx = ctx or dist.setup(
+            backend=config.backend, emulate_devices=config.emulate_devices
+        )
+        setup_logging(self.ctx.process_id)
+
+        devices = jax.devices()
+        if config.num_devices > 0:
+            devices = devices[: config.num_devices]
+        self.mesh = make_mesh(MeshSpec(data=len(devices)), devices=devices)
+        self.data_shards = int(
+            np.prod([self.mesh.shape[a] for a in data_axes(self.mesh)])
+        )
+        self.global_batch_size = config.batch_size * self.data_shards
+
+        self.model = get_model(config.model)
+        self.optimizer = optax.sgd(config.lr, momentum=config.momentum or None)
+
+        train_split, test_split = load_dataset(
+            config.dataset,
+            config.data_root,
+            allow_synthetic=config.synthetic_data,
+            synthetic_size=config.synthetic_size,
+        )
+        self.train_split, self.test_split = train_split, test_split
+        self.loader = ShardedLoader(
+            train_split.images,
+            train_split.labels,
+            self.mesh,
+            self.global_batch_size,
+            shuffle=config.shuffle,
+            seed=config.seed,
+        )
+
+        compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+        self.train_step = make_train_step(
+            self.model, self.optimizer, self.mesh, compute_dtype=compute_dtype
+        )
+        self.eval_step = make_eval_step(
+            self.model, self.mesh, compute_dtype=compute_dtype
+        )
+
+        sample = jnp.zeros(
+            (1, *train_split.images.shape[1:]), jnp.float32
+        )
+        state = create_train_state(
+            self.model, self.optimizer, sample, seed=config.seed
+        )
+        self.state = replicate_state(state, self.mesh)
+        self.ckpt = CheckpointManager(
+            config.checkpoint_dir, max_to_keep=config.max_checkpoints
+        )
+        self.history: list[EpochStats] = []
+
+    # ---- the reference's epoch/batch loop (train_ddp.py:192-209) ----
+
+    def train(self) -> dict[str, Any]:
+        cfg = self.config
+        self.state, start_epoch = self.ckpt.restore_or_init(self.state)
+        if start_epoch >= cfg.epochs:
+            logger.info(
+                "Checkpoint epoch %d ≥ requested epochs %d — nothing to do",
+                start_epoch - 1,
+                cfg.epochs,
+            )
+        profiling = False
+        if cfg.profile_dir and self.ctx.is_main:
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling = True
+        last_eval: tuple[float, float] | None = None
+        try:
+            for epoch in range(start_epoch, cfg.epochs):
+                stats = self._train_epoch(epoch)
+                self.history.append(stats)
+                self.ckpt.save(epoch, self.state)
+                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                    last_eval = self.evaluate()
+                    logger.info(
+                        "Epoch %d eval: accuracy %.4f loss %.4f",
+                        epoch,
+                        *last_eval,
+                    )
+                else:
+                    last_eval = None
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+            self.ckpt.wait()
+        # reuse the last per-epoch eval rather than re-running it
+        final_acc, final_loss = last_eval or self.evaluate()
+        logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
+        return {
+            "epochs_run": len(self.history),
+            "final_accuracy": final_acc,
+            "final_loss": final_loss,
+            "history": [dataclasses.asdict(h) for h in self.history],
+        }
+
+    # How far the host may run ahead of the devices. Unbounded async
+    # dispatch deadlocks the emulated-CPU collective rendezvous when the
+    # cores are oversubscribed, and on real chips just buffers garbage;
+    # a small window keeps dispatch overlapped with compute.
+    MAX_INFLIGHT_STEPS = 8
+
+    def _train_epoch(self, epoch: int) -> EpochStats:
+        cfg = self.config
+        logger.info("Starting epoch %d", epoch)  # train_ddp.py:194 parity
+        t0 = time.perf_counter()
+        losses = []
+        last_metrics = None
+        n_batches = 0
+        inflight: deque = deque()
+        for batch_idx, batch in enumerate(self.loader.epoch(epoch)):
+            self.state, metrics = self.train_step(
+                self.state, batch.images, batch.labels
+            )
+            last_metrics = metrics
+            n_batches += 1
+            inflight.append(metrics.loss)
+            if len(inflight) > self.MAX_INFLIGHT_STEPS:
+                jax.block_until_ready(inflight.popleft())
+            if batch_idx % cfg.log_interval == 0:
+                # train_ddp.py:201-202 parity: rank-0 loss print. .item()
+                # syncs, so only at the log cadence.
+                loss = float(metrics.loss)
+                losses.append(loss)
+                logger.info(
+                    "Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss
+                )
+        if last_metrics is not None:
+            jax.block_until_ready(last_metrics.loss)
+        seconds = time.perf_counter() - t0
+        images = n_batches * self.global_batch_size
+        stats = EpochStats(
+            epoch=epoch,
+            mean_loss=float(np.mean(losses)) if losses else float("nan"),
+            seconds=seconds,
+            images_per_sec=images / seconds if seconds else 0.0,
+        )
+        logger.info(
+            "Epoch %d done: %d batches in %.2fs (%.0f images/sec global)",
+            epoch,
+            n_batches,
+            seconds,
+            stats.images_per_sec,
+        )
+        return stats
+
+    # ---- eval (absent in the reference; required by the north star) ----
+
+    def evaluate(self) -> tuple[float, float]:
+        """Full test-split accuracy/loss, batched over the mesh.
+
+        The split is padded with wraparound to a global-batch multiple;
+        padding carries weight 0 so the totals are exact. In multi-host
+        runs each process feeds its contiguous slice of the padded
+        split.
+        """
+        images, labels = self.test_split
+        bs = self.global_batch_size
+        n = len(images)
+        if n == 0:
+            return float("nan"), float("nan")
+        padded = -(-n // bs) * bs
+        weights = np.ones(padded, np.float32)
+        weights[n:] = 0.0
+        idx = np.arange(padded) % n
+        procs, pid = jax.process_count(), jax.process_index()
+        local = bs // procs
+        correct_total, loss_total = 0.0, 0.0
+        for b in range(padded // bs):
+            lo = b * bs + pid * local
+            sel = idx[lo : lo + local]
+            img_np, lbl_np, w_np = images[sel], labels[sel], weights[lo : lo + local]
+            if procs == 1:
+                put = lambda a, s: jax.device_put(a, s)
+            else:
+                put = lambda a, s: jax.make_array_from_process_local_data(s, a)
+            c, l = self.eval_step(
+                self.state.params,
+                put(img_np, self.loader._img_sharding),
+                put(lbl_np, self.loader._lbl_sharding),
+                put(w_np, self.loader._lbl_sharding),
+            )
+            correct_total += float(c)
+            loss_total += float(l)
+        return correct_total / n, loss_total / n
+
+    def close(self) -> None:
+        self.ckpt.close()

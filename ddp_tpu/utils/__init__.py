@@ -1,0 +1,3 @@
+"""Cross-cutting utilities: logging, timing, profiling."""
+
+from ddp_tpu.utils.logging import setup_logging  # noqa: F401

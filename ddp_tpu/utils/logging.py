@@ -1,0 +1,34 @@
+"""Process-aware logging — the reference's rank-gated prints, structured.
+
+train_ddp.py logs through bare ``print`` guarded by ``if rank == 0``
+(train_ddp.py:201-202 and lifecycle prints). Here every process gets a
+logger tagged with its process id; by default only process 0 logs at
+INFO (others at WARNING), preserving the observable single-stream
+output while keeping per-process debugging one env var away
+(DDP_TPU_LOG_ALL=1).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def setup_logging(process_id: int = 0, *, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger("ddp_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(
+            logging.Formatter(
+                f"[p{process_id}] %(asctime)s %(levelname)s %(message)s",
+                datefmt="%H:%M:%S",
+            )
+        )
+        logger.addHandler(h)
+    if process_id == 0 or os.environ.get("DDP_TPU_LOG_ALL"):
+        logger.setLevel(level)
+    else:
+        logger.setLevel(logging.WARNING)
+    logger.propagate = False
+    return logger

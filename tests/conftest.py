@@ -1,0 +1,48 @@
+"""Test harness: 8 emulated CPU devices — the TPU analogue of the
+reference's "2-process gloo on a laptop" test strategy (SURVEY.md §4).
+
+Real ``psum``/sharding semantics are exercised in-process over 8
+virtual devices. Must configure the platform before any JAX backend
+initializes; the axon/TPU plugin pins ``jax_platforms`` at import, so
+we both set the env var and force the config.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu" and len(devs) == 8, devs
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=8), devices=devices)
+
+
+@pytest.fixture(scope="session")
+def mnist_synthetic():
+    from ddp_tpu.data import mnist
+
+    return mnist.synthetic(4096, seed=0), mnist.synthetic(1024, seed=1)
+
+
+@pytest.fixture()
+def tmp_ckpt_dir(tmp_path):
+    return str(tmp_path / "checkpoints")

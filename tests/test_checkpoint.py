@@ -1,0 +1,102 @@
+"""Checkpoint save / latest-epoch discovery / resume round-trip.
+
+The reference's contract (SURVEY.md §3.4-3.5): per-epoch save of
+{params, optimizer, epoch}; on restart, discover latest and resume at
+epoch+1; optimizer state must actually round-trip (fixing the
+reference's silent drop at train_ddp.py:88).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models import SimpleCNN
+from ddp_tpu.parallel.ddp import create_train_state, replicate_state
+from ddp_tpu.train.checkpoint import CheckpointManager
+
+
+@pytest.fixture()
+def state_and_tx(mesh8):
+    model = SimpleCNN()
+    tx = optax.sgd(0.01, momentum=0.9)  # momentum ⇒ non-empty opt state
+    state = create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0)
+    return replicate_state(state, mesh8), tx
+
+
+def perturb(state, val):
+    return state._replace(
+        params=jax.tree.map(lambda p: p + val, state.params),
+        step=state.step + 1,
+    )
+
+
+class TestRoundTrip:
+    def test_save_restore_identical(self, state_and_tx, tmp_ckpt_dir):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        restored, epoch = mgr.restore(state)
+        assert epoch == 0
+        for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mgr.close()
+
+    def test_optimizer_state_roundtrips(self, state_and_tx, tmp_ckpt_dir):
+        state, tx = state_and_tx
+        # run one real update so momentum buffers are non-zero
+        grads = jax.tree.map(jnp.ones_like, state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        state = state._replace(opt_state=opt_state)
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(3, state)
+        restored, _ = mgr.restore(state)
+        trace = jax.tree.leaves(restored.opt_state)
+        assert any(np.abs(np.asarray(t)).sum() > 0 for t in trace)
+        mgr.close()
+
+
+class TestDiscovery:
+    def test_latest_is_highest_epoch(self, state_and_tx, tmp_ckpt_dir):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        for e in (0, 1, 2):
+            mgr.save(e, perturb(state, float(e)))
+        assert mgr.latest_epoch() == 2
+        mgr.close()
+
+    def test_restore_or_init_fresh(self, state_and_tx, tmp_ckpt_dir):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        restored, start = mgr.restore_or_init(state)
+        assert start == 0
+        assert restored is state
+        mgr.close()
+
+    def test_restore_or_init_resumes_at_plus_one(
+        self, state_and_tx, tmp_ckpt_dir
+    ):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(4, perturb(state, 1.0))
+        mgr.close()
+        # fresh manager = fresh process restart (train_ddp.py:49-89 flow)
+        mgr2 = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        restored, start = mgr2.restore_or_init(state)
+        assert start == 5
+        first = jax.tree.leaves(restored.params)[0]
+        orig = jax.tree.leaves(state.params)[0]
+        np.testing.assert_allclose(
+            np.asarray(first), np.asarray(orig) + 1.0, rtol=1e-6
+        )
+        mgr2.close()
+
+    def test_missing_dir_raises_on_explicit_restore(
+        self, state_and_tx, tmp_ckpt_dir
+    ):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(state)
+        mgr.close()

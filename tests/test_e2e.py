@@ -1,0 +1,78 @@
+"""End-to-end: the reference quickstart flow (README.md:59-74) on an
+8-device mesh — train, checkpoint per epoch, auto-resume on re-run."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def make_config(tmp_path, **kw):
+    defaults = dict(
+        epochs=2,
+        batch_size=8,  # ×8 devices = global 64, the quickstart batch
+        checkpoint_dir=str(tmp_path / "checkpoints"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=2048,
+        log_interval=16,
+        eval_every=0,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+class TestEndToEnd:
+    def test_train_checkpoints_and_resumes(self, tmp_path):
+        cfg = make_config(tmp_path)
+        t = Trainer(cfg)
+        summary = t.train()
+        t.close()
+
+        assert summary["epochs_run"] == 2
+        # loss went down across the run
+        hist = summary["history"]
+        assert hist[-1]["mean_loss"] < hist[0]["mean_loss"]
+        # per-epoch checkpoints on disk (train_ddp.py:204-209 contract)
+        ckpts = os.listdir(cfg.checkpoint_dir)
+        assert any("0" in c for c in ckpts) and any("1" in c for c in ckpts)
+        # synthetic data is separable; 2 epochs beats chance comfortably
+        assert summary["final_accuracy"] > 0.5
+
+        # Re-run with more epochs: resumes at epoch 2, runs only 2-3
+        # (the README.md:74 "restart and it picks up" behavior).
+        cfg2 = make_config(tmp_path, epochs=4)
+        t2 = Trainer(cfg2)
+        summary2 = t2.train()
+        t2.close()
+        assert summary2["epochs_run"] == 2
+        assert summary2["history"][0]["epoch"] == 2
+
+    def test_rerun_at_same_epochs_trains_nothing(self, tmp_path):
+        cfg = make_config(tmp_path, epochs=1)
+        t = Trainer(cfg)
+        t.train()
+        t.close()
+        t2 = Trainer(make_config(tmp_path, epochs=1))
+        summary = t2.train()
+        t2.close()
+        assert summary["epochs_run"] == 0
+
+    def test_deterministic_restart_data_order(self, tmp_path):
+        """Epoch data order is a function of (seed, epoch) only, so a
+        resumed run sees the same epoch-1 order a straight-through run
+        would — stronger than the reference, whose sampler reshuffle is
+        deterministic but whose resume path never worked."""
+        cfg = make_config(tmp_path)
+        t = Trainer(cfg)
+        batches_a = [
+            np.asarray(b.labels) for b in t.loader.epoch(1)
+        ]
+        t.close()
+        t2 = Trainer(make_config(tmp_path))
+        batches_b = [np.asarray(b.labels) for b in t2.loader.epoch(1)]
+        t2.close()
+        assert all(np.array_equal(a, b) for a, b in zip(batches_a, batches_b))

@@ -1,0 +1,80 @@
+"""IDX reader and synthetic-fallback tests (torch-free MNIST ingestion,
+replacing torchvision — SURVEY.md §2b N8)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from ddp_tpu.data import mnist
+
+
+def idx_bytes(arr: np.ndarray) -> bytes:
+    codes = {np.dtype(np.uint8): 0x08}
+    header = struct.pack(
+        f">BBBB{arr.ndim}I", 0, 0, codes[arr.dtype], arr.ndim, *arr.shape
+    )
+    return header + arr.tobytes()
+
+
+class TestParseIdx:
+    def test_roundtrip_images(self):
+        arr = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        out = mnist.parse_idx(idx_bytes(arr))
+        assert np.array_equal(out, arr)
+
+    def test_roundtrip_labels(self):
+        arr = np.array([3, 1, 4], dtype=np.uint8)
+        assert np.array_equal(mnist.parse_idx(idx_bytes(arr)), arr)
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            mnist.parse_idx(b"\x01\x00\x08\x01" + b"\x00" * 8)
+
+    def test_truncated_payload(self):
+        arr = np.zeros((4, 4), dtype=np.uint8)
+        with pytest.raises(ValueError):
+            mnist.parse_idx(idx_bytes(arr)[:-3])
+
+
+class TestLocalCache:
+    def test_load_from_cached_gz(self, tmp_path):
+        """A cached copy is used without network, like torchvision."""
+        imgs = np.random.default_rng(0).integers(0, 255, (10, 28, 28), np.uint8)
+        lbls = np.arange(10, dtype=np.uint8)
+        names = {
+            "train-images-idx3-ubyte.gz": idx_bytes(imgs),
+            "train-labels-idx1-ubyte.gz": idx_bytes(lbls),
+        }
+        for name, payload in names.items():
+            (tmp_path / name).write_bytes(gzip.compress(payload))
+        split = mnist.load(str(tmp_path), "train")
+        assert split.images.shape == (10, 28, 28, 1)
+        assert split.images.dtype == np.uint8
+        assert np.array_equal(split.labels, np.arange(10))
+        assert split.labels.dtype == np.int32
+
+
+class TestSynthetic:
+    def test_shapes_match_mnist(self):
+        s = mnist.synthetic(100)
+        assert s.images.shape == (100, 28, 28, 1) and s.images.dtype == np.uint8
+        assert s.labels.shape == (100,) and s.labels.dtype == np.int32
+        assert s.labels.min() >= 0 and s.labels.max() <= 9
+
+    def test_deterministic(self):
+        a, b = mnist.synthetic(50, seed=3), mnist.synthetic(50, seed=3)
+        assert np.array_equal(a.images, b.images)
+        assert np.array_equal(a.labels, b.labels)
+
+    def test_fallback_gated(self, tmp_path):
+        # network will fail in this env; without the flag, load raises
+        with pytest.raises((RuntimeError, OSError)):
+            mnist.load(str(tmp_path / "nope"), "train")
+        s = mnist.load(
+            str(tmp_path / "nope"), "train",
+            allow_synthetic=True, synthetic_size=64,
+        )
+        assert len(s.images) == 64

@@ -1,0 +1,54 @@
+"""SimpleCNN parity: architecture, parameter count, shapes.
+
+The reference model (model.py:4-20) has exactly 520,586 parameters
+(SURVEY.md §2a #5, verified by instantiation there); the Flax
+re-expression must match.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_tpu.models import SimpleCNN, available, get_model
+
+
+def test_param_count_matches_reference():
+    model = SimpleCNN()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert n == 520_586
+
+
+def test_layer_shapes():
+    model = SimpleCNN()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    assert params["conv1"]["kernel"].shape == (3, 3, 1, 32)
+    assert params["conv2"]["kernel"].shape == (3, 3, 32, 64)
+    assert params["fc"]["kernel"].shape == (64 * 28 * 28, 10)
+
+
+def test_forward_shape_and_dtype():
+    model = SimpleCNN()
+    x = jnp.zeros((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    logits = model.apply({"params": params}, x)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_registry():
+    assert "simple_cnn" in available()
+    assert isinstance(get_model("simple_cnn"), SimpleCNN)
+
+
+def test_init_is_deterministic():
+    # Same seed on every process ⇒ identical replicas with no broadcast
+    # (replaces DDP's ctor broadcast, train_ddp.py:34).
+    m = SimpleCNN()
+    x = jnp.zeros((1, 28, 28, 1))
+    p1 = m.init(jax.random.key(7), x)["params"]
+    p2 = m.init(jax.random.key(7), x)["params"]
+    assert all(
+        np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2))
+    )

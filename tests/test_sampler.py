@@ -1,0 +1,131 @@
+"""ShardSampler parity with torch's DistributedSampler semantics.
+
+The reference relies on DistributedSampler(shuffle=True) + set_epoch
+(data.py:16-19, train_ddp.py:193). Structural semantics are checked
+directly, and — since torch (CPU) is available in the test env — a
+property-level comparison against the real DistributedSampler pins the
+contract: equal shard sizes, padding by wraparound, disjoint-union
+coverage, per-epoch reshuffle, epoch determinism.
+"""
+
+import numpy as np
+import pytest
+
+from ddp_tpu.data.sampler import ShardSampler
+
+
+def make(n=100, shards=4, sid=0, **kw):
+    return ShardSampler(num_examples=n, num_shards=shards, shard_id=sid, **kw)
+
+
+class TestShardSizes:
+    def test_even_split(self):
+        s = make(100, 4)
+        assert s.total_size == 100 and s.shard_size == 25
+
+    def test_pad_to_multiple(self):
+        # 100 / 3 → pad to 102, like torch's ceil(len/replicas)*replicas
+        s = make(100, 3)
+        assert s.total_size == 102 and s.shard_size == 34
+
+    def test_bad_shard_id(self):
+        with pytest.raises(ValueError):
+            make(10, 2, sid=2)
+
+
+class TestCoverage:
+    def test_disjoint_union_covers_dataset(self):
+        n, shards = 103, 4
+        all_idx = np.concatenate(
+            [make(n, shards, s).shard_indices(epoch=0) for s in range(shards)]
+        )
+        # every example appears; only the pad duplicates
+        assert set(all_idx.tolist()) == set(range(n))
+        assert len(all_idx) == make(n, shards).total_size
+
+    def test_shards_equal_length(self):
+        for s in range(4):
+            assert len(make(103, 4, s).shard_indices(0)) == make(103, 4).shard_size
+
+    def test_padding_wraps_from_start(self):
+        # unshuffled: torch pads indices += indices[:pad]
+        s = make(10, 4, shuffle=False)
+        idx = s.epoch_indices(0)
+        assert idx.tolist() == list(range(10)) + [0, 1]
+
+    def test_stride_slicing(self):
+        # unshuffled shard r gets indices[r::num_shards] exactly
+        for r in range(3):
+            got = make(9, 3, r, shuffle=False).shard_indices(0)
+            assert got.tolist() == list(range(9))[r::3]
+
+
+class TestEpochSemantics:
+    def test_reshuffle_per_epoch(self):
+        s = make(1000, 2)
+        assert not np.array_equal(s.shard_indices(0), s.shard_indices(1))
+
+    def test_deterministic_given_epoch(self):
+        a = make(1000, 2).shard_indices(5)
+        b = make(1000, 2).shard_indices(5)
+        assert np.array_equal(a, b)
+
+    def test_seed_changes_order(self):
+        a = make(1000, 2, seed=0).shard_indices(0)
+        b = make(1000, 2, seed=1).shard_indices(0)
+        assert not np.array_equal(a, b)
+
+    def test_no_shuffle_is_identity_order(self):
+        s = make(8, 2, shuffle=False)
+        assert s.epoch_indices(3).tolist() == list(range(8))
+
+
+class TestTorchParity:
+    """Structural parity against the real torch DistributedSampler."""
+
+    @pytest.mark.parametrize("n,shards", [(100, 4), (101, 4), (7, 2), (64, 8)])
+    def test_same_structure(self, n, shards):
+        torch = pytest.importorskip("torch")
+        from torch.utils.data import DistributedSampler
+
+        class _DS(torch.utils.data.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return i
+
+        # shuffle=False: torch's index plan is fully deterministic
+        # (range → pad-by-wrap → stride slice) and ours must match it
+        # index-for-index.
+        for r in range(shards):
+            ts = DistributedSampler(
+                _DS(), num_replicas=shards, rank=r, shuffle=False
+            )
+            tidx = list(iter(ts))
+            ours = make(n, shards, r, shuffle=False).shard_indices(0)
+            assert tidx == ours.tolist()
+
+        # shuffle=True: the permutations come from different PRNGs, so
+        # parity is structural — same shard sizes, full coverage, same
+        # number of pad duplicates.
+        for epoch in (0, 1):
+            ours_all, torch_all = [], []
+            for r in range(shards):
+                ts = DistributedSampler(
+                    _DS(), num_replicas=shards, rank=r, shuffle=True, seed=0
+                )
+                ts.set_epoch(epoch)
+                tidx = list(iter(ts))
+                ours = make(n, shards, r).shard_indices(epoch)
+                assert len(tidx) == len(ours)  # same shard size
+                torch_all += tidx
+                ours_all += ours.tolist()
+            assert set(torch_all) == set(ours_all) == set(range(n))
+            assert len(torch_all) == len(ours_all)  # same pad count
+
+    def test_num_batches_matches_reference_run(self):
+        # 60k MNIST / 2 ranks / bs 32 → 938 non-drop batches per rank
+        # (SURVEY.md §6 "Per-rank work": 938 steps @ bs=32).
+        s = ShardSampler(num_examples=60_000, num_shards=2, shard_id=0)
+        assert s.num_batches(32, drop_last=False) == 938

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""CLI entry point — parity with ``python train_ddp.py --epochs N --batch_size B``.
+
+The reference's launcher (train_ddp.py:215-224) parses two flags and
+spawns world_size=2 processes. Here there is nothing to spawn on a
+single host: one process drives every local TPU chip SPMD, and
+multi-host runs start one process per host (each calling this same
+script) with ``jax.distributed`` rendezvous — see ddp_tpu.runtime.dist.
+
+Quickstart (the reference's README.md:59-74 flow, torch-free):
+
+    python train.py --epochs 3 --batch_size 64            # real data
+    python train.py --epochs 3 --batch_size 64 \
+        --emulate_devices 2 --synthetic_data              # dev box, offline
+
+Re-running resumes from the latest checkpoint in ./checkpoints.
+"""
+
+import sys
+
+from ddp_tpu.runtime import dist
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def main(argv=None) -> int:
+    config = TrainConfig.from_args(argv)
+    trainer = Trainer(config)
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+        dist.cleanup()
+    acc = summary.get("final_accuracy")
+    if acc is not None and trainer.ctx.is_main:
+        print(f"final_accuracy={acc:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
