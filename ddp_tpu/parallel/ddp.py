@@ -77,23 +77,19 @@ def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
     return images.astype(compute_dtype)
 
 
-def make_train_step(
+def make_per_shard_step(
     model,
     optimizer: optax.GradientTransformation,
-    mesh: Mesh,
+    axes: tuple[str, ...],
+    world: int,
     *,
     compute_dtype=jnp.float32,
-    donate: bool = True,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
-    """Build the compiled DDP train step for ``mesh``.
+    """The per-device SPMD step body (runs inside shard_map).
 
-    Returns ``step(state, images, labels) -> (state, metrics)`` where
-    ``images``/``labels`` are sharded over the data axes and ``state``
-    is replicated. ``compute_dtype=jnp.bfloat16`` gives mixed precision:
-    bf16 activations/grads on the MXU, fp32 master params and update.
+    Exposed separately so the compiled-epoch runner (train.fast) can
+    ``lax.scan`` it without re-stating the DDP semantics.
     """
-    axes = data_axes(mesh)
-    batch_spec = P(axes)
 
     def per_shard_step(state: TrainState, images, labels):
         def loss_fn(params):
@@ -120,10 +116,33 @@ def make_train_step(
         correct = (jnp.argmax(logits, -1) == labels).sum()
         metrics = StepMetrics(
             loss=lax.pmean(loss, axes),
-            accuracy=lax.psum(correct, axes) / (labels.shape[0] * _world(mesh, axes)),
+            accuracy=lax.psum(correct, axes) / (labels.shape[0] * world),
         )
         return TrainState(state.step + 1, params, opt_state), metrics
 
+    return per_shard_step
+
+
+def make_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
+    """Build the compiled DDP train step for ``mesh``.
+
+    Returns ``step(state, images, labels) -> (state, metrics)`` where
+    ``images``/``labels`` are sharded over the data axes and ``state``
+    is replicated. ``compute_dtype=jnp.bfloat16`` gives mixed precision:
+    bf16 activations/grads on the MXU, fp32 master params and update.
+    """
+    axes = data_axes(mesh)
+    batch_spec = P(axes)
+    per_shard_step = make_per_shard_step(
+        model, optimizer, axes, _world(mesh, axes), compute_dtype=compute_dtype
+    )
     sharded = jax.shard_map(
         per_shard_step,
         mesh=mesh,

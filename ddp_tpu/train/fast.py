@@ -1,0 +1,123 @@
+"""Compiled-epoch fast path: the whole training epoch as ONE XLA program.
+
+The reference's hot loop (train_ddp.py:195-202) crosses Python→C++ per
+op and per batch; the host-loader path here (train.trainer) already
+compiles each *step*, but for small models the per-step dispatch from a
+single Python thread is still the ceiling. This module removes the host
+from the loop entirely, which is what the ≥50k images/sec/chip target
+requires (SURVEY.md §7 "hard parts"):
+
+- the dataset lives on device, uint8, replicated (MNIST: 47 MB — HBM
+  noise);
+- the per-epoch shuffle (DistributedSampler ``set_epoch`` semantics:
+  seed=epoch permutation, pad-to-multiple) is computed on device;
+- ``lax.scan`` drives the per-shard DDP step over all batches, each
+  device gathering its stripe of each global batch;
+- one dispatch per epoch, one device sync at the end.
+
+Semantics match the step-at-a-time path: same sampler contract (keyed
+permutation, padding by wraparound, per-device stripes), same DDP
+all-reduce, same SGD update — pinned by tests/test_fast.py comparing
+the two paths batch-for-batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.parallel.ddp import (
+    StepMetrics,
+    TrainState,
+    make_per_shard_step,
+)
+from ddp_tpu.runtime.mesh import data_axes
+
+
+def device_put_dataset(images, labels, mesh: Mesh):
+    """Stage the full dataset on device, replicated across the mesh."""
+    rep = NamedSharding(mesh, P())
+    return jax.device_put(jnp.asarray(images), rep), jax.device_put(
+        jnp.asarray(labels), rep
+    )
+
+
+def make_epoch_runner(
+    model,
+    optimizer,
+    mesh: Mesh,
+    images: jax.Array,
+    labels: jax.Array,
+    global_batch_size: int,
+    *,
+    compute_dtype=jnp.float32,
+    seed: int = 0,
+    donate: bool = True,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, StepMetrics]]:
+    """Build ``run(state, epoch) -> (state, stacked per-step metrics)``.
+
+    ``images``/``labels`` must be device-resident and replicated (see
+    ``device_put_dataset``). Batches-per-epoch is static:
+    ``num_examples // global_batch_size`` (final partial batch dropped,
+    matching ShardedLoader).
+    """
+    axes = data_axes(mesh)
+    shards = 1
+    for a in axes:
+        shards *= mesh.shape[a]
+    if global_batch_size % shards:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by {shards} shards"
+        )
+    local_bs = global_batch_size // shards
+    n = images.shape[0]
+    steps = n // global_batch_size
+    per_shard_step = make_per_shard_step(
+        model, optimizer, axes, shards, compute_dtype=compute_dtype
+    )
+
+    def per_device_epoch(state: TrainState, epoch, imgs, lbls):
+        # Same-keyed permutation on every device — identical plan, no
+        # communication. ShardSampler semantics: seed+epoch keying.
+        perm = jax.random.permutation(jax.random.key(seed + epoch), n)
+        # This device's stripe: shard s takes rows [b*G + s*local, ...)
+        # of the permuted order for batch b.
+        offset = _linear_shard_index(axes) * local_bs
+
+        def body(state, t):
+            idx = lax.dynamic_slice(perm, (t * global_batch_size + offset,), (local_bs,))
+            batch_img = jnp.take(imgs, idx, axis=0)
+            batch_lbl = jnp.take(lbls, idx, axis=0)
+            return per_shard_step(state, batch_img, batch_lbl)
+
+        return lax.scan(body, state, jnp.arange(steps))
+
+    sharded = jax.shard_map(
+        per_device_epoch,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    def run(state: TrainState, epoch) -> tuple[TrainState, StepMetrics]:
+        return jitted(state, jnp.asarray(epoch, jnp.int32))
+
+    jitted = jax.jit(
+        lambda state, epoch: sharded(state, epoch, images, labels),
+        donate_argnums=(0,) if donate else (),
+    )
+    run.steps_per_epoch = steps  # type: ignore[attr-defined]
+    return run
+
+
+def _linear_shard_index(axes) -> jax.Array:
+    """Flat index of this device within the data-parallel axes."""
+    idx = jnp.zeros((), jnp.int32)
+    for a in axes:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
