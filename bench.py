@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Headline benchmark: MNIST DDP training throughput, images/sec/chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Baseline is the driver's north-star target of 50,000 images/sec/chip on
+TPU (BASELINE.json) — the reference itself publishes no numbers
+(/root/reference/README.md has only a quickstart; see BASELINE.md).
+
+Measures the compiled-epoch fast path (ddp_tpu/train/fast.py): dataset
+device-resident as uint8, per-epoch shuffle on device, ``lax.scan`` over
+per-batch DDP steps — one dispatch per epoch. This is the framework's
+answer to the reference's hot loop (train_ddp.py:195-202), which pays a
+Python→C++ crossing per op and a collective sync per batch.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 50_000.0
+
+
+def run_bench(
+    *,
+    global_batch_size: int = 4096,
+    warmup_epochs: int = 1,
+    timed_epochs: int = 3,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.data import mnist
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.ddp import create_train_state, replicate_state
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+    from ddp_tpu.train.fast import device_put_dataset, make_epoch_runner
+
+    devices = jax.devices()
+    platform = devices[0].platform
+    mesh = make_mesh(MeshSpec(data=len(devices)), devices=devices)
+
+    train = mnist.load("./data", "train", allow_synthetic=True)
+    n = (train.images.shape[0] // global_batch_size) * global_batch_size
+    images, labels = device_put_dataset(
+        train.images[:n], train.labels[:n], mesh
+    )
+
+    model = get_model("simple_cnn")
+    tx = optax.sgd(0.01)
+    compute_dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    state = replicate_state(
+        create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0), mesh
+    )
+    runner = make_epoch_runner(
+        model,
+        tx,
+        mesh,
+        images,
+        labels,
+        global_batch_size,
+        compute_dtype=compute_dtype,
+        seed=0,
+    )
+    images_per_epoch = runner.steps_per_epoch * global_batch_size
+
+    for e in range(warmup_epochs):  # compile + stabilize clocks
+        state, metrics = runner(state, e)
+    jax.block_until_ready(metrics.loss)
+
+    t0 = time.perf_counter()
+    for e in range(warmup_epochs, warmup_epochs + timed_epochs):
+        state, metrics = runner(state, e)
+    jax.block_until_ready(metrics.loss)
+    seconds = time.perf_counter() - t0
+
+    total_images = images_per_epoch * timed_epochs
+    per_chip = total_images / seconds / len(devices)
+    return {
+        "metric": "mnist_ddp_train_throughput",
+        "value": round(per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+        "platform": platform,
+        "num_chips": len(devices),
+        "global_batch_size": global_batch_size,
+        "timed_epochs": timed_epochs,
+        "final_loss": round(float(metrics.loss[-1]), 4),
+        "seconds": round(seconds, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_bench()))

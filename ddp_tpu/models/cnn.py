@@ -21,17 +21,26 @@ import jax.numpy as jnp
 
 
 class SimpleCNN(nn.Module):
-    """2-conv + linear MNIST classifier (model.py:4-20 parity)."""
+    """2-conv + linear MNIST classifier (model.py:4-20 parity).
+
+    ``features`` defaults to the reference's (32, 64); tests shrink it
+    to keep emulated-CPU runs cheap.
+    """
 
     num_classes: int = 10
+    features: tuple[int, int] = (32, 64)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         # x: [B, 28, 28, 1] float. SAME padding preserves 28×28 like the
         # reference's padding=1 (model.py:9,12).
-        x = nn.Conv(features=32, kernel_size=(3, 3), padding="SAME", name="conv1")(x)
+        x = nn.Conv(
+            features=self.features[0], kernel_size=(3, 3), padding="SAME", name="conv1"
+        )(x)
         x = nn.relu(x)
-        x = nn.Conv(features=64, kernel_size=(3, 3), padding="SAME", name="conv2")(x)
+        x = nn.Conv(
+            features=self.features[1], kernel_size=(3, 3), padding="SAME", name="conv2"
+        )(x)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # Flatten (model.py:15)
         x = nn.Dense(features=self.num_classes, name="fc")(x)  # model.py:16

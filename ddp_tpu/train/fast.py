@@ -16,9 +16,9 @@ requires (SURVEY.md §7 "hard parts"):
 - one dispatch per epoch, one device sync at the end.
 
 Semantics match the step-at-a-time path: same sampler contract (keyed
-permutation, padding by wraparound, per-device stripes), same DDP
-all-reduce, same SGD update — pinned by tests/test_fast.py comparing
-the two paths batch-for-batch.
+permutation, per-device stripes, final partial batch dropped — see
+ShardedLoader.steps_per_epoch), same DDP all-reduce, same SGD update —
+pinned by tests/test_fast.py comparing the two paths batch-for-batch.
 """
 
 from __future__ import annotations
@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddp_tpu.parallel.ddp import (
     StepMetrics,
     TrainState,
+    _world,
     make_per_shard_step,
 )
 from ddp_tpu.runtime.mesh import data_axes
@@ -66,9 +67,7 @@ def make_epoch_runner(
     matching ShardedLoader).
     """
     axes = data_axes(mesh)
-    shards = 1
-    for a in axes:
-        shards *= mesh.shape[a]
+    shards = _world(mesh, axes)
     if global_batch_size % shards:
         raise ValueError(
             f"global batch {global_batch_size} not divisible by {shards} shards"
@@ -76,6 +75,10 @@ def make_epoch_runner(
     local_bs = global_batch_size // shards
     n = images.shape[0]
     steps = n // global_batch_size
+    if steps == 0:
+        raise ValueError(
+            f"dataset of {n} examples yields zero batches of {global_batch_size}"
+        )
     per_shard_step = make_per_shard_step(
         model, optimizer, axes, shards, compute_dtype=compute_dtype
     )
