@@ -69,7 +69,7 @@ def run_bench(
 
     for e in range(warmup_epochs):  # compile + stabilize clocks
         state, metrics = runner(state, e)
-    jax.block_until_ready(metrics.loss)
+        jax.block_until_ready(metrics.loss)
 
     t0 = time.perf_counter()
     for e in range(warmup_epochs, warmup_epochs + timed_epochs):

@@ -1,0 +1,136 @@
+"""CIFAR-10/100 ingestion without torchvision: raw binary reader.
+
+The driver's extension configs (BASELINE.json 3 and 4) swap the
+reference's MNIST pipeline (/root/reference/data.py:11-14) for CIFAR.
+Same design as ddp_tpu.data.mnist: download-with-mirrors into ``root``
+idempotently, parse the raw format directly, keep uint8 NHWC in memory
+(normalization happens inside the jitted step), and degrade to a
+deterministic synthetic set only when explicitly allowed.
+
+Binary layout (the "-binary" tarballs):
+- CIFAR-10: 6 files × 10000 records of [label u8][3072 u8 RGB, CHW].
+- CIFAR-100: train/test files, records of [coarse u8][fine u8][3072 u8].
+Pixels are stored channel-planar (CHW); we transpose to HWC.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ddp_tpu.data.mnist import Split
+
+_MIRRORS = (
+    "https://www.cs.toronto.edu/~kriz/",
+    "https://ossci-datasets.s3.amazonaws.com/",
+)
+_TARS = {
+    "cifar10": "cifar-10-binary.tar.gz",
+    "cifar100": "cifar-100-binary.tar.gz",
+}
+_TRAIN_FILES = {
+    "cifar10": [f"cifar-10-batches-bin/data_batch_{i}.bin" for i in range(1, 6)],
+    "cifar100": ["cifar-100-binary/train.bin"],
+}
+_TEST_FILES = {
+    "cifar10": ["cifar-10-batches-bin/test_batch.bin"],
+    "cifar100": ["cifar-100-binary/test.bin"],
+}
+
+
+def _fetch_tar(root: str, name: str) -> str:
+    fname = _TARS[name]
+    path = os.path.join(root, fname)
+    if os.path.exists(path):
+        return path
+    os.makedirs(root, exist_ok=True)
+    last_err: Exception | None = None
+    for mirror in _MIRRORS:
+        try:
+            tmp = path + ".part"
+            urllib.request.urlretrieve(mirror + fname, tmp)
+            os.replace(tmp, path)
+            return path
+        except (urllib.error.URLError, OSError) as e:
+            last_err = e
+    raise RuntimeError(f"could not download {fname} from any mirror: {last_err}")
+
+
+def parse_records(raw: bytes, *, name: str) -> Split:
+    """Decode one binary batch file into (uint8 NHWC images, labels)."""
+    label_bytes = 1 if name == "cifar10" else 2  # cifar100: coarse+fine
+    record = label_bytes + 3072
+    if len(raw) % record:
+        raise ValueError(f"{name} batch size {len(raw)} not a multiple of {record}")
+    arr = np.frombuffer(raw, np.uint8).reshape(-1, record)
+    labels = arr[:, label_bytes - 1].astype(np.int32)  # fine label for cifar100
+    images = (
+        arr[:, label_bytes:]
+        .reshape(-1, 3, 32, 32)  # CHW planar
+        .transpose(0, 2, 3, 1)  # → NHWC
+    )
+    return Split(np.ascontiguousarray(images), labels)
+
+
+def _load_split(root: str, name: str, split: str) -> Split:
+    tar_path = _fetch_tar(root, name)
+    members = (_TRAIN_FILES if split == "train" else _TEST_FILES)[name]
+    parts: list[Split] = []
+    with tarfile.open(tar_path, "r:gz") as tf:
+        for member in members:
+            raw = tf.extractfile(member).read()  # type: ignore[union-attr]
+            parts.append(parse_records(raw, name=name))
+    return Split(
+        np.concatenate([p.images for p in parts]),
+        np.concatenate([p.labels for p in parts]),
+    )
+
+
+def synthetic(num: int, *, seed: int = 0, num_classes: int = 10) -> Split:
+    """Deterministic CIFAR-shaped synthetic data (offline fallback)."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32
+    templates = np.stack(
+        [
+            np.stack(
+                [
+                    np.sin((c + 2) * np.pi * xx + ch) * np.cos((c % 5 + 1) * np.pi * yy)
+                    for ch in range(3)
+                ],
+                axis=-1,
+            )
+            for c in range(num_classes)
+        ]
+    )  # [C, 32, 32, 3] in [-1, 1]
+    labels = rng.integers(0, num_classes, size=num).astype(np.int32)
+    base = (templates[labels] * 0.5 + 0.5) * 200.0
+    noise = rng.normal(0.0, 20.0, size=base.shape)
+    images = np.clip(base + noise, 0, 255).astype(np.uint8)
+    return Split(images, labels)
+
+
+def load(
+    root: str = "./data",
+    split: str = "train",
+    *,
+    name: str = "cifar10",
+    allow_synthetic: bool = False,
+    synthetic_size: int | None = None,
+) -> Split:
+    try:
+        return _load_split(root, name, split)
+    except (RuntimeError, OSError, ValueError, KeyError) as e:
+        if isinstance(e, KeyError) and name not in _TARS:
+            raise
+        if not allow_synthetic:
+            raise
+        n = synthetic_size or (50_000 if split == "train" else 10_000)
+        return synthetic(
+            n,
+            seed=0 if split == "train" else 1,
+            num_classes=10 if name == "cifar10" else 100,
+        )

@@ -42,6 +42,36 @@ def _mnist(root, *, allow_synthetic, synthetic_size):
         root,
         "test",
         allow_synthetic=allow_synthetic,
-        synthetic_size=(synthetic_size // 6 if synthetic_size else None),
+        synthetic_size=(max(1, synthetic_size // 6) if synthetic_size else None),
     )
     return train, test
+
+
+def _cifar(name):
+    def loader(root, *, allow_synthetic, synthetic_size):
+        from ddp_tpu.data import cifar
+
+        train = cifar.load(
+            root,
+            "train",
+            name=name,
+            allow_synthetic=allow_synthetic,
+            synthetic_size=synthetic_size,
+        )
+        test = cifar.load(
+            root,
+            "test",
+            name=name,
+            allow_synthetic=allow_synthetic,
+            synthetic_size=(max(1, synthetic_size // 5) if synthetic_size else None),
+        )
+        return train, test
+
+    return loader
+
+
+register("cifar10")(_cifar("cifar10"))
+register("cifar100")(_cifar("cifar100"))
+
+
+NUM_CLASSES = {"mnist": 10, "cifar10": 10, "cifar100": 100, "imagenet": 1000}

@@ -34,4 +34,18 @@ def available() -> list[str]:
     return sorted(_REGISTRY)
 
 
-register("simple_cnn")(SimpleCNN)
+def _register_all():
+    from ddp_tpu.models.resnet import ResNet18, ResNet34, ResNet50
+    from ddp_tpu.models.vit import ViTTiny
+
+    register("simple_cnn")(SimpleCNN)
+    # BASELINE.json config 3: CIFAR-10 ResNet-18
+    register("resnet18")(ResNet18)
+    register("resnet34")(ResNet34)
+    # BASELINE.json config 5: ImageNet-1k ResNet-50
+    register("resnet50")(ResNet50)
+    # BASELINE.json config 4: ViT-Tiny / CIFAR-100 (attention path)
+    register("vit_tiny")(ViTTiny)
+
+
+_register_all()

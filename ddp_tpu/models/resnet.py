@@ -1,0 +1,152 @@
+"""ResNet family (NHWC, Flax) — the driver's deeper-conv extension configs.
+
+BASELINE.json configs 3 and 5: "CIFAR-10 ResNet-18 (swap model.py/data.py
+— deeper conv stack)" and "ImageNet-1k ResNet-50 on v4-32 multi-host".
+The reference itself ships only SimpleCNN (/root/reference/model.py:4-20);
+these are the models its README-level 'tweaks' section imagines swapping
+in, built TPU-first:
+
+- NHWC layout throughout (TPU conv layout; torchvision is NCHW);
+- BatchNorm running statistics live in the ``batch_stats`` collection
+  and ride ``TrainState.model_state``; the DDP step averages them
+  across replicas each step (SyncBN semantics — stricter than torch
+  DDP's per-rank stats);
+- the CIFAR variant uses the standard 3×3/stride-1 stem with no
+  max-pool (32×32 inputs would otherwise collapse before stage 1);
+- He-normal conv init, zero-init for the final BN scale in each
+  residual branch (the standard "zero-gamma" trick), matching
+  torchvision's defaults in function if not in RNG stream.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+_conv = partial(
+    nn.Conv, use_bias=False, kernel_init=nn.initializers.he_normal()
+)
+
+
+class BasicBlock(nn.Module):
+    """2×(3×3 conv) residual block — ResNet-18/34."""
+
+    features: int
+    strides: int = 1
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = _conv(self.features, (3, 3), strides=(self.strides, self.strides))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = _conv(self.features, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(
+                self.features, (1, 1), strides=(self.strides, self.strides),
+                name="downsample",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 residual block (4× expansion) — ResNet-50/101/152."""
+
+    features: int
+    strides: int = 1
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        out = self.features * 4
+        y = _conv(self.features, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = _conv(self.features, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = _conv(out, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = _conv(
+                out, (1, 1), strides=(self.strides, self.strides),
+                name="downsample",
+            )(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Stage-configurable ResNet with ImageNet or CIFAR stem."""
+
+    stage_sizes: Sequence[int]
+    block: Callable  # BasicBlock | BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    cifar_stem: bool = False  # 3×3/1 stem, no pool (32×32 inputs)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=x.dtype,
+        )
+        if self.cifar_stem:
+            x = _conv(self.width, (3, 3), name="stem_conv")(x)
+        else:
+            x = _conv(self.width, (7, 7), strides=(2, 2), name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for block_idx in range(num_blocks):
+                strides = 2 if stage > 0 and block_idx == 0 else 1
+                x = self.block(
+                    features=self.width * 2**stage,
+                    strides=strides,
+                    norm=norm,
+                    name=f"stage{stage + 1}_block{block_idx + 1}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, name="fc", dtype=jnp.float32)(x)
+        return x
+
+
+def ResNet18(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+    return ResNet(
+        stage_sizes=(2, 2, 2, 2),
+        block=BasicBlock,
+        num_classes=num_classes,
+        cifar_stem=cifar_stem,
+    )
+
+
+def ResNet34(num_classes: int = 1000, cifar_stem: bool = False) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block=BasicBlock,
+        num_classes=num_classes,
+        cifar_stem=cifar_stem,
+    )
+
+
+def ResNet50(num_classes: int = 1000, cifar_stem: bool = False) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3),
+        block=BottleneckBlock,
+        num_classes=num_classes,
+        cifar_stem=cifar_stem,
+    )

@@ -1,0 +1,136 @@
+"""Vision Transformer (ViT-Tiny and friends) — the attention-path config.
+
+BASELINE.json config 4: "ViT-Tiny on CIFAR-100 (attention path, bf16
+mixed precision)". The reference has no attention anywhere
+(/root/reference/model.py:8-16 is conv+linear); this adds the family
+TPU-first:
+
+- attention runs through a pluggable callable (``attention_fn``) with
+  the signature ``(q, k, v) -> out`` on [B, T, H, D] arrays, so the
+  same module serves dense single-chip attention and the
+  sequence-parallel ring attention in ``ddp_tpu.parallel.ring`` — the
+  mesh decides, the model doesn't;
+- pre-LN blocks, GELU MLP, learned position embeddings, class token;
+- all matmul-heavy ops inherit the input dtype (bf16 under mixed
+  precision) while LayerNorm and the head stay fp32-stable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ddp_tpu.ops.attention import dot_product_attention
+
+AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+class MultiHeadAttention(nn.Module):
+    """QKV projection + pluggable attention kernel + output projection."""
+
+    num_heads: int
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        B, T, C = x.shape
+        assert C % self.num_heads == 0, (C, self.num_heads)
+        head_dim = C // self.num_heads
+        qkv = nn.Dense(3 * C, name="qkv")(x)
+        qkv = qkv.reshape(B, T, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = self.attention_fn(q, k, v)  # [B, T, H, D]
+        out = out.reshape(B, T, C)
+        return nn.Dense(C, name="proj")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout_rate: float = 0.0
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
+        y = MultiHeadAttention(
+            self.num_heads, attention_fn=self.attention_fn, name="attn"
+        )(y, deterministic=deterministic)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(x.dtype)
+        y = nn.Dense(self.mlp_dim, name="mlp1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(x.shape[-1], name="mlp2")(y)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """Patch-embed → [cls] + pos-embed → N pre-LN blocks → head."""
+
+    num_classes: int = 100
+    patch_size: int = 4
+    embed_dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    attention_fn: AttentionFn = dot_product_attention
+    use_cls_token: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        p = self.patch_size
+        x = nn.Conv(
+            self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            name="patch_embed",
+        )(x)  # [B, H/p, W/p, C]
+        x = x.reshape(B, -1, self.embed_dim)
+        if self.use_cls_token:
+            cls = self.param(
+                "cls_token", nn.initializers.zeros, (1, 1, self.embed_dim)
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (B, 1, self.embed_dim)).astype(x.dtype), x],
+                axis=1,
+            )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.embed_dim),
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.embed_dim * self.mlp_ratio,
+                dropout_rate=self.dropout_rate,
+                attention_fn=self.attention_fn,
+                name=f"block{i + 1}",
+            )(x, deterministic=not train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        x = x[:, 0] if self.use_cls_token else x.mean(axis=1)
+        return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(x)
+
+
+def ViTTiny(
+    num_classes: int = 100,
+    patch_size: int = 4,
+    depth: int = 12,
+    attention_fn: Optional[AttentionFn] = None,
+    **kwargs,
+) -> ViT:
+    return ViT(
+        num_classes=num_classes,
+        patch_size=patch_size,
+        embed_dim=192,
+        depth=depth,
+        num_heads=3,
+        attention_fn=attention_fn or dot_product_attention,
+        **kwargs,
+    )

@@ -1,0 +1,74 @@
+"""Attention kernels on [B, T, H, D] arrays.
+
+The framework's attention contract: ``fn(q, k, v) -> out`` with all
+four arrays shaped [batch, tokens, heads, head_dim]. Everything above
+(the ViT family) is kernel-agnostic; everything below (dense reference,
+blockwise/flash-style, the sequence-parallel ring in
+ddp_tpu.parallel.ring) implements this one signature.
+
+The reference repo has no attention at all (model.py is conv+linear);
+this exists for the ViT extension config and the long-context path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dot_product_attention(q, k, v):
+    """Plain softmax attention, fp32 accumulation.
+
+    [B, T, H, D] in/out. Softmax runs in fp32 regardless of input dtype
+    (bf16-safe); the two matmuls stay in the input dtype for the MXU.
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", weights.astype(dtype), v)
+
+
+def blockwise_attention(q, k, v, *, block_size: int = 512):
+    """Memory-bounded attention: online-softmax over key/value blocks.
+
+    Flash-attention's recurrence expressed with ``lax.scan`` — O(T)
+    memory in the key length instead of O(T²), XLA fuses the inner
+    block math onto the MXU. Exact (not approximate): matches
+    ``dot_product_attention`` to fp32 tolerance for any block size.
+    Also the building block of ring attention (each ring hop feeds one
+    remote KV block through the same accumulator).
+    """
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    if S % block_size:
+        # Fall back to one block rather than padding with masks.
+        block_size = S
+    n_blocks = S // block_size
+    qf = q.astype(jnp.float32)
+    kf = k.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    vf = v.reshape(B, n_blocks, block_size, H, D).transpose(1, 0, 2, 3, 4)
+    scale = D**-0.5
+
+    def step(carry, kv):
+        acc, row_max, row_sum = carry
+        kb, vb = kv
+        logits = (
+            jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32)) * scale
+        )  # [B, H, T, block]
+        new_max = jnp.maximum(row_max, logits.max(axis=-1))
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(logits - new_max[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhts,bshd->bthd", p, vb.astype(jnp.float32)
+        ).transpose(0, 2, 1, 3)
+        row_sum = row_sum * correction + p.sum(axis=-1)
+        return (acc, new_max, row_sum), None
+
+    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    max0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    sum0 = jnp.zeros((B, H, T), jnp.float32)
+    (acc, _, row_sum), _ = lax.scan(step, (acc0, max0, sum0), (kf, vf))
+    out = acc / row_sum[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -38,11 +38,16 @@ class TrainState(NamedTuple):
 
     The analogue of the reference's (model.state_dict(), opt.state_dict())
     pair that its checkpoints carry (train_ddp.py:205-209).
+    ``model_state`` holds non-gradient variable collections (e.g.
+    BatchNorm ``batch_stats`` for the ResNet family) — torch keeps these
+    inside ``state_dict()`` as buffers; here they are an explicit tree,
+    empty ``{}`` for buffer-free models like SimpleCNN.
     """
 
     step: jax.Array  # int32 scalar
     params: Any  # pytree
     opt_state: Any  # optax state pytree
+    model_state: Any = {}  # non-gradient collections, e.g. batch_stats
 
 
 class StepMetrics(NamedTuple):
@@ -59,12 +64,30 @@ def create_train_state(
     broadcast at wrap time (train_ddp.py:34): replicas are identical by
     construction, no collective needed.
     """
-    params = model.init(jax.random.key(seed), sample_input)["params"]
+    variables = model.init(
+        jax.random.key(seed), sample_input, **_train_kwarg(model, False)
+    )
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
         opt_state=optimizer.init(params),
+        model_state=model_state,
     )
+
+
+def _train_kwarg(model, train: bool) -> dict:
+    """``{'train': train}`` if the model's __call__ takes it, else {}.
+
+    SimpleCNN has no train/eval mode distinction (neither does the
+    reference's, model.py:18-20); the ResNet/ViT families do (BatchNorm,
+    dropout).
+    """
+    import inspect
+
+    sig = inspect.signature(type(model).__call__)
+    return {"train": train} if "train" in sig.parameters else {}
 
 
 def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
@@ -84,6 +107,7 @@ def make_per_shard_step(
     world: int,
     *,
     compute_dtype=jnp.float32,
+    seed: int = 0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
@@ -91,34 +115,65 @@ def make_per_shard_step(
     ``lax.scan`` it without re-stating the DDP semantics.
     """
 
+    train_kw = _train_kwarg(model, True)
+
     def per_shard_step(state: TrainState, images, labels):
+        mutable = list(state.model_state.keys())
+        # Per-device, per-step dropout key: fold in the linear shard
+        # index so masks decorrelate across replicas (each sees
+        # different data). Unused rngs are ignored by Flax.
+        rng = jax.random.fold_in(jax.random.key(seed), state.step)
+        for a in axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(a))
+
         def loss_fn(params):
             x = _preprocess(images, compute_dtype)
             if compute_dtype != jnp.float32:
                 params_c = jax.tree.map(lambda p: p.astype(compute_dtype), params)
             else:
                 params_c = params
-            logits = model.apply({"params": params_c}, x).astype(jnp.float32)
+            variables = {"params": params_c, **state.model_state}
+            if mutable:
+                logits, new_ms = model.apply(
+                    variables,
+                    x,
+                    mutable=mutable,
+                    rngs={"dropout": rng},
+                    **train_kw,
+                )
+            else:
+                logits = model.apply(
+                    variables, x, rngs={"dropout": rng}, **train_kw
+                )
+                new_ms = state.model_state
             loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels
+                logits.astype(jnp.float32), labels
             ).mean()
-            return loss, logits
+            return loss, (logits, new_ms)
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        (loss, (logits, new_ms)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
         # THE all-reduce: the entire job of DDP's C++ reducer
         # (SURVEY.md §2b N4) is this one line. pmean = psum / world.
         grads = lax.pmean(grads, axes)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        # SyncBN-style: average non-gradient stats (BatchNorm running
+        # mean/var) across replicas so they stay identical. The torch
+        # reference keeps per-rank stats and checkpoints rank 0's;
+        # averaging is the strictly-more-correct contract.
+        new_ms = jax.tree.map(
+            lambda v: lax.pmean(v.astype(jnp.float32), axes), new_ms
+        )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        logits = logits.astype(jnp.float32)
         correct = (jnp.argmax(logits, -1) == labels).sum()
         metrics = StepMetrics(
             loss=lax.pmean(loss, axes),
             accuracy=lax.psum(correct, axes) / (labels.shape[0] * world),
         )
-        return TrainState(state.step + 1, params, opt_state), metrics
+        return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 
     return per_shard_step
 
@@ -130,6 +185,7 @@ def make_train_step(
     *,
     compute_dtype=jnp.float32,
     donate: bool = True,
+    seed: int = 0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -137,11 +193,14 @@ def make_train_step(
     ``images``/``labels`` are sharded over the data axes and ``state``
     is replicated. ``compute_dtype=jnp.bfloat16`` gives mixed precision:
     bf16 activations/grads on the MXU, fp32 master params and update.
+    ``seed`` keys the per-step dropout stream (independent of the data
+    order and init seeds only by convention — pass the run seed).
     """
     axes = data_axes(mesh)
     batch_spec = P(axes)
     per_shard_step = make_per_shard_step(
-        model, optimizer, axes, _world(mesh, axes), compute_dtype=compute_dtype
+        model, optimizer, axes, _world(mesh, axes),
+        compute_dtype=compute_dtype, seed=seed,
     )
     sharded = jax.shard_map(
         per_shard_step,
@@ -165,10 +224,12 @@ def make_eval_step(
     """
     axes = data_axes(mesh)
     batch_spec = P(axes)
+    train_kw = _train_kwarg(model, False)
 
-    def per_shard(params, images, labels, weights):
+    def per_shard(params, model_state, images, labels, weights):
         x = _preprocess(images, compute_dtype)
-        logits = model.apply({"params": params}, x).astype(jnp.float32)
+        variables = {"params": params, **model_state}
+        logits = model.apply(variables, x, **train_kw).astype(jnp.float32)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
         correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
         return lax.psum(correct, axes), lax.psum((loss * weights).sum(), axes)
@@ -176,7 +237,7 @@ def make_eval_step(
     sharded = jax.shard_map(
         per_shard,
         mesh=mesh,
-        in_specs=(P(), batch_spec, batch_spec, batch_spec),
+        in_specs=(P(), P(), batch_spec, batch_spec, batch_spec),
         out_specs=(P(), P()),
         check_vma=False,
     )

@@ -87,7 +87,19 @@ class CheckpointManager:
             if epoch is None:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
-        restored = self._mgr.restore(epoch, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self._mgr.restore(
+                epoch, args=ocp.args.StandardRestore(abstract)
+            )
+        except (ValueError, KeyError):
+            # Migration: checkpoints written before TrainState grew
+            # model_state lack that key. Restore the old 3-field tree
+            # and carry the caller's (freshly initialized) model_state.
+            legacy = {k: v for k, v in abstract.items() if k != "model_state"}
+            restored = dict(
+                self._mgr.restore(epoch, args=ocp.args.StandardRestore(legacy))
+            )
+            restored["model_state"] = state_like.model_state
         return TrainState(**restored), epoch
 
     def restore_or_init(

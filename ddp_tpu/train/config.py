@@ -34,6 +34,12 @@ class TrainConfig:
     # Framework knobs (no reference analogue)
     model: str = "simple_cnn"
     dataset: str = "mnist"
+    num_classes: int | None = None  # None = infer from dataset
+    optimizer: str = "sgd"  # sgd | adam | adamw
+    weight_decay: float = 0.0
+    warmup_steps: int = 0
+    decay_steps: int = 0  # >0 enables cosine decay to this many steps
+    grad_clip_norm: float = 0.0
     backend: str | None = None  # None = auto (tpu if present else cpu)
     num_devices: int = -1  # devices on the data axis; -1 = all
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
@@ -58,6 +64,14 @@ class TrainConfig:
         p.add_argument("--no_shuffle", action="store_true")
         p.add_argument("--model", default=cls.model)
         p.add_argument("--dataset", default=cls.dataset)
+        p.add_argument("--num_classes", type=int, default=None)
+        p.add_argument(
+            "--optimizer", default=cls.optimizer, choices=("sgd", "adam", "adamw")
+        )
+        p.add_argument("--weight_decay", type=float, default=cls.weight_decay)
+        p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps)
+        p.add_argument("--decay_steps", type=int, default=cls.decay_steps)
+        p.add_argument("--grad_clip_norm", type=float, default=cls.grad_clip_norm)
         p.add_argument("--backend", default=None, choices=(None, "tpu", "cpu"))
         p.add_argument("--num_devices", type=int, default=cls.num_devices)
         p.add_argument("--emulate_devices", type=int, default=None)

@@ -80,7 +80,7 @@ def make_epoch_runner(
             f"dataset of {n} examples yields zero batches of {global_batch_size}"
         )
     per_shard_step = make_per_shard_step(
-        model, optimizer, axes, shards, compute_dtype=compute_dtype
+        model, optimizer, axes, shards, compute_dtype=compute_dtype, seed=seed
     )
 
     def per_device_epoch(state: TrainState, epoch, imgs, lbls):

@@ -1,0 +1,61 @@
+"""Optimizer factory.
+
+The reference hard-codes ``SGD(lr=0.01)`` (train_ddp.py:41) — that stays
+the default for parity. The extension configs need more: ResNets train
+with momentum + weight decay, ViTs with AdamW + cosine decay and
+warmup, so those are first-class here, all as optax transforms (pure,
+jit-compatible, state rides TrainState.opt_state and checkpoints
+through Orbax — fixing the reference's dropped-optimizer-state bug,
+SURVEY.md §2a #8).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def make_optimizer(
+    name: str = "sgd",
+    *,
+    lr: float = 0.01,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    grad_clip_norm: float = 0.0,
+) -> optax.GradientTransformation:
+    """Build the update rule; ``decay_steps > 0`` enables cosine decay."""
+    if decay_steps > 0:
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps else lr,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+        )
+    elif warmup_steps > 0:
+        schedule = optax.linear_schedule(0.0, lr, warmup_steps)
+    else:
+        schedule = lr
+
+    if name == "sgd":
+        tx = optax.sgd(schedule, momentum=momentum or None)
+        if weight_decay:
+            tx = optax.chain(
+                optax.add_decayed_weights(weight_decay), tx
+            )
+    elif name == "adamw":
+        if momentum:
+            raise ValueError("momentum is an SGD knob; adamw has betas")
+        tx = optax.adamw(schedule, weight_decay=weight_decay)
+    elif name == "adam":
+        if weight_decay:
+            raise ValueError("adam ignores weight_decay — use adamw")
+        if momentum:
+            raise ValueError("momentum is an SGD knob; adam has betas")
+        tx = optax.adam(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+
+    if grad_clip_norm:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx

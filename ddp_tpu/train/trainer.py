@@ -68,8 +68,22 @@ class Trainer:
         )
         self.global_batch_size = config.batch_size * self.data_shards
 
-        self.model = get_model(config.model)
-        self.optimizer = optax.sgd(config.lr, momentum=config.momentum or None)
+        from ddp_tpu.data.registry import NUM_CLASSES
+        from ddp_tpu.train.optim import make_optimizer
+
+        self.model = get_model(
+            config.model,
+            num_classes=config.num_classes or NUM_CLASSES.get(config.dataset, 10),
+        )
+        self.optimizer = make_optimizer(
+            config.optimizer,
+            lr=config.lr,
+            momentum=config.momentum,
+            weight_decay=config.weight_decay,
+            warmup_steps=config.warmup_steps,
+            decay_steps=config.decay_steps,
+            grad_clip_norm=config.grad_clip_norm,
+        )
 
         train_split, test_split = load_dataset(
             config.dataset,
@@ -89,7 +103,8 @@ class Trainer:
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
         self.train_step = make_train_step(
-            self.model, self.optimizer, self.mesh, compute_dtype=compute_dtype
+            self.model, self.optimizer, self.mesh,
+            compute_dtype=compute_dtype, seed=config.seed,
         )
         self.eval_step = make_eval_step(
             self.model, self.mesh, compute_dtype=compute_dtype
@@ -233,6 +248,7 @@ class Trainer:
                 put = lambda a, s: jax.make_array_from_process_local_data(s, a)
             c, l = self.eval_step(
                 self.state.params,
+                self.state.model_state,
                 put(img_np, self.loader._img_sharding),
                 put(lbl_np, self.loader._lbl_sharding),
                 put(w_np, self.loader._lbl_sharding),

@@ -1,0 +1,64 @@
+"""CIFAR binary parsing + synthetic fallback (offline box: no download)."""
+
+import numpy as np
+import pytest
+
+from ddp_tpu.data import cifar
+
+
+def _records_cifar10(n):
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, n, dtype=np.uint8)
+    pixels = rng.integers(0, 256, (n, 3072), dtype=np.uint8)
+    raw = np.concatenate([labels[:, None], pixels], axis=1).tobytes()
+    return raw, labels, pixels
+
+
+def test_parse_cifar10_records():
+    raw, labels, pixels = _records_cifar10(7)
+    split = cifar.parse_records(raw, name="cifar10")
+    assert split.images.shape == (7, 32, 32, 3)
+    assert split.images.dtype == np.uint8
+    np.testing.assert_array_equal(split.labels, labels.astype(np.int32))
+    # CHW-planar → HWC: red plane is the first 1024 bytes
+    np.testing.assert_array_equal(
+        split.images[0, :, :, 0].ravel(), pixels[0, :1024]
+    )
+
+
+def test_parse_cifar100_records_picks_fine_label():
+    rng = np.random.default_rng(1)
+    coarse = rng.integers(0, 20, 5, dtype=np.uint8)
+    fine = rng.integers(0, 100, 5, dtype=np.uint8)
+    pixels = rng.integers(0, 256, (5, 3072), dtype=np.uint8)
+    raw = np.concatenate(
+        [coarse[:, None], fine[:, None], pixels], axis=1
+    ).tobytes()
+    split = cifar.parse_records(raw, name="cifar100")
+    np.testing.assert_array_equal(split.labels, fine.astype(np.int32))
+
+
+def test_parse_rejects_truncated():
+    raw, _, _ = _records_cifar10(3)
+    with pytest.raises(ValueError):
+        cifar.parse_records(raw[:-1], name="cifar10")
+
+
+def test_synthetic_fallback_offline(tmp_path):
+    split = cifar.load(
+        str(tmp_path), "train", name="cifar10",
+        allow_synthetic=True, synthetic_size=256,
+    )
+    assert split.images.shape == (256, 32, 32, 3)
+    assert split.labels.min() >= 0 and split.labels.max() < 10
+    # deterministic
+    again = cifar.load(
+        str(tmp_path), "train", name="cifar10",
+        allow_synthetic=True, synthetic_size=256,
+    )
+    np.testing.assert_array_equal(split.images, again.images)
+
+
+def test_no_silent_fallback(tmp_path):
+    with pytest.raises((RuntimeError, OSError)):
+        cifar.load(str(tmp_path), "train", name="cifar10", allow_synthetic=False)

@@ -114,9 +114,9 @@ class TestEvalStep:
         images, labels = batch_of(train, 64)
         ev = make_eval_step(model, mesh)
         w = jnp.ones((64,), jnp.float32)
-        c_full, l_full = ev(state.params, images, labels, w)
+        c_full, l_full = ev(state.params, state.model_state, images, labels, w)
         half = w.at[32:].set(0.0)
-        c_half, l_half = ev(state.params, images, labels, half)
+        c_half, l_half = ev(state.params, state.model_state, images, labels, half)
         assert 0 <= float(c_half) <= float(c_full) <= 64
         assert float(l_half) <= float(l_full) + 1e-6
 
@@ -125,9 +125,10 @@ class TestEvalStep:
         images_u8, labels = batch_of(train, 64)
         ev = make_eval_step(model, mesh)
         w = jnp.ones((64,), jnp.float32)
-        c1, l1 = ev(state.params, images_u8, labels, w)
+        c1, l1 = ev(state.params, state.model_state, images_u8, labels, w)
         c2, l2 = ev(
-            state.params, images_u8.astype(jnp.float32) / 255.0, labels, w
+            state.params, state.model_state,
+            images_u8.astype(jnp.float32) / 255.0, labels, w,
         )
         assert float(c1) == float(c2)
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
