@@ -77,17 +77,28 @@ def parse_records(raw: bytes, *, name: str) -> Split:
 
 
 def _load_split(root: str, name: str, split: str) -> Split:
-    tar_path = _fetch_tar(root, name)
     members = (_TRAIN_FILES if split == "train" else _TEST_FILES)[name]
-    parts: list[Split] = []
-    with tarfile.open(tar_path, "r:gz") as tf:
-        for member in members:
-            raw = tf.extractfile(member).read()  # type: ignore[union-attr]
-            parts.append(parse_records(raw, name=name))
-    return Split(
-        np.concatenate([p.images for p in parts]),
-        np.concatenate([p.labels for p in parts]),
-    )
+    for attempt in range(2):
+        tar_path = _fetch_tar(root, name)
+        parts: list[Split] = []
+        try:
+            with tarfile.open(tar_path, "r:gz") as tf:
+                for member in members:
+                    raw = tf.extractfile(member).read()  # type: ignore[union-attr]
+                    parts.append(parse_records(raw, name=name))
+        except (tarfile.TarError, EOFError, KeyError):
+            # Corrupt cache (truncated download, mirror error page):
+            # drop it so _fetch_tar re-downloads instead of failing on
+            # the same bad bytes forever; one retry, then propagate.
+            os.remove(tar_path)
+            if attempt:
+                raise
+            continue
+        return Split(
+            np.concatenate([p.images for p in parts]),
+            np.concatenate([p.labels for p in parts]),
+        )
+    raise AssertionError("unreachable")
 
 
 def synthetic(num: int, *, seed: int = 0, num_classes: int = 10) -> Split:
@@ -123,7 +134,7 @@ def load(
 ) -> Split:
     try:
         return _load_split(root, name, split)
-    except (RuntimeError, OSError, ValueError, KeyError) as e:
+    except (RuntimeError, OSError, ValueError, KeyError, tarfile.TarError, EOFError) as e:
         if isinstance(e, KeyError) and name not in _TARS:
             raise
         if not allow_synthetic:

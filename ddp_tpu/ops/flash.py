@@ -1,0 +1,200 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the attention path, written for the hardware
+(/opt/skills/guides/pallas_guide.md): Q blocks stream through VMEM, the
+online-softmax recurrence runs in fp32 vector registers, both matmuls
+hit the MXU with ``preferred_element_type=jnp.float32``, and HBM
+traffic is O(T·D) per query block instead of materializing the [T, S]
+score matrix. Same math as ``ops.attention.blockwise_attention`` — the
+kernel is the TPU-resident version of that scan.
+
+Differentiation: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes through the XLA blockwise implementation (exact
+same accumulator, so gradients are exact); forward-pass inference and
+the forward half of training run the Pallas kernel.
+
+``interpret=True`` runs the kernel on CPU for tests — the same code
+path the TPU compiles, minus Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on CPU-only builds of pallas
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _kernel(
+    q_ref, k_ref, v_ref, o_ref, *, scale, block_k, causal, block_q, T_total
+):
+    """One (batch·head, q-block) grid cell."""
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    S_total = S = k_ref.shape[1]
+    num_kb = S // block_k
+    q_start = pl.program_id(1) * block_q
+
+    def body(i, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            # Anchored at the sequence END (query t sees keys up to
+            # t + S - T), matching _reference's tril(k=S-T) — the
+            # KV-cache convention when T != S.
+            rows = q_start + (S_total - T_total) + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = i * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        new_m = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        # With causal masking a fully-masked row has new_m = -inf;
+        # exp(-inf - -inf) would be NaN. Guard the shift.
+        shift = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        p = jnp.exp(s - shift)
+        corr = jnp.exp(jnp.where(jnp.isfinite(m), m - shift, -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+        acc = acc * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        l = l * corr + p.sum(axis=-1, keepdims=True)
+        return acc, new_m, l
+
+    D = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, *, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, S)
+    if T % block_q:
+        block_q = T
+    if S % block_k:
+        block_k = S
+    scale = D**-0.5
+    # [B, T, H, D] → [B·H, T, D]: one grid row per (batch, head).
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    spec_kwargs = {} if _VMEM is None or interpret else {"memory_space": _VMEM}
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, block_k=block_k, causal=causal,
+            block_q=block_q, T_total=T,
+        ),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **spec_kwargs),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0), **spec_kwargs),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0), **spec_kwargs),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, D), lambda b, i: (b, i, 0), **spec_kwargs
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _reference(q, k, v, causal: bool):
+    """XLA online-softmax attention — the exact math the kernel runs.
+
+    Used for the backward pass (recompute + AD) and as the non-TPU
+    fallback. fp32 accumulation throughout.
+    """
+    dtype = q.dtype
+    scale = q.shape[-1] ** -0.5
+    logits = (
+        jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+        * scale
+    )
+    if causal:
+        T, S = logits.shape[-2:]
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", weights, v.astype(jnp.float32))
+    return out.astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Flash attention on [B, T, H, D]; Pallas forward, exact gradients.
+
+    ``interpret=True`` for CPU (tests); on TPU the kernel compiles via
+    Mosaic. Use keyword-style through ``make_flash_attention`` for the
+    model-facing ``(q, k, v) -> out`` contract.
+    """
+    return _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def make_flash_attention(
+    *, causal: bool = False, block_q: int = 128, block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Bind options → the framework's ``(q, k, v) -> out`` attention fn.
+
+    ``interpret=None`` auto-detects: compiled kernel on TPU, interpreter
+    elsewhere (CPU dev boxes), so the same model config runs anywhere.
+    """
+
+    def fn(q, k, v):
+        interp = interpret
+        if interp is None:
+            interp = jax.devices()[0].platform != "tpu"
+        return flash_attention(q, k, v, causal, block_q, block_k, interp)
+
+    return fn

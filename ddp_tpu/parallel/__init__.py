@@ -1,11 +1,19 @@
-"""Parallelism layer: DDP today; TP/FSDP/sequence axes by design.
+"""Parallelism layer: every strategy the mesh vocabulary names.
 
 The reference implements exactly one strategy — synchronous data
 parallelism (SURVEY.md §2c). This package provides it as a compiled
-SPMD step (``ddp.py``) over a mesh whose extra axes (``model``,
-``fsdp``, ``seq``, ``pipe`` — see runtime.mesh) keep tensor, sharded-
-optimizer, sequence/ring-attention, and pipeline parallelism reachable
-without restructuring the trainer.
+SPMD step (``ddp.py``), and builds the rest of the axis vocabulary
+(runtime.mesh) out for real:
+
+- ``ddp``      — data parallelism: shard_map step, explicit ``pmean``
+                 gradient all-reduce (the reference's whole capability).
+- ``spmd``     — GSPMD step: tensor parallelism (``model`` axis) +
+                 ZeRO-style parameter/optimizer sharding (``fsdp``)
+                 from PartitionSpec rules; XLA inserts the collectives.
+- ``ring``     — sequence/context parallelism (``seq`` axis): ring
+                 attention via ``ppermute`` and Ulysses all-to-all.
+- ``pipeline`` — GPipe microbatch pipelining (``pipe`` axis) via
+                 ``ppermute`` ring shifts, differentiable schedule.
 """
 
 from ddp_tpu.parallel.ddp import (  # noqa: F401
@@ -15,4 +23,21 @@ from ddp_tpu.parallel.ddp import (  # noqa: F401
     make_train_step,
     make_eval_step,
     replicate_state,
+)
+from ddp_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipelined_apply,
+    spmd_pipeline,
+    stack_stage_params,
+)
+from ddp_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    sequence_sharded_attention,
+    ulysses_attention,
+)
+from ddp_tpu.parallel.spmd import (  # noqa: F401
+    ShardingRules,
+    create_spmd_state,
+    make_spmd_eval_step,
+    make_spmd_train_step,
+    param_specs,
 )

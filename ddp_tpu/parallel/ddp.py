@@ -228,6 +228,8 @@ def make_eval_step(
 
     def per_shard(params, model_state, images, labels, weights):
         x = _preprocess(images, compute_dtype)
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
         variables = {"params": params, **model_state}
         logits = model.apply(variables, x, **train_kw).astype(jnp.float32)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)

@@ -1,0 +1,120 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the ``pipe`` axis.
+
+Absent from the reference (single-stage model, SURVEY.md §2c "Pipeline
+parallelism: No"); built here the TPU-native way. Stages are
+*same-shaped* programs (the transformer-block case): stage s holds its
+slice of a parameter tree stacked on a leading stage dimension, sharded
+over ``pipe``. The schedule is a ``lax.scan`` over M + S - 1 ticks —
+each tick every device runs its stage on the activation it holds, then
+``lax.ppermute`` shifts activations one hop down the ring (stage s →
+s+1, the classic bubble-fill/drain pattern). XLA overlaps the
+neighbor-hop transfer with the next tick's compute on ICI.
+
+The whole schedule is differentiable (scan + ppermute have exact
+transposes: the backward pass is the reverse schedule with ppermute
+running the ring the other way), so ``jax.grad`` through
+``spmd_pipeline`` *is* the 1F1B-equivalent backward — no hand-written
+backward schedule.
+
+Composes with the other axes: batch on ``data``, microbatch tokens on
+``seq``, stage weights on ``model`` — the stage_fn only ever sees its
+local shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = "pipe",
+):
+    """Run the GPipe schedule. Call INSIDE shard_map over ``axis_name``.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, x) -> y`` with ``y.shape ==
+        x.shape`` (same-shaped stages).
+      stage_params: this device's slice of the stacked param tree —
+        leading dim 1 (from ``in_specs=P('pipe', ...)``); squeezed here.
+      microbatches: [M, mb, ...] — the full microbatched input,
+        replicated; only stage 0 reads it.
+
+    Returns [M, mb, ...] outputs, identical on every device.
+    """
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    stage = lax.axis_index(axis_name)
+    S = lax.psum(1, axis_name)  # static under shard_map
+    M = microbatches.shape[0]
+    shift = [(i, i + 1) for i in range(S - 1)]  # no wraparound: drain off the end
+
+    def tick(carry, t):
+        x, outputs = carry
+        # Fill: stage 0 injects microbatch t (clamped index is harmless
+        # past the end — those ticks' stage-0 outputs are never collected).
+        inject = microbatches[jnp.minimum(t, M - 1)]
+        x = jnp.where(stage == 0, inject, x)
+        y = stage_fn(params, x)
+        # Drain: the last stage has finished microbatch t-(S-1) at tick t.
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        take = (stage == S - 1) & (t >= S - 1)
+        current = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(take, y, current), out_idx, 0
+        )
+        x_next = lax.ppermute(y, axis_name, shift)
+        return (x_next, outputs), None
+
+    x0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick, (x0, out0), jnp.arange(M + S - 1))
+    # Outputs live on the last stage only; replicate them so callers
+    # (loss on every device, or out_specs P()) see the same values.
+    return lax.psum(outputs * (stage == S - 1), axis_name)
+
+
+def make_pipelined_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    axis_name: str = "pipe",
+):
+    """Jitted ``apply(stacked_params, x) -> y`` over the pipeline mesh.
+
+    ``stacked_params``: pytree with leading stage dim S on every leaf.
+    ``x``: [B, ...] global batch; split into ``num_microbatches`` along
+    dim 0, streamed through, re-assembled. Differentiable.
+    """
+
+    def run(stacked_params, x):
+        B = x.shape[0]
+        M = num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = x.reshape(M, B // M, *x.shape[1:])
+
+        sharded = jax.shard_map(
+            lambda p, m: spmd_pipeline(stage_fn, p, m, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(P(axis_name), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        out = sharded(stacked_params, mb)
+        return out.reshape(B, *out.shape[2:])
+
+    return jax.jit(run)
+
+
+def stack_stage_params(param_list) -> Any:
+    """[tree, tree, …] (one per stage, same shapes) → stacked tree."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)

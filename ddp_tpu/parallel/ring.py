@@ -1,0 +1,121 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no attention and no sequence dimension at all
+(/root/reference/model.py:8-16 is conv+linear on 28×28 images;
+SURVEY.md §2c/§5 "long-context: absent"), but long-context attention is
+a first-class capability of this framework: sequences longer than one
+chip's HBM are sharded on the ``seq`` mesh axis and attention runs as a
+collective program.
+
+Two standard strategies, both implementing the framework's attention
+contract ``fn(q, k, v) -> out`` on [B, T_local, H, D] shards (tokens
+sharded over ``seq``), exact to fp32 tolerance vs. dense attention on
+the gathered sequence:
+
+- **Ring attention** (`ring_attention`): K/V blocks rotate around the
+  ring via ``lax.ppermute`` while each device's Q stays put; a running
+  online-softmax (same recurrence as
+  ``ops.attention.blockwise_attention``) folds each arriving block into
+  the accumulator. Memory is O(T_local) per device for any total T;
+  each hop's transfer rides one ICI neighbor link and XLA overlaps it
+  with the block matmuls. No head-count constraint.
+- **Ulysses / all-to-all** (`ulysses_attention`): one
+  ``lax.all_to_all`` re-shards from sequence-sharded to head-sharded,
+  dense attention runs locally over the full sequence with H/n heads,
+  a second all-to-all re-shards back. Two collectives total instead of
+  n-1 hops — cheaper when heads divide evenly and T fits in HBM.
+
+``sequence_sharded_attention`` picks between them; both compose with
+data parallelism (batch on ``data``, tokens on ``seq``) because they
+only ever name the ``seq`` axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq"):
+    """Exact attention with K/V rotating around the ``axis_name`` ring.
+
+    Args: q, k, v — [B, T_local, H, D] shards (inside shard_map, tokens
+    sharded over ``axis_name``). Non-causal (bidirectional), matching
+    ``ops.attention.dot_product_attention`` over the full sequence.
+    """
+    axis_size = lax.psum(1, axis_name)
+    B, T, H, D = q.shape
+    qf = q.astype(jnp.float32)
+    scale = D**-0.5
+    # Send to the next device, receive from the previous: after hop j,
+    # this device holds the K/V block of (my_index - j) mod n.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(carry, _):
+        acc, row_max, row_sum, kb, vb = carry
+        # Rotate first and let XLA overlap the ppermute with the block
+        # compute on the *current* kb/vb (no data dependence between them).
+        kb_next = lax.ppermute(kb, axis_name, perm)
+        vb_next = lax.ppermute(vb, axis_name, perm)
+        logits = (
+            jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32)) * scale
+        )  # [B, H, T_local, S_block]
+        new_max = jnp.maximum(row_max, logits.max(axis=-1))
+        corr = jnp.exp(row_max - new_max)
+        p = jnp.exp(logits - new_max[..., None])
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhts,bshd->bthd", p, vb.astype(jnp.float32)
+        ).transpose(0, 2, 1, 3)
+        row_sum = row_sum * corr + p.sum(axis=-1)
+        return (acc, new_max, row_sum, kb_next, vb_next), None
+
+    acc0 = jnp.zeros((B, H, T, D), jnp.float32)
+    max0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    sum0 = jnp.zeros((B, H, T), jnp.float32)
+    (acc, _, row_sum, _, _), _ = lax.scan(
+        fold, (acc0, max0, sum0, k, v), None, length=axis_size
+    )
+    out = acc / row_sum[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq", attention_fn=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    Re-shards [B, T/n, H, D] → [B, T, H/n, D] with one ``all_to_all``,
+    runs ``attention_fn`` (dense by default) over the full sequence on
+    the local head subset, then re-shards back. Requires H divisible by
+    the axis size.
+    """
+    from ddp_tpu.ops.attention import dot_product_attention
+
+    attention_fn = attention_fn or dot_product_attention
+    n = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(f"{H} heads not divisible by seq axis size {n}")
+    # [B, T/n, H, D] → gather tokens, scatter heads → [B, T, H/n, D]
+    to_heads = partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = attention_fn(qh, kh, vh)  # [B, T, H/n, D]
+    # gather heads, scatter tokens → [B, T/n, H, D]
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def sequence_sharded_attention(
+    q, k, v, *, axis_name: str = "seq", strategy: str = "ring"
+):
+    """Dispatch: ``strategy`` ∈ {"ring", "ulysses"}."""
+    if strategy == "ring":
+        return ring_attention(q, k, v, axis_name=axis_name)
+    if strategy == "ulysses":
+        return ulysses_attention(q, k, v, axis_name=axis_name)
+    raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")

@@ -1,0 +1,235 @@
+"""GSPMD train step: tensor/FSDP/data parallelism from sharding rules.
+
+The reference's only strategy is DDP (SURVEY.md §2c); its stack has no
+tensor or parameter sharding at all. This module is the framework's
+scale-out past pure data parallelism, built the TPU-native way: instead
+of rewriting layers Megatron-style, we *annotate* — pick a mesh, give
+every parameter a ``PartitionSpec``, pin activations with
+``with_sharding_constraint``, and let XLA's SPMD partitioner insert the
+collectives (all-gather for row-parallel inputs, reduce-scatter/psum
+for column-parallel outputs, gradient all-reduce over the replicated
+``data`` axis). The scaling-book recipe, literally.
+
+Axis semantics (runtime/mesh.py vocabulary):
+
+- ``data``  — batch sharded; params NOT named in specs ⇒ replicated ⇒
+              XLA emits the gradient all-reduce (DDP for free).
+- ``model`` — tensor parallelism: attention/MLP "column" kernels shard
+              their output features, "row" kernels their input features
+              (Megatron pairing ⇒ one collective per block, not per
+              layer — XLA finds this from the specs alone).
+- ``fsdp``  — remaining large params shard their biggest dimension;
+              XLA all-gathers them just-in-time per layer and keeps
+              optimizer state sharded (ZeRO-3 behavior, zero code).
+
+Optimizer-state sharding falls out of propagation: the jitted init
+constrains params only, and GSPMD lays momentum/Adam moments out like
+their params — no spec bookkeeping for optax internals.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.parallel.ddp import StepMetrics, TrainState, _train_kwarg, _preprocess
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Name-pattern → parallel style, matched against the param path.
+
+    ``column`` kernels shard the output (last) dim on ``model``;
+    ``row`` kernels shard the input (first) dim. Defaults cover the
+    framework's transformer family (models/vit.py): qkv+mlp1 column,
+    proj+mlp2 row — the Megatron pairing. Anything else big enough is
+    fsdp-sharded on its largest dimension.
+    """
+
+    column: tuple[str, ...] = ("qkv", "mlp1")
+    row: tuple[str, ...] = ("proj", "mlp2")
+    fsdp_min_size: int = 2**12  # params smaller than this stay replicated
+
+    def spec_for(self, path: tuple[str, ...], shape: tuple[int, ...], mesh: Mesh) -> P:
+        tp = mesh.shape.get("model", 1)
+        fsdp = mesh.shape.get("fsdp", 1)
+        spec: list[Any] = [None] * len(shape)
+        name = "/".join(path)
+        is_kernel = len(shape) >= 2
+        if tp > 1 and is_kernel:
+            if any(re.search(p, name) for p in self.column) and shape[-1] % tp == 0:
+                spec[-1] = "model"
+            elif any(re.search(p, name) for p in self.row) and shape[-2] % tp == 0:
+                spec[-2] = "model"
+        if fsdp > 1 and _size(shape) >= self.fsdp_min_size:
+            # Shard the largest still-unsharded dim that divides evenly.
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if spec[i] is None and shape[i] % fsdp == 0:
+                    spec[i] = "fsdp"
+                    break
+        return P(*spec)
+
+
+def _size(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def param_specs(params, mesh: Mesh, rules: ShardingRules | None = None):
+    """PartitionSpec pytree for ``params`` (shapes or arrays).
+
+    Works on any tree whose leaf *paths* end in param names — including
+    optax state (``trace/…/qkv/kernel``), because the rules match name
+    patterns anywhere in the joined path. Scalars get ``P()``.
+    """
+    rules = rules or ShardingRules()
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rules.spec_for(
+            tuple(getattr(k, "key", str(k)) for k in path), leaf.shape, mesh
+        ),
+        params,
+    )
+
+
+def constrain_tree(tree, mesh: Mesh, rules: ShardingRules | None = None):
+    """with_sharding_constraint every leaf per the name-based rules."""
+    specs = param_specs(tree, mesh, rules)
+    return jax.tree.map(
+        lambda x, s: lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        tree,
+        specs,
+    )
+
+
+def batch_spec(mesh: Mesh) -> P:
+    """Batch dim sharded over every data-parallel axis present."""
+    axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    return P(axes if axes else None)
+
+
+def create_spmd_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    mesh: Mesh,
+    *,
+    rules: ShardingRules | None = None,
+    seed: int = 0,
+) -> TrainState:
+    """Initialize directly into the sharded layout.
+
+    Params get their rule specs; GSPMD propagates those through
+    ``optimizer.init`` so optimizer state comes out sharded the same
+    way (ZeRO without writing ZeRO). Nothing materializes replicated
+    first — safe for models larger than one chip's HBM.
+    """
+    rules = rules or ShardingRules()
+
+    def init_fn():
+        variables = model.init(
+            jax.random.key(seed), sample_input, **_train_kwarg(model, False)
+        )
+        params = constrain_tree(variables["params"], mesh, rules)
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=constrain_tree(optimizer.init(params), mesh, rules),
+            model_state=model_state,
+        )
+
+    return jax.jit(init_fn)()
+
+
+def make_spmd_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    rules: ShardingRules | None = None,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+    seed: int = 0,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
+    """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
+
+    Same contract as ``parallel.ddp.make_train_step`` (loss is the
+    global-batch mean; metrics replicated), but the state may be
+    tensor-/fsdp-sharded per ``rules``. The gradient all-reduce over
+    ``data`` is *implied* — params have no ``data`` axis in their
+    specs, so XLA partial-sums their grads across it, exactly the DDP
+    reducer's contract (SURVEY.md §2b N4) derived rather than written.
+    """
+    rules = rules or ShardingRules()
+    bspec = batch_spec(mesh)
+    train_kw = _train_kwarg(model, True)
+
+    def step(state: TrainState, images, labels):
+        images = lax.with_sharding_constraint(images, NamedSharding(mesh, bspec))
+        labels = lax.with_sharding_constraint(labels, NamedSharding(mesh, bspec))
+        mutable = list(state.model_state.keys())
+        rng = jax.random.fold_in(jax.random.key(seed), state.step)
+
+        def loss_fn(params):
+            x = _preprocess(images, compute_dtype)
+            params_c = (
+                jax.tree.map(lambda p: p.astype(compute_dtype), params)
+                if compute_dtype != jnp.float32
+                else params
+            )
+            variables = {"params": params_c, **state.model_state}
+            if mutable:
+                logits, new_ms = model.apply(
+                    variables, x, mutable=mutable, rngs={"dropout": rng}, **train_kw
+                )
+            else:
+                logits = model.apply(variables, x, rngs={"dropout": rng}, **train_kw)
+                new_ms = state.model_state
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()  # global mean: the batch is one logical array
+            return loss, (logits, new_ms)
+
+        (loss, (logits, new_ms)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        grads = constrain_tree(grads, mesh, rules)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        opt_state = constrain_tree(opt_state, mesh, rules)
+        params = constrain_tree(
+            optax.apply_updates(state.params, updates), mesh, rules
+        )
+        correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
+        metrics = StepMetrics(loss=loss, accuracy=correct)
+        return TrainState(state.step + 1, params, opt_state, new_ms), metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_spmd_eval_step(model, mesh: Mesh, *, compute_dtype=jnp.float32):
+    """(params, model_state, images, labels, weights) → (correct, loss_sum)."""
+    bspec = batch_spec(mesh)
+    train_kw = _train_kwarg(model, False)
+
+    def step(params, model_state, images, labels, weights):
+        images = lax.with_sharding_constraint(images, NamedSharding(mesh, bspec))
+        x = _preprocess(images, compute_dtype)
+        logits = model.apply(
+            {"params": params, **model_state}, x, **train_kw
+        ).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
+        return correct, (loss * weights).sum()
+
+    return jax.jit(step)

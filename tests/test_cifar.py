@@ -62,3 +62,17 @@ def test_synthetic_fallback_offline(tmp_path):
 def test_no_silent_fallback(tmp_path):
     with pytest.raises((RuntimeError, OSError)):
         cifar.load(str(tmp_path), "train", name="cifar10", allow_synthetic=False)
+
+
+def test_corrupt_cached_tar_falls_back_and_is_removed(tmp_path):
+    """A corrupt cached tarball must not wedge load() forever: the bad
+    file is deleted (so a future call re-downloads) and allow_synthetic
+    still yields data."""
+    bad = tmp_path / "cifar-10-binary.tar.gz"
+    bad.write_bytes(b"<html>totally not a tarball</html>")
+    split = cifar.load(
+        str(tmp_path), "train", name="cifar10",
+        allow_synthetic=True, synthetic_size=64,
+    )
+    assert split.images.shape == (64, 32, 32, 3)
+    assert not bad.exists()
