@@ -50,6 +50,7 @@ class ShardedLoader:
         *,
         shuffle: bool = True,
         seed: int = 0,
+        num_workers: int = 0,
     ):
         self.mesh = mesh
         self.global_batch_size = global_batch_size
@@ -79,6 +80,29 @@ class ShardedLoader:
         spec = P(data_axes(self.mesh))
         self._img_sharding = NamedSharding(mesh, spec)
         self._lbl_sharding = NamedSharding(mesh, spec)
+        # Optional native worker pool — the C++ analogue of the
+        # reference's DataLoader(num_workers=2) (data.py:22). 0 keeps
+        # the single-thread Python gather; >0 tries the native path and
+        # falls back (with a warning) if no toolchain is available.
+        self._prefetcher = None
+        if num_workers > 0:
+            from ddp_tpu import native
+
+            if native.available():
+                self._prefetcher = native.NativePrefetcher(
+                    self.images,
+                    self.labels,
+                    self.local_batch_size,
+                    num_workers=num_workers,
+                )
+            else:
+                import logging
+
+                logging.getLogger("ddp_tpu").warning(
+                    "num_workers=%d requested but native pipeline "
+                    "unavailable; using Python gather",
+                    num_workers,
+                )
 
     def steps_per_epoch(self) -> int:
         # The final partial batch is always dropped: SPMD steps need
@@ -89,11 +113,19 @@ class ShardedLoader:
 
     def _host_batches(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         idx = self.sampler.shard_indices(epoch)
+        if self._prefetcher is not None:
+            yield from self._prefetcher.epoch(idx)
+            return
         lb = self.local_batch_size
         n_full = len(idx) // lb
         for b in range(n_full):
             sel = idx[b * lb : (b + 1) * lb]
             yield self.images[sel], self.labels[sel]
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
 
     def epoch(self, epoch: int) -> Iterator[Batch]:
         """Batches for ``epoch``, prefetched one step ahead.

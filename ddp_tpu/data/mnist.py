@@ -94,15 +94,20 @@ def _fetch(root: str, fname: str) -> str:
     raise RuntimeError(f"could not download {fname} from any mirror: {last_err}")
 
 
+def _read_idx_file(path: str) -> np.ndarray:
+    """Decode one IDX file, native (C++) decoder first, Python fallback."""
+    from ddp_tpu import native
+
+    if native.available():
+        return native.read_idx(path)
+    return parse_idx(gzip.decompress(open(path, "rb").read()))
+
+
 def _load_pair(root: str, split: str) -> Split:
-    img_raw = gzip.decompress(
-        open(_fetch(root, _FILES[f"{split}_images"]), "rb").read()
+    images = _read_idx_file(_fetch(root, _FILES[f"{split}_images"]))[..., None]
+    labels = _read_idx_file(_fetch(root, _FILES[f"{split}_labels"])).astype(
+        np.int32
     )
-    lbl_raw = gzip.decompress(
-        open(_fetch(root, _FILES[f"{split}_labels"]), "rb").read()
-    )
-    images = parse_idx(img_raw)[..., None]  # NHWC
-    labels = parse_idx(lbl_raw).astype(np.int32)
     if images.shape[0] != labels.shape[0]:
         raise ValueError("image/label count mismatch")
     return Split(np.ascontiguousarray(images), labels)

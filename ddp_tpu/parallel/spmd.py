@@ -225,6 +225,8 @@ def make_spmd_eval_step(model, mesh: Mesh, *, compute_dtype=jnp.float32):
     def step(params, model_state, images, labels, weights):
         images = lax.with_sharding_constraint(images, NamedSharding(mesh, bspec))
         x = _preprocess(images, compute_dtype)
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
         logits = model.apply(
             {"params": params, **model_state}, x, **train_kw
         ).astype(jnp.float32)

@@ -30,6 +30,7 @@ class TrainConfig:
     log_interval: int = 100  # train_ddp.py:201
     seed: int = 0
     shuffle: bool = True  # data.py:18
+    num_workers: int = 2  # data.py:22 — native C++ prefetch pool size
 
     # Framework knobs (no reference analogue)
     model: str = "simple_cnn"
@@ -62,6 +63,7 @@ class TrainConfig:
         p.add_argument("--log_interval", type=int, default=cls.log_interval)
         p.add_argument("--seed", type=int, default=cls.seed)
         p.add_argument("--no_shuffle", action="store_true")
+        p.add_argument("--num_workers", type=int, default=cls.num_workers)
         p.add_argument("--model", default=cls.model)
         p.add_argument("--dataset", default=cls.dataset)
         p.add_argument("--num_classes", type=int, default=None)

@@ -99,6 +99,7 @@ class Trainer:
             self.global_batch_size,
             shuffle=config.shuffle,
             seed=config.seed,
+            num_workers=config.num_workers,
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
@@ -258,4 +259,5 @@ class Trainer:
         return correct_total / n, loss_total / n
 
     def close(self) -> None:
+        self.loader.close()
         self.ckpt.close()
