@@ -98,7 +98,7 @@ def _read_idx_file(path: str) -> np.ndarray:
     """Decode one IDX file, native (C++) decoder first, Python fallback."""
     from ddp_tpu import native
 
-    if native.available():
+    if native.available(build=False):
         return native.read_idx(path)
     return parse_idx(gzip.decompress(open(path, "rb").read()))
 

@@ -35,6 +35,7 @@ def available() -> list[str]:
 
 
 def _register_all():
+    from ddp_tpu.models.moe import MoEViTTiny
     from ddp_tpu.models.resnet import ResNet18, ResNet34, ResNet50
     from ddp_tpu.models.vit import ViTTiny
 
@@ -46,6 +47,8 @@ def _register_all():
     register("resnet50")(ResNet50)
     # BASELINE.json config 4: ViT-Tiny / CIFAR-100 (attention path)
     register("vit_tiny")(ViTTiny)
+    # Expert-parallel family (no reference counterpart — SURVEY.md §2c)
+    register("vit_moe_tiny")(MoEViTTiny)
 
 
 _register_all()

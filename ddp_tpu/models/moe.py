@@ -1,0 +1,230 @@
+"""Mixture-of-Experts layers + MoE ViT — the expert-parallel family.
+
+The reference has no MoE anywhere (SURVEY.md §2c lists expert
+parallelism as absent); this module adds the capability TPU-first, the
+GShard/GSPMD way: expert computation is expressed as *global* einsums
+over a dispatch tensor, expert weights carry a leading ``num_experts``
+dim sharded on the mesh's ``expert`` axis (parallel/spmd.py
+ShardingRules), and XLA's partitioner derives the token all-to-alls
+from the shardings alone — no hand-written routing collectives.
+
+Routing is classic top-k with capacity (GShard): per-token softmax
+gates, iterative top-k selection, position-in-expert by a global
+cumsum with earlier choices taking priority, tokens past capacity
+dropped. The load-balancing auxiliary loss is recorded in a ``losses``
+variable collection (stable-structure `self.variable`, not `sow`, so
+the train-state pytree never changes shape); the train steps in
+parallel/{ddp,spmd}.py add it to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ddp_tpu.models.vit import AttentionFn, EncoderBlock, MultiHeadAttention
+from ddp_tpu.ops.attention import dot_product_attention
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert MLP with capacity-based token dropping.
+
+    Input/output: ``[B, T, d]``. Expert weights: ``wi [E, d, mlp_dim]``,
+    ``wo [E, mlp_dim, d]`` (+ biases ``bi``/``bo``) — the leading dim is
+    what the ``expert`` mesh axis shards.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    normalize_gates: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        B, T, d = x.shape
+        E = self.num_experts
+        n = B * T
+        tokens = x.reshape(n, d)
+        # Per-expert slot count; static (derived from traced shapes).
+        capacity = max(1, int(round(self.capacity_factor * n * self.top_k / E)))
+
+        # Router in fp32 for numerically stable softmax under bf16.
+        gate_logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        gates = jax.nn.softmax(gate_logits, axis=-1)  # [n, E]
+
+        # Iterative top-k: pick, record, mask out, repeat.
+        remaining = gates
+        expert_masks, gate_vals = [], []
+        for _ in range(self.top_k):
+            idx = jnp.argmax(remaining, axis=-1)
+            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [n, E]
+            gate_vals.append((remaining * mask).sum(-1))  # [n]
+            expert_masks.append(mask)
+            remaining = remaining * (1.0 - mask)
+
+        # Position-in-expert via one global cumsum with k=0 assignments
+        # taking priority over k=1 for the limited capacity slots.
+        masks = jnp.stack(expert_masks)  # [K, n, E]
+        flat = masks.reshape(self.top_k * n, E)
+        pos = jnp.cumsum(flat, axis=0) - flat  # slot index per assignment
+        pos = pos.reshape(self.top_k, n, E)
+        in_cap = masks * (pos < capacity)  # [K, n, E]
+        pos_in_expert = (pos * in_cap).sum(-1).astype(jnp.int32)  # [K, n]
+
+        gate_stack = jnp.stack(gate_vals) * in_cap.sum(-1)  # zero dropped
+        if self.normalize_gates:
+            denom = gate_stack.sum(0, keepdims=True)
+            gate_stack = gate_stack / jnp.maximum(denom, 1e-9)
+
+        slot_onehot = jax.nn.one_hot(pos_in_expert, capacity)  # [K, n, C]
+        # dispatch[n, e, c] = token n occupies slot c of expert e
+        dispatch = jnp.einsum("kne,knc->nec", in_cap, slot_onehot)
+        combine = jnp.einsum("kne,kn,knc->nec", in_cap, gate_stack, slot_onehot)
+
+        # GShard load-balance aux loss: E * Σ_e mean-gate_e · frac-routed_e
+        # (first-choice fractions). Recorded with stable pytree shape.
+        frac_routed = expert_masks[0].mean(0)
+        aux = E * jnp.sum(gates.mean(0) * frac_routed)
+        if self.is_mutable_collection("losses"):
+            self.variable(
+                "losses", "moe_aux", lambda: jnp.zeros((), jnp.float32)
+            ).value = aux
+
+        dtype = x.dtype
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (E, d, self.mlp_dim)
+        )
+        bi = self.param("bi", nn.initializers.zeros, (E, 1, self.mlp_dim))
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (E, self.mlp_dim, d)
+        )
+        bo = self.param("bo", nn.initializers.zeros, (E, 1, d))
+
+        # Dispatch → expert FFN → combine. All global einsums: with
+        # tokens batch-sharded and wi/wo expert-sharded, XLA inserts the
+        # token all-to-alls here.
+        xs = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), tokens)
+        h = nn.gelu(
+            jnp.einsum("ecd,edf->ecf", xs, wi.astype(dtype)) + bi.astype(dtype)
+        )
+        ys = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype)) + bo.astype(dtype)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(dtype), ys)
+        return out.reshape(B, T, d)
+
+
+class MoEEncoderBlock(nn.Module):
+    """Pre-LN transformer block whose MLP is a routed expert layer."""
+
+    num_heads: int
+    mlp_dim: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    dropout_rate: float = 0.0
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
+        y = MultiHeadAttention(
+            self.num_heads, attention_fn=self.attention_fn, name="attn"
+        )(y, deterministic=deterministic)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(x.dtype)
+        y = MoEMLP(
+            num_experts=self.num_experts,
+            mlp_dim=self.mlp_dim,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+            name="moe",
+        )(y, deterministic=deterministic)
+        return x + y
+
+
+class MoEViT(nn.Module):
+    """ViT where every ``moe_every``-th block routes its MLP to experts.
+
+    Same patch-embed/cls/pos front and head as models/vit.py ViT;
+    interleaving dense and MoE blocks is the standard GShard/ST-MoE
+    layout.
+    """
+
+    num_classes: int = 100
+    patch_size: int = 4
+    embed_dim: int = 192
+    depth: int = 12
+    num_heads: int = 3
+    mlp_ratio: int = 4
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    moe_every: int = 2
+    dropout_rate: float = 0.0
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        p = self.patch_size
+        x = nn.Conv(
+            self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.embed_dim)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.embed_dim),
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        mlp_dim = self.embed_dim * self.mlp_ratio
+        for i in range(self.depth):
+            if (i + 1) % self.moe_every == 0:
+                x = MoEEncoderBlock(
+                    num_heads=self.num_heads,
+                    mlp_dim=mlp_dim,
+                    num_experts=self.num_experts,
+                    top_k=self.top_k,
+                    capacity_factor=self.capacity_factor,
+                    dropout_rate=self.dropout_rate,
+                    attention_fn=self.attention_fn,
+                    name=f"block{i + 1}",
+                )(x, deterministic=not train)
+            else:
+                x = EncoderBlock(
+                    num_heads=self.num_heads,
+                    mlp_dim=mlp_dim,
+                    dropout_rate=self.dropout_rate,
+                    attention_fn=self.attention_fn,
+                    name=f"block{i + 1}",
+                )(x, deterministic=not train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(
+            x.mean(axis=1)
+        )
+
+
+def MoEViTTiny(
+    num_classes: int = 100,
+    num_experts: int = 8,
+    depth: int = 12,
+    attention_fn: Optional[AttentionFn] = None,
+    **kwargs,
+) -> MoEViT:
+    return MoEViT(
+        num_classes=num_classes,
+        embed_dim=192,
+        depth=depth,
+        num_heads=3,
+        num_experts=num_experts,
+        attention_fn=attention_fn or dot_product_attention,
+        **kwargs,
+    )

@@ -109,8 +109,24 @@ def _load() -> ctypes.CDLL | None:
         return _LIB
 
 
-def available() -> bool:
-    """True when the native library compiled (or was cached) and loaded."""
+def available(build: bool = True) -> bool:
+    """True when the native library is loaded (or loadable).
+
+    ``build=False`` never triggers a compile: it answers True only if
+    the library is already loaded or a cached build exists on disk —
+    callers with a cheap Python fallback (e.g. the IDX reader) use this
+    so a cold environment doesn't pay a blocking g++ run for four small
+    files. The prefetcher path (which has no fallback) builds on demand.
+    """
+    if _LIB is not None:
+        return True
+    if not build:
+        try:
+            tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+        except OSError:
+            return False
+        if not (_SRC.parent / "_build" / f"libdataio-{tag}.so").exists():
+            return False
     return _load() is not None
 
 
@@ -211,13 +227,17 @@ class NativePrefetcher:
         finally:
             # If the consumer abandoned the epoch mid-way, drain the
             # remaining batches so workers quiesce and the next
-            # start_epoch is safe.
-            scratch_i = np.empty((self.batch_size, *self._item_shape), np.uint8)
-            scratch_l = np.empty((self.batch_size,), np.int32)
-            while self._lib.dt_loader_next(
-                self._handle, scratch_i.ctypes.data, scratch_l.ctypes.data
-            ):
-                pass
+            # start_epoch is safe. close() may already have destroyed
+            # the handle (generator GC'd after Trainer.close()).
+            if self._handle is not None:
+                scratch_i = np.empty(
+                    (self.batch_size, *self._item_shape), np.uint8
+                )
+                scratch_l = np.empty((self.batch_size,), np.int32)
+                while self._lib.dt_loader_next(
+                    self._handle, scratch_i.ctypes.data, scratch_l.ctypes.data
+                ):
+                    pass
             self._draining = False
 
     def close(self) -> None:

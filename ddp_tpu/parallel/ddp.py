@@ -108,6 +108,7 @@ def make_per_shard_step(
     *,
     compute_dtype=jnp.float32,
     seed: int = 0,
+    aux_loss_weight: float = 0.01,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
@@ -149,6 +150,10 @@ def make_per_shard_step(
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), labels
             ).mean()
+            if "losses" in mutable:  # MoE load-balance aux (models/moe.py)
+                loss = loss + aux_loss_weight * sum(
+                    jax.tree.leaves(new_ms["losses"])
+                )
             return loss, (logits, new_ms)
 
         (loss, (logits, new_ms)), grads = jax.value_and_grad(
@@ -186,6 +191,7 @@ def make_train_step(
     compute_dtype=jnp.float32,
     donate: bool = True,
     seed: int = 0,
+    aux_loss_weight: float = 0.01,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -201,6 +207,7 @@ def make_train_step(
     per_shard_step = make_per_shard_step(
         model, optimizer, axes, _world(mesh, axes),
         compute_dtype=compute_dtype, seed=seed,
+        aux_loss_weight=aux_loss_weight,
     )
     sharded = jax.shard_map(
         per_shard_step,

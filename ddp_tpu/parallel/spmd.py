@@ -49,20 +49,31 @@ class ShardingRules:
     ``column`` kernels shard the output (last) dim on ``model``;
     ``row`` kernels shard the input (first) dim. Defaults cover the
     framework's transformer family (models/vit.py): qkv+mlp1 column,
-    proj+mlp2 row — the Megatron pairing. Anything else big enough is
-    fsdp-sharded on its largest dimension.
+    proj+mlp2 row — the Megatron pairing; the MoE expert FFN
+    (models/moe.py wi/wo) gets the same pairing on its trailing dims
+    while its leading expert dim shards on ``expert``. Anything else
+    big enough is fsdp-sharded on its largest dimension.
     """
 
-    column: tuple[str, ...] = ("qkv", "mlp1")
-    row: tuple[str, ...] = ("proj", "mlp2")
+    column: tuple[str, ...] = ("qkv", "mlp1", "moe/wi")
+    row: tuple[str, ...] = ("proj", "mlp2", "moe/wo")
+    expert: tuple[str, ...] = (r"moe/(wi|wo|bi|bo)",)
     fsdp_min_size: int = 2**12  # params smaller than this stay replicated
 
     def spec_for(self, path: tuple[str, ...], shape: tuple[int, ...], mesh: Mesh) -> P:
         tp = mesh.shape.get("model", 1)
         fsdp = mesh.shape.get("fsdp", 1)
+        ep = mesh.shape.get("expert", 1)
         spec: list[Any] = [None] * len(shape)
         name = "/".join(path)
         is_kernel = len(shape) >= 2
+        if (
+            ep > 1
+            and len(shape) >= 1
+            and any(re.search(p, name) for p in self.expert)
+            and shape[0] % ep == 0
+        ):
+            spec[0] = "expert"
         if tp > 1 and is_kernel:
             if any(re.search(p, name) for p in self.column) and shape[-1] % tp == 0:
                 spec[-1] = "model"
@@ -112,8 +123,15 @@ def constrain_tree(tree, mesh: Mesh, rules: ShardingRules | None = None):
 
 
 def batch_spec(mesh: Mesh) -> P:
-    """Batch dim sharded over every data-parallel axis present."""
-    axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    """Batch dim sharded over every data-parallel axis present.
+
+    ``expert`` is a data axis for everything except the expert weights
+    themselves (GShard layout): tokens shard over it, and XLA turns the
+    dispatch/combine einsums in models/moe.py into token all-to-alls.
+    """
+    axes = tuple(
+        a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
+    )
     return P(axes if axes else None)
 
 
@@ -160,6 +178,7 @@ def make_spmd_train_step(
     compute_dtype=jnp.float32,
     donate: bool = True,
     seed: int = 0,
+    aux_loss_weight: float = 0.01,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
 
@@ -198,6 +217,10 @@ def make_spmd_train_step(
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), labels
             ).mean()  # global mean: the batch is one logical array
+            if "losses" in mutable:  # MoE load-balance aux (models/moe.py)
+                loss = loss + aux_loss_weight * sum(
+                    jax.tree.leaves(new_ms["losses"])
+                )
             return loss, (logits, new_ms)
 
         (loss, (logits, new_ms)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -8,14 +8,18 @@ placement alone.
 
 Axis vocabulary (fixed across the framework):
 
-- ``data``  — data parallelism: batch sharded, params replicated,
-              gradient all-reduce (the reference's entire capability,
-              SURVEY.md §2c).
-- ``fsdp``  — parameter/optimizer sharding (ZeRO-style) on top of data
-              parallelism.
-- ``model`` — tensor parallelism within layers.
-- ``seq``   — sequence/context parallelism (ring attention).
-- ``pipe``  — pipeline stages.
+- ``data``   — data parallelism: batch sharded, params replicated,
+               gradient all-reduce (the reference's entire capability,
+               SURVEY.md §2c).
+- ``fsdp``   — parameter/optimizer sharding (ZeRO-style) on top of data
+               parallelism.
+- ``expert`` — expert parallelism: MoE expert weights shard their
+               leading (expert) dim; tokens shard their batch dim over
+               this axis too, so it doubles as a data axis for dense
+               layers (GShard-style).
+- ``model``  — tensor parallelism within layers.
+- ``seq``    — sequence/context parallelism (ring attention).
+- ``pipe``   — pipeline stages.
 
 A 1-D ``('data',)`` mesh over all chips reproduces DDP exactly; the
 other axes exist so the same train step scales without restructuring.
@@ -29,7 +33,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-AXIS_ORDER = ("pipe", "data", "fsdp", "seq", "model")
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "model")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +45,7 @@ class MeshSpec:
 
     data: int = -1
     fsdp: int = 1
+    expert: int = 1
     model: int = 1
     seq: int = 1
     pipe: int = 1
@@ -100,9 +105,9 @@ def make_mesh(
 def data_axes(mesh) -> tuple[str, ...]:
     """Axes over which the batch is sharded and grads are averaged.
 
-    ``fsdp`` participates in batch sharding (each fsdp group sees
-    different data) — so DDP gradient reduction runs over both. Only
-    axes the mesh actually has are returned, so hand-built meshes
-    (e.g. ``Mesh(devices, ('data',))``) work too.
+    ``fsdp`` and ``expert`` participate in batch sharding (each group
+    sees different data) — so DDP gradient reduction runs over all
+    three. Only axes the mesh actually has are returned, so hand-built
+    meshes (e.g. ``Mesh(devices, ('data',))``) work too.
     """
-    return tuple(a for a in ("data", "fsdp") if a in mesh.shape)
+    return tuple(a for a in ("data", "fsdp", "expert") if a in mesh.shape)

@@ -21,7 +21,7 @@ class TestSharding:
         assert batch.images.shape == (64, 28, 28, 1)
         assert batch.images.dtype == np.uint8
         spec = batch.images.sharding.spec
-        assert spec[0] == ("data", "fsdp") or spec[0] == "data"
+        assert spec[0] == ("data", "fsdp", "expert") or spec[0] == "data"
         # 8 devices × 8 examples each
         assert len(batch.images.addressable_shards) == 8
         assert batch.images.addressable_shards[0].data.shape[0] == 8
