@@ -145,6 +145,7 @@ class MoEEncoderBlock(nn.Module):
             capacity_factor=self.capacity_factor,
             name="moe",
         )(y, deterministic=deterministic)
+        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
         return x + y
 
 

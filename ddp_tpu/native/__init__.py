@@ -41,10 +41,14 @@ _IDX_DTYPES = {
 }
 
 
+def _cache_path() -> Path:
+    """Where the compiled library lives, keyed by a source hash."""
+    tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    return _SRC.parent / "_build" / f"libdataio-{tag}.so"
+
+
 def _build() -> Path:
-    src = _SRC.read_bytes()
-    tag = hashlib.sha256(src).hexdigest()[:16]
-    out = _SRC.parent / "_build" / f"libdataio-{tag}.so"
+    out = _cache_path()
     if out.exists():
         return out
     out.parent.mkdir(exist_ok=True)
@@ -122,10 +126,9 @@ def available(build: bool = True) -> bool:
         return True
     if not build:
         try:
-            tag = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+            if not _cache_path().exists():
+                return False
         except OSError:
-            return False
-        if not (_SRC.parent / "_build" / f"libdataio-{tag}.so").exists():
             return False
     return _load() is not None
 

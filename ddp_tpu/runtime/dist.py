@@ -171,12 +171,11 @@ def cleanup() -> None:
     import jax
 
     ctx, _ACTIVE = _ACTIVE, None
-    if ctx is not None and ctx.num_processes > 1:
+    if ctx is None:
+        return  # already torn down (or never set up) — idempotent
+    if ctx.num_processes > 1:
         jax.distributed.shutdown()
-    logger.info(
-        "Process %s cleanup complete",
-        ctx.process_id if ctx is not None else "?",
-    )
+    logger.info("Process %d cleanup complete", ctx.process_id)
 
 
 def sync_global_devices(tag: str) -> None:

@@ -1,0 +1,154 @@
+"""Single-host multi-process launcher — ``torch.multiprocessing.spawn`` parity.
+
+The reference launches its 2 ranks with ``torch.multiprocessing.spawn(
+ddp_train, args=(world_size, epochs, batch_size), nprocs=world_size)``
+(train_ddp.py:222-224). The JAX production launch is one process per
+*host* (SURVEY.md §2b N9) rendezvoused by ``jax.distributed``; this
+launcher reproduces the reference's dev-box experience on top of that —
+N local processes, each a ``jax.distributed`` participant with its own
+emulated CPU device(s) and a localhost coordinator — which is also how
+multi-host code paths are tested without a cluster (SURVEY.md §4: the
+TPU analogue of "2-proc gloo on a laptop").
+
+Workers must be module-level callables ``fn(rank, world_size, *args)``
+(the reference's ``ddp_train`` signature). They are resolved by source
+file + qualified name in the child, so functions from test modules and
+scripts work even when those modules aren't importable by package name.
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import multiprocessing
+import os
+import socket
+import sys
+import time
+from typing import Callable, Sequence
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (the coordinator's MASTER_PORT role)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _resolve(src_file: str, module_name: str, qualname: str) -> Callable:
+    try:
+        mod = importlib.import_module(module_name)
+    except ImportError:
+        spec = importlib.util.spec_from_file_location(module_name, src_file)
+        if spec is None or spec.loader is None:
+            raise
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[module_name] = mod
+        spec.loader.exec_module(mod)
+    obj = mod
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _child_main(
+    src_file: str,
+    module_name: str,
+    qualname: str,
+    rank: int,
+    world_size: int,
+    port: int,
+    devices_per_process: int,
+    args: tuple,
+) -> None:
+    # Platform must be pinned before any JAX backend initializes in the
+    # fresh ('spawn') interpreter. dist imports jax lazily, so using its
+    # flag helper here is safe.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from ddp_tpu.runtime import dist
+
+    dist._ensure_host_device_count(devices_per_process)
+
+    dist.setup(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=world_size,
+        process_id=rank,
+        backend="cpu",
+    )
+    fn = _resolve(src_file, module_name, qualname)
+    try:
+        fn(rank, world_size, *args)
+    finally:
+        dist.cleanup()
+
+
+def spawn(
+    fn: Callable,
+    nprocs: int,
+    args: Sequence = (),
+    *,
+    devices_per_process: int = 1,
+    coordinator_port: int | None = None,
+    timeout: float | None = 600.0,
+    grace: float = 15.0,
+) -> None:
+    """Run ``fn(rank, world_size, *args)`` in ``nprocs`` processes.
+
+    Same contract as the reference's launcher (spawn prepends the rank,
+    train_ddp.py:222-224) with the c10d env:// rendezvous replaced by a
+    localhost ``jax.distributed`` coordinator. Blocks until every rank
+    exits (``timeout=None`` waits forever). Fails fast: the first rank
+    to die with a non-zero exit code is reported as the culprit, and
+    surviving ranks — typically blocked in a collective waiting for the
+    dead one, the reference's hang failure mode (SURVEY.md §5) — get
+    ``grace`` seconds to exit before being terminated.
+    """
+    import inspect
+
+    src_file = os.path.abspath(inspect.getfile(fn))
+    port = coordinator_port or free_port()
+    ctx = multiprocessing.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=_child_main,
+            args=(
+                src_file,
+                fn.__module__,
+                fn.__qualname__,
+                rank,
+                nprocs,
+                port,
+                devices_per_process,
+                tuple(args),
+            ),
+            daemon=False,
+        )
+        for rank in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    try:
+        while True:
+            exited = {r: p.exitcode for r, p in enumerate(procs) if not p.is_alive()}
+            bad = {r: c for r, c in exited.items() if c != 0}
+            if bad:
+                # Give blocked survivors a moment, then report the
+                # actual failure rather than a survivor's timeout.
+                grace_end = time.monotonic() + grace
+                for p in procs:
+                    p.join(max(0.0, grace_end - time.monotonic()))
+                raise RuntimeError(f"worker failures (rank: exitcode): {bad}")
+            if len(exited) == nprocs:
+                return
+            if deadline is not None and time.monotonic() > deadline:
+                alive = [r for r, p in enumerate(procs) if p.is_alive()]
+                raise RuntimeError(
+                    f"ranks {alive} still running after {timeout}s"
+                )
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(10)

@@ -51,6 +51,13 @@ class TrainConfig:
     synthetic_size: int | None = None
     profile_dir: str | None = None  # jax.profiler trace output
 
+    # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
+    # + env:// rendezvous at utils.py:7-11)
+    spawn: int = 1  # >1: fork N local jax.distributed processes
+    coordinator_address: str | None = None  # host:port, MASTER_ADDR role
+    num_processes: int | None = None
+    process_id: int | None = None
+
     @classmethod
     def parser(cls) -> argparse.ArgumentParser:
         p = argparse.ArgumentParser(description="TPU-native DDP trainer")
@@ -86,6 +93,10 @@ class TrainConfig:
         p.add_argument("--synthetic_data", action="store_true")
         p.add_argument("--synthetic_size", type=int, default=None)
         p.add_argument("--profile_dir", default=None)
+        p.add_argument("--spawn", type=int, default=cls.spawn)
+        p.add_argument("--coordinator_address", default=None)
+        p.add_argument("--num_processes", type=int, default=None)
+        p.add_argument("--process_id", type=int, default=None)
         return p
 
     @classmethod

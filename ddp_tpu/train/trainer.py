@@ -55,7 +55,11 @@ class Trainer:
     def __init__(self, config: TrainConfig, ctx: dist.DistContext | None = None):
         self.config = config
         self.ctx = ctx or dist.setup(
-            backend=config.backend, emulate_devices=config.emulate_devices
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+            backend=config.backend,
+            emulate_devices=config.emulate_devices,
         )
         setup_logging(self.ctx.process_id)
 

@@ -22,6 +22,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multihost: spawns real jax.distributed worker processes",
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

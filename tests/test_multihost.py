@@ -1,0 +1,173 @@
+"""Real multi-process ``jax.distributed`` tests.
+
+SURVEY.md §4: the reference's answer to "test distributed without a
+cluster" is N processes + the CPU collective backend on one host
+(README.md:67-70, 2-proc gloo). The TPU-native analogue here is the
+launcher in runtime/launch.py — N spawned processes, each a
+``jax.distributed`` participant with one emulated CPU device, sharing a
+localhost coordinator. Collectives cross real process boundaries (Gloo
+under XLA:CPU), unlike the in-process 8-device emulation the rest of
+the suite uses — this is what validates the multi-host code paths:
+process-sharded loading, ``make_array_from_process_local_data``
+assembly, Orbax collective save/restore, and failure propagation.
+
+Workers are module-level (picklable-by-reference) and report back
+through files in a handoff directory.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ddp_tpu.runtime.launch import spawn
+
+pytestmark = pytest.mark.multihost
+
+
+# ---------------------------------------------------------------- workers
+
+
+def _psum_worker(rank, world, out_dir):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == world
+    devs = jax.devices()
+    assert len(devs) == world, devs
+    mesh = Mesh(np.array(devs), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    arr = jax.make_array_from_process_local_data(
+        sh, np.array([float(rank + 1)], np.float32)
+    )
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"sum": float(total)}, f)
+
+
+def _ddp_step_worker(rank, world, out_dir):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.ddp import (
+        create_train_state,
+        make_train_step,
+        replicate_state,
+    )
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=world))
+    model = get_model("simple_cnn")
+    tx = optax.sgd(0.01)
+    state = replicate_state(
+        create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0), mesh
+    )
+    step = make_train_step(model, tx, mesh)
+
+    # Each process contributes a DIFFERENT local batch; after the
+    # gradient all-reduce the updated params must be identical anyway.
+    rng = np.random.default_rng(100 + rank)
+    images = rng.integers(0, 256, size=(4, 28, 28, 1), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=(4,)).astype(np.int32)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data"))
+    state, metrics = step(
+        state,
+        jax.make_array_from_process_local_data(sh, images),
+        jax.make_array_from_process_local_data(sh, labels),
+    )
+    param_sum = float(
+        sum(jnp.sum(jnp.abs(p)) for p in jax.tree.leaves(state.params))
+    )
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {"loss": float(metrics.loss), "param_sum": param_sum}, f
+        )
+
+
+def _trainer_worker(rank, world, epochs, ckpt_dir, data_root, out_dir):
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=epochs,
+        batch_size=8,
+        synthetic_data=True,
+        synthetic_size=256,
+        checkpoint_dir=ckpt_dir,
+        data_root=data_root,
+        log_interval=8,
+        num_workers=0,
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "epochs_run": summary["epochs_run"],
+                "acc": summary["final_accuracy"],
+            },
+            f,
+        )
+
+
+def _failing_worker(rank, world):
+    raise ValueError(f"rank {rank} injected failure")
+
+
+def _read(out_dir, world):
+    out = []
+    for r in range(world):
+        with open(os.path.join(out_dir, f"rank{r}.json")) as f:
+            out.append(json.load(f))
+    return out
+
+
+# ----------------------------------------------------------------- tests
+
+
+def test_spawn_psum_across_processes(tmp_path):
+    spawn(_psum_worker, 2, (str(tmp_path),), timeout=240)
+    results = _read(tmp_path, 2)
+    assert [r["sum"] for r in results] == [3.0, 3.0]
+
+
+def test_spawn_ddp_step_replicas_stay_identical(tmp_path):
+    spawn(_ddp_step_worker, 2, (str(tmp_path),), timeout=240)
+    results = _read(tmp_path, 2)
+    assert np.isfinite(results[0]["loss"])
+    # same loss (it's pmean'd) and bitwise-identical param sums
+    assert results[0]["loss"] == results[1]["loss"]
+    assert results[0]["param_sum"] == results[1]["param_sum"]
+
+
+def test_spawn_trainer_e2e_and_resume(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    data = str(tmp_path / "data")
+    out1 = tmp_path / "run1"
+    out1.mkdir()
+    spawn(_trainer_worker, 2, (1, ckpt, data, str(out1)), timeout=420)
+    first = _read(out1, 2)
+    assert [r["epochs_run"] for r in first] == [1, 1]
+
+    # Re-launch with a higher target: must resume and run only 1 more.
+    out2 = tmp_path / "run2"
+    out2.mkdir()
+    spawn(_trainer_worker, 2, (2, ckpt, data, str(out2)), timeout=420)
+    second = _read(out2, 2)
+    assert [r["epochs_run"] for r in second] == [1, 1]
+    assert all(np.isfinite(r["acc"]) for r in second)
+
+
+def test_spawn_propagates_worker_failure():
+    with pytest.raises(RuntimeError, match="worker failures"):
+        spawn(_failing_worker, 2, timeout=240)

@@ -23,9 +23,8 @@ from ddp_tpu.train.config import TrainConfig
 from ddp_tpu.train.trainer import Trainer
 
 
-def main(argv=None) -> int:
-    config = TrainConfig.from_args(argv)
-    trainer = Trainer(config)
+def _run(config: TrainConfig, ctx=None) -> int:
+    trainer = Trainer(config, ctx=ctx)
     try:
         summary = trainer.train()
     finally:
@@ -35,6 +34,40 @@ def main(argv=None) -> int:
     if acc is not None and trainer.ctx.is_main:
         print(f"final_accuracy={acc:.4f}")
     return 0
+
+
+def _spawned_worker(rank: int, world_size: int, argv) -> None:
+    """Per-rank body under ``--spawn`` (the reference's ``ddp_train``).
+
+    The launcher already brought up ``jax.distributed`` for this
+    process, so the trainer reuses that context.
+    """
+    config = TrainConfig.from_args(argv)
+    _run(config, ctx=dist.current())
+
+
+def main(argv=None) -> int:
+    config = TrainConfig.from_args(argv)
+    if config.spawn > 1:
+        # Reference parity: torch.multiprocessing.spawn(ddp_train,
+        # nprocs=world_size) at train_ddp.py:222-224. Each rank gets
+        # --emulate_devices CPU devices (default 1, like one GPU/rank).
+        if config.backend == "tpu":
+            raise ValueError(
+                "--spawn emulates multi-host on CPU; it cannot combine "
+                "with --backend tpu (one process drives all local chips)"
+            )
+        from ddp_tpu.runtime.launch import spawn
+
+        spawn(
+            _spawned_worker,
+            config.spawn,
+            (sys.argv[1:] if argv is None else list(argv),),
+            devices_per_process=config.emulate_devices or 1,
+            timeout=None,  # a training run may legitimately take hours
+        )
+        return 0
+    return _run(config)
 
 
 if __name__ == "__main__":
