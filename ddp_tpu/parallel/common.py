@@ -1,0 +1,128 @@
+"""Shared train-step machinery for the DDP (shard_map) and GSPMD paths.
+
+Both `parallel.ddp` and `parallel.spmd` compile the same per-batch
+computation — preprocess, apply, softmax-xent (+ MoE aux loss), and
+optionally a `lax.scan` over gradient-accumulation microbatches — and
+differ only in how the result is reduced across the mesh (explicit
+`pmean` inside shard_map vs. GSPMD-derived collectives). The common
+pieces live here so a fix to one path cannot silently miss the other.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def _train_kwarg(model, train: bool) -> dict:
+    """``{'train': train}`` if the model's __call__ takes it, else {}.
+
+    SimpleCNN has no train/eval mode distinction (neither does the
+    reference's, model.py:18-20); the ResNet/ViT families do (BatchNorm,
+    dropout).
+    """
+    import inspect
+
+    sig = inspect.signature(type(model).__call__)
+    return {"train": train} if "train" in sig.parameters else {}
+
+
+def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
+    """ToTensor parity (data.py:13): uint8 → float / 255, nothing else.
+
+    Runs on-device inside the step so the pipeline ships uint8.
+    """
+    if images.dtype == jnp.uint8:
+        images = images.astype(compute_dtype) / jnp.asarray(255.0, compute_dtype)
+    return images.astype(compute_dtype)
+
+
+def make_loss_fn(model, compute_dtype, aux_loss_weight: float):
+    """``loss_fn(params, model_state, images, labels, rng, mutable)``.
+
+    Returns ``(loss, (logits, new_model_state))`` — mean softmax
+    cross-entropy plus the weighted MoE load-balance aux losses when
+    the model records a ``losses`` collection (models/moe.py).
+    """
+    train_kw = _train_kwarg(model, True)
+
+    def loss_fn(params, model_state, images, labels, rng, mutable):
+        x = _preprocess(images, compute_dtype)
+        if compute_dtype != jnp.float32:
+            params_c = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        else:
+            params_c = params
+        variables = {"params": params_c, **model_state}
+        if mutable:
+            logits, new_ms = model.apply(
+                variables, x, mutable=mutable, rngs={"dropout": rng}, **train_kw
+            )
+        else:
+            logits = model.apply(variables, x, rngs={"dropout": rng}, **train_kw)
+            new_ms = model_state
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), labels
+        ).mean()
+        if "losses" in mutable:
+            loss = loss + aux_loss_weight * sum(
+                jax.tree.leaves(new_ms["losses"])
+            )
+        return loss, (logits, new_ms)
+
+    return loss_fn
+
+
+def check_accum_divisible(batch: int, grad_accum_steps: int) -> int:
+    """Microbatch size, validated at trace time (shapes are static)."""
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be ≥ 1, got {grad_accum_steps}")
+    if batch % grad_accum_steps or batch < grad_accum_steps:
+        raise ValueError(
+            f"batch of {batch} not divisible into {grad_accum_steps} "
+            f"non-empty microbatches"
+        )
+    return batch // grad_accum_steps
+
+
+def grad_accum_scan(loss_fn, params, model_state, imgs, lbls, rng, mutable):
+    """Accumulate gradients over stacked microbatches ``[k, mb, ...]``.
+
+    One `lax.scan` over k microbatches: model-state (BatchNorm stats,
+    MoE aux) chains through the carry; gradients and losses average;
+    correct-prediction counts sum. Returns
+    ``(mean_grads, new_model_state, mean_loss, correct_count)``.
+    """
+    k = imgs.shape[0]
+
+    def micro(carry, xy):
+        g_acc, ms, loss_acc, correct_acc, i = carry
+        x, y = xy
+        (loss, (logits, new_ms)), g = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, ms, x, y, jax.random.fold_in(rng, i), mutable)
+        g_acc = jax.tree.map(jnp.add, g_acc, g)
+        c = (jnp.argmax(logits.astype(jnp.float32), -1) == y).sum()
+        return (
+            g_acc,
+            new_ms,
+            loss_acc + loss,
+            correct_acc + c.astype(jnp.float32),
+            i + 1,
+        ), None
+
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    (g_sum, new_ms, loss_sum, correct, _), _ = lax.scan(
+        micro,
+        (
+            zero_g,
+            model_state,
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.int32),
+        ),
+        (imgs, lbls),
+    )
+    grads = jax.tree.map(lambda g: g / k, g_sum)
+    return grads, new_ms, loss_sum / k, correct

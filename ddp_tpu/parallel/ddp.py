@@ -30,6 +30,13 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ddp_tpu.parallel.common import (
+    _preprocess,
+    _train_kwarg,
+    check_accum_divisible,
+    grad_accum_scan,
+    make_loss_fn,
+)
 from ddp_tpu.runtime.mesh import data_axes
 
 
@@ -77,29 +84,6 @@ def create_train_state(
     )
 
 
-def _train_kwarg(model, train: bool) -> dict:
-    """``{'train': train}`` if the model's __call__ takes it, else {}.
-
-    SimpleCNN has no train/eval mode distinction (neither does the
-    reference's, model.py:18-20); the ResNet/ViT families do (BatchNorm,
-    dropout).
-    """
-    import inspect
-
-    sig = inspect.signature(type(model).__call__)
-    return {"train": train} if "train" in sig.parameters else {}
-
-
-def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
-    """ToTensor parity (data.py:13): uint8 → float / 255, nothing else.
-
-    Runs on-device inside the step so the pipeline ships uint8.
-    """
-    if images.dtype == jnp.uint8:
-        images = images.astype(compute_dtype) / jnp.asarray(255.0, compute_dtype)
-    return images.astype(compute_dtype)
-
-
 def make_per_shard_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -109,14 +93,21 @@ def make_per_shard_step(
     compute_dtype=jnp.float32,
     seed: int = 0,
     aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
     Exposed separately so the compiled-epoch runner (train.fast) can
     ``lax.scan`` it without re-stating the DDP semantics.
+
+    ``grad_accum_steps=k`` splits the incoming batch into k equal
+    microbatches, accumulates their mean gradients with ``lax.scan``,
+    and applies ONE optimizer update and ONE all-reduce — how large
+    effective batches fit in HBM. The reference has no accumulation
+    (SURVEY.md §2c: one step per batch, train_ddp.py:196-200).
     """
 
-    train_kw = _train_kwarg(model, True)
+    loss_fn = make_loss_fn(model, compute_dtype, aux_loss_weight)
 
     def per_shard_step(state: TrainState, images, labels):
         mutable = list(state.model_state.keys())
@@ -127,38 +118,22 @@ def make_per_shard_step(
         for a in axes:
             rng = jax.random.fold_in(rng, lax.axis_index(a))
 
-        def loss_fn(params):
-            x = _preprocess(images, compute_dtype)
-            if compute_dtype != jnp.float32:
-                params_c = jax.tree.map(lambda p: p.astype(compute_dtype), params)
-            else:
-                params_c = params
-            variables = {"params": params_c, **state.model_state}
-            if mutable:
-                logits, new_ms = model.apply(
-                    variables,
-                    x,
-                    mutable=mutable,
-                    rngs={"dropout": rng},
-                    **train_kw,
-                )
-            else:
-                logits = model.apply(
-                    variables, x, rngs={"dropout": rng}, **train_kw
-                )
-                new_ms = state.model_state
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), labels
-            ).mean()
-            if "losses" in mutable:  # MoE load-balance aux (models/moe.py)
-                loss = loss + aux_loss_weight * sum(
-                    jax.tree.leaves(new_ms["losses"])
-                )
-            return loss, (logits, new_ms)
-
-        (loss, (logits, new_ms)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        if grad_accum_steps == 1:
+            (loss, (logits, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.model_state, images, labels, rng, mutable)
+            correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).sum()
+            n_labels = labels.shape[0]
+        else:
+            mb = check_accum_divisible(images.shape[0], grad_accum_steps)
+            # Contiguous per-shard microbatches: data is already local
+            # to this device inside shard_map, so no comm is implied.
+            imgs = images.reshape(grad_accum_steps, mb, *images.shape[1:])
+            lbls = labels.reshape(grad_accum_steps, mb)
+            grads, new_ms, loss, correct = grad_accum_scan(
+                loss_fn, state.params, state.model_state, imgs, lbls, rng, mutable
+            )
+            n_labels = images.shape[0]
         # THE all-reduce: the entire job of DDP's C++ reducer
         # (SURVEY.md §2b N4) is this one line. pmean = psum / world.
         grads = lax.pmean(grads, axes)
@@ -172,11 +147,9 @@ def make_per_shard_step(
         )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        logits = logits.astype(jnp.float32)
-        correct = (jnp.argmax(logits, -1) == labels).sum()
         metrics = StepMetrics(
             loss=lax.pmean(loss, axes),
-            accuracy=lax.psum(correct, axes) / (labels.shape[0] * world),
+            accuracy=lax.psum(correct, axes) / (n_labels * world),
         )
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 
@@ -192,6 +165,7 @@ def make_train_step(
     donate: bool = True,
     seed: int = 0,
     aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -208,6 +182,7 @@ def make_train_step(
         model, optimizer, axes, _world(mesh, axes),
         compute_dtype=compute_dtype, seed=seed,
         aux_loss_weight=aux_loss_weight,
+        grad_accum_steps=grad_accum_steps,
     )
     sharded = jax.shard_map(
         per_shard_step,

@@ -39,7 +39,14 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ddp_tpu.parallel.ddp import StepMetrics, TrainState, _train_kwarg, _preprocess
+from ddp_tpu.parallel.common import (
+    _preprocess,
+    _train_kwarg,
+    check_accum_divisible,
+    grad_accum_scan,
+    make_loss_fn,
+)
+from ddp_tpu.parallel.ddp import StepMetrics, TrainState
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,6 +186,7 @@ def make_spmd_train_step(
     donate: bool = True,
     seed: int = 0,
     aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
 
@@ -188,10 +196,12 @@ def make_spmd_train_step(
     ``data`` is *implied* — params have no ``data`` axis in their
     specs, so XLA partial-sums their grads across it, exactly the DDP
     reducer's contract (SURVEY.md §2b N4) derived rather than written.
+    ``grad_accum_steps=k`` accumulates k microbatch gradients
+    (``lax.scan``) into one update, like the DDP path.
     """
     rules = rules or ShardingRules()
     bspec = batch_spec(mesh)
-    train_kw = _train_kwarg(model, True)
+    loss_fn = make_loss_fn(model, compute_dtype, aux_loss_weight)
 
     def step(state: TrainState, images, labels):
         images = lax.with_sharding_constraint(images, NamedSharding(mesh, bspec))
@@ -199,33 +209,36 @@ def make_spmd_train_step(
         mutable = list(state.model_state.keys())
         rng = jax.random.fold_in(jax.random.key(seed), state.step)
 
-        def loss_fn(params):
-            x = _preprocess(images, compute_dtype)
-            params_c = (
-                jax.tree.map(lambda p: p.astype(compute_dtype), params)
-                if compute_dtype != jnp.float32
-                else params
+        if grad_accum_steps == 1:
+            (loss, (logits, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.model_state, images, labels, rng, mutable)
+            correct = (
+                jnp.argmax(logits.astype(jnp.float32), -1) == labels
+            ).mean()
+        else:
+            mb = check_accum_divisible(images.shape[0], grad_accum_steps)
+            # STRIDED microbatches (micro i = rows i::k): with the batch
+            # contiguously sharded over the data axes, every device
+            # keeps its own rows in every microbatch — a contiguous
+            # [k, mb] split would instead reshard the whole batch
+            # across devices each step. Semantics are identical (the
+            # gradient is the mean over the full batch either way).
+            mspec = P(None, *bspec)  # microbatch dim leading
+            imgs = lax.with_sharding_constraint(
+                images.reshape(mb, grad_accum_steps, *images.shape[1:])
+                .swapaxes(0, 1),
+                NamedSharding(mesh, mspec),
             )
-            variables = {"params": params_c, **state.model_state}
-            if mutable:
-                logits, new_ms = model.apply(
-                    variables, x, mutable=mutable, rngs={"dropout": rng}, **train_kw
-                )
-            else:
-                logits = model.apply(variables, x, rngs={"dropout": rng}, **train_kw)
-                new_ms = state.model_state
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), labels
-            ).mean()  # global mean: the batch is one logical array
-            if "losses" in mutable:  # MoE load-balance aux (models/moe.py)
-                loss = loss + aux_loss_weight * sum(
-                    jax.tree.leaves(new_ms["losses"])
-                )
-            return loss, (logits, new_ms)
+            lbls = lax.with_sharding_constraint(
+                labels.reshape(mb, grad_accum_steps).swapaxes(0, 1),
+                NamedSharding(mesh, mspec),
+            )
+            grads, new_ms, loss, count = grad_accum_scan(
+                loss_fn, state.params, state.model_state, imgs, lbls, rng, mutable
+            )
+            correct = count / images.shape[0]
 
-        (loss, (logits, new_ms)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         grads = constrain_tree(grads, mesh, rules)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -233,7 +246,6 @@ def make_spmd_train_step(
         params = constrain_tree(
             optax.apply_updates(state.params, updates), mesh, rules
         )
-        correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
         metrics = StepMetrics(loss=loss, accuracy=correct)
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 

@@ -41,6 +41,7 @@ class TrainConfig:
     warmup_steps: int = 0
     decay_steps: int = 0  # >0 enables cosine decay to this many steps
     grad_clip_norm: float = 0.0
+    grad_accum_steps: int = 1  # microbatches accumulated per update
     backend: str | None = None  # None = auto (tpu if present else cpu)
     num_devices: int = -1  # devices on the data axis; -1 = all
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
@@ -81,6 +82,9 @@ class TrainConfig:
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps)
         p.add_argument("--decay_steps", type=int, default=cls.decay_steps)
         p.add_argument("--grad_clip_norm", type=float, default=cls.grad_clip_norm)
+        p.add_argument(
+            "--grad_accum_steps", type=int, default=cls.grad_accum_steps
+        )
         p.add_argument("--backend", default=None, choices=(None, "tpu", "cpu"))
         p.add_argument("--num_devices", type=int, default=cls.num_devices)
         p.add_argument("--emulate_devices", type=int, default=None)

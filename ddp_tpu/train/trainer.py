@@ -70,7 +70,11 @@ class Trainer:
         self.data_shards = int(
             np.prod([self.mesh.shape[a] for a in data_axes(self.mesh)])
         )
-        self.global_batch_size = config.batch_size * self.data_shards
+        # With accumulation the loader delivers k microbatches' worth at
+        # once; the step splits them and applies one update.
+        self.global_batch_size = (
+            config.batch_size * self.data_shards * config.grad_accum_steps
+        )
 
         from ddp_tpu.data.registry import NUM_CLASSES
         from ddp_tpu.train.optim import make_optimizer
@@ -110,6 +114,7 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.optimizer, self.mesh,
             compute_dtype=compute_dtype, seed=config.seed,
+            grad_accum_steps=config.grad_accum_steps,
         )
         self.eval_step = make_eval_step(
             self.model, self.mesh, compute_dtype=compute_dtype
@@ -232,7 +237,10 @@ class Trainer:
         split.
         """
         images, labels = self.test_split
-        bs = self.global_batch_size
+        # Accumulation exists to keep the per-forward footprint at
+        # batch_size×shards — eval must not undo that by running one
+        # k×-sized forward.
+        bs = self.config.batch_size * self.data_shards
         n = len(images)
         if n == 0:
             return float("nan"), float("nan")
