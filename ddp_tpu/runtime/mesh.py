@@ -52,6 +52,11 @@ class MeshSpec:
 
     def resolve(self, num_devices: int) -> dict[str, int]:
         sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        bad = {a: s for a, s in sizes.items() if s < 1 and s != -1}
+        if bad:
+            raise ValueError(
+                f"mesh axis sizes must be ≥ 1 (or -1 for 'the rest'): {bad}"
+            )
         unknown = [a for a, s in sizes.items() if s == -1]
         if len(unknown) > 1:
             raise ValueError(f"at most one -1 axis, got {unknown}")

@@ -34,6 +34,7 @@ class TrainConfig:
 
     # Framework knobs (no reference analogue)
     model: str = "simple_cnn"
+    model_depth: int | None = None  # None = family default (e.g. ViT 12)
     dataset: str = "mnist"
     num_classes: int | None = None  # None = infer from dataset
     optimizer: str = "sgd"  # sgd | adam | adamw
@@ -44,6 +45,12 @@ class TrainConfig:
     grad_accum_steps: int = 1  # microbatches accumulated per update
     backend: str | None = None  # None = auto (tpu if present else cpu)
     num_devices: int = -1  # devices on the data axis; -1 = all
+    # Mesh geometry past pure DDP (runtime/mesh.py axis vocabulary).
+    # Any axis > 1 switches the trainer to the GSPMD step
+    # (parallel/spmd.py): tensor / ZeRO-style / expert parallelism.
+    mesh_model: int = 1  # tensor parallelism
+    mesh_fsdp: int = 1  # parameter+optimizer sharding
+    mesh_expert: int = 1  # MoE expert parallelism
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     eval_every: int = 1  # epochs between test-split evals (0 = only final)
@@ -51,6 +58,7 @@ class TrainConfig:
     synthetic_data: bool = False  # offline fallback dataset
     synthetic_size: int | None = None
     profile_dir: str | None = None  # jax.profiler trace output
+    metrics_file: str | None = None  # JSONL metrics from process 0
 
     # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
     # + env:// rendezvous at utils.py:7-11)
@@ -73,6 +81,7 @@ class TrainConfig:
         p.add_argument("--no_shuffle", action="store_true")
         p.add_argument("--num_workers", type=int, default=cls.num_workers)
         p.add_argument("--model", default=cls.model)
+        p.add_argument("--model_depth", type=int, default=None)
         p.add_argument("--dataset", default=cls.dataset)
         p.add_argument("--num_classes", type=int, default=None)
         p.add_argument(
@@ -87,6 +96,9 @@ class TrainConfig:
         )
         p.add_argument("--backend", default=None, choices=(None, "tpu", "cpu"))
         p.add_argument("--num_devices", type=int, default=cls.num_devices)
+        p.add_argument("--mesh_model", type=int, default=cls.mesh_model)
+        p.add_argument("--mesh_fsdp", type=int, default=cls.mesh_fsdp)
+        p.add_argument("--mesh_expert", type=int, default=cls.mesh_expert)
         p.add_argument("--emulate_devices", type=int, default=None)
         p.add_argument(
             "--compute_dtype", default=cls.compute_dtype,
@@ -97,6 +109,7 @@ class TrainConfig:
         p.add_argument("--synthetic_data", action="store_true")
         p.add_argument("--synthetic_size", type=int, default=None)
         p.add_argument("--profile_dir", default=None)
+        p.add_argument("--metrics_file", default=None)
         p.add_argument("--spawn", type=int, default=cls.spawn)
         p.add_argument("--coordinator_address", default=None)
         p.add_argument("--num_processes", type=int, default=None)

@@ -66,7 +66,23 @@ class Trainer:
         devices = jax.devices()
         if config.num_devices > 0:
             devices = devices[: config.num_devices]
-        self.mesh = make_mesh(MeshSpec(data=len(devices)), devices=devices)
+        # Any non-data axis > 1 switches to the GSPMD step — tensor/
+        # fsdp/expert sharding by annotation (parallel/spmd.py). A pure
+        # data mesh keeps the explicit shard_map DDP step.
+        self.use_spmd = (
+            config.mesh_model > 1
+            or config.mesh_fsdp > 1
+            or config.mesh_expert > 1
+        )
+        self.mesh = make_mesh(
+            MeshSpec(
+                data=-1,
+                model=config.mesh_model,
+                fsdp=config.mesh_fsdp,
+                expert=config.mesh_expert,
+            ),
+            devices=devices,
+        )
         self.data_shards = int(
             np.prod([self.mesh.shape[a] for a in data_axes(self.mesh)])
         )
@@ -79,9 +95,13 @@ class Trainer:
         from ddp_tpu.data.registry import NUM_CLASSES
         from ddp_tpu.train.optim import make_optimizer
 
+        model_kw = {}
+        if config.model_depth is not None:
+            model_kw["depth"] = config.model_depth
         self.model = get_model(
             config.model,
             num_classes=config.num_classes or NUM_CLASSES.get(config.dataset, 10),
+            **model_kw,
         )
         self.optimizer = make_optimizer(
             config.optimizer,
@@ -111,24 +131,48 @@ class Trainer:
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
-        self.train_step = make_train_step(
-            self.model, self.optimizer, self.mesh,
-            compute_dtype=compute_dtype, seed=config.seed,
-            grad_accum_steps=config.grad_accum_steps,
-        )
-        self.eval_step = make_eval_step(
-            self.model, self.mesh, compute_dtype=compute_dtype
-        )
-
         sample = jnp.zeros(
             (1, *train_split.images.shape[1:]), jnp.float32
         )
-        state = create_train_state(
-            self.model, self.optimizer, sample, seed=config.seed
-        )
-        self.state = replicate_state(state, self.mesh)
+        if self.use_spmd:
+            from ddp_tpu.parallel.spmd import (
+                create_spmd_state,
+                make_spmd_eval_step,
+                make_spmd_train_step,
+            )
+
+            self.train_step = make_spmd_train_step(
+                self.model, self.optimizer, self.mesh,
+                compute_dtype=compute_dtype, seed=config.seed,
+                grad_accum_steps=config.grad_accum_steps,
+            )
+            self.eval_step = make_spmd_eval_step(
+                self.model, self.mesh, compute_dtype=compute_dtype
+            )
+            self.state = create_spmd_state(
+                self.model, self.optimizer, sample, self.mesh,
+                seed=config.seed,
+            )
+        else:
+            self.train_step = make_train_step(
+                self.model, self.optimizer, self.mesh,
+                compute_dtype=compute_dtype, seed=config.seed,
+                grad_accum_steps=config.grad_accum_steps,
+            )
+            self.eval_step = make_eval_step(
+                self.model, self.mesh, compute_dtype=compute_dtype
+            )
+            state = create_train_state(
+                self.model, self.optimizer, sample, seed=config.seed
+            )
+            self.state = replicate_state(state, self.mesh)
         self.ckpt = CheckpointManager(
             config.checkpoint_dir, max_to_keep=config.max_checkpoints
+        )
+        from ddp_tpu.utils.metrics import MetricsWriter
+
+        self.metrics_writer = MetricsWriter(
+            config.metrics_file, enabled=self.ctx.is_main
         )
         self.history: list[EpochStats] = []
 
@@ -169,6 +213,10 @@ class Trainer:
         # reuse the last per-epoch eval rather than re-running it
         final_acc, final_loss = last_eval or self.evaluate()
         logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
+        self.metrics_writer.write(
+            "final", accuracy=final_acc, loss=final_loss,
+            epochs_run=len(self.history),
+        )
         return {
             "epochs_run": len(self.history),
             "final_accuracy": final_acc,
@@ -207,6 +255,13 @@ class Trainer:
                 logger.info(
                     "Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss
                 )
+                self.metrics_writer.write(
+                    "step",
+                    epoch=epoch,
+                    batch=batch_idx,
+                    step=int(self.state.step),
+                    loss=loss,
+                )
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
         seconds = time.perf_counter() - t0
@@ -223,6 +278,14 @@ class Trainer:
             n_batches,
             seconds,
             stats.images_per_sec,
+        )
+        self.metrics_writer.write(
+            "epoch",
+            epoch=epoch,
+            batches=n_batches,
+            seconds=round(seconds, 3),
+            images_per_sec=round(stats.images_per_sec, 1),
+            mean_loss=stats.mean_loss,
         )
         return stats
 
@@ -273,3 +336,4 @@ class Trainer:
     def close(self) -> None:
         self.loader.close()
         self.ckpt.close()
+        self.metrics_writer.close()

@@ -1,0 +1,50 @@
+"""Structured run metrics — the observability the reference lacks.
+
+The reference's only observability is rank-gated prints
+(train_ddp.py:201-202 and lifecycle lines; SURVEY.md §5 calls the
+subsystem "print-only"). This writer emits machine-readable JSONL from
+process 0: one record per logged step and per epoch, each stamped with
+wall time, so throughput and loss curves can be plotted or asserted on
+without scraping logs. Pair with ``--profile_dir`` (jax.profiler,
+Perfetto/TensorBoard traces) for kernel-level views.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, IO
+
+
+class MetricsWriter:
+    """Append-only JSONL metrics stream; no-op when disabled."""
+
+    def __init__(self, path: str | None, *, enabled: bool = True):
+        self._f: IO[str] | None = None
+        if path and enabled:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "a", buffering=1)  # line-buffered
+
+    def write(self, kind: str, **fields: Any) -> None:
+        if self._f is None:
+            return
+        rec = {"kind": kind, "time": round(time.time(), 3), **fields}
+        # Strict JSON: NaN/Infinity (e.g. diverged loss, empty-epoch
+        # mean) serialize as null, not the bare `NaN` jq/JSON.parse
+        # reject — divergence is precisely when the stream gets read.
+        rec = {
+            k: (
+                None
+                if isinstance(v, float) and not math.isfinite(v)
+                else v
+            )
+            for k, v in rec.items()
+        }
+        self._f.write(json.dumps(rec, allow_nan=False) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -1,0 +1,51 @@
+"""JSONL metrics stream (SURVEY.md §5: reference observability is
+print-only; this subsystem replaces scraping with structured records)."""
+
+import json
+
+import numpy as np
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+from ddp_tpu.utils.metrics import MetricsWriter
+
+
+def test_writer_disabled_is_noop(tmp_path):
+    w = MetricsWriter(str(tmp_path / "m.jsonl"), enabled=False)
+    w.write("step", loss=1.0)
+    w.close()
+    assert not (tmp_path / "m.jsonl").exists()
+
+
+def test_writer_none_path_is_noop():
+    w = MetricsWriter(None)
+    w.write("step", loss=1.0)
+    w.close()
+
+
+def test_trainer_emits_step_epoch_final_records(tmp_path):
+    metrics_path = tmp_path / "metrics.jsonl"
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=8,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=512,
+        log_interval=4,
+        eval_every=0,
+        metrics_file=str(metrics_path),
+    )
+    t = Trainer(cfg)
+    t.train()
+    t.close()
+
+    records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    kinds = {r["kind"] for r in records}
+    assert kinds == {"step", "epoch", "final"}
+    steps = [r for r in records if r["kind"] == "step"]
+    assert all(np.isfinite(r["loss"]) for r in steps)
+    epoch = next(r for r in records if r["kind"] == "epoch")
+    assert epoch["images_per_sec"] > 0
+    final = next(r for r in records if r["kind"] == "final")
+    assert final["epochs_run"] == 1
