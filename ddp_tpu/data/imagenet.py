@@ -1,0 +1,85 @@
+"""ImageNet-1k ingestion: preprocessed-array loader + synthetic fallback.
+
+BASELINE.json config 5 scales the reference's pipeline shape
+(/root/reference/data.py) to ImageNet ResNet-50. Full JPEG decode is a
+preprocessing concern, not a training-loop one — the TPU-efficient
+layout is the dataset as contiguous uint8 NHWC arrays, memory-mapped so
+the loader's gather (and the native prefetch pool) reads pages on
+demand instead of resident-loading 150 GB. This module therefore:
+
+- loads ``{split}_images.npy`` / ``{split}_labels.npy`` from ``root``
+  (written once by any offline preprocessing job; ``np.load(...,
+  mmap_mode='r')`` keeps the working set at the touched pages);
+- else, when explicitly allowed, generates a deterministic synthetic
+  set with ImageNet's exact shapes/dtypes ([N, 224, 224, 3] uint8,
+  1000 classes) — class-conditional interference patterns, separable
+  enough for convergence smoke tests.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ddp_tpu.data.mnist import Split
+
+IMAGE_SIZE = 224
+NUM_CLASSES = 1000
+
+
+def synthetic(
+    num: int,
+    *,
+    seed: int = 0,
+    num_classes: int = NUM_CLASSES,
+    side: int = IMAGE_SIZE,
+) -> Split:
+    """Deterministic ImageNet-shaped synthetic data.
+
+    Per-class plane-wave interference patterns (frequency/phase keyed by
+    the label) plus noise — no per-class template bank, so memory stays
+    O(batch) even with 1000 classes.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=num).astype(np.int32)
+    yy, xx = np.mgrid[0:side, 0:side].astype(np.float32) / side
+    fx = (1 + labels % 13).astype(np.float32)[:, None, None]
+    fy = (1 + (labels // 13) % 11).astype(np.float32)[:, None, None]
+    phase = (labels * 2.618).astype(np.float32)[:, None, None]
+    base = np.sin(fx * np.pi * xx[None] + phase) * np.cos(fy * np.pi * yy[None])
+    channels = [base, np.roll(base, side // 7, axis=1), -base]
+    img = np.stack(channels, axis=-1) * 90.0 + 128.0
+    img += rng.normal(0.0, 12.0, size=img.shape).astype(np.float32)
+    return Split(np.clip(img, 0, 255).astype(np.uint8), labels)
+
+
+def load(
+    root: str = "./data",
+    split: str = "train",
+    *,
+    allow_synthetic: bool = False,
+    synthetic_size: int | None = None,
+) -> Split:
+    """Load a split as (uint8 NHWC images, int32 labels), mmap-backed."""
+    img_path = os.path.join(root, f"imagenet_{split}_images.npy")
+    lbl_path = os.path.join(root, f"imagenet_{split}_labels.npy")
+    if os.path.exists(img_path) and os.path.exists(lbl_path):
+        images = np.load(img_path, mmap_mode="r")
+        labels = np.asarray(np.load(lbl_path)).astype(np.int32)
+        if images.ndim != 4 or images.dtype != np.uint8:
+            raise ValueError(
+                f"{img_path}: expected uint8 [N, H, W, C], got "
+                f"{images.dtype} {images.shape}"
+            )
+        if len(images) != len(labels):
+            raise ValueError("image/label count mismatch")
+        return Split(images, labels)
+    if not allow_synthetic:
+        raise RuntimeError(
+            f"no preprocessed ImageNet arrays under {root!r} "
+            f"(need {img_path}); pass allow_synthetic to use the "
+            f"deterministic synthetic stand-in"
+        )
+    n = synthetic_size or (4096 if split == "train" else 1024)
+    return synthetic(n, seed=0 if split == "train" else 1)

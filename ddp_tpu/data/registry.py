@@ -74,4 +74,21 @@ register("cifar10")(_cifar("cifar10"))
 register("cifar100")(_cifar("cifar100"))
 
 
+@register("imagenet")
+def _imagenet(root, *, allow_synthetic, synthetic_size):
+    from ddp_tpu.data import imagenet
+
+    train = imagenet.load(
+        root, "train", allow_synthetic=allow_synthetic,
+        synthetic_size=synthetic_size,
+    )
+    test = imagenet.load(
+        root,
+        "test",
+        allow_synthetic=allow_synthetic,
+        synthetic_size=(max(1, synthetic_size // 4) if synthetic_size else None),
+    )
+    return train, test
+
+
 NUM_CLASSES = {"mnist": 10, "cifar10": 10, "cifar100": 100, "imagenet": 1000}

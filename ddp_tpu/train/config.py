@@ -59,6 +59,11 @@ class TrainConfig:
     synthetic_size: int | None = None
     profile_dir: str | None = None  # jax.profiler trace output
     metrics_file: str | None = None  # JSONL metrics from process 0
+    # Abort the process when no step completes for this many seconds
+    # (0 = off). Converts a hung collective into a crash the launcher
+    # detects, so restart+resume can recover. Set generously above the
+    # first-step compile time.
+    watchdog_timeout: float = 0.0
 
     # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
     # + env:// rendezvous at utils.py:7-11)
@@ -110,6 +115,9 @@ class TrainConfig:
         p.add_argument("--synthetic_size", type=int, default=None)
         p.add_argument("--profile_dir", default=None)
         p.add_argument("--metrics_file", default=None)
+        p.add_argument(
+            "--watchdog_timeout", type=float, default=cls.watchdog_timeout
+        )
         p.add_argument("--spawn", type=int, default=cls.spawn)
         p.add_argument("--coordinator_address", default=None)
         p.add_argument("--num_processes", type=int, default=None)

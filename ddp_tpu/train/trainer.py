@@ -39,6 +39,8 @@ from ddp_tpu.runtime.mesh import MeshSpec, data_axes, make_mesh
 from ddp_tpu.train.checkpoint import CheckpointManager
 from ddp_tpu.train.config import TrainConfig
 from ddp_tpu.utils.logging import setup_logging
+from ddp_tpu.utils.metrics import MetricsWriter
+from ddp_tpu.utils.watchdog import StepWatchdog
 
 logger = logging.getLogger("ddp_tpu")
 
@@ -169,11 +171,11 @@ class Trainer:
         self.ckpt = CheckpointManager(
             config.checkpoint_dir, max_to_keep=config.max_checkpoints
         )
-        from ddp_tpu.utils.metrics import MetricsWriter
-
         self.metrics_writer = MetricsWriter(
             config.metrics_file, enabled=self.ctx.is_main
         )
+        # Constructed here, armed in train() (start/stop bracket the run).
+        self._watchdog = StepWatchdog(config.watchdog_timeout)
         self.history: list[EpochStats] = []
 
     # ---- the reference's epoch/batch loop (train_ddp.py:192-209) ----
@@ -191,6 +193,7 @@ class Trainer:
         if cfg.profile_dir and self.ctx.is_main:
             jax.profiler.start_trace(cfg.profile_dir)
             profiling = True
+        self._watchdog.start()
         last_eval: tuple[float, float] | None = None
         try:
             for epoch in range(start_epoch, cfg.epochs):
@@ -207,6 +210,7 @@ class Trainer:
                 else:
                     last_eval = None
         finally:
+            self._watchdog.stop()
             if profiling:
                 jax.profiler.stop_trace()
             self.ckpt.wait()
@@ -247,6 +251,10 @@ class Trainer:
             inflight.append(metrics.loss)
             if len(inflight) > self.MAX_INFLIGHT_STEPS:
                 jax.block_until_ready(inflight.popleft())
+            # Progress beat AFTER the bounded sync above: a hung
+            # collective stalls that block_until_ready, beats stop,
+            # and the watchdog converts the hang into a crash.
+            self._watchdog.beat()
             if batch_idx % cfg.log_interval == 0:
                 # train_ddp.py:201-202 parity: rank-0 loss print. .item()
                 # syncs, so only at the log cadence.
@@ -331,6 +339,9 @@ class Trainer:
             )
             correct_total += float(c)
             loss_total += float(l)
+            # Eval progress counts as progress — a slow (healthy) eval
+            # must not trip the hang detector.
+            self._watchdog.beat()
         return correct_total / n, loss_total / n
 
     def close(self) -> None:

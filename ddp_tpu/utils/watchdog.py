@@ -1,0 +1,100 @@
+"""Hang detection: turn a stuck collective into a crash.
+
+The reference has no failure detection at all (SURVEY.md §5): a dead
+rank leaves every peer blocked in the next all-reduce forever, and
+nothing restarts the job. The framework's recovery model is
+fail-fast + auto-resume (launcher kills stragglers and reports the
+failed rank, runtime/launch.py; checkpoints restore on re-run,
+train/checkpoint.py) — which only works if a hang *becomes* a failure.
+This watchdog supplies that conversion: a monitor thread observes
+per-step progress beats and, when none arrives within the timeout,
+runs the abort action (default: log loudly and ``os._exit``) so the
+launcher/orchestrator sees a dead process instead of a silent stall.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable
+
+logger = logging.getLogger("ddp_tpu")
+
+
+def _default_abort(seconds: float) -> None:
+    logger.error(
+        "watchdog: no training progress for %.0fs — aborting so the "
+        "launcher can detect the hang and a restart can resume from "
+        "the latest checkpoint",
+        seconds,
+    )
+    # sys.exit only raises in this thread; a hung main thread never
+    # sees it. _exit is the point: make the process observably dead.
+    os._exit(124)
+
+
+class StepWatchdog:
+    """Monitor thread that aborts when ``beat()`` stops arriving.
+
+    Usage::
+
+        wd = StepWatchdog(timeout=300.0)
+        wd.start()
+        for batch in loader:
+            step(...)
+            wd.beat()
+        wd.stop()
+
+    ``timeout <= 0`` disables everything (all methods are no-ops), so
+    callers can wire it unconditionally from config.
+    """
+
+    def __init__(
+        self,
+        timeout: float,
+        *,
+        on_timeout: Callable[[float], None] = _default_abort,
+        poll_interval: float | None = None,
+    ):
+        self.timeout = timeout
+        self._on_timeout = on_timeout
+        self._poll = poll_interval or max(0.1, min(timeout / 4, 10.0)) if timeout > 0 else 1.0
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self.timeout <= 0 or self._thread is not None:
+            return
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ddp-tpu-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout:
+                self._on_timeout(idle)
+                return

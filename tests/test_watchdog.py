@@ -1,0 +1,54 @@
+"""Hang detection (SURVEY.md §5: the reference hangs forever on a dead
+rank; the watchdog turns that into a crash the launcher reports)."""
+
+import threading
+import time
+
+import pytest
+
+from ddp_tpu.runtime.launch import spawn
+from ddp_tpu.utils.watchdog import StepWatchdog
+
+pytestmark = pytest.mark.multihost
+
+
+def test_fires_when_beats_stop():
+    fired = threading.Event()
+    wd = StepWatchdog(
+        0.3, on_timeout=lambda idle: fired.set(), poll_interval=0.05
+    )
+    with wd:
+        assert fired.wait(3.0)
+
+
+def test_does_not_fire_while_beating():
+    fired = threading.Event()
+    wd = StepWatchdog(
+        0.4, on_timeout=lambda idle: fired.set(), poll_interval=0.05
+    )
+    with wd:
+        for _ in range(10):
+            time.sleep(0.1)
+            wd.beat()
+        assert not fired.is_set()
+
+
+def test_disabled_is_noop():
+    wd = StepWatchdog(0.0, on_timeout=lambda idle: pytest.fail("fired"))
+    wd.start()
+    assert wd._thread is None
+    wd.beat()
+    wd.stop()
+
+
+def _hung_worker(rank, world):
+    wd = StepWatchdog(0.5, poll_interval=0.1)  # default abort: os._exit(124)
+    wd.start()
+    time.sleep(60)  # simulate a rank stuck in a collective
+
+
+def test_hung_worker_becomes_launcher_failure():
+    """Dead-rank contract end-to-end: hang → watchdog abort(124) →
+    launcher reports the failed rank instead of waiting forever."""
+    with pytest.raises(RuntimeError, match="124"):
+        spawn(_hung_worker, 2, timeout=120)
