@@ -44,14 +44,28 @@ def synthetic(
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=num).astype(np.int32)
     yy, xx = np.mgrid[0:side, 0:side].astype(np.float32) / side
-    fx = (1 + labels % 13).astype(np.float32)[:, None, None]
-    fy = (1 + (labels // 13) % 11).astype(np.float32)[:, None, None]
-    phase = (labels * 2.618).astype(np.float32)[:, None, None]
-    base = np.sin(fx * np.pi * xx[None] + phase) * np.cos(fy * np.pi * yy[None])
-    channels = [base, np.roll(base, side // 7, axis=1), -base]
-    img = np.stack(channels, axis=-1) * 90.0 + 128.0
-    img += rng.normal(0.0, 12.0, size=img.shape).astype(np.float32)
-    return Split(np.clip(img, 0, 255).astype(np.uint8), labels)
+    out = np.empty((num, side, side, 3), np.uint8)
+    # Chunked, float32-only generation: peak temp memory stays at
+    # O(chunk) instead of ~10× the final uint8 array (rng.normal's
+    # float64 output alone would double the dataset size).
+    chunk = 256
+    for lo in range(0, num, chunk):
+        lab = labels[lo : lo + chunk]
+        fx = (1 + lab % 13).astype(np.float32)[:, None, None]
+        fy = (1 + (lab // 13) % 11).astype(np.float32)[:, None, None]
+        phase = (lab * 2.618).astype(np.float32)[:, None, None]
+        base = np.sin(fx * np.pi * xx[None] + phase) * np.cos(
+            fy * np.pi * yy[None]
+        )
+        img = np.stack(
+            [base, np.roll(base, side // 7, axis=1), -base], axis=-1
+        )
+        img *= 90.0
+        img += 128.0
+        img += 12.0 * rng.standard_normal(size=img.shape, dtype=np.float32)
+        np.clip(img, 0, 255, out=img)
+        out[lo : lo + chunk] = img.astype(np.uint8)
+    return Split(out, labels)
 
 
 def load(

@@ -1,0 +1,262 @@
+"""Pipeline-parallel ViT: a real model through the GPipe schedule.
+
+The reference has no pipeline parallelism (SURVEY.md §2c); the
+framework's schedule (parallel/pipeline.py) needs *same-shaped* stage
+programs, which transformers provide naturally: the patch-embed front
+and the LN+head back run data-parallel outside the pipeline, and the
+uniform encoder-block stack is cut into S stages of ``depth_per_stage``
+blocks each, parameters stacked on a leading stage dim sharded over
+``pipe``. Composes with ``data``: the batch shards across the data
+axis while activations ride the pipe ring, and the whole train step —
+embed → pipeline → head → loss → grad → update — is one jitted,
+differentiable program (the backward schedule is the scan/ppermute
+transpose, derived by AD).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.models.vit import AttentionFn, EncoderBlock
+from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.parallel.ddp import StepMetrics
+from ddp_tpu.parallel.common import _preprocess
+from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
+
+
+class PipeViTConfig(NamedTuple):
+    num_classes: int = 10
+    patch_size: int = 4
+    embed_dim: int = 64
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    num_stages: int = 4
+    depth_per_stage: int = 1
+    num_microbatches: int = 4
+    attention_fn: AttentionFn = dot_product_attention
+
+
+class PatchEmbed(nn.Module):
+    """Patch projection + learned position embedding (no cls token —
+    the pipeline keeps stages shape-uniform; the head mean-pools)."""
+
+    embed_dim: int
+    patch_size: int
+
+    @nn.compact
+    def __call__(self, x):
+        p = self.patch_size
+        x = nn.Conv(
+            self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+            name="proj",
+        )(x)
+        B = x.shape[0]
+        x = x.reshape(B, -1, self.embed_dim)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], self.embed_dim),
+        )
+        return x + pos.astype(x.dtype)
+
+
+class StageBlocks(nn.Module):
+    """One pipeline stage: ``depth`` encoder blocks, shape-preserving."""
+
+    depth: int
+    num_heads: int
+    mlp_dim: int
+    attention_fn: AttentionFn = dot_product_attention
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_dim,
+                attention_fn=self.attention_fn,
+                name=f"block{i + 1}",
+            )(x, deterministic=True)
+        return x
+
+
+class PipeHead(nn.Module):
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln")(x)
+        return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(
+            x.mean(axis=1)
+        )
+
+
+class PipeViTParams(NamedTuple):
+    embed: Any
+    stages: Any  # stacked: leading dim num_stages, sharded on 'pipe'
+    head: Any
+
+
+class PipeViTState(NamedTuple):
+    step: jax.Array
+    params: PipeViTParams
+    opt_state: Any
+
+
+def _modules(cfg: PipeViTConfig):
+    embed = PatchEmbed(embed_dim=cfg.embed_dim, patch_size=cfg.patch_size)
+    stage = StageBlocks(
+        depth=cfg.depth_per_stage,
+        num_heads=cfg.num_heads,
+        mlp_dim=cfg.embed_dim * cfg.mlp_ratio,
+        attention_fn=cfg.attention_fn,
+    )
+    head = PipeHead(num_classes=cfg.num_classes)
+    return embed, stage, head
+
+
+def init_pipe_vit(
+    cfg: PipeViTConfig, sample_input, *, seed: int = 0
+) -> PipeViTParams:
+    """Initialize all segments; stage s seeded by fold_in(seed, s)."""
+    embed, stage, head = _modules(cfg)
+    k = jax.random.key(seed)
+    embed_p = embed.init(k, sample_input)["params"]
+    feats = embed.apply({"params": embed_p}, sample_input)
+    stage_ps = [
+        stage.init(jax.random.fold_in(k, 1 + s), feats)["params"]
+        for s in range(cfg.num_stages)
+    ]
+    head_p = head.init(jax.random.fold_in(k, 0), feats)["params"]
+    return PipeViTParams(embed_p, stack_stage_params(stage_ps), head_p)
+
+
+def sequential_apply(cfg: PipeViTConfig, params: PipeViTParams, images):
+    """Reference forward without the pipeline — same math, one device."""
+    embed, stage, head = _modules(cfg)
+    x = embed.apply({"params": params.embed}, images)
+    for s in range(cfg.num_stages):
+        stage_p = jax.tree.map(lambda p: p[s], params.stages)
+        x = stage.apply({"params": stage_p}, x)
+    return head.apply({"params": params.head}, x)
+
+
+def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
+    """Jitted pipelined ``apply(params, images) -> logits``.
+
+    Batch shards over the mesh's ``data`` axis (if present) and
+    microbatches stream over ``pipe``. Differentiable end to end.
+    """
+    embed, stage, head = _modules(cfg)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P()
+    mbspec = P(None, "data") if has_data else P()
+
+    def stage_fn(p, x):
+        return stage.apply({"params": p}, x)
+
+    def apply_fn(params: PipeViTParams, images):
+        images = lax.with_sharding_constraint(
+            images, NamedSharding(mesh, bspec)
+        )
+        feats = embed.apply({"params": params.embed}, images)
+        B = feats.shape[0]
+        M = cfg.num_microbatches
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = feats.reshape(M, B // M, *feats.shape[1:])
+
+        pipelined = jax.shard_map(
+            lambda p, m: spmd_pipeline(stage_fn, p, m, axis_name="pipe"),
+            mesh=mesh,
+            in_specs=(P("pipe"), mbspec),
+            out_specs=mbspec,
+            check_vma=False,
+        )
+        out = pipelined(params.stages, mb)
+        out = out.reshape(B, *out.shape[2:])
+        return head.apply({"params": params.head}, out)
+
+    return apply_fn
+
+
+def make_pipe_vit_train_step(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+):
+    """``step(state, images, labels) -> (state, metrics)`` over dp×pp.
+
+    Stage params (and their optimizer state, by GSPMD propagation
+    through the constrained update) stay sharded on ``pipe``; embed and
+    head replicate, their gradients all-reduced over ``data`` by XLA.
+    """
+    apply_fn = make_pipe_vit_apply(cfg, mesh)
+    stage_sharding = NamedSharding(mesh, P("pipe"))
+
+    def constrain(params: PipeViTParams) -> PipeViTParams:
+        return params._replace(
+            stages=jax.tree.map(
+                lambda x: lax.with_sharding_constraint(x, stage_sharding),
+                params.stages,
+            )
+        )
+
+    def step(state: PipeViTState, images, labels):
+        def loss_fn(params):
+            logits = apply_fn(params, _preprocess(images, compute_dtype))
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        grads = constrain(jax.tree.map(lambda g: g.astype(jnp.float32), grads))
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = constrain(optax.apply_updates(state.params, updates))
+        correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
+        return (
+            PipeViTState(state.step + 1, params, opt_state),
+            StepMetrics(loss=loss, accuracy=correct),
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def create_pipe_vit_state(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+) -> PipeViTState:
+    params = init_pipe_vit(cfg, sample_input, seed=seed)
+    stage_sharding = NamedSharding(mesh, P("pipe"))
+    rep = NamedSharding(mesh, P())
+    params = PipeViTParams(
+        embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),
+        stages=jax.tree.map(
+            lambda x: jax.device_put(x, stage_sharding), params.stages
+        ),
+        head=jax.tree.map(lambda x: jax.device_put(x, rep), params.head),
+    )
+    return PipeViTState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )

@@ -196,26 +196,30 @@ class Trainer:
         self._watchdog.start()
         last_eval: tuple[float, float] | None = None
         try:
-            for epoch in range(start_epoch, cfg.epochs):
-                stats = self._train_epoch(epoch)
-                self.history.append(stats)
-                self.ckpt.save(epoch, self.state)
-                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    last_eval = self.evaluate()
-                    logger.info(
-                        "Epoch %d eval: accuracy %.4f loss %.4f",
-                        epoch,
-                        *last_eval,
-                    )
-                else:
-                    last_eval = None
+            try:
+                for epoch in range(start_epoch, cfg.epochs):
+                    stats = self._train_epoch(epoch)
+                    self.history.append(stats)
+                    self.ckpt.save(epoch, self.state)
+                    if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                        last_eval = self.evaluate()
+                        logger.info(
+                            "Epoch %d eval: accuracy %.4f loss %.4f",
+                            epoch,
+                            *last_eval,
+                        )
+                    else:
+                        last_eval = None
+            finally:
+                if profiling:
+                    jax.profiler.stop_trace()
+                self.ckpt.wait()
+            # Reuse the last per-epoch eval rather than re-running it.
+            # Still inside the watchdog window: a hang in the final
+            # eval collective or checkpoint flush must crash, not stall.
+            final_acc, final_loss = last_eval or self.evaluate()
         finally:
             self._watchdog.stop()
-            if profiling:
-                jax.profiler.stop_trace()
-            self.ckpt.wait()
-        # reuse the last per-epoch eval rather than re-running it
-        final_acc, final_loss = last_eval or self.evaluate()
         logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
         self.metrics_writer.write(
             "final", accuracy=final_acc, loss=final_loss,

@@ -1,0 +1,84 @@
+"""Pipeline-parallel ViT: a real transformer through the GPipe
+schedule (models/pipeline_vit.py), checked against the sequential
+forward and trained end to end on pp and dp×pp meshes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models.pipeline_vit import (
+    PipeViTConfig,
+    create_pipe_vit_state,
+    init_pipe_vit,
+    make_pipe_vit_apply,
+    make_pipe_vit_train_step,
+    sequential_apply,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+CFG = PipeViTConfig(
+    num_classes=10,
+    patch_size=7,
+    embed_dim=32,
+    num_heads=4,
+    num_stages=4,
+    depth_per_stage=1,
+    num_microbatches=4,
+)
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(n,)).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+class TestForward:
+    def test_pipelined_matches_sequential(self, devices):
+        mesh = make_mesh(MeshSpec(data=1, pipe=4), devices=devices[:4])
+        images, _ = _batch(8)
+        params = init_pipe_vit(CFG, images[:1], seed=0)
+        seq = sequential_apply(CFG, params, images)
+        pipe = jax.jit(make_pipe_vit_apply(CFG, mesh))(params, images)
+        np.testing.assert_allclose(
+            np.asarray(pipe), np.asarray(seq), rtol=2e-4, atol=2e-5
+        )
+
+    def test_dp_pp_matches_sequential(self, devices):
+        mesh = make_mesh(MeshSpec(data=2, pipe=4), devices=devices)
+        images, _ = _batch(8, seed=1)
+        params = init_pipe_vit(CFG, images[:1], seed=0)
+        seq = sequential_apply(CFG, params, images)
+        pipe = jax.jit(make_pipe_vit_apply(CFG, mesh))(params, images)
+        np.testing.assert_allclose(
+            np.asarray(pipe), np.asarray(seq), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestTrain:
+    def test_trains_on_dp_pp_mesh(self, devices):
+        mesh = make_mesh(MeshSpec(data=2, pipe=4), devices=devices)
+        tx = optax.adam(3e-3)
+        images, labels = _batch(16, seed=2)
+        state = create_pipe_vit_state(CFG, tx, images[:1], mesh, seed=0)
+        # stage params actually sharded over pipe
+        leaf = jax.tree.leaves(state.params.stages)[0]
+        assert leaf.sharding.spec[0] == "pipe"
+        step = make_pipe_vit_train_step(CFG, tx, mesh)
+        losses = []
+        for _ in range(8):
+            state, metrics = step(state, images, labels)
+            losses.append(float(metrics.loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+        assert int(state.step) == 8
+
+    def test_indivisible_microbatch_raises(self, devices):
+        mesh = make_mesh(MeshSpec(data=1, pipe=4), devices=devices[:4])
+        images, _ = _batch(6)
+        params = init_pipe_vit(CFG, images[:1], seed=0)
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(make_pipe_vit_apply(CFG, mesh))(params, images)

@@ -9,8 +9,6 @@ import pytest
 from ddp_tpu.runtime.launch import spawn
 from ddp_tpu.utils.watchdog import StepWatchdog
 
-pytestmark = pytest.mark.multihost
-
 
 def test_fires_when_beats_stop():
     fired = threading.Event()
@@ -47,6 +45,7 @@ def _hung_worker(rank, world):
     time.sleep(60)  # simulate a rank stuck in a collective
 
 
+@pytest.mark.multihost
 def test_hung_worker_becomes_launcher_failure():
     """Dead-rank contract end-to-end: hang → watchdog abort(124) →
     launcher reports the failed rank instead of waiting forever."""
