@@ -1,0 +1,238 @@
+"""Long-context transformer with model-level sequence parallelism.
+
+SURVEY.md §5 lists long-context/sequence parallelism as absent from the
+reference; parallel/ring.py supplies the collective attention kernels,
+and this module puts a whole model on top of them: tokens shard over
+the ``seq`` mesh axis end to end — embedding, position slices,
+attention (ring or Ulysses), MLPs, pooling — so sequences larger than
+one chip's HBM train without ever materializing [B, T_global, C] on a
+device.
+
+Design: the per-token ops (Dense, LayerNorm, MLP) are embarrassingly
+token-parallel, so the module body runs unchanged on a local token
+shard; the two places that need the global sequence are pluggable —
+``attention_fn`` (ring/Ulysses collectives from parallel/ring.py) and
+``pool_fn`` (a psum-mean for the classification head). Position
+embeddings are a global-length parameter sliced per shard by offset.
+Gradients for the replicated parameters come out correct by
+construction: ``jax.grad`` through ``shard_map`` transposes the
+replicated-in broadcast into a psum over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.models.vit import EncoderBlock
+from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.parallel.ddp import StepMetrics
+from ddp_tpu.parallel.ring import sequence_sharded_attention
+
+
+class LongContextTransformer(nn.Module):
+    """Encoder over [B, T_local, d_in] feature sequences.
+
+    ``total_len`` sizes the global position table; ``pos_offset`` says
+    where this shard's tokens start. With the defaults (dense attention,
+    local mean-pool, offset 0) it is an ordinary single-device model —
+    the sequence-parallel wrapper below swaps the two pluggable fns.
+    """
+
+    num_classes: int
+    total_len: int
+    d_model: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    attention_fn: Callable = dot_product_attention
+    pool_fn: Callable = lambda x: x.mean(axis=1)
+
+    @nn.compact
+    def __call__(self, x, pos_offset=0):
+        B, T_local, _ = x.shape
+        x = nn.Dense(self.d_model, name="embed")(x)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.total_len, self.d_model),
+        )
+        x = x + lax.dynamic_slice_in_dim(
+            pos.astype(x.dtype), pos_offset, T_local, axis=1
+        )
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.d_model * self.mlp_ratio,
+                attention_fn=self.attention_fn,
+                name=f"block{i + 1}",
+            )(x, deterministic=True)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        pooled = self.pool_fn(x)
+        return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(
+            pooled
+        )
+
+
+class SeqTransformerSpec(NamedTuple):
+    num_classes: int
+    total_len: int
+    d_in: int
+    d_model: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    strategy: str = "ring"  # or "ulysses"
+
+
+def _dense_model(spec: SeqTransformerSpec) -> LongContextTransformer:
+    return LongContextTransformer(
+        num_classes=spec.num_classes,
+        total_len=spec.total_len,
+        d_model=spec.d_model,
+        depth=spec.depth,
+        num_heads=spec.num_heads,
+    )
+
+
+def _sharded_model(spec: SeqTransformerSpec) -> LongContextTransformer:
+    def attention(q, k, v):
+        return sequence_sharded_attention(
+            q, k, v, axis_name="seq", strategy=spec.strategy
+        )
+
+    def pool(x):
+        total = lax.psum(jnp.asarray(x.shape[1], jnp.float32), "seq")
+        return lax.psum(x.sum(axis=1), "seq") / total
+
+    return LongContextTransformer(
+        num_classes=spec.num_classes,
+        total_len=spec.total_len,
+        d_model=spec.d_model,
+        depth=spec.depth,
+        num_heads=spec.num_heads,
+        attention_fn=attention,
+        pool_fn=pool,
+    )
+
+
+def init_seq_transformer(spec: SeqTransformerSpec, *, seed: int = 0):
+    """Initialize params without touching the full sequence.
+
+    Every parameter shape is independent of the input length — the
+    position table is sized by the ``total_len`` attribute, not by the
+    sample — so init runs on a short stub sequence. This keeps init
+    O(short²) in attention cost where a full-length init would
+    materialize the [H, T_global, T_global] score tensor on one device
+    and defeat the module's whole point at long context.
+    """
+    model = _dense_model(spec)
+    stub_len = min(spec.total_len, 128)
+    return model.init(
+        jax.random.key(seed), jnp.zeros((1, stub_len, spec.d_in))
+    )["params"]
+
+
+def dense_apply(spec: SeqTransformerSpec, params, x):
+    """Single-device reference forward over the full sequence."""
+    return _dense_model(spec).apply({"params": params}, x)
+
+
+def make_seq_parallel_apply(spec: SeqTransformerSpec, mesh: Mesh):
+    """Jitted ``apply(params, x) -> logits`` with tokens on ``seq``.
+
+    ``x``: [B, T_global, d_in] global array — batch shards over
+    ``data``, tokens over ``seq``; logits come back sharded over
+    ``data`` only (identical on every seq member).
+    """
+    model = _sharded_model(spec)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P(None)
+    xspec = P(bspec[0], "seq")
+
+    def per_shard(params, x_shard):
+        t_local = x_shard.shape[1]
+        offset = lax.axis_index("seq") * t_local
+        return model.apply({"params": params}, x_shard, pos_offset=offset)
+
+    sharded = jax.shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(), xspec),
+        out_specs=bspec,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+class SeqTrainState(NamedTuple):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_seq_parallel_train_step(
+    spec: SeqTransformerSpec,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    donate: bool = True,
+):
+    """Full dp×sp train step: loss/grad through the collective forward.
+
+    Params replicate; their gradients arrive correctly psum'd over both
+    axes by the shard_map transpose. Batch shards over ``data``, tokens
+    over ``seq``.
+    """
+    apply_fn = make_seq_parallel_apply(spec, mesh)
+    has_data = mesh.shape.get("data", 1) > 1
+    lspec = P("data") if has_data else P(None)
+
+    def step(state: SeqTrainState, x, labels):
+        labels = lax.with_sharding_constraint(
+            labels, NamedSharding(mesh, lspec)
+        )
+
+        def loss_fn(params):
+            logits = apply_fn(params, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels
+            ).mean()
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
+        return (
+            SeqTrainState(state.step + 1, params, opt_state),
+            StepMetrics(loss=loss, accuracy=correct),
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def create_seq_train_state(
+    spec: SeqTransformerSpec,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+) -> SeqTrainState:
+    params = init_seq_transformer(spec, seed=seed)
+    rep = NamedSharding(mesh, P())
+    params = jax.tree.map(lambda x: jax.device_put(x, rep), params)
+    return SeqTrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=optimizer.init(params),
+    )

@@ -1,0 +1,98 @@
+"""Model-level sequence parallelism: the long-context transformer
+(models/seq_transformer.py) sharded over the seq axis matches the
+dense single-device forward exactly and trains on dp×sp meshes with
+both ring and Ulysses attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models.seq_transformer import (
+    SeqTransformerSpec,
+    create_seq_train_state,
+    dense_apply,
+    init_seq_transformer,
+    make_seq_parallel_apply,
+    make_seq_parallel_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+
+def _data(spec, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch, spec.total_len, spec.d_in)).astype(np.float32)
+    y = rng.integers(0, spec.num_classes, size=(batch,)).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+SPEC = SeqTransformerSpec(
+    num_classes=6, total_len=64, d_in=8, d_model=32, depth=2, num_heads=4
+)
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+    def test_seq_parallel_matches_dense(self, devices, strategy):
+        spec = SPEC._replace(strategy=strategy)
+        mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+        params = init_seq_transformer(spec, seed=0)
+        x, _ = _data(spec, 4)
+        ref = dense_apply(spec, params, x)
+        out = make_seq_parallel_apply(spec, mesh)(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+    def test_seq_only_mesh(self, devices):
+        mesh = make_mesh(MeshSpec(data=1, seq=8), devices=devices)
+        params = init_seq_transformer(SPEC, seed=1)
+        x, _ = _data(SPEC, 2, seed=1)
+        ref = dense_apply(SPEC, params, x)
+        out = make_seq_parallel_apply(SPEC, mesh)(params, x)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestTraining:
+    def test_trains_on_dp_sp_mesh(self, devices):
+        mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+        tx = optax.adam(3e-3)
+        state = create_seq_train_state(SPEC, tx, mesh, seed=0)
+        step = make_seq_parallel_train_step(SPEC, tx, mesh)
+        x, y = _data(SPEC, 8, seed=2)
+        losses = []
+        for _ in range(8):
+            state, metrics = step(state, x, y)
+            losses.append(float(metrics.loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_grads_match_dense_reference(self, devices):
+        """The shard_map transpose must produce the same parameter
+        gradients as single-device autodiff on the full sequence."""
+        mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+        params = init_seq_transformer(SPEC, seed=3)
+        x, y = _data(SPEC, 4, seed=3)
+        apply_sp = make_seq_parallel_apply(SPEC, mesh)
+
+        def loss_sp(p):
+            logits = apply_sp(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+
+        def loss_dense(p):
+            logits = dense_apply(SPEC, p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y
+            ).mean()
+
+        g_sp = jax.grad(loss_sp)(params)
+        g_dense = jax.grad(loss_dense)(params)
+        for a, b in zip(jax.tree.leaves(g_sp), jax.tree.leaves(g_dense)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+            )
