@@ -1,0 +1,63 @@
+"""On-device, jittable train-time augmentation.
+
+The reference applies no augmentation (ToTensor only, data.py:13;
+SURVEY.md §2a #6) — enough for MNIST, not for the CIFAR/ImageNet
+extension configs where random-crop + horizontal-flip is the standard
+recipe behind the accuracy targets. TPU-first placement: augmentation
+runs *inside* the jitted train step on the VPU, after the uint8→float
+conversion — the host pipeline stays a pure uint8 gather, nothing new
+crosses PCIe, and XLA fuses the crop/flip into the step.
+
+All fns share the signature ``fn(rng, images) -> images`` on NHWC
+float batches and are deterministic in ``rng`` (replays byte-identically
+on resume, like the seed=epoch shuffle).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def random_crop_flip(rng, images, *, pad: int = 4):
+    """Pad-reflect by ``pad``, random-crop back, random horizontal flip.
+
+    The torchvision ``RandomCrop(padding=4)`` + ``RandomHorizontalFlip``
+    recipe (zero padding, like its default), vectorized: per-image
+    offsets via ``vmap``'d dynamic_slice.
+    """
+    B, H, W, C = images.shape
+    r_off, r_flip = jax.random.split(rng)
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    offsets = jax.random.randint(r_off, (B, 2), 0, 2 * pad + 1)
+
+    def crop(img, off):
+        return lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
+
+    images = jax.vmap(crop)(padded, offsets)
+    flip = jax.random.bernoulli(r_flip, 0.5, (B,))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+def random_flip(rng, images):
+    """Horizontal flip only — for inputs where translation hurts."""
+    flip = jax.random.bernoulli(rng, 0.5, (images.shape[0],))
+    return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
+
+
+AUGMENTATIONS = {
+    "crop_flip": random_crop_flip,
+    "flip": random_flip,
+}
+
+
+def get_augmentation(name: str | None):
+    """name → fn(rng, images) or None. Raises on unknown names."""
+    if name is None or name == "none":
+        return None
+    if name not in AUGMENTATIONS:
+        raise KeyError(
+            f"unknown augmentation {name!r}; have {sorted(AUGMENTATIONS)}"
+        )
+    return AUGMENTATIONS[name]

@@ -39,17 +39,21 @@ def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
     return images.astype(compute_dtype)
 
 
-def make_loss_fn(model, compute_dtype, aux_loss_weight: float):
+def make_loss_fn(model, compute_dtype, aux_loss_weight: float, augment_fn=None):
     """``loss_fn(params, model_state, images, labels, rng, mutable)``.
 
     Returns ``(loss, (logits, new_model_state))`` — mean softmax
     cross-entropy plus the weighted MoE load-balance aux losses when
     the model records a ``losses`` collection (models/moe.py).
+    ``augment_fn(rng, images)`` (data/augment.py), when given, runs
+    on-device after the uint8→float conversion.
     """
     train_kw = _train_kwarg(model, True)
 
     def loss_fn(params, model_state, images, labels, rng, mutable):
         x = _preprocess(images, compute_dtype)
+        if augment_fn is not None:
+            x = augment_fn(jax.random.fold_in(rng, 7919), x).astype(x.dtype)
         if compute_dtype != jnp.float32:
             params_c = jax.tree.map(lambda p: p.astype(compute_dtype), params)
         else:

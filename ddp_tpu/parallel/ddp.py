@@ -94,6 +94,7 @@ def make_per_shard_step(
     seed: int = 0,
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
+    augment_fn=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
@@ -107,7 +108,9 @@ def make_per_shard_step(
     (SURVEY.md §2c: one step per batch, train_ddp.py:196-200).
     """
 
-    loss_fn = make_loss_fn(model, compute_dtype, aux_loss_weight)
+    loss_fn = make_loss_fn(
+        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn
+    )
 
     def per_shard_step(state: TrainState, images, labels):
         mutable = list(state.model_state.keys())
@@ -166,6 +169,7 @@ def make_train_step(
     seed: int = 0,
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
+    augment_fn=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -183,6 +187,7 @@ def make_train_step(
         compute_dtype=compute_dtype, seed=seed,
         aux_loss_weight=aux_loss_weight,
         grad_accum_steps=grad_accum_steps,
+        augment_fn=augment_fn,
     )
     sharded = jax.shard_map(
         per_shard_step,

@@ -187,6 +187,7 @@ def make_spmd_train_step(
     seed: int = 0,
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
+    augment_fn=None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
 
@@ -201,7 +202,9 @@ def make_spmd_train_step(
     """
     rules = rules or ShardingRules()
     bspec = batch_spec(mesh)
-    loss_fn = make_loss_fn(model, compute_dtype, aux_loss_weight)
+    loss_fn = make_loss_fn(
+        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn
+    )
 
     def step(state: TrainState, images, labels):
         images = lax.with_sharding_constraint(images, NamedSharding(mesh, bspec))

@@ -35,6 +35,7 @@ class TrainConfig:
     # Framework knobs (no reference analogue)
     model: str = "simple_cnn"
     model_depth: int | None = None  # None = family default (e.g. ViT 12)
+    augment: str | None = None  # data/augment.py: "crop_flip" | "flip"
     dataset: str = "mnist"
     num_classes: int | None = None  # None = infer from dataset
     optimizer: str = "sgd"  # sgd | adam | adamw
@@ -87,6 +88,9 @@ class TrainConfig:
         p.add_argument("--num_workers", type=int, default=cls.num_workers)
         p.add_argument("--model", default=cls.model)
         p.add_argument("--model_depth", type=int, default=None)
+        p.add_argument(
+            "--augment", default=None, choices=(None, "none", "crop_flip", "flip")
+        )
         p.add_argument("--dataset", default=cls.dataset)
         p.add_argument("--num_classes", type=int, default=None)
         p.add_argument(

@@ -58,6 +58,7 @@ def make_epoch_runner(
     compute_dtype=jnp.float32,
     seed: int = 0,
     donate: bool = True,
+    augment_fn=None,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build ``run(state, epoch) -> (state, stacked per-step metrics)``.
 
@@ -80,7 +81,8 @@ def make_epoch_runner(
             f"dataset of {n} examples yields zero batches of {global_batch_size}"
         )
     per_shard_step = make_per_shard_step(
-        model, optimizer, axes, shards, compute_dtype=compute_dtype, seed=seed
+        model, optimizer, axes, shards, compute_dtype=compute_dtype, seed=seed,
+        augment_fn=augment_fn,
     )
 
     def per_device_epoch(state: TrainState, epoch, imgs, lbls):

@@ -133,6 +133,9 @@ class Trainer:
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
+        from ddp_tpu.data.augment import get_augmentation
+
+        augment_fn = get_augmentation(config.augment)
         sample = jnp.zeros(
             (1, *train_split.images.shape[1:]), jnp.float32
         )
@@ -147,6 +150,7 @@ class Trainer:
                 self.model, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype, seed=config.seed,
                 grad_accum_steps=config.grad_accum_steps,
+                augment_fn=augment_fn,
             )
             self.eval_step = make_spmd_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
@@ -160,6 +164,7 @@ class Trainer:
                 self.model, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype, seed=config.seed,
                 grad_accum_steps=config.grad_accum_steps,
+                augment_fn=augment_fn,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype

@@ -1,0 +1,97 @@
+"""On-device augmentation (data/augment.py): shape/range invariants,
+determinism, and integration with the compiled train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.data.augment import (
+    get_augmentation,
+    random_crop_flip,
+    random_flip,
+)
+from ddp_tpu.models import get_model
+from ddp_tpu.parallel.ddp import (
+    create_train_state,
+    make_train_step,
+    replicate_state,
+)
+from ddp_tpu.runtime.mesh import data_axes
+from ddp_tpu.train.config import TrainConfig
+
+
+def _images(n=16, side=32, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.random(size=(n, side, side, c)).astype(np.float32)
+    )
+
+
+class TestOps:
+    def test_crop_flip_shape_and_range(self):
+        x = _images()
+        y = random_crop_flip(jax.random.key(0), x)
+        assert y.shape == x.shape
+        assert float(y.min()) >= 0.0 and float(y.max()) <= 1.0
+
+    def test_deterministic_in_rng(self):
+        x = _images()
+        a = random_crop_flip(jax.random.key(7), x)
+        b = random_crop_flip(jax.random.key(7), x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = random_crop_flip(jax.random.key(8), x)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_flip_is_flip_or_identity(self):
+        x = _images(n=64)
+        y = np.asarray(random_flip(jax.random.key(1), x))
+        xn = np.asarray(x)
+        flipped = 0
+        for i in range(len(xn)):
+            if np.array_equal(y[i], xn[i]):
+                continue
+            np.testing.assert_array_equal(y[i], xn[i, :, ::-1, :])
+            flipped += 1
+        assert 10 < flipped < 54  # ~Binomial(64, 0.5)
+
+    def test_registry(self):
+        assert get_augmentation(None) is None
+        assert get_augmentation("none") is None
+        assert get_augmentation("crop_flip") is random_crop_flip
+        with pytest.raises(KeyError):
+            get_augmentation("cutmix")
+
+
+class TestIntegration:
+    def test_train_step_with_augmentation_learns(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model = get_model("simple_cnn")
+        tx = optax.sgd(0.05)
+        state = replicate_state(
+            create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0),
+            mesh8,
+        )
+        step = make_train_step(
+            model, tx, mesh8, augment_fn=random_crop_flip
+        )
+        sh = NamedSharding(mesh8, P(data_axes(mesh8)))
+        rng = np.random.default_rng(0)
+        images = jax.device_put(
+            rng.integers(0, 256, size=(32, 28, 28, 1), dtype=np.uint8), sh
+        )
+        labels = jax.device_put(
+            rng.integers(0, 10, size=(32,)).astype(np.int32), sh
+        )
+        losses = []
+        for _ in range(6):
+            state, m = step(state, images, labels)
+            losses.append(float(m.loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_cli_flag(self):
+        cfg = TrainConfig.from_args(["--augment", "crop_flip"])
+        assert cfg.augment == "crop_flip"
