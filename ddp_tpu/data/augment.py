@@ -21,7 +21,7 @@ from jax import lax
 
 
 def random_crop_flip(rng, images, *, pad: int = 4):
-    """Pad-reflect by ``pad``, random-crop back, random horizontal flip.
+    """Zero-pad by ``pad``, random-crop back, random horizontal flip.
 
     The torchvision ``RandomCrop(padding=4)`` + ``RandomHorizontalFlip``
     recipe (zero padding, like its default), vectorized: per-image

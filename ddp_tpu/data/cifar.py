@@ -61,11 +61,22 @@ def _fetch_tar(root: str, name: str) -> str:
 
 
 def parse_records(raw: bytes, *, name: str) -> Split:
-    """Decode one binary batch file into (uint8 NHWC images, labels)."""
+    """Decode one binary batch file into (uint8 NHWC images, labels).
+
+    Native (C++) decode first — the CHW→HWC transpose runs in
+    dataio.cpp without a numpy strided-copy pass — Python fallback
+    otherwise (only when a cached native build exists; see
+    native.available(build=False)).
+    """
     label_bytes = 1 if name == "cifar10" else 2  # cifar100: coarse+fine
     record = label_bytes + 3072
     if len(raw) % record:
         raise ValueError(f"{name} batch size {len(raw)} not a multiple of {record}")
+    from ddp_tpu import native
+
+    if native.available(build=False):
+        images, labels = native.cifar_decode(raw, label_bytes)
+        return Split(images, labels)
     arr = np.frombuffer(raw, np.uint8).reshape(-1, record)
     labels = arr[:, label_bytes - 1].astype(np.int32)  # fine label for cifar100
     images = (

@@ -93,6 +93,15 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.dt_free.restype = None
         lib.dt_free.argtypes = [ctypes.c_void_p]
+        lib.dt_cifar_decode.restype = ctypes.c_int
+        lib.dt_cifar_decode.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         lib.dt_loader_create.restype = ctypes.c_void_p
         lib.dt_loader_create.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p,
@@ -162,6 +171,33 @@ def read_idx(path: str | os.PathLike) -> np.ndarray:
         return flat.reshape(tuple(dims[i] for i in range(ndim.value))).copy()
     finally:
         lib.dt_free(data)
+
+
+def cifar_decode(raw: bytes, label_bytes: int) -> tuple[np.ndarray, np.ndarray]:
+    """Decode a CIFAR binary batch natively (CHW→HWC transpose in C++).
+
+    Same contract as ``ddp_tpu.data.cifar.parse_records`` on the raw
+    member bytes — used as its fast path.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    images = ctypes.POINTER(ctypes.c_uint8)()
+    labels = ctypes.POINTER(ctypes.c_int32)()
+    n = ctypes.c_int64()
+    rc = lib.dt_cifar_decode(
+        raw, len(raw), label_bytes,
+        ctypes.byref(images), ctypes.byref(labels), ctypes.byref(n),
+    )
+    if rc != 0:
+        raise ValueError(f"dt_cifar_decode failed: code {rc}")
+    try:
+        img = np.ctypeslib.as_array(images, shape=(n.value, 32, 32, 3)).copy()
+        lbl = np.ctypeslib.as_array(labels, shape=(n.value,)).copy()
+        return img, lbl
+    finally:
+        lib.dt_free(images)
+        lib.dt_free(labels)
 
 
 class NativePrefetcher:

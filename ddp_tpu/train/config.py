@@ -89,7 +89,7 @@ class TrainConfig:
         p.add_argument("--model", default=cls.model)
         p.add_argument("--model_depth", type=int, default=None)
         p.add_argument(
-            "--augment", default=None, choices=(None, "none", "crop_flip", "flip")
+            "--augment", default=None, choices=("none", "crop_flip", "flip")
         )
         p.add_argument("--dataset", default=cls.dataset)
         p.add_argument("--num_classes", type=int, default=None)

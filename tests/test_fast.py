@@ -80,3 +80,35 @@ def test_epochs_reshuffle(parts):
     _, mb = runner(s, 1)
     # different data order ⇒ different per-step losses from same state
     assert not np.allclose(np.asarray(ma.loss), np.asarray(mb.loss))
+
+
+def test_epoch_runner_with_augmentation(devices, mnist_synthetic):
+    """The fast path accepts the same augment_fn as the step path.
+    Narrow model for the same CPU-emulation reason as `parts` above.
+    """
+    from ddp_tpu.data.augment import random_flip
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+    from ddp_tpu.train.fast import device_put_dataset, make_epoch_runner
+
+    mesh8 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    train, _ = mnist_synthetic
+    images, labels = device_put_dataset(
+        train.images[:1024], train.labels[:1024], mesh8
+    )
+    model = SimpleCNN(features=(4, 8))
+    tx = optax.sgd(0.05)
+    state = replicate_state(
+        create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0),
+        mesh8,
+    )
+    runner = make_epoch_runner(
+        model, tx, mesh8, images, labels, 256,
+        seed=0, augment_fn=random_flip,
+    )
+    losses = []
+    for e in range(3):
+        state, metrics = runner(state, e)
+        jax.block_until_ready(metrics.loss)
+        losses.append(float(metrics.loss[-1]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

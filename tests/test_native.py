@@ -166,3 +166,34 @@ def test_mnist_loader_uses_native_decoder(tmp_path):
     split = mnist.load(str(tmp_path), "train")
     np.testing.assert_array_equal(split.images[..., 0], images)
     np.testing.assert_array_equal(split.labels, labels.astype(np.int32))
+
+
+def _cifar_bytes(n, label_bytes, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for _ in range(n):
+        labels = rng.integers(0, 100, size=label_bytes, dtype=np.uint8)
+        planes = rng.integers(0, 256, size=3072, dtype=np.uint8)
+        recs.append(labels.tobytes() + planes.tobytes())
+    return b"".join(recs)
+
+
+@pytest.mark.parametrize("name,label_bytes", [("cifar10", 1), ("cifar100", 2)])
+def test_cifar_decode_matches_python(name, label_bytes):
+    raw = _cifar_bytes(7, label_bytes)
+    images, labels = native.cifar_decode(raw, label_bytes)
+    # Python reference decode (the fallback path in parse_records)
+    record = label_bytes + 3072
+    arr = np.frombuffer(raw, np.uint8).reshape(-1, record)
+    ref_labels = arr[:, label_bytes - 1].astype(np.int32)
+    ref_images = arr[:, label_bytes:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    np.testing.assert_array_equal(images, ref_images)
+    np.testing.assert_array_equal(labels, ref_labels)
+    assert images.flags["C_CONTIGUOUS"]
+
+
+def test_cifar_decode_rejects_malformed():
+    with pytest.raises(ValueError):
+        native.cifar_decode(b"\x00" * 100, 1)
+    with pytest.raises(ValueError):
+        native.cifar_decode(_cifar_bytes(2, 1), 3)
