@@ -98,9 +98,8 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_char_p,
             ctypes.c_int64,
             ctypes.c_int32,
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
-            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p,
+            ctypes.c_void_p,
         ]
         lib.dt_loader_create.restype = ctypes.c_void_p
         lib.dt_loader_create.argtypes = [
@@ -182,22 +181,23 @@ def cifar_decode(raw: bytes, label_bytes: int) -> tuple[np.ndarray, np.ndarray]:
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")
-    images = ctypes.POINTER(ctypes.c_uint8)()
-    labels = ctypes.POINTER(ctypes.c_int32)()
-    n = ctypes.c_int64()
+    record = label_bytes + 3072
+    if label_bytes not in (1, 2) or not raw or len(raw) % record:
+        raise ValueError(
+            f"malformed CIFAR batch: {len(raw)} bytes, record {record}"
+        )
+    n = len(raw) // record
+    # Caller-allocated outputs (dt_loader_next convention): C++ fills
+    # the numpy buffers directly, no malloc/copy/free round-trip.
+    images = np.empty((n, 32, 32, 3), np.uint8)
+    labels = np.empty((n,), np.int32)
     rc = lib.dt_cifar_decode(
         raw, len(raw), label_bytes,
-        ctypes.byref(images), ctypes.byref(labels), ctypes.byref(n),
+        images.ctypes.data, labels.ctypes.data,
     )
     if rc != 0:
         raise ValueError(f"dt_cifar_decode failed: code {rc}")
-    try:
-        img = np.ctypeslib.as_array(images, shape=(n.value, 32, 32, 3)).copy()
-        lbl = np.ctypeslib.as_array(labels, shape=(n.value,)).copy()
-        return img, lbl
-    finally:
-        lib.dt_free(images)
-        lib.dt_free(labels)
+    return images, labels
 
 
 class NativePrefetcher:

@@ -150,37 +150,29 @@ void dt_free(void* p) { std::free(p); }
 //   [label_bytes of labels][3072 bytes RGB, channel-planar CHW].
 // label_bytes: 1 (CIFAR-10) or 2 (CIFAR-100: coarse then fine — the
 // FINE label, the last byte, is kept, matching data/cifar.py).
-// On success returns 0 and fills malloc'd buffers (free with dt_free):
-//   *out_images — [n, 32, 32, 3] uint8, transposed to HWC here so the
-//                 Python side gets a contiguous NHWC array with no
-//                 numpy transpose/copy pass
-//   *out_labels — [n] int32
-//   *out_n      — record count
-// Error codes: 1 alloc, 3 malformed (size not a multiple of the record).
+// Caller-buffer convention (like dt_loader_next): Python computes
+// n = size / record, allocates the numpy outputs, and passes their
+// data pointers — no double-buffering:
+//   out_images — [n, 32, 32, 3] uint8, filled HWC-interleaved here so
+//                the Python side needs no transpose/copy pass
+//   out_labels — [n] int32
+// Returns 0 on success, 3 on malformed input (size not a multiple of
+// the record, or bad label_bytes).
 int dt_cifar_decode(const uint8_t* data, int64_t size, int32_t label_bytes,
-                    uint8_t** out_images, int32_t** out_labels,
-                    int64_t* out_n) {
+                    uint8_t* out_images, int32_t* out_labels) {
   constexpr int64_t kSide = 32, kChan = 3;
   constexpr int64_t kPixels = kSide * kSide;       // 1024 per plane
   constexpr int64_t kImageBytes = kPixels * kChan; // 3072
   if (label_bytes != 1 && label_bytes != 2) return 3;
   const int64_t record = label_bytes + kImageBytes;
-  if (!data || size <= 0 || size % record != 0) return 3;
+  if (!data || !out_images || !out_labels || size <= 0 || size % record != 0)
+    return 3;
   const int64_t n = size / record;
-  uint8_t* imgs =
-      static_cast<uint8_t*>(std::malloc(static_cast<size_t>(n * kImageBytes)));
-  int32_t* lbls =
-      static_cast<int32_t*>(std::malloc(static_cast<size_t>(n) * sizeof(int32_t)));
-  if (!imgs || !lbls) {
-    std::free(imgs);
-    std::free(lbls);
-    return 1;
-  }
   for (int64_t r = 0; r < n; ++r) {
     const uint8_t* rec = data + r * record;
-    lbls[r] = rec[label_bytes - 1];  // fine label for CIFAR-100
+    out_labels[r] = rec[label_bytes - 1];  // fine label for CIFAR-100
     const uint8_t* planes = rec + label_bytes;
-    uint8_t* dst = imgs + r * kImageBytes;
+    uint8_t* dst = out_images + r * kImageBytes;
     // CHW planes → HWC interleaved.
     for (int64_t p = 0; p < kPixels; ++p) {
       dst[p * kChan + 0] = planes[p];
@@ -188,9 +180,6 @@ int dt_cifar_decode(const uint8_t* data, int64_t size, int32_t label_bytes,
       dst[p * kChan + 2] = planes[2 * kPixels + p];
     }
   }
-  *out_images = imgs;
-  *out_labels = lbls;
-  *out_n = n;
   return 0;
 }
 

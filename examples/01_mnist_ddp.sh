@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# The reference quickstart (train_ddp.py README:59-74), torch-free.
+# --synthetic_data keeps it offline; drop it when MNIST can download.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Train 2 epochs on an emulated 8-device mesh (on TPU: drop
+# --emulate_devices and every local chip is used automatically).
+python train.py --epochs 2 --batch_size 64 --emulate_devices 8 \
+    --synthetic_data --checkpoint_dir /tmp/ddp_tpu_example/ck \
+    --data_root /tmp/ddp_tpu_example/data --metrics_file /tmp/ddp_tpu_example/metrics.jsonl
+
+# Re-run with a higher target: auto-resumes from the latest checkpoint.
+python train.py --epochs 3 --batch_size 64 --emulate_devices 8 \
+    --synthetic_data --checkpoint_dir /tmp/ddp_tpu_example/ck \
+    --data_root /tmp/ddp_tpu_example/data
+
+# The reference's 2-process launch: real jax.distributed rendezvous
+# over a localhost coordinator, one emulated device per rank.
+python train.py --spawn 2 --epochs 1 --batch_size 32 \
+    --synthetic_data --checkpoint_dir /tmp/ddp_tpu_example/ck2 \
+    --data_root /tmp/ddp_tpu_example/data

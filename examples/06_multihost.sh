@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Multi-process and multi-host launches.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# Single machine, N processes (dev stand-in for N hosts): the launcher
+# forks workers, each a jax.distributed participant with its own
+# emulated CPU device, rendezvoused over a localhost coordinator.
+python train.py --spawn 2 --epochs 1 --batch_size 32 --synthetic_data \
+    --checkpoint_dir /tmp/ddp_tpu_mh/ck --data_root /tmp/ddp_tpu_mh/data
+
+# Real multi-host TPU: run the SAME command on every host, one process
+# per host (each process drives all its local chips):
+#
+#   host 0:  python train.py --coordinator_address host0:9999 \
+#                --num_processes 2 --process_id 0 --epochs 10
+#   host 1:  python train.py --coordinator_address host0:9999 \
+#                --num_processes 2 --process_id 1 --epochs 10
+#
+# On Cloud TPU pods, jax.distributed auto-detects all three values from
+# the TPU metadata — plain `python train.py --epochs 10` on every host
+# works too. Checkpoints are written collectively (Orbax elects
+# writers); re-running resumes every host from the latest epoch.
+# A hung rank converts to a crash via --watchdog_timeout, so the
+# launcher/orchestrator can restart the job and resume.
