@@ -19,7 +19,7 @@ parallel/{ddp,spmd}.py add it to the objective.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Optional
 
 import flax.linen as nn
 import jax

@@ -24,7 +24,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-from typing import Sequence
 
 logger = logging.getLogger("ddp_tpu")
 

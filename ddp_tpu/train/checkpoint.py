@@ -32,10 +32,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any
-
 import jax
-import numpy as np
 import orbax.checkpoint as ocp
 
 from ddp_tpu.parallel.ddp import TrainState

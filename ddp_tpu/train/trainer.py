@@ -23,7 +23,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 from ddp_tpu.data.loader import ShardedLoader
 from ddp_tpu.data.registry import load_dataset

@@ -29,7 +29,6 @@ from ddp_tpu.parallel.spmd import (
     batch_spec,
     create_spmd_state,
     make_spmd_train_step,
-    param_specs,
 )
 from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
 

@@ -100,3 +100,34 @@ class TestDiscovery:
         with pytest.raises(FileNotFoundError):
             mgr.restore(state)
         mgr.close()
+
+
+class TestCrashResilience:
+    def test_partial_save_is_not_discovered(self, state_and_tx, tmp_ckpt_dir):
+        """A crash mid-save must not poison discovery (SURVEY.md §7
+        'hard parts': latest-checkpoint discovery racing partially
+        written saves). Orbax's atomic-commit protocol writes into a
+        temp dir and renames on completion — a leftover temp dir for a
+        higher epoch must be invisible to latest_epoch()/resume."""
+        import os
+
+        state, _ = state_and_tx
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        mgr.save(1, perturb(state, 0.5))
+        # Simulate a crash while epoch 7 was being written: an
+        # uncommitted orbax temp directory with partial contents.
+        partial = os.path.join(
+            tmp_ckpt_dir, "epoch_7.orbax-checkpoint-tmp-12345"
+        )
+        os.makedirs(os.path.join(partial, "state"))
+        with open(os.path.join(partial, "state", "garbage"), "w") as f:
+            f.write("not a checkpoint")
+        mgr2 = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        assert mgr2.latest_epoch() == 1
+        restored, start = mgr2.restore_or_init(state)
+        assert start == 2  # resumes after epoch 1, ignoring the wreck
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(restored.params)[0]),
+            np.asarray(jax.tree.leaves(perturb(state, 0.5).params)[0]),
+        )

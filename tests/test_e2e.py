@@ -4,7 +4,6 @@
 import os
 
 import numpy as np
-import pytest
 
 from ddp_tpu.train.config import TrainConfig
 from ddp_tpu.train.trainer import Trainer
