@@ -55,6 +55,10 @@ class TrainConfig:
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     eval_every: int = 1  # epochs between test-split evals (0 = only final)
+    # Compiled-epoch fast path (train/fast.py): dataset device-resident,
+    # on-device shuffle, lax.scan over the epoch — one dispatch/epoch.
+    # Single-process, pure-DDP, no grad accumulation.
+    fast_epoch: bool = False
     max_checkpoints: int | None = None  # None = keep all, like the reference
     synthetic_data: bool = False  # offline fallback dataset
     synthetic_size: int | None = None
@@ -114,6 +118,7 @@ class TrainConfig:
             choices=("float32", "bfloat16"),
         )
         p.add_argument("--eval_every", type=int, default=cls.eval_every)
+        p.add_argument("--fast_epoch", action="store_true")
         p.add_argument("--max_checkpoints", type=int, default=None)
         p.add_argument("--synthetic_data", action="store_true")
         p.add_argument("--synthetic_size", type=int, default=None)

@@ -128,7 +128,9 @@ class Trainer:
             self.global_batch_size,
             shuffle=config.shuffle,
             seed=config.seed,
-            num_workers=config.num_workers,
+            # The fast path never drains the loader — don't spin up a
+            # native worker pool that would idle for the whole run.
+            num_workers=0 if config.fast_epoch else config.num_workers,
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
@@ -172,6 +174,49 @@ class Trainer:
                 self.model, self.optimizer, sample, seed=config.seed
             )
             self.state = replicate_state(state, self.mesh)
+        self.fast_runner = None
+        if config.fast_epoch:
+            if self.use_spmd or config.grad_accum_steps > 1:
+                raise ValueError(
+                    "--fast_epoch supports the pure-DDP step without "
+                    "gradient accumulation"
+                )
+            if self.ctx.num_processes > 1:
+                raise ValueError(
+                    "--fast_epoch is single-process (the dataset is "
+                    "staged device-resident, replicated)"
+                )
+            if not config.shuffle:
+                raise ValueError(
+                    "--fast_epoch always reshuffles per epoch "
+                    "(on-device permutation); drop --no_shuffle"
+                )
+            if config.watchdog_timeout > 0:
+                raise ValueError(
+                    "--fast_epoch runs a whole epoch as one dispatch "
+                    "with no per-step progress beats, so a step-scale "
+                    "--watchdog_timeout would kill healthy runs; drop "
+                    "one of the two flags"
+                )
+            from ddp_tpu.train.fast import (
+                device_put_dataset,
+                make_epoch_runner,
+            )
+
+            # Full arrays on device: the runner permutes all n images
+            # per epoch and drops a DIFFERENT tail of the permutation
+            # each time (make_epoch_runner), matching the step path's
+            # coverage — a static [:usable] truncation would exclude
+            # the same images every epoch.
+            dev_images, dev_labels = device_put_dataset(
+                train_split.images, train_split.labels, self.mesh
+            )
+            self.fast_runner = make_epoch_runner(
+                self.model, self.optimizer, self.mesh,
+                dev_images, dev_labels, self.global_batch_size,
+                compute_dtype=compute_dtype, seed=config.seed,
+                augment_fn=augment_fn,
+            )
         self.ckpt = CheckpointManager(
             config.checkpoint_dir, max_to_keep=config.max_checkpoints
         )
@@ -243,6 +288,8 @@ class Trainer:
     MAX_INFLIGHT_STEPS = 8
 
     def _train_epoch(self, epoch: int) -> EpochStats:
+        if self.fast_runner is not None:
+            return self._train_epoch_fast(epoch)
         cfg = self.config
         logger.info("Starting epoch %d", epoch)  # train_ddp.py:194 parity
         t0 = time.perf_counter()
@@ -281,6 +328,12 @@ class Trainer:
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
         seconds = time.perf_counter() - t0
+        return self._finish_epoch(epoch, losses, n_batches, seconds)
+
+    def _finish_epoch(
+        self, epoch: int, losses: list, n_batches: int, seconds: float
+    ) -> EpochStats:
+        """Shared epoch-summary contract for the step and fast paths."""
         images = n_batches * self.global_batch_size
         stats = EpochStats(
             epoch=epoch,
@@ -304,6 +357,33 @@ class Trainer:
             mean_loss=stats.mean_loss,
         )
         return stats
+
+    def _train_epoch_fast(self, epoch: int) -> EpochStats:
+        """One dispatch for the whole epoch (train/fast.py).
+
+        Per-step losses come back as one stacked array; the reference's
+        every-``log_interval`` loss lines are printed from it after the
+        device sync, so observable output matches the step path.
+        """
+        cfg = self.config
+        logger.info("Starting epoch %d (compiled fast path)", epoch)
+        t0 = time.perf_counter()
+        self.state, metrics = self.fast_runner(self.state, epoch)
+        losses_all = np.asarray(metrics.loss)
+        seconds = time.perf_counter() - t0
+        n_batches = len(losses_all)
+        end_step = int(self.state.step)  # one sync, outside the loop
+        losses = []
+        for batch_idx in range(0, n_batches, cfg.log_interval):
+            loss = float(losses_all[batch_idx])
+            losses.append(loss)
+            logger.info("Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss)
+            self.metrics_writer.write(
+                "step", epoch=epoch, batch=batch_idx,
+                step=end_step - n_batches + batch_idx + 1,
+                loss=loss,
+            )
+        return self._finish_epoch(epoch, losses, n_batches, seconds)
 
     # ---- eval (absent in the reference; required by the north star) ----
 

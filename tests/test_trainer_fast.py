@@ -1,0 +1,61 @@
+"""--fast_epoch: the compiled-epoch path through the user-facing
+Trainer — trains, checkpoints, resumes, and rejects unsupported
+combinations loudly."""
+
+import numpy as np
+import pytest
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def make_config(tmp_path, **kw):
+    defaults = dict(
+        epochs=1,
+        batch_size=8,
+        model="vit_tiny",  # matmul path; scanned convs are a CPU tarpit
+        model_depth=1,
+        num_classes=10,
+        optimizer="adam",
+        lr=1e-3,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=256,
+        log_interval=2,
+        eval_every=0,
+        fast_epoch=True,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def test_fast_epoch_trains_and_resumes(tmp_path):
+    t = Trainer(make_config(tmp_path))
+    assert t.fast_runner is not None
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["final_accuracy"])
+    assert summary["history"][0]["images_per_sec"] > 0
+
+    t2 = Trainer(make_config(tmp_path, epochs=2))
+    summary2 = t2.train()
+    t2.close()
+    assert summary2["epochs_run"] == 1
+    assert summary2["history"][0]["epoch"] == 1
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        dict(grad_accum_steps=2),
+        dict(mesh_model=2),
+        dict(shuffle=False),
+        dict(synthetic_size=16),  # smaller than one global batch (64)
+        dict(watchdog_timeout=60.0),  # no per-step beats on this path
+    ],
+)
+def test_fast_epoch_rejects_unsupported(tmp_path, bad):
+    with pytest.raises(ValueError):
+        Trainer(make_config(tmp_path, **bad))
