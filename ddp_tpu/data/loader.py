@@ -65,6 +65,15 @@ class ShardedLoader:
                 f"global batch {global_batch_size} not divisible by "
                 f"{shard_count} data-parallel shards"
             )
+        if shard_count % procs:
+            # Each process materializes a DISJOINT sample shard; with
+            # fewer batch shards than processes the assembled array
+            # would need replicated-but-different blocks — undefined.
+            raise ValueError(
+                f"{shard_count} data-parallel shard(s) cannot be fed by "
+                f"{procs} processes (need shards % processes == 0); give "
+                f"the mesh a data axis spanning the processes"
+            )
         self.local_batch_size = global_batch_size // procs
         self.images = images
         self.labels = labels

@@ -66,3 +66,19 @@ class TestDeterminism:
             np.bincount(seen, minlength=10),
             np.bincount(train.labels, minlength=10),
         )
+
+
+def test_rejects_undersharded_multiprocess_mesh(monkeypatch, mnist_synthetic, devices):
+    """procs > data shards would assemble an undefined global array
+    (each process materializes a disjoint sample shard but the mesh has
+    nowhere to put it) — must be rejected loudly."""
+    import jax
+
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    train, _ = mnist_synthetic
+    mesh = make_mesh(MeshSpec(data=1, model=8), devices=devices)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    with pytest.raises(ValueError, match="cannot be fed by"):
+        ShardedLoader(train.images, train.labels, mesh, 8)
