@@ -65,20 +65,38 @@ class ShardedLoader:
                 f"global batch {global_batch_size} not divisible by "
                 f"{shard_count} data-parallel shards"
             )
-        if shard_count % procs:
-            # Each process materializes a DISJOINT sample shard; with
-            # fewer batch shards than processes the assembled array
-            # would need replicated-but-different blocks — undefined.
-            raise ValueError(
-                f"{shard_count} data-parallel shard(s) cannot be fed by "
-                f"{procs} processes (need shards % processes == 0); give "
-                f"the mesh a data axis spanning the processes"
-            )
         self.local_batch_size = global_batch_size // procs
+        spec = P(data_axes(self.mesh))
+        self._img_sharding = NamedSharding(mesh, spec)
+        self._lbl_sharding = NamedSharding(mesh, spec)
+        if procs > 1:
+            # Each process materializes a DISJOINT contiguous sample
+            # shard. That is only well-defined when every device's
+            # batch slice lies inside its own process's block —
+            # otherwise the assembled array would hold
+            # replicated-but-different blocks (e.g. a non-data axis
+            # like pipe spanning the processes while batch blocks
+            # replicate across it).
+            shape = (global_batch_size, *images.shape[1:])
+            for dev, idx in self._img_sharding.devices_indices_map(
+                shape
+            ).items():
+                sl = idx[0]
+                lo = 0 if sl.start is None else sl.start
+                hi = global_batch_size if sl.stop is None else sl.stop
+                p = dev.process_index
+                if lo < p * self.local_batch_size or hi > (p + 1) * self.local_batch_size:
+                    raise ValueError(
+                        f"device {dev} (process {p}) covers batch rows "
+                        f"[{lo}, {hi}) outside its process's block — "
+                        f"this mesh cannot be fed by process-sharded "
+                        f"loading; give the mesh a data axis spanning "
+                        f"the processes"
+                    )
         self.images = images
         self.labels = labels
         # Shard the *sample stream* by process; device-level sharding of
-        # each assembled batch is handled by the sharding spec below.
+        # each assembled batch is handled by the sharding spec above.
         self.sampler = ShardSampler(
             num_examples=len(images),
             num_shards=procs,
@@ -86,9 +104,6 @@ class ShardedLoader:
             shuffle=shuffle,
             seed=seed,
         )
-        spec = P(data_axes(self.mesh))
-        self._img_sharding = NamedSharding(mesh, spec)
-        self._lbl_sharding = NamedSharding(mesh, spec)
         # Optional native worker pool — the C++ analogue of the
         # reference's DataLoader(num_workers=2) (data.py:22). 0 keeps
         # the single-thread Python gather; >0 tries the native path and

@@ -13,6 +13,13 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
     os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent XLA compilation cache: repeat suite runs reuse compiled
+# programs. Env vars (not just config) so spawned multihost workers
+# inherit them; threshold 0 so the many sub-second CPU compiles cache
+# too (the default 1.0s would exclude most of the suite's programs).
+_CACHE = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.abspath(_CACHE))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 
 import jax  # noqa: E402
 

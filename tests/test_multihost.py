@@ -99,7 +99,7 @@ def _trainer_worker(rank, world, epochs, ckpt_dir, data_root, out_dir):
         epochs=epochs,
         batch_size=8,
         synthetic_data=True,
-        synthetic_size=256,
+        synthetic_size=128,
         checkpoint_dir=ckpt_dir,
         data_root=data_root,
         log_interval=8,
