@@ -135,8 +135,15 @@ class ShardedLoader:
         # keeps it, at 60000/64 a 0.05% difference per epoch).
         return self.sampler.shard_size // self.local_batch_size
 
-    def _host_batches(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    def _host_batches(
+        self, epoch: int, skip_batches: int = 0
+    ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         idx = self.sampler.shard_indices(epoch)
+        if skip_batches:
+            # Mid-epoch resume: the index plan is deterministic in
+            # (seed, epoch), so dropping the consumed prefix continues
+            # the exact same data order.
+            idx = idx[skip_batches * self.local_batch_size :]
         if self._prefetcher is not None:
             yield from self._prefetcher.epoch(idx)
             return
@@ -151,12 +158,14 @@ class ShardedLoader:
             self._prefetcher.close()
             self._prefetcher = None
 
-    def epoch(self, epoch: int) -> Iterator[Batch]:
+    def epoch(self, epoch: int, skip_batches: int = 0) -> Iterator[Batch]:
         """Batches for ``epoch``, prefetched one step ahead.
 
         ``epoch`` plays the role of ``sampler.set_epoch(epoch)`` at
         train_ddp.py:193 — same data order on re-runs, reshuffled per
-        epoch.
+        epoch. ``skip_batches`` resumes mid-epoch after a preemption
+        save (the consumed prefix of the deterministic plan is
+        dropped).
         """
 
         def put(img_np: np.ndarray, lbl_np: np.ndarray) -> Batch:
@@ -171,7 +180,7 @@ class ShardedLoader:
             )
 
         pending: Batch | None = None
-        for img_np, lbl_np in self._host_batches(epoch):
+        for img_np, lbl_np in self._host_batches(epoch, skip_batches):
             nxt = put(img_np, lbl_np)  # async dispatch — overlaps prior step
             if pending is not None:
                 yield pending

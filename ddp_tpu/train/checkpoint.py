@@ -33,6 +33,7 @@ from __future__ import annotations
 import logging
 import os
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from ddp_tpu.parallel.ddp import TrainState
@@ -41,7 +42,14 @@ logger = logging.getLogger("ddp_tpu")
 
 
 class CheckpointManager:
-    """Per-epoch checkpoints with latest-epoch auto-resume."""
+    """Per-epoch checkpoints with latest-epoch auto-resume.
+
+    ``last_restored_spe`` holds the steps-per-epoch recorded in the
+    most recently restored checkpoint (None for legacy checkpoints) —
+    the trainer uses it to validate mid-epoch resume positions.
+    """
+
+    last_restored_spe: int | None = None
 
     def __init__(
         self,
@@ -67,14 +75,44 @@ class CheckpointManager:
         """Discovery: the reference's "latest file in ./checkpoints"."""
         return self._mgr.latest_step()
 
-    def save(self, epoch: int, state: TrainState) -> None:
+    def save(
+        self,
+        epoch: int,
+        state: TrainState,
+        *,
+        overwrite: bool = False,
+        steps_per_epoch: int = 0,
+    ) -> bool:
         """Save ``{params, opt_state, step}`` for ``epoch``.
 
         Collective: every process calls it; Orbax elects writers — the
         multi-host-safe version of the reference's ``if rank == 0:
         torch.save(...)`` (train_ddp.py:204).
+
+        Same-epoch conflicts (a mid-epoch preemption artifact already
+        holds this tag): with ``overwrite=False`` the save is skipped —
+        the old artifact stays valid and the NEXT epoch's save
+        supersedes it, so no crash window ever leaves the directory
+        without a usable latest. ``overwrite=True`` (preemption saves
+        replacing an older same-epoch artifact) deletes then saves;
+        a crash inside that window falls back to the previous epoch —
+        recompute, never corruption.
         """
-        self._mgr.save(epoch, args=ocp.args.StandardSave(state._asdict()))
+        if epoch in (self._mgr.all_steps() or []):
+            if not overwrite:
+                logger.info(
+                    "Checkpoint for epoch %d already exists (preemption "
+                    "artifact) — keeping it; a later save supersedes it",
+                    epoch,
+                )
+                return False
+            self._mgr.delete(epoch)
+        # steps_per_epoch rides along so resume can tell a genuine
+        # mid-epoch artifact from a completed-epoch save under a
+        # CHANGED config (step-counter arithmetic alone can collide).
+        tree = dict(state._asdict(), spe=np.int32(steps_per_epoch))
+        self._mgr.save(epoch, args=ocp.args.StandardSave(tree))
+        return True
 
     def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:
         """Restore → (state, epoch). ``state_like`` supplies the tree
@@ -84,19 +122,23 @@ class CheckpointManager:
             if epoch is None:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
-        try:
-            restored = self._mgr.restore(
-                epoch, args=ocp.args.StandardRestore(abstract)
-            )
-        except (ValueError, KeyError):
-            # Migration: checkpoints written before TrainState grew
-            # model_state lack that key. Restore the old 3-field tree
-            # and carry the caller's (freshly initialized) model_state.
-            legacy = {k: v for k, v in abstract.items() if k != "model_state"}
-            restored = dict(
-                self._mgr.restore(epoch, args=ocp.args.StandardRestore(legacy))
-            )
-            restored["model_state"] = state_like.model_state
+        abstract["spe"] = jax.ShapeDtypeStruct((), np.int32)
+        # Migration ladder: older checkpoints lack "spe" (and, before
+        # that, "model_state"); retry dropping the optional keys.
+        for drop in ((), ("spe",), ("spe", "model_state")):
+            attempt = {k: v for k, v in abstract.items() if k not in drop}
+            try:
+                restored = dict(
+                    self._mgr.restore(
+                        epoch, args=ocp.args.StandardRestore(attempt)
+                    )
+                )
+                break
+            except (ValueError, KeyError):
+                if drop == ("spe", "model_state"):
+                    raise
+        restored.setdefault("model_state", state_like.model_state)
+        self.last_restored_spe = int(restored.pop("spe", 0)) or None
         return TrainState(**restored), epoch
 
     def restore_or_init(

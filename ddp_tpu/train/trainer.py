@@ -225,13 +225,97 @@ class Trainer:
         )
         # Constructed here, armed in train() (start/stop bracket the run).
         self._watchdog = StepWatchdog(config.watchdog_timeout)
+        self._preempt_requested = False
         self.history: list[EpochStats] = []
 
     # ---- the reference's epoch/batch loop (train_ddp.py:192-209) ----
 
+    def _install_preemption_handler(self):
+        """SIGTERM → finish the in-flight step, checkpoint, exit clean.
+
+        Preemptible/spot TPU VMs get SIGTERM before reclaim; the
+        reference would lose the whole epoch (it has no handler —
+        SURVEY.md §5 failure detection). Returns the previous handler
+        (restored after training); no-op off the main thread.
+        """
+        import signal
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return (False, None)
+
+        def _on_term(signum, frame):
+            logger.warning(
+                "SIGTERM received — will checkpoint at the next step "
+                "boundary and exit"
+            )
+            self._preempt_requested = True
+
+        try:
+            return (True, signal.signal(signal.SIGTERM, _on_term))
+        except ValueError:  # non-main interpreter contexts
+            return (False, None)
+
+    def _preempt_agreed(self) -> bool:
+        """Cross-host agreement on the preemption flag.
+
+        Single process: the local flag. Multi-host: SIGTERM lands on
+        hosts at different times, so every process contributes its flag
+        to an all-gather and all adopt the OR — callers invoke this at
+        deterministic points (a fixed batch cadence, epoch boundaries)
+        so every process takes the same branch with identical state and
+        the subsequent collective checkpoint save is safe.
+        """
+        if self.ctx.num_processes == 1:
+            return self._preempt_requested
+        from jax.experimental import multihost_utils
+
+        agreed = bool(
+            multihost_utils.process_allgather(
+                np.asarray(self._preempt_requested)
+            ).any()
+        )
+        if agreed:
+            self._preempt_requested = True
+        return agreed
+
     def train(self) -> dict[str, Any]:
         cfg = self.config
         self.state, start_epoch = self.ckpt.restore_or_init(self.state)
+        # Mid-epoch preemption saves are tagged with their (incomplete)
+        # epoch; the global step counter says exactly how far in it
+        # got, so resume re-enters that epoch at the next batch.
+        start_batch = 0
+        spe = self.loader.steps_per_epoch()
+        resumed_step = int(self.state.step)
+        if self.fast_runner is None and spe and resumed_step % spe:
+            # Only trust the step-derived position when the checkpoint
+            # was written under the SAME steps-per-epoch (recorded in
+            # it) — a changed batch size / device count makes the old
+            # counter's arithmetic meaningless, and tag heuristics can
+            # collide by coincidence.
+            tag = start_epoch - 1
+            if (
+                self.ckpt.last_restored_spe == spe
+                and resumed_step // spe == tag
+            ):
+                start_epoch = tag
+                start_batch = resumed_step % spe
+                logger.info(
+                    "Resuming mid-epoch: epoch %d, batch %d (step %d)",
+                    start_epoch,
+                    start_batch,
+                    resumed_step,
+                )
+            else:
+                logger.warning(
+                    "Checkpoint step %d was written under %s "
+                    "steps/epoch; current config has %d — resuming at "
+                    "epoch granularity",
+                    resumed_step,
+                    self.ckpt.last_restored_spe,
+                    spe,
+                )
         if start_epoch >= cfg.epochs:
             logger.info(
                 "Checkpoint epoch %d ≥ requested epochs %d — nothing to do",
@@ -243,13 +327,50 @@ class Trainer:
             jax.profiler.start_trace(cfg.profile_dir)
             profiling = True
         self._watchdog.start()
+        self._preempt_requested = False
+        handler_installed, prev_handler = self._install_preemption_handler()
+        preempted = False
         last_eval: tuple[float, float] | None = None
         try:
             try:
                 for epoch in range(start_epoch, cfg.epochs):
-                    stats = self._train_epoch(epoch)
+                    stats = self._train_epoch(
+                        epoch, start_batch if epoch == start_epoch else 0
+                    )
+                    # Agreement at the epoch boundary: a SIGTERM that
+                    # landed after the last in-loop cadence check must
+                    # still stop every host on the same side of the
+                    # epoch, or survivors would block in the next
+                    # epoch's first collective.
+                    if self._preempt_agreed():
+                        # Mid-epoch state, tagged with the incomplete
+                        # epoch; overwrite any older preemption save.
+                        self.ckpt.save(
+                            epoch, self.state, overwrite=True,
+                            steps_per_epoch=spe,
+                        )
+                        logger.warning(
+                            "Preempted during epoch %d at step %d — "
+                            "checkpointed; re-run to resume",
+                            epoch,
+                            int(self.state.step),
+                        )
+                        preempted = True
+                        break
                     self.history.append(stats)
-                    self.ckpt.save(epoch, self.state)
+                    # overwrite=False: if a mid-epoch preemption
+                    # artifact holds this tag, keep it (redo-on-crash)
+                    # rather than opening a delete-before-commit window;
+                    # a later epoch's save supersedes it. If this was
+                    # the LAST epoch, supersede explicitly below.
+                    saved = self.ckpt.save(
+                        epoch, self.state, steps_per_epoch=spe
+                    )
+                    if not saved and epoch == cfg.epochs - 1:
+                        self.ckpt.save(
+                            epoch, self.state, overwrite=True,
+                            steps_per_epoch=spe,
+                        )
                     if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                         last_eval = self.evaluate()
                         logger.info(
@@ -263,12 +384,30 @@ class Trainer:
                 if profiling:
                     jax.profiler.stop_trace()
                 self.ckpt.wait()
+            if preempted:
+                return {
+                    "epochs_run": len(self.history),
+                    "preempted": True,
+                    "final_accuracy": None,
+                    "final_loss": None,
+                    "history": [dataclasses.asdict(h) for h in self.history],
+                }
             # Reuse the last per-epoch eval rather than re-running it.
             # Still inside the watchdog window: a hang in the final
             # eval collective or checkpoint flush must crash, not stall.
             final_acc, final_loss = last_eval or self.evaluate()
         finally:
             self._watchdog.stop()
+            if handler_installed:
+                import signal
+
+                # prev None means a non-Python (C-installed) handler we
+                # cannot reinstate — SIG_DFL beats leaving ours bound
+                # to this finished Trainer.
+                signal.signal(
+                    signal.SIGTERM,
+                    prev_handler if prev_handler is not None else signal.SIG_DFL,
+                )
         logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
         self.metrics_writer.write(
             "final", accuracy=final_acc, loss=final_loss,
@@ -287,8 +426,10 @@ class Trainer:
     # a small window keeps dispatch overlapped with compute.
     MAX_INFLIGHT_STEPS = 8
 
-    def _train_epoch(self, epoch: int) -> EpochStats:
+    def _train_epoch(self, epoch: int, skip_batches: int = 0) -> EpochStats:
         if self.fast_runner is not None:
+            # The fast path has no mid-epoch granularity (one dispatch
+            # per epoch); preemption is honored between epochs.
             return self._train_epoch_fast(epoch)
         cfg = self.config
         logger.info("Starting epoch %d", epoch)  # train_ddp.py:194 parity
@@ -297,7 +438,9 @@ class Trainer:
         last_metrics = None
         n_batches = 0
         inflight: deque = deque()
-        for batch_idx, batch in enumerate(self.loader.epoch(epoch)):
+        for batch_idx, batch in enumerate(
+            self.loader.epoch(epoch, skip_batches), start=skip_batches
+        ):
             self.state, metrics = self.train_step(
                 self.state, batch.images, batch.labels
             )
@@ -310,6 +453,15 @@ class Trainer:
             # collective stalls that block_until_ready, beats stop,
             # and the watchdog converts the hang into a crash.
             self._watchdog.beat()
+            if self.ctx.num_processes == 1:
+                if self._preempt_requested:
+                    break  # caller checkpoints the mid-epoch state
+            elif batch_idx % cfg.log_interval == 0 and self._preempt_agreed():
+                # Multi-host: breaking on the local flag alone would
+                # leave peers blocked in the next step's collective;
+                # _preempt_agreed runs at this deterministic cadence so
+                # every process exits at the SAME batch.
+                break
             if batch_idx % cfg.log_interval == 0:
                 # train_ddp.py:201-202 parity: rank-0 loss print. .item()
                 # syncs, so only at the log cadence.

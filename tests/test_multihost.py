@@ -90,16 +90,19 @@ def _ddp_step_worker(rank, world, out_dir):
         )
 
 
-def _trainer_worker(rank, world, epochs, ckpt_dir, data_root, out_dir):
+def _trainer_worker(
+    rank, world, epochs, ckpt_dir, data_root, out_dir,
+    batch_size=8, synthetic_size=128,
+):
     from ddp_tpu.runtime import dist
     from ddp_tpu.train.config import TrainConfig
     from ddp_tpu.train.trainer import Trainer
 
     config = TrainConfig(
         epochs=epochs,
-        batch_size=8,
+        batch_size=batch_size,
         synthetic_data=True,
-        synthetic_size=128,
+        synthetic_size=synthetic_size,
         checkpoint_dir=ckpt_dir,
         data_root=data_root,
         log_interval=8,
@@ -232,3 +235,81 @@ def test_spawn_gspmd_tensor_parallel_across_processes(tmp_path):
     assert np.isfinite(results[0]["loss"])
     assert results[0]["loss"] == results[1]["loss"]
     assert results[0]["param_sum"] == results[1]["param_sum"]
+
+
+def _preempting_trainer_worker(
+    rank, world, epochs, ckpt_dir, data_root, out_dir, preempt_rank, preempt_at
+):
+    """Only ``preempt_rank`` 'receives SIGTERM' (flag set after N local
+    steps); the cross-host agreement must stop BOTH ranks at the same
+    batch so the collective checkpoint save succeeds."""
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=epochs,
+        batch_size=4,
+        synthetic_data=True,
+        synthetic_size=256,
+        checkpoint_dir=ckpt_dir,
+        data_root=data_root,
+        log_interval=2,
+        eval_every=0,
+        num_workers=0,
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    if rank == preempt_rank:
+        orig = trainer.train_step
+        count = {"n": 0}
+
+        def wrapped(state, images, labels):
+            out = orig(state, images, labels)
+            count["n"] += 1
+            if count["n"] == preempt_at:
+                trainer._preempt_requested = True
+            return out
+
+        trainer.train_step = wrapped
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "preempted": bool(summary.get("preempted")),
+                "step": int(trainer.state.step),
+                "epochs_run": summary["epochs_run"],
+            },
+            f,
+        )
+
+
+def test_multihost_preemption_agreement_and_resume(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    data = str(tmp_path / "data")
+    out1 = tmp_path / "run1"
+    out1.mkdir()
+    # SIGTERM-equivalent lands on rank 1 only, mid-epoch.
+    spawn(
+        _preempting_trainer_worker,
+        2,
+        (2, ckpt, data, str(out1), 1, 5),
+        timeout=420,
+    )
+    first = _read(out1, 2)
+    assert [r["preempted"] for r in first] == [True, True]
+    # both ranks stopped at the SAME step, mid-epoch
+    assert first[0]["step"] == first[1]["step"]
+    assert 0 < first[0]["step"] < 32  # 256/(4*2) = 32 steps/epoch
+
+    # Re-launch with the SAME config (batch/dataset size), so the
+    # mid-epoch resume path genuinely engages.
+    out2 = tmp_path / "run2"
+    out2.mkdir()
+    spawn(
+        _trainer_worker, 2, (2, ckpt, data, str(out2), 4, 256), timeout=420
+    )
+    second = _read(out2, 2)
+    assert all(np.isfinite(r["acc"]) for r in second)

@@ -1,0 +1,100 @@
+"""Preemption-safe mid-epoch checkpoint/resume.
+
+Preemptible TPU VMs get SIGTERM before reclaim; the trainer must
+checkpoint at the next step boundary and, on re-run, re-enter the SAME
+epoch at the SAME batch with the SAME data order — the reference loses
+the whole in-progress epoch (no handler, epoch-granular saves only).
+The global step counter encodes intra-epoch progress, so no checkpoint
+format change is involved.
+"""
+
+import numpy as np
+import pytest
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def make_config(tmp_path, **kw):
+    defaults = dict(
+        epochs=2,
+        batch_size=4,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=512,  # 512/(4*8) = 16 steps/epoch
+        log_interval=4,
+        eval_every=0,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def test_preempt_mid_epoch_then_resume_exactly(tmp_path):
+    # Straight-through reference run for the expected data order.
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    t_ref = Trainer(make_config(tmp_path, checkpoint_dir=str(ref_dir / "ck")))
+    ref_labels = [
+        np.asarray(b.labels) for e in range(2) for b in t_ref.loader.epoch(e)
+    ]
+    t_ref.close()
+
+    # Run 1: preempt after ~3 batches of epoch 0 (flag set by a fake
+    # SIGTERM — the handler only flips this bool, so setting it from a
+    # step-count probe exercises the identical code path).
+    t1 = Trainer(make_config(tmp_path))
+    orig_step = t1.train_step
+    count = {"n": 0}
+
+    def counting_step(state, images, labels):
+        out = orig_step(state, images, labels)
+        count["n"] += 1
+        if count["n"] == 3:
+            t1._preempt_requested = True
+        return out
+
+    t1.train_step = counting_step
+    summary1 = t1.train()
+    t1.close()
+    assert summary1["preempted"] is True
+    assert summary1["epochs_run"] == 0  # epoch 0 incomplete
+
+    # Run 2: must resume at epoch 0, batch 3, and finish both epochs.
+    t2 = Trainer(make_config(tmp_path))
+    seen = []
+
+    orig_step2 = t2.train_step
+
+    def recording_step(state, images, labels):
+        seen.append(np.asarray(labels))
+        return orig_step2(state, images, labels)
+
+    t2.train_step = recording_step
+    summary2 = t2.train()
+    t2.close()
+    assert "preempted" not in summary2 or not summary2.get("preempted")
+    assert int(t2.state.step) == 32  # 2 epochs × 16 steps, no step lost
+    # data order continues exactly where run 1 stopped
+    expected = ref_labels[3:]
+    assert len(seen) == len(expected)
+    for a, b in zip(seen, expected):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sigterm_handler_sets_flag(tmp_path):
+    import os
+    import signal
+
+    t = Trainer(make_config(tmp_path, epochs=1, synthetic_size=128))
+    installed, prev = t._install_preemption_handler()
+    try:
+        assert installed
+        assert t._preempt_requested is False
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert t._preempt_requested is True
+    finally:
+        signal.signal(
+            signal.SIGTERM, prev if prev is not None else signal.SIG_DFL
+        )
+        t.close()
