@@ -1,0 +1,47 @@
+"""CLI/config parity with the reference's surface.
+
+The reference parses exactly --epochs (default 10) and --batch_size
+(default 32) (train_ddp.py:216-218) and hard-codes lr=0.01
+(train_ddp.py:41), ./checkpoints (train_ddp.py:53), ./data (data.py:11),
+log every 100 batches (train_ddp.py:201), shuffle=True (data.py:18),
+num_workers=2 (data.py:22), SGD (train_ddp.py:41). Those values are
+this framework's defaults so `python train.py` behaves like
+`python train_ddp.py`."""
+
+from ddp_tpu.train.config import TrainConfig
+
+
+def test_reference_defaults():
+    cfg = TrainConfig.from_args([])
+    assert cfg.epochs == 10  # train_ddp.py:217
+    assert cfg.batch_size == 32  # train_ddp.py:218
+    assert cfg.lr == 0.01  # train_ddp.py:41
+    assert cfg.momentum == 0.0  # SGD(lr=0.01) only
+    assert cfg.optimizer == "sgd"
+    assert cfg.checkpoint_dir == "./checkpoints"  # train_ddp.py:53
+    assert cfg.data_root == "./data"  # data.py:11
+    assert cfg.log_interval == 100  # train_ddp.py:201
+    assert cfg.shuffle is True  # data.py:18
+    assert cfg.num_workers == 2  # data.py:22
+    assert cfg.dataset == "mnist"
+    assert cfg.model == "simple_cnn"
+
+
+def test_reference_flags_roundtrip():
+    cfg = TrainConfig.from_args(["--epochs", "3", "--batch_size", "64"])
+    assert cfg.epochs == 3 and cfg.batch_size == 64
+
+
+def test_framework_knobs_default_off():
+    """Everything beyond the reference's surface defaults to parity
+    behavior: no accumulation, no augmentation, pure-DDP mesh, fp32,
+    no watchdog, step-at-a-time path."""
+    cfg = TrainConfig.from_args([])
+    assert cfg.grad_accum_steps == 1
+    assert cfg.augment is None
+    assert cfg.mesh_model == cfg.mesh_fsdp == cfg.mesh_expert == 1
+    assert cfg.compute_dtype == "float32"
+    assert cfg.watchdog_timeout == 0.0
+    assert cfg.fast_epoch is False
+    assert cfg.spawn == 1
+    assert cfg.synthetic_data is False
