@@ -86,13 +86,8 @@ class ShardingRules:
                 spec[-1] = "model"
             elif any(re.search(p, name) for p in self.row) and shape[-2] % tp == 0:
                 spec[-2] = "model"
-        if fsdp > 1 and _size(shape) >= self.fsdp_min_size:
-            # Shard the largest still-unsharded dim that divides evenly.
-            order = sorted(range(len(shape)), key=lambda i: -shape[i])
-            for i in order:
-                if spec[i] is None and shape[i] % fsdp == 0:
-                    spec[i] = "fsdp"
-                    break
+        if _size(shape) >= self.fsdp_min_size:
+            _shard_largest_dim(spec, shape, "fsdp", fsdp)
         return P(*spec)
 
 
@@ -101,6 +96,17 @@ def _size(shape) -> int:
     for s in shape:
         n *= s
     return n
+
+
+def _shard_largest_dim(spec: list, shape, axis_name: str, axis_size: int) -> list:
+    """Put ``axis_name`` on the largest still-unsharded dim that divides
+    evenly (shared by the fsdp rule and the ZeRO-1 layout)."""
+    if axis_size > 1:
+        for i in sorted(range(len(shape)), key=lambda j: -shape[j]):
+            if spec[i] is None and shape[i] % axis_size == 0:
+                spec[i] = axis_name
+                break
+    return spec
 
 
 def param_specs(params, mesh: Mesh, rules: ShardingRules | None = None):
@@ -129,6 +135,51 @@ def constrain_tree(tree, mesh: Mesh, rules: ShardingRules | None = None):
     )
 
 
+def zero1_spec_for(shape, mesh: Mesh, *, min_size: int = 2**12) -> P:
+    """ZeRO-1 layout: shard a state tensor's largest fitting dim on the
+    DATA axis. Params stay replicated (unlike fsdp); only the optimizer
+    math and its memory are partitioned."""
+    spec: list = [None] * len(shape)
+    if _size(shape) >= min_size:
+        _shard_largest_dim(spec, shape, "data", mesh.shape.get("data", 1))
+    return P(*spec)
+
+
+def check_zero1_mesh(mesh: Mesh) -> None:
+    """ZeRO-1 swaps out the rule-based opt-state layout entirely, so it
+    must not silently undo fsdp/tp/expert sharding of the moments —
+    reject the combination (those meshes already shard optimizer state
+    their own way; stage 1 is for pure data-parallel meshes)."""
+    bad = {
+        a: mesh.shape[a]
+        for a in ("model", "fsdp", "expert")
+        if mesh.shape.get(a, 1) > 1
+    }
+    if bad:
+        raise ValueError(
+            f"zero1 only composes with pure data-parallel meshes; "
+            f"{bad} already shard optimizer state via the rule layout "
+            f"(fsdp IS ZeRO-3) — drop --zero1 or the mesh axes"
+        )
+
+
+def constrain_zero1(opt_state, mesh: Mesh):
+    """with_sharding_constraint optimizer-state leaves to ZeRO-1 specs.
+
+    The reference replicates optimizer state on every rank (plain SGD,
+    SURVEY.md §2c "ZeRO: No"); with Adam-family optimizers the moments
+    are 2× the params — sharding them over data cuts that memory by the
+    data-parallel degree while XLA keeps the update math local to each
+    shard and all-gathers only the applied updates.
+    """
+    return jax.tree.map(
+        lambda x: lax.with_sharding_constraint(
+            x, NamedSharding(mesh, zero1_spec_for(x.shape, mesh))
+        ),
+        opt_state,
+    )
+
+
 def batch_spec(mesh: Mesh) -> P:
     """Batch dim sharded over every data-parallel axis present.
 
@@ -150,15 +201,20 @@ def create_spmd_state(
     *,
     rules: ShardingRules | None = None,
     seed: int = 0,
+    zero1: bool = False,
 ) -> TrainState:
     """Initialize directly into the sharded layout.
 
     Params get their rule specs; GSPMD propagates those through
     ``optimizer.init`` so optimizer state comes out sharded the same
-    way (ZeRO without writing ZeRO). Nothing materializes replicated
-    first — safe for models larger than one chip's HBM.
+    way (ZeRO without writing ZeRO). ``zero1=True`` instead shards the
+    optimizer state over the DATA axis while params stay replicated
+    (ZeRO stage 1). Nothing materializes replicated first — safe for
+    models larger than one chip's HBM.
     """
     rules = rules or ShardingRules()
+    if zero1:
+        check_zero1_mesh(mesh)
 
     def init_fn():
         variables = model.init(
@@ -166,10 +222,16 @@ def create_spmd_state(
         )
         params = constrain_tree(variables["params"], mesh, rules)
         model_state = {k: v for k, v in variables.items() if k != "params"}
+        opt_state = optimizer.init(params)
+        opt_state = (
+            constrain_zero1(opt_state, mesh)
+            if zero1
+            else constrain_tree(opt_state, mesh, rules)
+        )
         return TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
-            opt_state=constrain_tree(optimizer.init(params), mesh, rules),
+            opt_state=opt_state,
             model_state=model_state,
         )
 
@@ -188,6 +250,7 @@ def make_spmd_train_step(
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
     augment_fn=None,
+    zero1: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
 
@@ -201,6 +264,8 @@ def make_spmd_train_step(
     (``lax.scan``) into one update, like the DDP path.
     """
     rules = rules or ShardingRules()
+    if zero1:
+        check_zero1_mesh(mesh)
     bspec = batch_spec(mesh)
     loss_fn = make_loss_fn(
         model, compute_dtype, aux_loss_weight, augment_fn=augment_fn
@@ -245,7 +310,14 @@ def make_spmd_train_step(
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         grads = constrain_tree(grads, mesh, rules)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-        opt_state = constrain_tree(opt_state, mesh, rules)
+        # ZeRO-1: the moment/trace math runs on data-sharded slices;
+        # applying the (replicated-constrained) updates below is the
+        # implied all-gather.
+        opt_state = (
+            constrain_zero1(opt_state, mesh)
+            if zero1
+            else constrain_tree(opt_state, mesh, rules)
+        )
         params = constrain_tree(
             optax.apply_updates(state.params, updates), mesh, rules
         )

@@ -52,6 +52,7 @@ class TrainConfig:
     mesh_model: int = 1  # tensor parallelism
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
+    zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     eval_every: int = 1  # epochs between test-split evals (0 = only final)
@@ -112,6 +113,7 @@ class TrainConfig:
         p.add_argument("--mesh_model", type=int, default=cls.mesh_model)
         p.add_argument("--mesh_fsdp", type=int, default=cls.mesh_fsdp)
         p.add_argument("--mesh_expert", type=int, default=cls.mesh_expert)
+        p.add_argument("--zero1", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)
         p.add_argument(
             "--compute_dtype", default=cls.compute_dtype,

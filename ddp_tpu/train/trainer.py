@@ -74,6 +74,7 @@ class Trainer:
             config.mesh_model > 1
             or config.mesh_fsdp > 1
             or config.mesh_expert > 1
+            or config.zero1  # opt-state sharding rides the GSPMD step
         )
         self.mesh = make_mesh(
             MeshSpec(
@@ -152,6 +153,7 @@ class Trainer:
                 compute_dtype=compute_dtype, seed=config.seed,
                 grad_accum_steps=config.grad_accum_steps,
                 augment_fn=augment_fn,
+                zero1=config.zero1,
             )
             self.eval_step = make_spmd_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
@@ -159,6 +161,7 @@ class Trainer:
             self.state = create_spmd_state(
                 self.model, self.optimizer, sample, self.mesh,
                 seed=config.seed,
+                zero1=config.zero1,
             )
         else:
             self.train_step = make_train_step(
