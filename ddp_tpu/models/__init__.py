@@ -50,5 +50,24 @@ def _register_all():
     # Expert-parallel family (no reference counterpart — SURVEY.md §2c)
     register("vit_moe_tiny")(MoEViTTiny)
 
+    # Micro configs: smoke tests / CI / CLI dry runs. Same code paths
+    # as the tiny family at a fraction of the compile+step cost.
+    from ddp_tpu.models.moe import MoEViT
+    from ddp_tpu.models.vit import ViT
+
+    register("vit_micro")(
+        lambda num_classes=10, depth=2, **kw: ViT(
+            num_classes=num_classes, patch_size=7, embed_dim=32,
+            depth=depth, num_heads=4, **kw,
+        )
+    )
+    register("vit_moe_micro")(
+        lambda num_classes=10, depth=2, num_experts=4, **kw: MoEViT(
+            num_classes=num_classes, patch_size=7, embed_dim=32,
+            depth=depth, num_heads=4, num_experts=num_experts,
+            moe_every=2, **kw,
+        )
+    )
+
 
 _register_all()

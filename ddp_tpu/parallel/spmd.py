@@ -49,6 +49,9 @@ from ddp_tpu.parallel.common import (
 from ddp_tpu.parallel.ddp import StepMetrics, TrainState
 
 
+MIN_SHARD_SIZE = 2**12  # tensors smaller than this stay replicated
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardingRules:
     """Name-pattern → parallel style, matched against the param path.
@@ -62,10 +65,11 @@ class ShardingRules:
     big enough is fsdp-sharded on its largest dimension.
     """
 
+    # shared "stay replicated below this" threshold (fsdp AND zero1)
     column: tuple[str, ...] = ("qkv", "mlp1", "moe/wi")
     row: tuple[str, ...] = ("proj", "mlp2", "moe/wo")
     expert: tuple[str, ...] = (r"moe/(wi|wo|bi|bo)",)
-    fsdp_min_size: int = 2**12  # params smaller than this stay replicated
+    fsdp_min_size: int = MIN_SHARD_SIZE
 
     def spec_for(self, path: tuple[str, ...], shape: tuple[int, ...], mesh: Mesh) -> P:
         tp = mesh.shape.get("model", 1)
@@ -135,7 +139,7 @@ def constrain_tree(tree, mesh: Mesh, rules: ShardingRules | None = None):
     )
 
 
-def zero1_spec_for(shape, mesh: Mesh, *, min_size: int = 2**12) -> P:
+def zero1_spec_for(shape, mesh: Mesh, *, min_size: int = MIN_SHARD_SIZE) -> P:
     """ZeRO-1 layout: shard a state tensor's largest fitting dim on the
     DATA axis. Params stay replicated (unlike fsdp); only the optimizer
     math and its memory are partitioned."""

@@ -13,7 +13,7 @@ def make_config(tmp_path, **kw):
     defaults = dict(
         epochs=1,
         batch_size=8,
-        model="vit_tiny",  # matmul path; scanned convs are a CPU tarpit
+        model="vit_micro",  # matmul path; scanned convs are a CPU tarpit
         model_depth=1,
         num_classes=10,
         optimizer="adam",

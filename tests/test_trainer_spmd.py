@@ -31,8 +31,7 @@ def make_config(tmp_path, **kw):
 def test_tp_fsdp_trainer_trains_and_resumes(tmp_path):
     cfg = make_config(
         tmp_path,
-        model="vit_tiny",
-        model_depth=2,
+        model="vit_micro",
         num_classes=10,
         mesh_model=2,
         mesh_fsdp=2,
@@ -59,8 +58,7 @@ def test_tp_fsdp_trainer_trains_and_resumes(tmp_path):
     # resume with the sharded state
     t2 = Trainer(make_config(
         tmp_path,
-        model="vit_tiny",
-        model_depth=2,
+        model="vit_micro",
         num_classes=10,
         mesh_model=2,
         mesh_fsdp=2,
@@ -77,8 +75,7 @@ def test_tp_fsdp_trainer_trains_and_resumes(tmp_path):
 def test_expert_parallel_trainer(tmp_path):
     cfg = make_config(
         tmp_path,
-        model="vit_moe_tiny",
-        model_depth=2,
+        model="vit_moe_micro",
         num_classes=10,
         mesh_expert=2,
         mesh_model=2,

@@ -23,7 +23,7 @@ from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
 
 def _setup(devices, zero1, tx=None):
     mesh = make_mesh(MeshSpec(data=8), devices=devices)
-    vit = ViT(num_classes=10, patch_size=7, embed_dim=64, depth=2, num_heads=4)
+    vit = ViT(num_classes=10, patch_size=7, embed_dim=32, depth=2, num_heads=4)
     tx = tx or optax.adam(1e-3)
     state = create_spmd_state(
         vit, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0, zero1=zero1
@@ -106,3 +106,46 @@ def test_zero1_rejects_sharded_meshes(devices):
             vit, optax.adam(1e-3), jnp.zeros((1, 28, 28, 1)), mesh,
             seed=0, zero1=True,
         )
+
+
+def test_trainer_zero1_checkpoints_and_resumes(tmp_path):
+    """--zero1 end to end through the Trainer: data-sharded optimizer
+    state must round-trip Orbax and resume."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    def cfg(epochs):
+        return TrainConfig(
+            epochs=epochs,
+            batch_size=4,
+            model="vit_micro",
+            num_classes=10,
+            optimizer="adam",
+            lr=1e-3,
+            zero1=True,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=256,
+            log_interval=8,
+            eval_every=0,
+        )
+
+    t = Trainer(cfg(1))
+    assert t.use_spmd
+    sharded = [
+        m
+        for m in jax.tree.leaves(t.state.opt_state)
+        if hasattr(m, "sharding")
+        and "data" in jax.tree.leaves(tuple(m.sharding.spec))
+    ]
+    assert sharded
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
+
+    t2 = Trainer(cfg(2))
+    summary2 = t2.train()
+    t2.close()
+    assert summary2["epochs_run"] == 1
+    assert summary2["history"][0]["epoch"] == 1
