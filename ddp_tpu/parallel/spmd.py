@@ -65,10 +65,10 @@ class ShardingRules:
     big enough is fsdp-sharded on its largest dimension.
     """
 
-    # shared "stay replicated below this" threshold (fsdp AND zero1)
     column: tuple[str, ...] = ("qkv", "mlp1", "moe/wi")
     row: tuple[str, ...] = ("proj", "mlp2", "moe/wo")
     expert: tuple[str, ...] = (r"moe/(wi|wo|bi|bo)",)
+    # shared "stay replicated below this" threshold (fsdp AND zero1)
     fsdp_min_size: int = MIN_SHARD_SIZE
 
     def spec_for(self, path: tuple[str, ...], shape: tuple[int, ...], mesh: Mesh) -> P:

@@ -33,14 +33,16 @@ def _ddp_step_worker(rank, world, out_dir):
     import jax
     import jax.numpy as jnp
     import optax
-    from jax.sharding import Mesh, NamedSharding as NS, PartitionSpec as PS
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     # cross-process psum sanity first (was a separate spawn)
     m0 = Mesh(np.array(jax.devices()), ("data",))
     arr = jax.make_array_from_process_local_data(
-        NS(m0, PS("data")), np.array([float(rank + 1)], np.float32)
+        NamedSharding(m0, P("data")), np.array([float(rank + 1)], np.float32)
     )
-    psum_total = float(jax.jit(jnp.sum, out_shardings=NS(m0, PS()))(arr))
+    psum_total = float(
+        jax.jit(jnp.sum, out_shardings=NamedSharding(m0, P()))(arr)
+    )
 
     from ddp_tpu.models import get_model
     from ddp_tpu.parallel.ddp import (
@@ -63,8 +65,6 @@ def _ddp_step_worker(rank, world, out_dir):
     rng = np.random.default_rng(100 + rank)
     images = rng.integers(0, 256, size=(4, 28, 28, 1), dtype=np.uint8)
     labels = rng.integers(0, 10, size=(4,)).astype(np.int32)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     sh = NamedSharding(mesh, P("data"))
     state, metrics = step(
         state,

@@ -1,0 +1,100 @@
+"""ImageFolder → mmap-array preprocessing (scripts/preprocess_imagenet.py):
+the one-time job that feeds --dataset imagenet (data/imagenet.py)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+)
+
+from ddp_tpu.data import imagenet
+
+
+def _make_tree(root, split, classes, per_class, side=40):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls in classes:
+        d = root / split / cls
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 256, (side, side + 8, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"img{i}.png")
+
+
+def test_convert_and_load_roundtrip(tmp_path):
+    import preprocess_imagenet as pp
+
+    src, out = tmp_path / "src", tmp_path / "out"
+    classes = ["n01", "n02", "n03"]
+    _make_tree(src, "train", classes, per_class=4)
+    _make_tree(src, "val", classes, per_class=2)
+
+    rc = pp.main(
+        ["--src", str(src), "--out", str(out), "--size", "32",
+         "--resize", "36", "--workers", "2"]
+    )
+    assert rc == 0
+    assert not list(out.glob("*.part*"))  # temp names atomically renamed
+
+    train = imagenet.load(str(out), "train")
+    test = imagenet.load(str(out), "test")
+    assert train.images.shape == (12, 32, 32, 3)
+    assert test.images.shape == (6, 32, 32, 3)
+    assert train.images.dtype == np.uint8
+    # sorted-directory label order, like torchvision ImageFolder
+    mapping = json.loads((out / "imagenet_classes.json").read_text())
+    assert mapping == {"n01": 0, "n02": 1, "n03": 2}
+    assert sorted(set(train.labels.tolist())) == [0, 1, 2]
+    # 4 images per class, grouped by sorted class dir
+    assert train.labels.tolist() == sorted(train.labels.tolist())
+
+
+def test_decode_resize_center_crop(tmp_path):
+    from PIL import Image
+
+    import preprocess_imagenet as pp
+
+    arr = np.zeros((60, 100, 3), np.uint8)
+    arr[:, 40:60] = 255  # white vertical band in the center
+    p = tmp_path / "x.png"
+    Image.fromarray(arr).save(p)
+    out = pp.decode(str(p), resize=36, size=32)
+    assert out.shape == (32, 32, 3)
+    # center crop keeps the central band bright
+    assert out[:, 12:20].mean() > 200
+
+
+def test_empty_split_raises(tmp_path):
+    import preprocess_imagenet as pp
+
+    (tmp_path / "src" / "train" / "n01").mkdir(parents=True)
+    with pytest.raises(SystemExit, match="no images"):
+        pp.main(["--src", str(tmp_path / "src"), "--out", str(tmp_path / "o")])
+
+
+def test_unknown_val_class_is_hard_error(tmp_path):
+    import preprocess_imagenet as pp
+
+    src = tmp_path / "src"
+    _make_tree(src, "train", ["n01", "n02"], per_class=1)
+    _make_tree(src, "val", ["n01", "n03"], per_class=1)  # n03 not in train
+    with pytest.raises(SystemExit, match="not present in the train split"):
+        pp.main(["--src", str(src), "--out", str(tmp_path / "o"),
+                 "--size", "32", "--resize", "36"])
+
+
+def test_val_and_test_both_present_rejected(tmp_path):
+    import preprocess_imagenet as pp
+
+    src = tmp_path / "src"
+    _make_tree(src, "train", ["n01"], per_class=1)
+    _make_tree(src, "val", ["n01"], per_class=1)
+    _make_tree(src, "test", ["n01"], per_class=1)
+    with pytest.raises(SystemExit, match="BOTH val/ and test/"):
+        pp.main(["--src", str(src), "--out", str(tmp_path / "o")])

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ddp_tpu.models.vit import ViT
+from ddp_tpu.models import get_model
 from ddp_tpu.parallel.spmd import (
     batch_spec,
     create_spmd_state,
@@ -23,7 +23,7 @@ from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
 
 def _setup(devices, zero1, tx=None):
     mesh = make_mesh(MeshSpec(data=8), devices=devices)
-    vit = ViT(num_classes=10, patch_size=7, embed_dim=32, depth=2, num_heads=4)
+    vit = get_model("vit_micro")
     tx = tx or optax.adam(1e-3)
     state = create_spmd_state(
         vit, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0, zero1=zero1
@@ -45,16 +45,21 @@ def _batch(mesh, n=16, seed=0):
     )
 
 
-def test_opt_state_sharded_params_replicated(devices):
-    mesh, state, _ = _setup(devices, zero1=True)
-    # every big Adam moment is sharded on the data axis
-    sharded = [
+def _data_sharded_moments(opt_state):
+    return [
         m
-        for m in jax.tree.leaves(state.opt_state)
+        for m in jax.tree.leaves(opt_state)
         if hasattr(m, "sharding")
         and "data" in jax.tree.leaves(tuple(m.sharding.spec))
     ]
-    assert sharded, "no optimizer-state leaf sharded on data"
+
+
+def test_opt_state_sharded_params_replicated(devices):
+    mesh, state, _ = _setup(devices, zero1=True)
+    # every big Adam moment is sharded on the data axis
+    assert _data_sharded_moments(state.opt_state), (
+        "no optimizer-state leaf sharded on data"
+    )
     # params stay fully replicated
     for p in jax.tree.leaves(state.params):
         assert all(s is None for s in p.sharding.spec), p.sharding.spec
@@ -97,10 +102,8 @@ def test_zero1_adam_single_step_matches(devices):
 def test_zero1_rejects_sharded_meshes(devices):
     import pytest
 
-    from ddp_tpu.models.vit import ViT as _V
-
     mesh = make_mesh(MeshSpec(data=4, fsdp=2), devices=devices)
-    vit = _V(num_classes=10, patch_size=7, embed_dim=32, depth=2, num_heads=4)
+    vit = get_model("vit_micro")
     with pytest.raises(ValueError, match="pure data-parallel"):
         create_spmd_state(
             vit, optax.adam(1e-3), jnp.zeros((1, 28, 28, 1)), mesh,
@@ -133,13 +136,7 @@ def test_trainer_zero1_checkpoints_and_resumes(tmp_path):
 
     t = Trainer(cfg(1))
     assert t.use_spmd
-    sharded = [
-        m
-        for m in jax.tree.leaves(t.state.opt_state)
-        if hasattr(m, "sharding")
-        and "data" in jax.tree.leaves(tuple(m.sharding.spec))
-    ]
-    assert sharded
+    assert _data_sharded_moments(t.state.opt_state)
     summary = t.train()
     t.close()
     assert summary["epochs_run"] == 1
