@@ -57,13 +57,44 @@ class CheckpointManager:
         *,
         max_to_keep: int | None = None,
         async_save: bool = True,
+        keep_best_metric: str | None = None,
     ):
+        """``keep_best_metric``: retain the ``max_to_keep`` checkpoints
+        with the HIGHEST value of that metric (passed to ``save``) PLUS
+        the chronologically latest one — best-N alone would delete the
+        newest checkpoint whenever it underperforms, silently breaking
+        latest-epoch auto-resume (restarts would re-train completed
+        epochs). Saves without metrics (preemption artifacts) are
+        always preserved.
+        """
         self._dir = os.path.abspath(directory)
+        preservation = None
+        if keep_best_metric:
+            from orbax.checkpoint.checkpoint_managers import (
+                AnyPreservationPolicy,
+                BestN,
+                LatestN,
+            )
+
+            preservation = AnyPreservationPolicy(
+                [
+                    LatestN(1),  # auto-resume anchor
+                    BestN(
+                        get_metric_fn=lambda m: m[keep_best_metric],
+                        # reverse=False keeps the HIGHEST metric values
+                        # (empirically: reverse=True retains the lowest)
+                        reverse=False,
+                        n=max_to_keep,
+                        keep_checkpoints_without_metrics=True,
+                    ),
+                ]
+            )
         opts = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
+            max_to_keep=None if keep_best_metric else max_to_keep,
             create=True,
             enable_async_checkpointing=async_save,
             step_prefix="epoch",
+            preservation_policy=preservation,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=opts)
 
@@ -82,6 +113,7 @@ class CheckpointManager:
         *,
         overwrite: bool = False,
         steps_per_epoch: int = 0,
+        metrics: dict | None = None,
     ) -> bool:
         """Save ``{params, opt_state, step}`` for ``epoch``.
 
@@ -111,7 +143,9 @@ class CheckpointManager:
         # mid-epoch artifact from a completed-epoch save under a
         # CHANGED config (step-counter arithmetic alone can collide).
         tree = dict(state._asdict(), spe=np.int32(steps_per_epoch))
-        self._mgr.save(epoch, args=ocp.args.StandardSave(tree))
+        self._mgr.save(
+            epoch, args=ocp.args.StandardSave(tree), metrics=metrics
+        )
         return True
 
     def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:

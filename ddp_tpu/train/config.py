@@ -61,6 +61,9 @@ class TrainConfig:
     # Single-process, pure-DDP, no grad accumulation.
     fast_epoch: bool = False
     max_checkpoints: int | None = None  # None = keep all, like the reference
+    # Retain the max_checkpoints BEST-accuracy epochs instead of the
+    # most recent (requires eval_every=1 so every save has a metric).
+    keep_best: bool = False
     synthetic_data: bool = False  # offline fallback dataset
     synthetic_size: int | None = None
     profile_dir: str | None = None  # jax.profiler trace output
@@ -122,6 +125,7 @@ class TrainConfig:
         p.add_argument("--eval_every", type=int, default=cls.eval_every)
         p.add_argument("--fast_epoch", action="store_true")
         p.add_argument("--max_checkpoints", type=int, default=None)
+        p.add_argument("--keep_best", action="store_true")
         p.add_argument("--synthetic_data", action="store_true")
         p.add_argument("--synthetic_size", type=int, default=None)
         p.add_argument("--profile_dir", default=None)

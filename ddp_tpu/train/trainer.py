@@ -220,8 +220,15 @@ class Trainer:
                 compute_dtype=compute_dtype, seed=config.seed,
                 augment_fn=augment_fn,
             )
+        if config.keep_best and config.eval_every != 1:
+            raise ValueError(
+                "--keep_best ranks checkpoints by eval accuracy, so "
+                "every epoch needs one: set --eval_every 1"
+            )
         self.ckpt = CheckpointManager(
-            config.checkpoint_dir, max_to_keep=config.max_checkpoints
+            config.checkpoint_dir,
+            max_to_keep=config.max_checkpoints,
+            keep_best_metric="accuracy" if config.keep_best else None,
         )
         self.metrics_writer = MetricsWriter(
             config.metrics_file, enabled=self.ctx.is_main
@@ -348,6 +355,10 @@ class Trainer:
                     if self._preempt_agreed():
                         # Mid-epoch state, tagged with the incomplete
                         # epoch; overwrite any older preemption save.
+                        # No metrics on purpose: metric-less saves are
+                        # always preserved under keep_best (a ranked
+                        # sentinel would be garbage-collected as worst
+                        # and the preemption state lost).
                         self.ckpt.save(
                             epoch, self.state, overwrite=True,
                             steps_per_epoch=spe,
@@ -361,28 +372,40 @@ class Trainer:
                         preempted = True
                         break
                     self.history.append(stats)
+                    do_eval = bool(
+                        cfg.eval_every and (epoch + 1) % cfg.eval_every == 0
+                    )
+                    # keep_best needs the metric AT save time, so eval
+                    # runs first only there; otherwise save first — a
+                    # failure during a long eval must not lose the
+                    # fully-trained epoch.
+                    if cfg.keep_best and do_eval:
+                        last_eval = self.evaluate()
+                        metrics = {"accuracy": last_eval[0]}
+                    else:
+                        last_eval, metrics = None, None
                     # overwrite=False: if a mid-epoch preemption
                     # artifact holds this tag, keep it (redo-on-crash)
                     # rather than opening a delete-before-commit window;
                     # a later epoch's save supersedes it. If this was
                     # the LAST epoch, supersede explicitly below.
                     saved = self.ckpt.save(
-                        epoch, self.state, steps_per_epoch=spe
+                        epoch, self.state, steps_per_epoch=spe,
+                        metrics=metrics,
                     )
                     if not saved and epoch == cfg.epochs - 1:
                         self.ckpt.save(
                             epoch, self.state, overwrite=True,
-                            steps_per_epoch=spe,
+                            steps_per_epoch=spe, metrics=metrics,
                         )
-                    if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                    if do_eval and last_eval is None:
                         last_eval = self.evaluate()
+                    if last_eval is not None:
                         logger.info(
                             "Epoch %d eval: accuracy %.4f loss %.4f",
                             epoch,
                             *last_eval,
                         )
-                    else:
-                        last_eval = None
             finally:
                 if profiling:
                     jax.profiler.stop_trace()

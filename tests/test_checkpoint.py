@@ -131,3 +131,54 @@ class TestCrashResilience:
             np.asarray(jax.tree.leaves(restored.params)[0]),
             np.asarray(jax.tree.leaves(perturb(state, 0.5).params)[0]),
         )
+
+
+class TestKeepBest:
+    def test_best_metric_checkpoint_retained(self, state_and_tx, tmp_ckpt_dir):
+        state, _ = state_and_tx
+        mgr = CheckpointManager(
+            tmp_ckpt_dir, max_to_keep=1, async_save=False,
+            keep_best_metric="accuracy",
+        )
+        mgr.save(0, state, metrics={"accuracy": 0.5})
+        mgr.save(1, perturb(state, 0.1), metrics={"accuracy": 0.9})
+        mgr.save(2, perturb(state, 0.2), metrics={"accuracy": 0.7})
+        mgr.wait()
+        # best (0.9) AND the latest (auto-resume anchor) survive
+        assert sorted(mgr._mgr.all_steps()) == [1, 2]
+        assert mgr.latest_epoch() == 2
+
+    def test_trainer_keep_best_requires_eval_every_1(self, tmp_path):
+        import pytest
+
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            epochs=1, batch_size=8, keep_best=True, eval_every=0,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True, synthetic_size=128,
+        )
+        with pytest.raises(ValueError, match="eval_every 1"):
+            Trainer(cfg)
+
+    def test_trainer_keep_best_smoke(self, tmp_path):
+        import os
+
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            epochs=2, batch_size=8, keep_best=True, eval_every=1,
+            max_checkpoints=1,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True, synthetic_size=256, log_interval=8,
+        )
+        t = Trainer(cfg)
+        summary = t.train()
+        t.close()
+        assert summary["epochs_run"] == 2
+        kept = [d for d in os.listdir(cfg.checkpoint_dir) if "epoch" in d]
+        assert 1 <= len(kept) <= 2  # best-1 plus (possibly same) latest
