@@ -128,14 +128,15 @@ class MoEEncoderBlock(nn.Module):
     capacity_factor: float = 2.0
     dropout_rate: float = 0.0
     attention_fn: AttentionFn = dot_product_attention
+    deterministic: bool = True  # attribute, not call kwarg — remat-safe
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
         y = MultiHeadAttention(
             self.num_heads, attention_fn=self.attention_fn, name="attn"
-        )(y, deterministic=deterministic)
-        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        )(y, deterministic=self.deterministic)
+        y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(x.dtype)
         y = MoEMLP(
@@ -144,8 +145,8 @@ class MoEEncoderBlock(nn.Module):
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
             name="moe",
-        )(y, deterministic=deterministic)
-        y = nn.Dropout(self.dropout_rate, deterministic=deterministic)(y)
+        )(y, deterministic=self.deterministic)
+        y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         return x + y
 
 
@@ -169,6 +170,7 @@ class MoEViT(nn.Module):
     moe_every: int = 2
     dropout_rate: float = 0.0
     attention_fn: AttentionFn = dot_product_attention
+    remat: bool = False  # jax.checkpoint each block (see models/vit.py)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -187,9 +189,11 @@ class MoEViT(nn.Module):
         x = x + pos.astype(x.dtype)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         mlp_dim = self.embed_dim * self.mlp_ratio
+        moe_cls = nn.remat(MoEEncoderBlock) if self.remat else MoEEncoderBlock
+        dense_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.depth):
             if (i + 1) % self.moe_every == 0:
-                x = MoEEncoderBlock(
+                x = moe_cls(
                     num_heads=self.num_heads,
                     mlp_dim=mlp_dim,
                     num_experts=self.num_experts,
@@ -197,16 +201,18 @@ class MoEViT(nn.Module):
                     capacity_factor=self.capacity_factor,
                     dropout_rate=self.dropout_rate,
                     attention_fn=self.attention_fn,
+                    deterministic=not train,
                     name=f"block{i + 1}",
-                )(x, deterministic=not train)
+                )(x)
             else:
-                x = EncoderBlock(
+                x = dense_cls(
                     num_heads=self.num_heads,
                     mlp_dim=mlp_dim,
                     dropout_rate=self.dropout_rate,
                     attention_fn=self.attention_fn,
+                    deterministic=not train,
                     name=f"block{i + 1}",
-                )(x, deterministic=not train)
+                )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(
             x.mean(axis=1)

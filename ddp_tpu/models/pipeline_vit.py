@@ -41,6 +41,7 @@ class PipeViTConfig(NamedTuple):
     depth_per_stage: int = 1
     num_microbatches: int = 4
     attention_fn: AttentionFn = dot_product_attention
+    remat: bool = False  # jax.checkpoint each stage's blocks
 
 
 class PatchEmbed(nn.Module):
@@ -74,16 +75,18 @@ class StageBlocks(nn.Module):
     num_heads: int
     mlp_dim: int
     attention_fn: AttentionFn = dot_product_attention
+    remat: bool = False  # jax.checkpoint each block (see models/vit.py)
 
     @nn.compact
     def __call__(self, x):
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.depth):
-            x = EncoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 attention_fn=self.attention_fn,
                 name=f"block{i + 1}",
-            )(x, deterministic=True)
+            )(x)
         return x
 
 
@@ -117,6 +120,7 @@ def _modules(cfg: PipeViTConfig):
         num_heads=cfg.num_heads,
         mlp_dim=cfg.embed_dim * cfg.mlp_ratio,
         attention_fn=cfg.attention_fn,
+        remat=cfg.remat,
     )
     head = PipeHead(num_classes=cfg.num_classes)
     return embed, stage, head

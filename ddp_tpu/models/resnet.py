@@ -93,6 +93,9 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     cifar_stem: bool = False  # 3×3/1 stem, no pool (32×32 inputs)
+    # jax.checkpoint each residual block: recompute activations in the
+    # backward instead of storing them — HBM for FLOPs (see models/vit.py).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -111,10 +114,11 @@ class ResNet(nn.Module):
         x = nn.relu(x)
         if not self.cifar_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block_cls = nn.remat(self.block) if self.remat else self.block
         for stage, num_blocks in enumerate(self.stage_sizes):
             for block_idx in range(num_blocks):
                 strides = 2 if stage > 0 and block_idx == 0 else 1
-                x = self.block(
+                x = block_cls(
                     features=self.width * 2**stage,
                     strides=strides,
                     norm=norm,
@@ -125,28 +129,37 @@ class ResNet(nn.Module):
         return x
 
 
-def ResNet18(num_classes: int = 10, cifar_stem: bool = True) -> ResNet:
+def ResNet18(
+    num_classes: int = 10, cifar_stem: bool = True, remat: bool = False
+) -> ResNet:
     return ResNet(
         stage_sizes=(2, 2, 2, 2),
         block=BasicBlock,
         num_classes=num_classes,
         cifar_stem=cifar_stem,
+        remat=remat,
     )
 
 
-def ResNet34(num_classes: int = 1000, cifar_stem: bool = False) -> ResNet:
+def ResNet34(
+    num_classes: int = 1000, cifar_stem: bool = False, remat: bool = False
+) -> ResNet:
     return ResNet(
         stage_sizes=(3, 4, 6, 3),
         block=BasicBlock,
         num_classes=num_classes,
         cifar_stem=cifar_stem,
+        remat=remat,
     )
 
 
-def ResNet50(num_classes: int = 1000, cifar_stem: bool = False) -> ResNet:
+def ResNet50(
+    num_classes: int = 1000, cifar_stem: bool = False, remat: bool = False
+) -> ResNet:
     return ResNet(
         stage_sizes=(3, 4, 6, 3),
         block=BottleneckBlock,
         num_classes=num_classes,
         cifar_stem=cifar_stem,
+        remat=remat,
     )

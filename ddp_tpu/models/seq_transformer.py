@@ -53,6 +53,10 @@ class LongContextTransformer(nn.Module):
     mlp_ratio: int = 4
     attention_fn: Callable = dot_product_attention
     pool_fn: Callable = lambda x: x.mean(axis=1)
+    # jax.checkpoint each block — the natural pairing with sequence
+    # parallelism: long contexts are exactly where activations dominate
+    # HBM (see models/vit.py ViT.remat).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
@@ -66,13 +70,14 @@ class LongContextTransformer(nn.Module):
         x = x + lax.dynamic_slice_in_dim(
             pos.astype(x.dtype), pos_offset, T_local, axis=1
         )
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.depth):
-            x = EncoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.d_model * self.mlp_ratio,
                 attention_fn=self.attention_fn,
                 name=f"block{i + 1}",
-            )(x, deterministic=True)
+            )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         pooled = self.pool_fn(x)
         return nn.Dense(self.num_classes, name="head", dtype=jnp.float32)(
@@ -88,6 +93,7 @@ class SeqTransformerSpec(NamedTuple):
     depth: int = 2
     num_heads: int = 4
     strategy: str = "ring"  # or "ulysses"
+    remat: bool = False  # jax.checkpoint each block
 
 
 def _dense_model(spec: SeqTransformerSpec) -> LongContextTransformer:
@@ -97,6 +103,7 @@ def _dense_model(spec: SeqTransformerSpec) -> LongContextTransformer:
         d_model=spec.d_model,
         depth=spec.depth,
         num_heads=spec.num_heads,
+        remat=spec.remat,
     )
 
 
@@ -118,6 +125,7 @@ def _sharded_model(spec: SeqTransformerSpec) -> LongContextTransformer:
         num_heads=spec.num_heads,
         attention_fn=attention,
         pool_fn=pool,
+        remat=spec.remat,
     )
 
 

@@ -53,6 +53,10 @@ class TrainConfig:
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
     zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
+    # Rematerialize block activations in the backward (jax.checkpoint):
+    # HBM for FLOPs. Supported by the block-structured families
+    # (resnet*, vit*, vit_moe*); simple_cnn has no block stack to remat.
+    remat: bool = False
     emulate_devices: int | None = None  # N virtual CPU devices (dev box)
     compute_dtype: str = "float32"  # "bfloat16" for mixed precision
     eval_every: int = 1  # epochs between test-split evals (0 = only final)
@@ -117,6 +121,7 @@ class TrainConfig:
         p.add_argument("--mesh_fsdp", type=int, default=cls.mesh_fsdp)
         p.add_argument("--mesh_expert", type=int, default=cls.mesh_expert)
         p.add_argument("--zero1", action="store_true")
+        p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)
         p.add_argument(
             "--compute_dtype", default=cls.compute_dtype,

@@ -100,11 +100,21 @@ class Trainer:
         model_kw = {}
         if config.model_depth is not None:
             model_kw["depth"] = config.model_depth
-        self.model = get_model(
-            config.model,
-            num_classes=config.num_classes or NUM_CLASSES.get(config.dataset, 10),
-            **model_kw,
-        )
+        if config.remat:
+            model_kw["remat"] = True
+        try:
+            self.model = get_model(
+                config.model,
+                num_classes=config.num_classes or NUM_CLASSES.get(config.dataset, 10),
+                **model_kw,
+            )
+        except TypeError as e:
+            if config.remat and "remat" in str(e):
+                raise ValueError(
+                    f"--remat is not supported by model {config.model!r} "
+                    "(no block stack to rematerialize)"
+                ) from e
+            raise
         self.optimizer = make_optimizer(
             config.optimizer,
             lr=config.lr,
@@ -224,6 +234,12 @@ class Trainer:
             raise ValueError(
                 "--keep_best ranks checkpoints by eval accuracy, so "
                 "every epoch needs one: set --eval_every 1"
+            )
+        if config.keep_best and config.max_checkpoints is None:
+            raise ValueError(
+                "--keep_best retains the --max_checkpoints best epochs; "
+                "without --max_checkpoints it would keep everything — "
+                "set --max_checkpoints N (or drop --keep_best)"
             )
         self.ckpt = CheckpointManager(
             config.checkpoint_dir,

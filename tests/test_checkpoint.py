@@ -163,6 +163,22 @@ class TestKeepBest:
         with pytest.raises(ValueError, match="eval_every 1"):
             Trainer(cfg)
 
+    def test_trainer_keep_best_requires_max_checkpoints(self, tmp_path):
+        """Without a budget, best-N retention would silently keep all."""
+        import pytest
+
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig(
+            epochs=1, batch_size=8, keep_best=True, eval_every=1,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True, synthetic_size=128,
+        )
+        with pytest.raises(ValueError, match="max_checkpoints"):
+            Trainer(cfg)
+
     def test_trainer_keep_best_smoke(self, tmp_path):
         import os
 
