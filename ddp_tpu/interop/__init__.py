@@ -1,0 +1,21 @@
+"""Interop with the reference's torch checkpoint format.
+
+A reference user's training run lives in ``./checkpoints/epoch_N.pt``
+files (train_ddp.py:204-209). This package converts those to/from this
+framework's Orbax checkpoints so a migration keeps its training
+progress — the missing piece of "switch frameworks mid-run".
+"""
+
+from ddp_tpu.interop.torch_checkpoint import (
+    export_torch_checkpoint,
+    import_torch_checkpoint,
+    params_from_torch_state_dict,
+    params_to_torch_state_dict,
+)
+
+__all__ = [
+    "export_torch_checkpoint",
+    "import_torch_checkpoint",
+    "params_from_torch_state_dict",
+    "params_to_torch_state_dict",
+]

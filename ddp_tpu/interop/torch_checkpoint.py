@@ -1,0 +1,173 @@
+"""Reference-format (.pt) checkpoint import/export for SimpleCNN.
+
+The reference saves ``{"epoch", "model": state_dict, "optimizer":
+opt_state_dict}`` via ``torch.save`` every epoch (train_ddp.py:204-209)
+with keys ``net.0.*``/``net.2.*`` (the two convs inside the
+``nn.Sequential``) and ``fl.*`` (the linear head) — model.py:8-16.
+
+Layout translation, not just renaming:
+
+- conv weights: torch is OIHW, Flax/TPU is HWIO — transpose (2,3,1,0);
+- the linear head follows a flatten whose element order differs: torch
+  flattens NCHW activations (channel-major), this framework flattens
+  NHWC (channel-minor). The imported ``fl.weight`` is therefore
+  re-gathered per output unit — reshape (out, C, H, W) → transpose to
+  (out, H, W, C) → flatten → transpose — so the imported network
+  computes the SAME function on the same images, not merely the same
+  parameter multiset.
+
+Optimizer state is NOT translated: the reference runs momentum-less SGD
+whose torch state dict is empty (verified from its shipped
+``epoch_1.pt`` — SURVEY.md §2a #8), and cross-framework moment tensors
+would be layout-ambiguous for anything richer. Import starts a fresh
+optimizer; the epoch counter and parameters carry over.
+
+torch is imported lazily — the training path never needs it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def _strip_ddp_prefix(state_dict: Mapping[str, Any]) -> dict[str, Any]:
+    """Drop the ``module.`` prefix a DDP-wrapped ``state_dict()`` adds.
+
+    The reference saves ``model.module.state_dict()`` (already
+    unwrapped, train_ddp.py:206) but re-prefixes on load
+    (train_ddp.py:182); accept both forms.
+    """
+    return {
+        (k[len("module.") :] if k.startswith("module.") else k): v
+        for k, v in state_dict.items()
+    }
+
+
+def params_from_torch_state_dict(state_dict: Mapping[str, Any]) -> dict:
+    """Reference SimpleCNN ``state_dict`` → Flax ``params`` pytree.
+
+    Accepts torch tensors or numpy arrays as values. Returns
+    ``{"conv1": {...}, "conv2": {...}, "fc": {...}}`` matching
+    ``ddp_tpu.models.cnn.SimpleCNN``.
+    """
+    sd = {
+        k: np.asarray(getattr(v, "numpy", lambda: v)())
+        for k, v in _strip_ddp_prefix(state_dict).items()
+    }
+    expected = {
+        "net.0.weight", "net.0.bias", "net.2.weight", "net.2.bias",
+        "fl.weight", "fl.bias",
+    }
+    missing = expected - sd.keys()
+    if missing:
+        raise KeyError(
+            f"not a reference SimpleCNN state_dict: missing {sorted(missing)}"
+        )
+
+    w1 = sd["net.0.weight"]  # (O, I, kh, kw)
+    w2 = sd["net.2.weight"]
+    fl = sd["fl.weight"]  # (num_classes, C*H*W) in NCHW flatten order
+    out_dim, flat = fl.shape
+    channels = w2.shape[0]
+    if flat % channels:
+        raise ValueError(
+            f"fl.weight width {flat} is not divisible by the final "
+            f"conv's {channels} channels"
+        )
+    hw = flat // channels
+    side = math.isqrt(hw)
+    if side * side != hw:
+        raise ValueError(f"non-square spatial dim: {hw}")
+    # channel-major (C,H,W) flatten → channel-minor (H,W,C) flatten
+    fc_kernel = (
+        fl.reshape(out_dim, channels, side, side)
+        .transpose(0, 2, 3, 1)
+        .reshape(out_dim, flat)
+        .T
+    )
+    return {
+        "conv1": {
+            "kernel": w1.transpose(2, 3, 1, 0),  # OIHW → HWIO
+            "bias": sd["net.0.bias"],
+        },
+        "conv2": {
+            "kernel": w2.transpose(2, 3, 1, 0),
+            "bias": sd["net.2.bias"],
+        },
+        "fc": {"kernel": fc_kernel, "bias": sd["fl.bias"]},
+    }
+
+
+def params_to_torch_state_dict(params: Mapping[str, Any]) -> dict:
+    """Flax SimpleCNN ``params`` → reference-keyed torch ``state_dict``.
+
+    The exact inverse of :func:`params_from_torch_state_dict`; the
+    returned dict contains torch tensors ready for ``torch.save``.
+    """
+    import torch
+
+    k1 = np.asarray(params["conv1"]["kernel"])  # (kh, kw, I, O)
+    k2 = np.asarray(params["conv2"]["kernel"])
+    fc = np.asarray(params["fc"]["kernel"])  # (H*W*C, num_classes)
+    flat, out_dim = fc.shape
+    channels = k2.shape[-1]
+    side = math.isqrt(flat // channels)
+    fl = (
+        fc.T.reshape(out_dim, side, side, channels)
+        .transpose(0, 3, 1, 2)
+        .reshape(out_dim, flat)
+    )
+    to_t = lambda a: torch.from_numpy(np.ascontiguousarray(a))
+    return {
+        "net.0.weight": to_t(k1.transpose(3, 2, 0, 1)),  # HWIO → OIHW
+        "net.0.bias": to_t(np.asarray(params["conv1"]["bias"])),
+        "net.2.weight": to_t(k2.transpose(3, 2, 0, 1)),
+        "net.2.bias": to_t(np.asarray(params["conv2"]["bias"])),
+        "fl.weight": to_t(fl),
+        "fl.bias": to_t(np.asarray(params["fc"]["bias"])),
+    }
+
+
+def import_torch_checkpoint(path: str) -> tuple[dict, int]:
+    """Load a reference ``epoch_N.pt`` → ``(flax_params, epoch)``.
+
+    ``weights_only=True``: checkpoint files are data, not code — no
+    pickle execution from an untrusted file.
+    """
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    if not isinstance(ckpt, dict) or "model" not in ckpt:
+        raise ValueError(
+            f"{path}: expected the reference's {{epoch, model, optimizer}} "
+            "checkpoint layout"
+        )
+    return params_from_torch_state_dict(ckpt["model"]), int(ckpt.get("epoch", 0))
+
+
+def export_torch_checkpoint(path: str, params: Mapping[str, Any], epoch: int) -> None:
+    """Write ``{epoch, model, optimizer}`` the reference can consume.
+
+    The optimizer entry mirrors the reference's momentum-less SGD save:
+    empty ``state``, one param group listing the six tensors — enough
+    for its (never actually restored — train_ddp.py:88) optimizer slot.
+    """
+    import torch
+
+    state_dict = params_to_torch_state_dict(params)
+    torch.save(
+        {
+            "epoch": int(epoch),
+            "model": state_dict,
+            "optimizer": {
+                "state": {},
+                "param_groups": [
+                    {"lr": 0.01, "momentum": 0, "params": list(range(len(state_dict)))}
+                ],
+            },
+        },
+        path,
+    )

@@ -44,12 +44,19 @@ logger = logging.getLogger("ddp_tpu")
 class CheckpointManager:
     """Per-epoch checkpoints with latest-epoch auto-resume.
 
-    ``last_restored_spe`` holds the steps-per-epoch recorded in the
-    most recently restored checkpoint (None for legacy checkpoints) —
-    the trainer uses it to validate mid-epoch resume positions.
+    ``last_restored_spe`` / ``last_restored_mid_batch`` hold what the
+    most recently restored checkpoint recorded (None / 0 for legacy
+    checkpoints): the steps-per-epoch it was written under, and how
+    many batches into its tagged epoch the state is (0 = the epoch
+    completed). The trainer uses the pair to re-enter a preempted
+    epoch at the exact batch — an explicit marker, not step-counter
+    arithmetic, so imported checkpoints with foreign step offsets
+    (scripts/import_torch_checkpoint.py) can never alias a mid-epoch
+    position.
     """
 
     last_restored_spe: int | None = None
+    last_restored_mid_batch: int = 0
 
     def __init__(
         self,
@@ -113,6 +120,7 @@ class CheckpointManager:
         *,
         overwrite: bool = False,
         steps_per_epoch: int = 0,
+        mid_batch: int = 0,
         metrics: dict | None = None,
     ) -> bool:
         """Save ``{params, opt_state, step}`` for ``epoch``.
@@ -139,10 +147,15 @@ class CheckpointManager:
                 )
                 return False
             self._mgr.delete(epoch)
-        # steps_per_epoch rides along so resume can tell a genuine
-        # mid-epoch artifact from a completed-epoch save under a
-        # CHANGED config (step-counter arithmetic alone can collide).
-        tree = dict(state._asdict(), spe=np.int32(steps_per_epoch))
+        # steps_per_epoch and the explicit mid-epoch batch position ride
+        # along so resume needs no step-counter arithmetic (which a
+        # changed config or an imported foreign checkpoint would break);
+        # mid_batch 0 means the tagged epoch completed.
+        tree = dict(
+            state._asdict(),
+            spe=np.int32(steps_per_epoch),
+            mid_batch=np.int32(mid_batch),
+        )
         self._mgr.save(
             epoch, args=ocp.args.StandardSave(tree), metrics=metrics
         )
@@ -157,9 +170,17 @@ class CheckpointManager:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
         abstract["spe"] = jax.ShapeDtypeStruct((), np.int32)
-        # Migration ladder: older checkpoints lack "spe" (and, before
-        # that, "model_state"); retry dropping the optional keys.
-        for drop in ((), ("spe",), ("spe", "model_state")):
+        abstract["mid_batch"] = jax.ShapeDtypeStruct((), np.int32)
+        # Migration ladder: older checkpoints lack "mid_batch" (and
+        # before that "spe", and before that "model_state"); retry
+        # dropping the optional keys oldest-format-last.
+        ladder = (
+            (),
+            ("mid_batch",),
+            ("mid_batch", "spe"),
+            ("mid_batch", "spe", "model_state"),
+        )
+        for drop in ladder:
             attempt = {k: v for k, v in abstract.items() if k not in drop}
             try:
                 restored = dict(
@@ -169,10 +190,21 @@ class CheckpointManager:
                 )
                 break
             except (ValueError, KeyError):
-                if drop == ("spe", "model_state"):
+                if drop == ladder[-1]:
                     raise
         restored.setdefault("model_state", state_like.model_state)
         self.last_restored_spe = int(restored.pop("spe", 0)) or None
+        if "mid_batch" in restored:
+            self.last_restored_mid_batch = int(restored.pop("mid_batch"))
+        elif self.last_restored_spe:
+            # Pre-mid_batch checkpoint: its intra-epoch position is
+            # encoded only in the step counter (the old scheme, valid
+            # because nothing but the trainer ever wrote that format).
+            self.last_restored_mid_batch = (
+                int(restored["step"]) % self.last_restored_spe
+            )
+        else:
+            self.last_restored_mid_batch = 0
         return TrainState(**restored), epoch
 
     def restore_or_init(

@@ -309,36 +309,33 @@ class Trainer:
         cfg = self.config
         self.state, start_epoch = self.ckpt.restore_or_init(self.state)
         # Mid-epoch preemption saves are tagged with their (incomplete)
-        # epoch; the global step counter says exactly how far in it
-        # got, so resume re-enters that epoch at the next batch.
+        # epoch and record how many batches ran as an explicit
+        # mid_batch marker; resume re-enters that epoch at that batch.
         start_batch = 0
         spe = self.loader.steps_per_epoch()
-        resumed_step = int(self.state.step)
-        if self.fast_runner is None and spe and resumed_step % spe:
-            # Only trust the step-derived position when the checkpoint
-            # was written under the SAME steps-per-epoch (recorded in
-            # it) — a changed batch size / device count makes the old
-            # counter's arithmetic meaningless, and tag heuristics can
-            # collide by coincidence.
+        mid = self.ckpt.last_restored_mid_batch
+        if self.fast_runner is None and mid:
+            # Explicit mid-epoch marker (recorded at save time) — never
+            # derived from step-counter arithmetic, which an imported
+            # foreign checkpoint's step offset or a changed config
+            # would silently corrupt. Only trust the position when the
+            # checkpoint was written under the SAME steps-per-epoch.
             tag = start_epoch - 1
-            if (
-                self.ckpt.last_restored_spe == spe
-                and resumed_step // spe == tag
-            ):
+            if self.ckpt.last_restored_spe == spe and 0 < mid < spe:
                 start_epoch = tag
-                start_batch = resumed_step % spe
+                start_batch = mid
                 logger.info(
                     "Resuming mid-epoch: epoch %d, batch %d (step %d)",
                     start_epoch,
                     start_batch,
-                    resumed_step,
+                    int(self.state.step),
                 )
             else:
                 logger.warning(
-                    "Checkpoint step %d was written under %s "
+                    "Checkpoint was preempted at batch %d under %s "
                     "steps/epoch; current config has %d — resuming at "
                     "epoch granularity",
-                    resumed_step,
+                    mid,
                     self.ckpt.last_restored_spe,
                     spe,
                 )
@@ -360,9 +357,9 @@ class Trainer:
         try:
             try:
                 for epoch in range(start_epoch, cfg.epochs):
-                    stats = self._train_epoch(
-                        epoch, start_batch if epoch == start_epoch else 0
-                    )
+                    skip = start_batch if epoch == start_epoch else 0
+                    epoch_start_step = int(self.state.step)
+                    stats = self._train_epoch(epoch, skip)
                     # Agreement at the epoch boundary: a SIGTERM that
                     # landed after the last in-loop cadence check must
                     # still stop every host on the same side of the
@@ -375,9 +372,16 @@ class Trainer:
                         # always preserved under keep_best (a ranked
                         # sentinel would be garbage-collected as worst
                         # and the preemption state lost).
+                        # Position within the epoch measured relative
+                        # to this epoch's entry step (absolute step
+                        # values carry import/config offsets); >= spe
+                        # means the epoch actually completed before the
+                        # boundary-preemption landed → mid_batch 0.
+                        ran = int(self.state.step) - epoch_start_step + skip
                         self.ckpt.save(
                             epoch, self.state, overwrite=True,
                             steps_per_epoch=spe,
+                            mid_batch=ran if 0 < ran < spe else 0,
                         )
                         logger.warning(
                             "Preempted during epoch %d at step %d — "

@@ -4,8 +4,9 @@ Preemptible TPU VMs get SIGTERM before reclaim; the trainer must
 checkpoint at the next step boundary and, on re-run, re-enter the SAME
 epoch at the SAME batch with the SAME data order — the reference loses
 the whole in-progress epoch (no handler, epoch-granular saves only).
-The global step counter encodes intra-epoch progress, so no checkpoint
-format change is involved.
+The intra-epoch position is an explicit ``mid_batch`` marker in the
+checkpoint (train/checkpoint.py), never step-counter arithmetic —
+imported checkpoints carry foreign step offsets.
 """
 
 import numpy as np
@@ -80,6 +81,65 @@ def test_preempt_mid_epoch_then_resume_exactly(tmp_path):
     assert len(seen) == len(expected)
     for a, b in zip(seen, expected):
         np.testing.assert_array_equal(a, b)
+
+
+def test_preempt_after_imported_checkpoint_resumes_exactly(tmp_path):
+    """An imported checkpoint's step counter starts at 0 regardless of
+    its epoch tag (scripts/import_torch_checkpoint.py). A later
+    preemption must still re-enter the right epoch at the right batch —
+    the explicit mid_batch marker, not step//spe arithmetic, decides."""
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.ddp import create_train_state
+    from ddp_tpu.train.checkpoint import CheckpointManager
+
+    cfg = make_config(tmp_path, epochs=4)
+    # Import-style save: epoch tag 1, step=0 (foreign counter offset).
+    model = get_model("simple_cnn")
+    tx = optax.sgd(0.01)
+    st = create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0)
+    mgr = CheckpointManager(cfg.checkpoint_dir, async_save=False)
+    assert mgr.save(1, st)
+    mgr.close()
+
+    # Run: resumes at epoch 2, preempted after 3 batches of it.
+    t1 = Trainer(cfg)
+    orig_step = t1.train_step
+    count = {"n": 0}
+
+    def counting_step(state, images, labels):
+        out = orig_step(state, images, labels)
+        count["n"] += 1
+        if count["n"] == 3:
+            t1._preempt_requested = True
+        return out
+
+    t1.train_step = counting_step
+    summary1 = t1.train()
+    t1.close()
+    assert summary1["preempted"] is True
+
+    # Re-run: must re-enter epoch 2 at batch 3 — not skip epoch 2 (the
+    # pre-mid_batch arithmetic took step//spe==0 != tag and resumed at
+    # epoch granularity, silently dropping epoch 2's remaining batches).
+    t2 = Trainer(cfg)
+    batches = {"n": 0}
+    orig_step2 = t2.train_step
+
+    def counting_step2(state, images, labels):
+        batches["n"] += 1
+        return orig_step2(state, images, labels)
+
+    t2.train_step = counting_step2
+    summary2 = t2.train()
+    t2.close()
+    assert not summary2.get("preempted")
+    # epochs 2 (13 remaining) + 3 (16) = 29 batches; 16 would mean the
+    # rest of epoch 2 was silently skipped
+    assert batches["n"] == 29
+    assert summary2["epochs_run"] == 2
 
 
 def test_sigterm_handler_sets_flag(tmp_path):

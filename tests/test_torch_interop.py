@@ -1,0 +1,145 @@
+"""Torch-checkpoint interop: the reference's .pt files ↔ our params.
+
+The import must preserve the *function*, not just the tensors: torch
+flattens NCHW activations before its linear head, we flatten NHWC, so
+``fl.weight`` needs a per-unit re-gather (interop/torch_checkpoint.py).
+These tests check logits agree between a torch-functional forward of
+the reference topology (model.py:8-16: conv-pad1 → relu → conv-pad1 →
+relu → flatten → linear) and our SimpleCNN with imported weights — on
+random weights AND on the reference's real shipped checkpoint.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from ddp_tpu.interop import (  # noqa: E402
+    export_torch_checkpoint,
+    import_torch_checkpoint,
+    params_from_torch_state_dict,
+    params_to_torch_state_dict,
+)
+from ddp_tpu.models.cnn import SimpleCNN  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE_CKPT = "/root/reference/checkpoints/epoch_1.pt"
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(REFERENCE_CKPT),
+    reason="reference checkpoint not mounted",
+)
+
+
+def _random_state_dict(seed=0):
+    g = torch.Generator().manual_seed(seed)
+    r = lambda *s: torch.randn(*s, generator=g)
+    return {
+        "net.0.weight": r(32, 1, 3, 3) * 0.1,
+        "net.0.bias": r(32) * 0.1,
+        "net.2.weight": r(64, 32, 3, 3) * 0.1,
+        "net.2.bias": r(64) * 0.1,
+        "fl.weight": r(10, 64 * 28 * 28) * 0.01,
+        "fl.bias": r(10) * 0.1,
+    }
+
+
+def _torch_forward(sd, x_nchw):
+    """The reference topology via torch.nn.functional (model.py:8-16)."""
+    import torch.nn.functional as F
+
+    y = F.relu(F.conv2d(x_nchw, sd["net.0.weight"], sd["net.0.bias"], padding=1))
+    y = F.relu(F.conv2d(y, sd["net.2.weight"], sd["net.2.bias"], padding=1))
+    return F.linear(y.flatten(1), sd["fl.weight"], sd["fl.bias"])
+
+
+def _assert_same_function(sd, params, atol=1e-4):
+    x = torch.randn(4, 1, 28, 28, generator=torch.Generator().manual_seed(9))
+    with torch.no_grad():
+        want = _torch_forward(sd, x).numpy()
+    x_nhwc = jnp.asarray(x.numpy().transpose(0, 2, 3, 1))
+    got = SimpleCNN().apply({"params": jax.tree.map(jnp.asarray, params)}, x_nhwc)
+    np.testing.assert_allclose(np.asarray(got), want, atol=atol)
+
+
+def test_imported_params_compute_identical_logits():
+    sd = _random_state_dict()
+    _assert_same_function(sd, params_from_torch_state_dict(sd))
+
+
+def test_ddp_prefixed_state_dict_accepted():
+    sd = _random_state_dict()
+    prefixed = {f"module.{k}": v for k, v in sd.items()}
+    _assert_same_function(sd, params_from_torch_state_dict(prefixed))
+
+
+def test_rejects_non_simplecnn_state_dict():
+    with pytest.raises(KeyError, match="net.0.weight"):
+        params_from_torch_state_dict({"encoder.weight": torch.zeros(2, 2)})
+
+
+@needs_reference
+def test_reference_shipped_checkpoint_imports_and_matches():
+    """The actual artifact a migrating user brings (epoch_1.pt)."""
+    params, epoch = import_torch_checkpoint(REFERENCE_CKPT)
+    assert epoch == 1
+    assert params["conv1"]["kernel"].shape == (3, 3, 1, 32)
+    assert params["fc"]["kernel"].shape == (50176, 10)
+    sd = torch.load(REFERENCE_CKPT, map_location="cpu", weights_only=True)["model"]
+    _assert_same_function(sd, params)
+
+
+def test_export_roundtrip_bitwise():
+    sd = _random_state_dict(seed=3)
+    params = params_from_torch_state_dict(sd)
+    back = params_to_torch_state_dict(params)
+    for k in sd:
+        np.testing.assert_array_equal(back[k].numpy(), sd[k].numpy())
+
+
+def test_export_file_then_import(tmp_path):
+    params = params_from_torch_state_dict(_random_state_dict(seed=4))
+    path = str(tmp_path / "epoch_5.pt")
+    export_torch_checkpoint(path, params, epoch=5)
+    params2, epoch = import_torch_checkpoint(path)
+    assert epoch == 5
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@needs_reference
+def test_import_script_resumes_training(tmp_path):
+    """scripts/import_torch_checkpoint.py → train.py resumes at epoch 2."""
+    ckdir = str(tmp_path / "checkpoints")
+    res = subprocess.run(
+        [
+            sys.executable, "scripts/import_torch_checkpoint.py",
+            "--pt", REFERENCE_CKPT, "--checkpoint_dir", ckdir,
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "resume at epoch 2" in res.stdout
+
+    from ddp_tpu.train.checkpoint import CheckpointManager
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=3, batch_size=8, synthetic_data=True, synthetic_size=256,
+        checkpoint_dir=ckdir, data_root=str(tmp_path / "data"),
+        log_interval=8, eval_every=0,
+    )
+    t = Trainer(cfg)
+    summary = t.train()
+    t.close()
+    # imported epoch 1 → only epoch 2 left to run
+    assert summary["epochs_run"] == 1
+    mgr = CheckpointManager(ckdir)
+    assert mgr.latest_epoch() == 2
+    mgr.close()
