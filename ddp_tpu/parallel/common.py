@@ -39,16 +39,27 @@ def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
     return images.astype(compute_dtype)
 
 
-def make_loss_fn(model, compute_dtype, aux_loss_weight: float, augment_fn=None):
+def make_loss_fn(
+    model,
+    compute_dtype,
+    aux_loss_weight: float,
+    augment_fn=None,
+    label_smoothing: float = 0.0,
+):
     """``loss_fn(params, model_state, images, labels, rng, mutable)``.
 
     Returns ``(loss, (logits, new_model_state))`` — mean softmax
     cross-entropy plus the weighted MoE load-balance aux losses when
     the model records a ``losses`` collection (models/moe.py).
     ``augment_fn(rng, images)`` (data/augment.py), when given, runs
-    on-device after the uint8→float conversion.
+    on-device after the uint8→float conversion. ``label_smoothing``
+    α > 0 trains against ``(1-α)·one_hot + α/num_classes`` targets
+    (the ViT/ResNet recipe staple; the reference trains on hard
+    targets only, train_ddp.py:40).
     """
     train_kw = _train_kwarg(model, True)
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(f"label_smoothing must be in [0, 1), got {label_smoothing}")
 
     def loss_fn(params, model_state, images, labels, rng, mutable):
         x = _preprocess(images, compute_dtype)
@@ -66,9 +77,16 @@ def make_loss_fn(model, compute_dtype, aux_loss_weight: float, augment_fn=None):
         else:
             logits = model.apply(variables, x, rngs={"dropout": rng}, **train_kw)
             new_ms = model_state
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits.astype(jnp.float32), labels
-        ).mean()
+        logits32 = logits.astype(jnp.float32)
+        if label_smoothing:
+            targets = optax.smooth_labels(
+                jax.nn.one_hot(labels, logits32.shape[-1]), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(logits32, targets).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits32, labels
+            ).mean()
         if "losses" in mutable:
             loss = loss + aux_loss_weight * sum(
                 jax.tree.leaves(new_ms["losses"])

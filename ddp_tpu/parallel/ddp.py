@@ -95,6 +95,7 @@ def make_per_shard_step(
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
     augment_fn=None,
+    label_smoothing: float = 0.0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
@@ -109,7 +110,8 @@ def make_per_shard_step(
     """
 
     loss_fn = make_loss_fn(
-        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn
+        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn,
+        label_smoothing=label_smoothing,
     )
 
     def per_shard_step(state: TrainState, images, labels):
@@ -170,6 +172,7 @@ def make_train_step(
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
     augment_fn=None,
+    label_smoothing: float = 0.0,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -188,6 +191,7 @@ def make_train_step(
         aux_loss_weight=aux_loss_weight,
         grad_accum_steps=grad_accum_steps,
         augment_fn=augment_fn,
+        label_smoothing=label_smoothing,
     )
     sharded = jax.shard_map(
         per_shard_step,

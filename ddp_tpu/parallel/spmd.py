@@ -254,6 +254,7 @@ def make_spmd_train_step(
     aux_loss_weight: float = 0.01,
     grad_accum_steps: int = 1,
     augment_fn=None,
+    label_smoothing: float = 0.0,
     zero1: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
@@ -272,7 +273,8 @@ def make_spmd_train_step(
         check_zero1_mesh(mesh)
     bspec = batch_spec(mesh)
     loss_fn = make_loss_fn(
-        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn
+        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn,
+        label_smoothing=label_smoothing,
     )
 
     def step(state: TrainState, images, labels):

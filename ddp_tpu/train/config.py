@@ -43,6 +43,10 @@ class TrainConfig:
     warmup_steps: int = 0
     decay_steps: int = 0  # >0 enables cosine decay to this many steps
     grad_clip_norm: float = 0.0
+    label_smoothing: float = 0.0  # soft targets (1-α)·one_hot + α/K
+    # >0: track an EMA of params in opt_state and evaluate with it —
+    # the standard ViT/ResNet eval-quality lever; checkpoints carry it.
+    ema_decay: float = 0.0
     grad_accum_steps: int = 1  # microbatches accumulated per update
     backend: str | None = None  # None = auto (tpu if present else cpu)
     num_devices: int = -1  # devices on the data axis; -1 = all
@@ -112,6 +116,10 @@ class TrainConfig:
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps)
         p.add_argument("--decay_steps", type=int, default=cls.decay_steps)
         p.add_argument("--grad_clip_norm", type=float, default=cls.grad_clip_norm)
+        p.add_argument(
+            "--label_smoothing", type=float, default=cls.label_smoothing
+        )
+        p.add_argument("--ema_decay", type=float, default=cls.ema_decay)
         p.add_argument(
             "--grad_accum_steps", type=int, default=cls.grad_accum_steps
         )

@@ -59,6 +59,7 @@ def make_epoch_runner(
     seed: int = 0,
     donate: bool = True,
     augment_fn=None,
+    label_smoothing: float = 0.0,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build ``run(state, epoch) -> (state, stacked per-step metrics)``.
 
@@ -82,7 +83,7 @@ def make_epoch_runner(
         )
     per_shard_step = make_per_shard_step(
         model, optimizer, axes, shards, compute_dtype=compute_dtype, seed=seed,
-        augment_fn=augment_fn,
+        augment_fn=augment_fn, label_smoothing=label_smoothing,
     )
 
     def per_device_epoch(state: TrainState, epoch, imgs, lbls):

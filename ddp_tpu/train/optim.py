@@ -11,7 +11,62 @@ SURVEY.md §2a #8).
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
 import optax
+
+
+class EmaState(NamedTuple):
+    """Exponential moving average of the *parameters* (not updates).
+
+    Lives inside ``opt_state`` so it checkpoints, shards (GSPMD lays it
+    out like the params it mirrors), and restores with zero extra
+    plumbing — the TrainState pytree never changes shape.
+    """
+
+    ema: Any
+
+
+def param_ema(decay: float) -> optax.GradientTransformation:
+    """Track an EMA of the post-update parameters.
+
+    Appended (via ``optax.chain``) AFTER the update rule: ``update``
+    sees the final deltas plus the pre-update params, so the new params
+    are ``apply_updates(params, updates)`` — the EMA follows what the
+    optimizer actually writes. Retrieval: :func:`ema_params`.
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"ema decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        # A real copy, not jnp.asarray: aliasing the live param buffers
+        # would make the train step's donate_argnums hand XLA the same
+        # buffer twice (params AND opt_state.ema) — a runtime error.
+        return EmaState(ema=jax.tree.map(lambda p: jnp.array(p, copy=True), params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("param_ema needs params; use optax.chain")
+        new_params = optax.apply_updates(params, updates)
+        ema = jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p, state.ema, new_params
+        )
+        return updates, EmaState(ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(opt_state) -> Any | None:
+    """Pull the EMA param tree out of an optimizer state, or None."""
+    leaves = jax.tree_util.tree_flatten(
+        opt_state, is_leaf=lambda s: isinstance(s, EmaState)
+    )[0]
+    for leaf in leaves:
+        if isinstance(leaf, EmaState):
+            return leaf.ema
+    return None
 
 
 def make_optimizer(
@@ -23,6 +78,7 @@ def make_optimizer(
     warmup_steps: int = 0,
     decay_steps: int = 0,
     grad_clip_norm: float = 0.0,
+    ema_decay: float = 0.0,
 ) -> optax.GradientTransformation:
     """Build the update rule; ``decay_steps > 0`` enables cosine decay."""
     if decay_steps > 0:
@@ -58,4 +114,6 @@ def make_optimizer(
 
     if grad_clip_norm:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    if ema_decay:
+        tx = optax.chain(tx, param_ema(ema_decay))
     return tx

@@ -115,15 +115,16 @@ class Trainer:
                     "(no block stack to rematerialize)"
                 ) from e
             raise
-        self.optimizer = make_optimizer(
-            config.optimizer,
+        self._opt_kwargs = dict(
             lr=config.lr,
             momentum=config.momentum,
             weight_decay=config.weight_decay,
             warmup_steps=config.warmup_steps,
             decay_steps=config.decay_steps,
             grad_clip_norm=config.grad_clip_norm,
+            ema_decay=config.ema_decay,
         )
+        self.optimizer = make_optimizer(config.optimizer, **self._opt_kwargs)
 
         train_split, test_split = load_dataset(
             config.dataset,
@@ -163,6 +164,7 @@ class Trainer:
                 compute_dtype=compute_dtype, seed=config.seed,
                 grad_accum_steps=config.grad_accum_steps,
                 augment_fn=augment_fn,
+                label_smoothing=config.label_smoothing,
                 zero1=config.zero1,
             )
             self.eval_step = make_spmd_eval_step(
@@ -179,6 +181,7 @@ class Trainer:
                 compute_dtype=compute_dtype, seed=config.seed,
                 grad_accum_steps=config.grad_accum_steps,
                 augment_fn=augment_fn,
+                label_smoothing=config.label_smoothing,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
@@ -229,6 +232,7 @@ class Trainer:
                 dev_images, dev_labels, self.global_batch_size,
                 compute_dtype=compute_dtype, seed=config.seed,
                 augment_fn=augment_fn,
+                label_smoothing=config.label_smoothing,
             )
         if config.keep_best and config.eval_every != 1:
             raise ValueError(
@@ -305,9 +309,58 @@ class Trainer:
             self._preempt_requested = True
         return agreed
 
+    def _restore_or_init(self):
+        """Auto-resume, tolerant of --ema_decay being turned ON since
+        the checkpoint was written (or a torch-imported checkpoint):
+        restore the EMA-less optimizer layout and graft a fresh EMA
+        initialized from the restored params. Other optimizer-config
+        changes can't be reconciled — fail with the flags named instead
+        of Orbax's raw pytree-mismatch error.
+        """
+        from ddp_tpu.train.optim import EmaState, ema_params, make_optimizer
+
+        try:
+            return self.ckpt.restore_or_init(self.state)
+        except (ValueError, KeyError) as e:
+            if self.config.ema_decay:
+                tx_noema = make_optimizer(
+                    self.config.optimizer,
+                    **dict(self._opt_kwargs, ema_decay=0.0),
+                )
+                alt = self.state._replace(
+                    opt_state=tx_noema.init(self.state.params)
+                )
+                try:
+                    restored, start_epoch = self.ckpt.restore_or_init(alt)
+                except (ValueError, KeyError):
+                    restored = None
+                if restored is not None and ema_params(restored.opt_state) is None:
+                    logger.info(
+                        "Checkpoint has no EMA (written without "
+                        "--ema_decay) — initializing the EMA from the "
+                        "restored params"
+                    )
+                    ema = EmaState(
+                        ema=jax.tree.map(
+                            lambda p: jnp.array(p, copy=True), restored.params
+                        )
+                    )
+                    return (
+                        restored._replace(
+                            opt_state=(restored.opt_state, ema)
+                        ),
+                        start_epoch,
+                    )
+            raise RuntimeError(
+                "Checkpoint optimizer state does not match the current "
+                "optimizer config — changed --optimizer / --momentum / "
+                "--ema_decay / --grad_clip_norm since it was written? "
+                "Point --checkpoint_dir elsewhere to start fresh."
+            ) from e
+
     def train(self) -> dict[str, Any]:
         cfg = self.config
-        self.state, start_epoch = self.ckpt.restore_or_init(self.state)
+        self.state, start_epoch = self._restore_or_init()
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit
         # mid_batch marker; resume re-enters that epoch at that batch.
@@ -591,8 +644,16 @@ class Trainer:
         The split is padded with wraparound to a global-batch multiple;
         padding carries weight 0 so the totals are exact. In multi-host
         runs each process feeds its contiguous slice of the padded
-        split.
+        split. With ``--ema_decay`` the averaged parameters are
+        evaluated (the point of keeping them), not the raw ones.
         """
+        eval_params = self.state.params
+        if self.config.ema_decay:
+            from ddp_tpu.train.optim import ema_params
+
+            averaged = ema_params(self.state.opt_state)
+            if averaged is not None:
+                eval_params = averaged
         images, labels = self.test_split
         # Accumulation exists to keep the per-forward footprint at
         # batch_size×shards — eval must not undo that by running one
@@ -617,7 +678,7 @@ class Trainer:
             else:
                 put = lambda a, s: jax.make_array_from_process_local_data(s, a)
             c, l = self.eval_step(
-                self.state.params,
+                eval_params,
                 self.state.model_state,
                 put(img_np, self.loader._img_sharding),
                 put(lbl_np, self.loader._lbl_sharding),
