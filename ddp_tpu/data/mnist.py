@@ -29,11 +29,23 @@ from typing import NamedTuple
 
 import numpy as np
 
-_MIRRORS = (
-    "https://storage.googleapis.com/cvdf-datasets/mnist/",
-    "https://ossci-datasets.s3.amazonaws.com/mnist/",
-    "http://yann.lecun.com/exdb/mnist/",
-)
+# The IDX file family is shared by MNIST's drop-in siblings; variants
+# differ only in mirror URLs (and cache subdirectory). All are 28×28
+# grayscale, 10 classes, 60k/10k splits.
+_VARIANT_MIRRORS = {
+    "mnist": (
+        "https://storage.googleapis.com/cvdf-datasets/mnist/",
+        "https://ossci-datasets.s3.amazonaws.com/mnist/",
+        "http://yann.lecun.com/exdb/mnist/",
+    ),
+    "fashion_mnist": (
+        "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/",
+        "https://storage.googleapis.com/tensorflow/tf-keras-datasets/",
+    ),
+    "kmnist": (
+        "http://codh.rois.ac.jp/kmnist/dataset/kmnist/",
+    ),
+}
 _FILES = {
     "train_images": "train-images-idx3-ubyte.gz",
     "train_labels": "train-labels-idx1-ubyte.gz",
@@ -77,13 +89,17 @@ def parse_idx(raw: bytes) -> np.ndarray:
     return arr.reshape(dims)
 
 
-def _fetch(root: str, fname: str) -> str:
-    path = os.path.join(root, fname)
+def _fetch(root: str, fname: str, variant: str = "mnist") -> str:
+    # MNIST keeps the flat ``root`` layout (parity with data.py:11 and
+    # existing caches); siblings get a subdirectory since the file
+    # names collide across variants.
+    base = root if variant == "mnist" else os.path.join(root, variant)
+    path = os.path.join(base, fname)
     if os.path.exists(path):
         return path
-    os.makedirs(root, exist_ok=True)
+    os.makedirs(base, exist_ok=True)
     last_err: Exception | None = None
-    for mirror in _MIRRORS:
+    for mirror in _VARIANT_MIRRORS[variant]:
         try:
             tmp = path + ".part"
             urllib.request.urlretrieve(mirror + fname, tmp)
@@ -103,11 +119,13 @@ def _read_idx_file(path: str) -> np.ndarray:
     return parse_idx(gzip.decompress(open(path, "rb").read()))
 
 
-def _load_pair(root: str, split: str) -> Split:
-    images = _read_idx_file(_fetch(root, _FILES[f"{split}_images"]))[..., None]
-    labels = _read_idx_file(_fetch(root, _FILES[f"{split}_labels"])).astype(
-        np.int32
-    )
+def _load_pair(root: str, split: str, variant: str = "mnist") -> Split:
+    images = _read_idx_file(_fetch(root, _FILES[f"{split}_images"], variant))[
+        ..., None
+    ]
+    labels = _read_idx_file(
+        _fetch(root, _FILES[f"{split}_labels"], variant)
+    ).astype(np.int32)
     if images.shape[0] != labels.shape[0]:
         raise ValueError("image/label count mismatch")
     return Split(np.ascontiguousarray(images), labels)
@@ -141,16 +159,23 @@ def load(
     root: str = "./data",
     split: str = "train",
     *,
+    variant: str = "mnist",
     allow_synthetic: bool = False,
     synthetic_size: int | None = None,
 ) -> Split:
-    """Load an MNIST split as (uint8 NHWC images, int32 labels).
+    """Load an MNIST-family split as (uint8 NHWC images, int32 labels).
 
-    ``allow_synthetic`` gates the offline fallback so accidental network
-    failure can't silently swap datasets in a real run.
+    ``variant`` selects the sibling dataset (mnist | fashion_mnist |
+    kmnist — same IDX container, different bytes). ``allow_synthetic``
+    gates the offline fallback so accidental network failure can't
+    silently swap datasets in a real run.
     """
+    if variant not in _VARIANT_MIRRORS:
+        raise KeyError(
+            f"unknown variant {variant!r}; have {sorted(_VARIANT_MIRRORS)}"
+        )
     try:
-        return _load_pair(root, split)
+        return _load_pair(root, split, variant)
     except (RuntimeError, OSError, ValueError):
         if not allow_synthetic:
             raise

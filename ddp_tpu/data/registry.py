@@ -31,20 +31,31 @@ def load_dataset(
     )
 
 
-@register("mnist")
-def _mnist(root, *, allow_synthetic, synthetic_size):
-    from ddp_tpu.data import mnist
+def _mnist_family(variant):
+    def loader(root, *, allow_synthetic, synthetic_size):
+        from ddp_tpu.data import mnist
 
-    train = mnist.load(
-        root, "train", allow_synthetic=allow_synthetic, synthetic_size=synthetic_size
-    )
-    test = mnist.load(
-        root,
-        "test",
-        allow_synthetic=allow_synthetic,
-        synthetic_size=(max(1, synthetic_size // 6) if synthetic_size else None),
-    )
-    return train, test
+        train = mnist.load(
+            root, "train", variant=variant,
+            allow_synthetic=allow_synthetic, synthetic_size=synthetic_size,
+        )
+        test = mnist.load(
+            root,
+            "test",
+            variant=variant,
+            allow_synthetic=allow_synthetic,
+            synthetic_size=(
+                max(1, synthetic_size // 6) if synthetic_size else None
+            ),
+        )
+        return train, test
+
+    return loader
+
+
+register("mnist")(_mnist_family("mnist"))
+register("fashion_mnist")(_mnist_family("fashion_mnist"))
+register("kmnist")(_mnist_family("kmnist"))
 
 
 def _cifar(name):
@@ -91,4 +102,11 @@ def _imagenet(root, *, allow_synthetic, synthetic_size):
     return train, test
 
 
-NUM_CLASSES = {"mnist": 10, "cifar10": 10, "cifar100": 100, "imagenet": 1000}
+NUM_CLASSES = {
+    "mnist": 10,
+    "fashion_mnist": 10,
+    "kmnist": 10,
+    "cifar10": 10,
+    "cifar100": 100,
+    "imagenet": 1000,
+}

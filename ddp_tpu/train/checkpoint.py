@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import logging
 import os
+from typing import Any
+
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
@@ -103,7 +105,13 @@ class CheckpointManager:
             step_prefix="epoch",
             preservation_policy=preservation,
         )
-        self._mgr = ocp.CheckpointManager(self._dir, options=opts)
+        # Explicit handler so item_metadata works before any save/
+        # restore call registered one (the template-free inference path
+        # in a fresh process).
+        self._mgr = ocp.CheckpointManager(
+            self._dir, options=opts,
+            item_handlers=ocp.StandardCheckpointHandler(),
+        )
 
     @property
     def directory(self) -> str:
@@ -206,6 +214,61 @@ class CheckpointManager:
         else:
             self.last_restored_mid_batch = 0
         return TrainState(**restored), epoch
+
+    def restore_for_inference(
+        self, epoch: int | None = None
+    ) -> tuple[Any, Any, int]:
+        """Template-free restore → ``(params, model_state, epoch)``.
+
+        Builds the abstract tree from the checkpoint's own metadata, so
+        no model/optimizer construction is needed — inference tooling
+        (scripts/predict.py) can load ANY run's checkpoint without
+        knowing which optimizer produced it. The optimizer state is
+        read and discarded.
+        """
+        if epoch is None:
+            epoch = self.latest_epoch()
+            if epoch is None:
+                raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        meta = dict(self._mgr.item_metadata(epoch))
+        wanted = {
+            k: meta[k] for k in ("params", "model_state") if k in meta
+        }
+        # Explicit single-device sharding: the checkpoint's recorded
+        # shardings reference the topology it was WRITTEN under (e.g.
+        # an 8-device emulated mesh) and cannot deserialize elsewhere.
+        dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=dev),
+            wanted,
+        )
+        restore_args = jax.tree.map(
+            lambda _: ocp.ArrayRestoreArgs(sharding=dev), abstract
+        )
+        # A partial (params-only) read: an Adam-family opt_state is 2×
+        # the params (plus the EMA) — pointless I/O and host memory for
+        # inference. PyTreeRestore(partial_restore=True) skips those
+        # entries; a throwaway manager because the main one is
+        # registered for the Standard handler.
+        sub = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(step_prefix="epoch"),
+            item_handlers=ocp.PyTreeCheckpointHandler(),
+        )
+        try:
+            restored = dict(
+                sub.restore(
+                    epoch,
+                    args=ocp.args.PyTreeRestore(
+                        item=abstract,
+                        restore_args=restore_args,
+                        partial_restore=True,
+                    ),
+                )
+            )
+        finally:
+            sub.close()
+        return restored["params"], restored.get("model_state", {}), epoch
 
     def restore_or_init(
         self, state: TrainState

@@ -43,6 +43,10 @@ class TrainConfig:
     warmup_steps: int = 0
     decay_steps: int = 0  # >0 enables cosine decay to this many steps
     grad_clip_norm: float = 0.0
+    # Staircase decay: lr ×= lr_decay_factor at each step milestone
+    # (e.g. "3000,6000"); mutually exclusive with decay_steps (cosine).
+    lr_milestones: str = ""
+    lr_decay_factor: float = 0.1
     label_smoothing: float = 0.0  # soft targets (1-α)·one_hot + α/K
     # >0: track an EMA of params in opt_state and evaluate with it —
     # the standard ViT/ResNet eval-quality lever; checkpoints carry it.
@@ -116,6 +120,10 @@ class TrainConfig:
         p.add_argument("--warmup_steps", type=int, default=cls.warmup_steps)
         p.add_argument("--decay_steps", type=int, default=cls.decay_steps)
         p.add_argument("--grad_clip_norm", type=float, default=cls.grad_clip_norm)
+        p.add_argument("--lr_milestones", default=cls.lr_milestones)
+        p.add_argument(
+            "--lr_decay_factor", type=float, default=cls.lr_decay_factor
+        )
         p.add_argument(
             "--label_smoothing", type=float, default=cls.label_smoothing
         )

@@ -79,8 +79,20 @@ def make_optimizer(
     decay_steps: int = 0,
     grad_clip_norm: float = 0.0,
     ema_decay: float = 0.0,
+    lr_milestones: tuple[int, ...] = (),
+    lr_decay_factor: float = 0.1,
 ) -> optax.GradientTransformation:
-    """Build the update rule; ``decay_steps > 0`` enables cosine decay."""
+    """Build the update rule.
+
+    Schedules: ``decay_steps > 0`` → warmup+cosine; ``lr_milestones``
+    (step numbers) → piecewise-constant ×``lr_decay_factor`` at each
+    milestone (the classic ResNet staircase), composable with warmup.
+    """
+    if decay_steps > 0 and lr_milestones:
+        raise ValueError(
+            "decay_steps (cosine) and lr_milestones (staircase) are "
+            "mutually exclusive schedules"
+        )
     if decay_steps > 0:
         schedule = optax.warmup_cosine_decay_schedule(
             init_value=0.0 if warmup_steps else lr,
@@ -88,6 +100,24 @@ def make_optimizer(
             warmup_steps=warmup_steps,
             decay_steps=decay_steps,
         )
+    elif lr_milestones:
+        if sorted(lr_milestones) != list(lr_milestones):
+            raise ValueError(f"lr_milestones must ascend: {lr_milestones}")
+        stair = optax.piecewise_constant_schedule(
+            lr, {int(m): lr_decay_factor for m in lr_milestones}
+        )
+        if warmup_steps > 0:
+            # NOT join_schedules: it re-zeroes the count past each
+            # boundary, which would silently shift every milestone by
+            # warmup_steps. Milestones are global step numbers.
+            warm = optax.linear_schedule(0.0, lr, warmup_steps)
+
+            def schedule(count):
+                return jnp.where(
+                    count < warmup_steps, warm(count), stair(count)
+                )
+        else:
+            schedule = stair
     elif warmup_steps > 0:
         schedule = optax.linear_schedule(0.0, lr, warmup_steps)
     else:

@@ -115,6 +115,9 @@ class Trainer:
                     "(no block stack to rematerialize)"
                 ) from e
             raise
+        milestones = tuple(
+            int(m) for m in config.lr_milestones.split(",") if m.strip()
+        )
         self._opt_kwargs = dict(
             lr=config.lr,
             momentum=config.momentum,
@@ -123,6 +126,8 @@ class Trainer:
             decay_steps=config.decay_steps,
             grad_clip_norm=config.grad_clip_norm,
             ema_decay=config.ema_decay,
+            lr_milestones=milestones,
+            lr_decay_factor=config.lr_decay_factor,
         )
         self.optimizer = make_optimizer(config.optimizer, **self._opt_kwargs)
 

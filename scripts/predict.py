@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Inference CLI: classify with a trained checkpoint, no trainer needed.
+
+The reference ends at training (no eval, no inference — SURVEY.md §5);
+this closes the deployment half of the loop:
+
+    python scripts/predict.py --model simple_cnn --dataset mnist
+    python scripts/predict.py --model resnet18 --dataset cifar10 \
+        --images batch.npy --out preds.npy
+
+Restores the latest (or ``--epoch N``) checkpoint template-free — the
+checkpoint's own metadata supplies the tree, so the optimizer that
+produced it is irrelevant. With ``--dataset``, runs the test split and
+prints accuracy as one JSON line; with ``--images`` (a .npy of NHWC
+uint8/float), writes predicted classes to ``--out`` (.npy) and prints a
+summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint_dir", default="./checkpoints")
+    p.add_argument("--epoch", type=int, default=None, help="default: latest")
+    p.add_argument("--model", default="simple_cnn")
+    p.add_argument("--model_depth", type=int, default=None)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--dataset", default=None, help="evaluate its test split")
+    p.add_argument("--data_root", default="./data")
+    p.add_argument("--synthetic_data", action="store_true")
+    p.add_argument("--images", default=None, help=".npy of NHWC images")
+    p.add_argument("--out", default=None, help=".npy for predicted classes")
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument(
+        "--compute_dtype", default="float32", choices=("float32", "bfloat16")
+    )
+    args = p.parse_args()
+    if (args.dataset is None) == (args.images is None):
+        p.error("exactly one of --dataset / --images is required")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddp_tpu.data.registry import NUM_CLASSES, load_dataset
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.common import _preprocess, _train_kwarg
+    from ddp_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(args.checkpoint_dir)
+    params, model_state, epoch = mgr.restore_for_inference(args.epoch)
+    mgr.close()
+
+    num_classes = args.num_classes or NUM_CLASSES.get(args.dataset or "", 10)
+    model_kw = {}
+    if args.model_depth is not None:
+        model_kw["depth"] = args.model_depth
+    model = get_model(args.model, num_classes=num_classes, **model_kw)
+    compute_dtype = (
+        jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
+    )
+    train_kw = _train_kwarg(model, False)
+
+    @jax.jit
+    def forward(images):
+        x = _preprocess(images, compute_dtype)
+        p_c = params
+        if compute_dtype != jnp.float32:
+            p_c = jax.tree.map(lambda v: v.astype(compute_dtype), params)
+        logits = model.apply({"params": p_c, **model_state}, x, **train_kw)
+        return jnp.argmax(logits.astype(jnp.float32), -1)
+
+    def predict_all(images):
+        if len(images) == 0:
+            return np.zeros((0,), np.int32)
+        preds = []
+        for i in range(0, len(images), args.batch_size):
+            chunk = np.asarray(images[i : i + args.batch_size])
+            n = len(chunk)
+            # Pad the tail so one compiled shape serves every batch.
+            if n < args.batch_size:
+                chunk = np.concatenate(
+                    [chunk, chunk[:1].repeat(args.batch_size - n, 0)]
+                )
+            preds.append(np.asarray(forward(jnp.asarray(chunk)))[:n])
+        return np.concatenate(preds)
+
+    if args.dataset:
+        _, test = load_dataset(
+            args.dataset, args.data_root, allow_synthetic=args.synthetic_data
+        )
+        preds = predict_all(test.images)
+        acc = float((preds == test.labels).mean())
+        print(
+            json.dumps(
+                {
+                    "epoch": epoch,
+                    "dataset": args.dataset,
+                    "n": int(len(test.labels)),
+                    "accuracy": round(acc, 4),
+                }
+            )
+        )
+    else:
+        images = np.load(args.images)
+        if images.ndim == 3:  # single image → batch of one
+            images = images[None]
+        preds = predict_all(images)
+        if args.out:
+            np.save(args.out, preds)
+        print(
+            json.dumps(
+                {
+                    "epoch": epoch,
+                    "n": int(len(preds)),
+                    "out": args.out,
+                    "predictions": preds[:16].tolist(),
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

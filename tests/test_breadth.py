@@ -1,0 +1,198 @@
+"""MNIST-family dataset variants, staircase LR, template-free inference.
+
+Covers the breadth bundle: fashion_mnist/kmnist registry entries (same
+IDX container as MNIST — data/mnist.py), the piecewise-constant LR
+schedule (the classic ResNet staircase the reference's fixed lr=0.01 at
+train_ddp.py:41 never needed), and checkpoint restore driven purely by
+checkpoint metadata (scripts/predict.py's loading path).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def no_network(monkeypatch):
+    """Hermetic mirrors: every variant points at a dead endpoint, so
+    tests behave identically on offline sandboxes and networked CI
+    (no surprise multi-dataset downloads, deterministic fallbacks)."""
+    from ddp_tpu.data import mnist
+
+    monkeypatch.setattr(
+        mnist,
+        "_VARIANT_MIRRORS",
+        {k: ("http://127.0.0.1:1/",) for k in mnist._VARIANT_MIRRORS},
+    )
+
+
+class TestMnistFamily:
+    def test_registry_resolves_variants(self, no_network, tmp_path):
+        from ddp_tpu.data.registry import NUM_CLASSES, load_dataset
+
+        for name in ("fashion_mnist", "kmnist"):
+            assert NUM_CLASSES[name] == 10
+            train, test = load_dataset(
+                name, str(tmp_path / "data"), allow_synthetic=True,
+                synthetic_size=64,
+            )
+            assert train.images.shape == (64, 28, 28, 1)
+            assert train.images.dtype == np.uint8
+
+    def test_unknown_variant_rejected(self):
+        from ddp_tpu.data import mnist
+
+        with pytest.raises(KeyError, match="variant"):
+            mnist.load("/tmp/x", "train", variant="emnist")
+
+    def test_variant_cache_paths_disjoint(self, no_network, tmp_path):
+        """fashion files must not collide with mnist's flat cache."""
+        from ddp_tpu.data import mnist
+
+        flat = tmp_path / "train-images-idx3-ubyte.gz"
+        flat.write_bytes(b"not-a-gzip")  # poison: would fail to parse
+        # fashion_mnist must NOT pick up the flat mnist file
+        with pytest.raises(RuntimeError, match="download"):
+            mnist._fetch(str(tmp_path), "train-images-idx3-ubyte.gz",
+                         "fashion_mnist")
+        # while mnist itself finds it
+        assert mnist._fetch(
+            str(tmp_path), "train-images-idx3-ubyte.gz", "mnist"
+        ) == str(flat)
+
+
+class TestStaircaseLR:
+    def test_decay_at_milestones(self):
+        from ddp_tpu.train.optim import make_optimizer
+
+        tx = make_optimizer(
+            "sgd", lr=1.0, lr_milestones=(2, 4), lr_decay_factor=0.5
+        )
+        p = {"w": jnp.zeros(())}
+        st = tx.init(p)
+        g = {"w": jnp.ones(())}
+        deltas = []
+        for _ in range(6):
+            up, st = tx.update(g, st, p)
+            deltas.append(-float(up["w"]))
+        # lr: steps 0,1 → 1.0; 2,3 → 0.5; 4,5 → 0.25
+        np.testing.assert_allclose(deltas, [1, 1, 0.5, 0.5, 0.25, 0.25])
+
+    def test_warmup_then_staircase(self):
+        from ddp_tpu.train.optim import make_optimizer
+
+        tx = make_optimizer(
+            "sgd", lr=1.0, warmup_steps=2, lr_milestones=(4,),
+            lr_decay_factor=0.1,
+        )
+        p = {"w": jnp.zeros(())}
+        st = tx.init(p)
+        g = {"w": jnp.ones(())}
+        deltas = []
+        for _ in range(6):
+            up, st = tx.update(g, st, p)
+            deltas.append(-float(up["w"]))
+        # linear 0→1 over 2 steps, constant to step 4, then ×0.1
+        np.testing.assert_allclose(deltas, [0, 0.5, 1, 1, 0.1, 0.1])
+
+    def test_mutually_exclusive_with_cosine(self):
+        from ddp_tpu.train.optim import make_optimizer
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            make_optimizer("sgd", decay_steps=100, lr_milestones=(10,))
+
+    def test_unsorted_milestones_rejected(self):
+        from ddp_tpu.train.optim import make_optimizer
+
+        with pytest.raises(ValueError, match="ascend"):
+            make_optimizer("sgd", lr_milestones=(10, 5))
+
+    def test_cli_parses_milestones(self, tmp_path):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = TrainConfig.from_args(["--lr_milestones", "100,200"])
+        assert cfg.lr_milestones == "100,200"
+        t = Trainer(
+            TrainConfig(
+                epochs=1, batch_size=8, synthetic_data=True,
+                synthetic_size=64, lr_milestones="10,20",
+                checkpoint_dir=str(tmp_path / "ck"),
+                data_root=str(tmp_path / "d"),
+            )
+        )
+        assert t._opt_kwargs["lr_milestones"] == (10, 20)
+        t.close()
+
+
+class TestInferenceRestore:
+    def test_restore_for_inference_optimizer_agnostic(self, tmp_path):
+        """Params come back without knowing the producing optimizer."""
+        from ddp_tpu.models import get_model
+        from ddp_tpu.parallel.ddp import create_train_state
+        from ddp_tpu.train.checkpoint import CheckpointManager
+
+        model = get_model("simple_cnn", features=(4, 8))
+        tx = optax.adamw(1e-3)  # stateful: moments in the checkpoint
+        st = create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=3)
+        mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+        mgr.save(2, st)
+        mgr.close()
+
+        mgr2 = CheckpointManager(str(tmp_path / "ck"))
+        params, model_state, epoch = mgr2.restore_for_inference()
+        mgr2.close()
+        assert epoch == 2
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(st.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_predict_cli_dataset_and_npy(self, tmp_path):
+        """Train briefly, then both predict modes end to end."""
+        env = dict(os.environ)
+        ck = str(tmp_path / "ck")
+        run = lambda *a: subprocess.run(
+            [sys.executable, *a], capture_output=True, text=True,
+            cwd=REPO_ROOT, env=env,
+        )
+        r = run(
+            "train.py", "--epochs", "1", "--batch_size", "8",
+            "--emulate_devices", "8", "--synthetic_data",
+            "--synthetic_size", "512", "--checkpoint_dir", ck,
+            "--data_root", str(tmp_path / "d"), "--log_interval", "16",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        r = run(
+            "scripts/predict.py", "--checkpoint_dir", ck,
+            "--dataset", "mnist", "--synthetic_data",
+            "--data_root", str(tmp_path / "d"), "--batch_size", "128",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["epoch"] == 0
+        assert out["accuracy"] > 0.5  # synthetic blobs are separable
+
+        from ddp_tpu.data import mnist
+
+        batch = mnist.synthetic(40, seed=5)
+        npy = str(tmp_path / "batch.npy")
+        np.save(npy, batch.images)
+        preds_path = str(tmp_path / "preds.npy")
+        r = run(
+            "scripts/predict.py", "--checkpoint_dir", ck,
+            "--images", npy, "--out", preds_path, "--batch_size", "16",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        preds = np.load(preds_path)
+        assert preds.shape == (40,)
+        # trained on the same synthetic distribution → mostly right
+        assert (preds == batch.labels).mean() > 0.5
