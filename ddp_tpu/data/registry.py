@@ -102,6 +102,32 @@ def _imagenet(root, *, allow_synthetic, synthetic_size):
     return train, test
 
 
+def load_split(
+    name: str,
+    root: str,
+    split: str,
+    *,
+    allow_synthetic: bool = False,
+    synthetic_size: int | None = None,
+):
+    """Load ONE split — inference tooling must not pay for (or
+    download) the train split just to evaluate the test set."""
+    kw = dict(allow_synthetic=allow_synthetic, synthetic_size=synthetic_size)
+    if name in ("mnist", "fashion_mnist", "kmnist"):
+        from ddp_tpu.data import mnist
+
+        return mnist.load(root, split, variant=name, **kw)
+    if name in ("cifar10", "cifar100"):
+        from ddp_tpu.data import cifar
+
+        return cifar.load(root, split, name=name, **kw)
+    if name == "imagenet":
+        from ddp_tpu.data import imagenet
+
+        return imagenet.load(root, split, **kw)
+    raise KeyError(f"unknown dataset {name!r}; have {sorted(_LOADERS)}")
+
+
 NUM_CLASSES = {
     "mnist": 10,
     "fashion_mnist": 10,

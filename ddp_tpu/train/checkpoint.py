@@ -215,6 +215,19 @@ class CheckpointManager:
             self.last_restored_mid_batch = 0
         return TrainState(**restored), epoch
 
+    def delete_after(self, epoch: int) -> list[int]:
+        """Delete every checkpoint tagged LATER than ``epoch``.
+
+        The rewind contract (``--resume_epoch``): the branch being
+        abandoned must not survive as "latest", or a crash in the
+        rewound run would auto-resume exactly the state the user chose
+        to discard. Returns the deleted tags.
+        """
+        stale = sorted(e for e in (self._mgr.all_steps() or []) if e > epoch)
+        for e in stale:
+            self._mgr.delete(e)
+        return stale
+
     def restore_for_inference(
         self, epoch: int | None = None
     ) -> tuple[Any, Any, int]:

@@ -73,6 +73,16 @@ class TrainConfig:
     # Single-process, pure-DDP, no grad accumulation.
     fast_epoch: bool = False
     max_checkpoints: int | None = None  # None = keep all, like the reference
+    # Resume from a specific saved epoch instead of the latest —
+    # rewind-and-retrain (e.g. after a bad LR change). The abandoned
+    # branch's LATER checkpoints are deleted on restore, so a crash
+    # mid-rewind can never auto-resume the discarded branch.
+    resume_epoch: int | None = None
+    # Restore ONLY params/model_state and start the optimizer (and its
+    # schedules/step counter) fresh. The escape hatch for changing the
+    # recipe mid-run — a checkpoint's optimizer state is unusable under
+    # a different --optimizer/schedule layout.
+    reset_opt_state: bool = False
     # Retain the max_checkpoints BEST-accuracy epochs instead of the
     # most recent (requires eval_every=1 so every save has a metric).
     keep_best: bool = False
@@ -146,6 +156,8 @@ class TrainConfig:
         p.add_argument("--eval_every", type=int, default=cls.eval_every)
         p.add_argument("--fast_epoch", action="store_true")
         p.add_argument("--max_checkpoints", type=int, default=None)
+        p.add_argument("--resume_epoch", type=int, default=None)
+        p.add_argument("--reset_opt_state", action="store_true")
         p.add_argument("--keep_best", action="store_true")
         p.add_argument("--synthetic_data", action="store_true")
         p.add_argument("--synthetic_size", type=int, default=None)

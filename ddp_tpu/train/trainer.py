@@ -324,8 +324,69 @@ class Trainer:
         """
         from ddp_tpu.train.optim import EmaState, ema_params, make_optimizer
 
+        def prune_rewound_branch(epoch):
+            # Rewind is a branch: the discarded later epochs must not
+            # remain discoverable as "latest" (a crash would
+            # auto-resume the branch the user just backed out of).
+            stale = self.ckpt.delete_after(epoch)
+            if stale:
+                logger.warning(
+                    "Rewind to epoch %d: deleted the abandoned "
+                    "branch's checkpoints %s", epoch, stale,
+                )
+
+        def do_restore(state):
+            if self.config.resume_epoch is not None:
+                restored, epoch = self.ckpt.restore(
+                    state, self.config.resume_epoch
+                )
+                prune_rewound_branch(epoch)
+                logger.info("Resumed from requested epoch %d", epoch)
+                return restored, epoch + 1
+            return self.ckpt.restore_or_init(state)
+
+        if self.config.reset_opt_state:
+            # Weights only; the optimizer (schedules, moments, step
+            # counter, EMA) starts fresh — the explicit recipe-change
+            # path, layout-independent by construction.
+            if self.ckpt.latest_epoch() is None:
+                return self.state, 0
+            params, model_state, epoch = self.ckpt.restore_for_inference(
+                self.config.resume_epoch
+            )
+            if self.config.resume_epoch is not None:
+                prune_rewound_branch(epoch)
+            # Adopt the live state's shardings (replicated or GSPMD
+            # rule layout), then rebuild optimizer state from the
+            # restored params so e.g. the EMA starts from them.
+            params = jax.tree.map(
+                lambda tpl, arr: jax.device_put(arr, tpl.sharding),
+                self.state.params,
+                params,
+            )
+            if model_state:
+                model_state = jax.tree.map(
+                    lambda tpl, arr: jax.device_put(arr, tpl.sharding),
+                    self.state.model_state,
+                    model_state,
+                )
+            else:
+                model_state = self.state.model_state
+            logger.warning(
+                "Restored epoch %d weights with a FRESH optimizer "
+                "state (--reset_opt_state)", epoch,
+            )
+            return (
+                self.state._replace(
+                    params=params,
+                    model_state=model_state,
+                    opt_state=self.optimizer.init(params),
+                ),
+                epoch + 1,
+            )
+
         try:
-            return self.ckpt.restore_or_init(self.state)
+            return do_restore(self.state)
         except (ValueError, KeyError) as e:
             if self.config.ema_decay:
                 tx_noema = make_optimizer(
@@ -336,7 +397,7 @@ class Trainer:
                     opt_state=tx_noema.init(self.state.params)
                 )
                 try:
-                    restored, start_epoch = self.ckpt.restore_or_init(alt)
+                    restored, start_epoch = do_restore(alt)
                 except (ValueError, KeyError):
                     restored = None
                 if restored is not None and ema_params(restored.opt_state) is None:
@@ -359,8 +420,12 @@ class Trainer:
             raise RuntimeError(
                 "Checkpoint optimizer state does not match the current "
                 "optimizer config — changed --optimizer / --momentum / "
-                "--ema_decay / --grad_clip_norm since it was written? "
-                "Point --checkpoint_dir elsewhere to start fresh."
+                "--ema_decay / --grad_clip_norm or a schedule flag "
+                "(--warmup_steps / --decay_steps / --lr_milestones; "
+                "schedules add a step-count state) since it was "
+                "written? Re-run with --reset_opt_state to keep the "
+                "weights and start the optimizer fresh, or point "
+                "--checkpoint_dir elsewhere."
             ) from e
 
     def train(self) -> dict[str, Any]:

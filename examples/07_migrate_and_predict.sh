@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Migration + inference + training-recipe knobs.
+#
+# Takes a reference (torch) run's checkpoint, converts it, continues
+# training with this framework's recipe features, then serves
+# predictions — the full "switch frameworks mid-run" loop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export WORK=${WORK:-/tmp/ddp_tpu_example7}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. Import a reference epoch_N.pt (here: the one the reference repo
+#    ships). Training will resume at epoch N+1.
+python scripts/import_torch_checkpoint.py \
+    --pt /root/reference/checkpoints/epoch_1.pt \
+    --checkpoint_dir "$WORK/checkpoints"
+
+# 2. Continue training where the torch run left off — now with label
+#    smoothing, parameter EMA, a staircase LR, and rematerialization
+#    available. --reset_opt_state: the new recipe's optimizer layout
+#    (schedule + EMA) differs from the imported plain-SGD one, so keep
+#    the weights and start the optimizer fresh.
+#    (--synthetic_data: offline stand-in for MNIST.)
+python train.py --epochs 4 --batch_size 64 --emulate_devices 8 \
+    --synthetic_data --synthetic_size 4096 \
+    --label_smoothing 0.1 --ema_decay 0.99 \
+    --lr_milestones 120,180 --lr_decay_factor 0.5 \
+    --reset_opt_state \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --log_interval 16
+
+# 3. Classify with the trained checkpoint: test-split accuracy, then a
+#    raw .npy batch.
+python scripts/predict.py --checkpoint_dir "$WORK/checkpoints" \
+    --dataset mnist --synthetic_data --data_root "$WORK/data"
+
+python - <<'EOF'
+import os
+import numpy as np
+from ddp_tpu.data import mnist
+work = os.environ["WORK"]
+np.save(os.path.join(work, "batch.npy"), mnist.synthetic(32, seed=9).images)
+EOF
+python scripts/predict.py --checkpoint_dir "$WORK/checkpoints" \
+    --images "$WORK/batch.npy" --out "$WORK/preds.npy"
+echo "predictions: $(python -c "import numpy as np; print(np.load('$WORK/preds.npy')[:10])")"
+
+# 4. And back out: export the trained params in the reference's format.
+python - <<'EOF'
+import os
+from ddp_tpu.interop import export_torch_checkpoint
+from ddp_tpu.train.checkpoint import CheckpointManager
+work = os.environ["WORK"]
+mgr = CheckpointManager(os.path.join(work, "checkpoints"))
+params, _, epoch = mgr.restore_for_inference()
+mgr.close()
+export_torch_checkpoint(os.path.join(work, "epoch_back.pt"), params, epoch)
+print(f"exported epoch {epoch} -> epoch_back.pt (reference format)")
+EOF

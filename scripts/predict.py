@@ -50,7 +50,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from ddp_tpu.data.registry import NUM_CLASSES, load_dataset
+    from ddp_tpu.data.registry import NUM_CLASSES, load_split
     from ddp_tpu.models import get_model
     from ddp_tpu.parallel.common import _preprocess, _train_kwarg
     from ddp_tpu.train.checkpoint import CheckpointManager
@@ -68,14 +68,14 @@ def main() -> None:
         jnp.bfloat16 if args.compute_dtype == "bfloat16" else jnp.float32
     )
     train_kw = _train_kwarg(model, False)
+    if compute_dtype != jnp.float32:
+        # Cast once on the host, not inside the jitted per-batch call.
+        params = jax.tree.map(lambda v: v.astype(compute_dtype), params)
 
     @jax.jit
     def forward(images):
         x = _preprocess(images, compute_dtype)
-        p_c = params
-        if compute_dtype != jnp.float32:
-            p_c = jax.tree.map(lambda v: v.astype(compute_dtype), params)
-        logits = model.apply({"params": p_c, **model_state}, x, **train_kw)
+        logits = model.apply({"params": params, **model_state}, x, **train_kw)
         return jnp.argmax(logits.astype(jnp.float32), -1)
 
     def predict_all(images):
@@ -94,8 +94,9 @@ def main() -> None:
         return np.concatenate(preds)
 
     if args.dataset:
-        _, test = load_dataset(
-            args.dataset, args.data_root, allow_synthetic=args.synthetic_data
+        test = load_split(
+            args.dataset, args.data_root, "test",
+            allow_synthetic=args.synthetic_data,
         )
         preds = predict_all(test.images)
         acc = float((preds == test.labels).mean())

@@ -134,6 +134,130 @@ class TestStaircaseLR:
         t.close()
 
 
+class TestResumeEpoch:
+    def test_rewind_to_requested_epoch(self, tmp_path):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        base = dict(
+            batch_size=8, synthetic_data=True, synthetic_size=256,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "d"), log_interval=8, eval_every=0,
+        )
+        t1 = Trainer(TrainConfig(epochs=3, **base))
+        assert t1.train()["epochs_run"] == 3
+        t1.close()
+
+        # rewind: branch from epoch 0's state; the abandoned branch's
+        # epochs 1-2 are deleted so they can't resurface as "latest",
+        # and the retrained epochs persist (supersede, not skip).
+        t2 = Trainer(TrainConfig(epochs=4, resume_epoch=0, **base))
+        assert sorted(t2.ckpt._mgr.all_steps()) == [0, 1, 2]
+        summary = t2.train()
+        assert summary["epochs_run"] == 3  # epochs 1,2,3
+        assert sorted(t2.ckpt._mgr.all_steps()) == [0, 1, 2, 3]
+        t2.close()
+
+        t3 = Trainer(TrainConfig(epochs=4, resume_epoch=99, **base))
+        with pytest.raises(FileNotFoundError):
+            t3.train()
+        t3.close()
+
+    def test_rewind_deletes_only_later_epochs(self, tmp_path):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        base = dict(
+            batch_size=8, synthetic_data=True, synthetic_size=256,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "d"), log_interval=8, eval_every=0,
+        )
+        t1 = Trainer(TrainConfig(epochs=3, **base))
+        t1.train()
+        t1.close()
+
+        # rewind to 1, then immediately "crash" (train only epoch 2's
+        # worth): epoch 2 from the old branch must be gone the moment
+        # restore happens, epochs 0-1 intact.
+        t2 = Trainer(TrainConfig(epochs=3, resume_epoch=1, **base))
+        state, start = t2._restore_or_init()
+        assert start == 2
+        assert sorted(t2.ckpt._mgr.all_steps()) == [0, 1]
+        t2.close()
+
+
+class TestResetOptState:
+    def test_recipe_change_keeps_weights(self, tmp_path):
+        """sgd checkpoint → adamw+EMA+staircase training: weights carry
+        over, optimizer starts fresh, run completes."""
+        import jax
+
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.optim import ema_params
+        from ddp_tpu.train.trainer import Trainer
+
+        base = dict(
+            batch_size=8, synthetic_data=True, synthetic_size=256,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "d"), log_interval=8, eval_every=0,
+        )
+        t1 = Trainer(TrainConfig(epochs=1, **base))
+        t1.train()
+        saved = jax.tree.map(np.asarray, t1.state.params)
+        t1.close()
+
+        cfg2 = TrainConfig(
+            epochs=2, optimizer="adamw", lr=1e-3, ema_decay=0.9,
+            lr_milestones="50", reset_opt_state=True, **base,
+        )
+        t2 = Trainer(cfg2)
+        state, start = t2._restore_or_init()
+        assert start == 1
+        for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(saved)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        # fresh optimizer: EMA starts at the restored params
+        ema = ema_params(state.opt_state)
+        for a, b in zip(jax.tree.leaves(ema), jax.tree.leaves(saved)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        assert int(state.step) == 0  # counter reset with the optimizer
+        summary = t2.train()
+        assert summary["epochs_run"] == 1
+        t2.close()
+
+    def test_without_flag_fails_with_hint(self, tmp_path):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        base = dict(
+            batch_size=8, synthetic_data=True, synthetic_size=256,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "d"), log_interval=8, eval_every=0,
+        )
+        t1 = Trainer(TrainConfig(epochs=1, **base))
+        t1.train()
+        t1.close()
+        t2 = Trainer(TrainConfig(epochs=2, optimizer="adamw", lr=1e-3, **base))
+        with pytest.raises(RuntimeError, match="reset_opt_state"):
+            t2.train()
+        t2.close()
+
+    def test_fresh_directory_starts_from_scratch(self, tmp_path):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        t = Trainer(
+            TrainConfig(
+                epochs=1, batch_size=8, synthetic_data=True,
+                synthetic_size=128, reset_opt_state=True,
+                checkpoint_dir=str(tmp_path / "ck"),
+                data_root=str(tmp_path / "d"), log_interval=8,
+                eval_every=0,
+            )
+        )
+        assert t.train()["epochs_run"] == 1
+        t.close()
+
+
 class TestInferenceRestore:
     def test_restore_for_inference_optimizer_agnostic(self, tmp_path):
         """Params come back without knowing the producing optimizer."""
@@ -196,3 +320,14 @@ class TestInferenceRestore:
         assert preds.shape == (40,)
         # trained on the same synthetic distribution → mostly right
         assert (preds == batch.labels).mean() > 0.5
+
+        # AOT export: serialized StableHLO round-trips numerically
+        artifact = str(tmp_path / "model.stablehlo")
+        r = run(
+            "scripts/export_model.py", "--checkpoint_dir", ck,
+            "--batch_size", "16", "--out", artifact, "--check",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["check"] == "ok"
+        assert os.path.getsize(artifact) == out["bytes"] > 0
