@@ -19,7 +19,32 @@ Layer map (mirrors SURVEY.md §1, re-homed for TPU):
                            kernels (ddp_tpu.ops), C++ data plane
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from ddp_tpu.runtime.dist import DistContext, setup, cleanup  # noqa: F401
-from ddp_tpu.runtime.mesh import make_mesh  # noqa: F401
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
+
+
+def __getattr__(name):
+    """Lazy top-level API: ``from ddp_tpu import Trainer, TrainConfig``.
+
+    Deferred imports keep ``import ddp_tpu`` light (no flax/optax/orbax
+    pull-in) for tools that only need the runtime layer.
+    """
+    if name == "Trainer":
+        from ddp_tpu.train.trainer import Trainer
+
+        return Trainer
+    if name == "TrainConfig":
+        from ddp_tpu.train.config import TrainConfig
+
+        return TrainConfig
+    if name == "CheckpointManager":
+        from ddp_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager
+    if name == "get_model":
+        from ddp_tpu.models import get_model
+
+        return get_model
+    raise AttributeError(f"module 'ddp_tpu' has no attribute {name!r}")

@@ -260,6 +260,7 @@ class Trainer:
         )
         # Constructed here, armed in train() (start/stop bracket the run).
         self._watchdog = StepWatchdog(config.watchdog_timeout)
+        self._raw_eval_count = 0  # companion raw evals under EMA
         self._preempt_requested = False
         self.history: list[EpochStats] = []
 
@@ -708,22 +709,46 @@ class Trainer:
 
     # ---- eval (absent in the reference; required by the north star) ----
 
-    def evaluate(self) -> tuple[float, float]:
+    def evaluate(self, *, use_ema: bool | None = None) -> tuple[float, float]:
         """Full test-split accuracy/loss, batched over the mesh.
 
         The split is padded with wraparound to a global-batch multiple;
         padding carries weight 0 so the totals are exact. In multi-host
         runs each process feeds its contiguous slice of the padded
         split. With ``--ema_decay`` the averaged parameters are
-        evaluated (the point of keeping them), not the raw ones.
+        evaluated (the point of keeping them) and the raw-weights
+        accuracy is logged alongside — early in a run the EMA lags far
+        behind and a single number would read as a regression.
         """
         eval_params = self.state.params
-        if self.config.ema_decay:
+        if use_ema is None:
+            use_ema = bool(self.config.ema_decay)
+        if use_ema:
             from ddp_tpu.train.optim import ema_params
 
-            averaged = ema_params(self.state.opt_state)
-            if averaged is not None:
+            averaged = (
+                ema_params(self.state.opt_state)
+                if self.config.ema_decay
+                else None
+            )
+            if averaged is None:
+                logger.warning(
+                    "evaluate(use_ema=True) but no EMA state exists "
+                    "(--ema_decay off?) — evaluating RAW weights"
+                )
+            else:
                 eval_params = averaged
+                # Companion raw-weights eval for the first couple of
+                # evals only: that's when the EMA lags enough to read
+                # as a regression, and a full second test-split pass
+                # per epoch forever is not worth one log line.
+                if self._raw_eval_count < 2:
+                    self._raw_eval_count += 1
+                    raw_acc, raw_loss = self.evaluate(use_ema=False)
+                    logger.info(
+                        "Eval with raw (non-EMA) weights: accuracy "
+                        "%.4f loss %.4f", raw_acc, raw_loss,
+                    )
         images, labels = self.test_split
         # Accumulation exists to keep the per-forward footprint at
         # batch_size×shards — eval must not undo that by running one
