@@ -60,6 +60,10 @@ class TrainState(NamedTuple):
 class StepMetrics(NamedTuple):
     loss: jax.Array
     accuracy: jax.Array
+    # Global L2 norm of the (already all-reduced) gradient — the
+    # standard divergence/clipping dashboard signal. Defaults keep the
+    # two-field constructors (pipeline/seq steps) valid.
+    grad_norm: jax.Array | float = 0.0
 
 
 def create_train_state(
@@ -155,6 +159,7 @@ def make_per_shard_step(
         metrics = StepMetrics(
             loss=lax.pmean(loss, axes),
             accuracy=lax.psum(correct, axes) / (n_labels * world),
+            grad_norm=optax.global_norm(grads),
         )
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 

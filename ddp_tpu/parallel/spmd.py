@@ -327,7 +327,9 @@ def make_spmd_train_step(
         params = constrain_tree(
             optax.apply_updates(state.params, updates), mesh, rules
         )
-        metrics = StepMetrics(loss=loss, accuracy=correct)
+        metrics = StepMetrics(
+            loss=loss, accuracy=correct, grad_norm=optax.global_norm(grads)
+        )
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

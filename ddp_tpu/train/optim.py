@@ -69,6 +69,63 @@ def ema_params(opt_state) -> Any | None:
     return None
 
 
+def make_schedule(
+    lr: float,
+    *,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+    lr_milestones: tuple[int, ...] = (),
+    lr_decay_factor: float = 0.1,
+):
+    """The learning-rate schedule alone — shared by ``make_optimizer``
+    and observability (logging the CURRENT lr per step without
+    `inject_hyperparams`, which would change the opt-state layout and
+    break checkpoint compatibility). Returns a float or a callable
+    ``schedule(count) -> lr``.
+    """
+    if decay_steps > 0 and lr_milestones:
+        raise ValueError(
+            "decay_steps (cosine) and lr_milestones (staircase) are "
+            "mutually exclusive schedules"
+        )
+    if decay_steps > 0:
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps else lr,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=decay_steps,
+        )
+    if lr_milestones:
+        if sorted(lr_milestones) != list(lr_milestones):
+            raise ValueError(f"lr_milestones must ascend: {lr_milestones}")
+        stair = optax.piecewise_constant_schedule(
+            lr, {int(m): lr_decay_factor for m in lr_milestones}
+        )
+        if warmup_steps > 0:
+            # NOT join_schedules: it re-zeroes the count past each
+            # boundary, which would silently shift every milestone by
+            # warmup_steps. Milestones are global step numbers.
+            warm = optax.linear_schedule(0.0, lr, warmup_steps)
+
+            def schedule(count):
+                return jnp.where(
+                    count < warmup_steps, warm(count), stair(count)
+                )
+
+            return schedule
+        return stair
+    if warmup_steps > 0:
+        return optax.linear_schedule(0.0, lr, warmup_steps)
+    return lr
+
+
+def lr_at(schedule, step: int) -> float:
+    """Evaluate a ``make_schedule`` result at a step (float passthrough)."""
+    if callable(schedule):
+        return float(schedule(step))
+    return float(schedule)
+
+
 def make_optimizer(
     name: str = "sgd",
     *,
@@ -88,40 +145,13 @@ def make_optimizer(
     (step numbers) → piecewise-constant ×``lr_decay_factor`` at each
     milestone (the classic ResNet staircase), composable with warmup.
     """
-    if decay_steps > 0 and lr_milestones:
-        raise ValueError(
-            "decay_steps (cosine) and lr_milestones (staircase) are "
-            "mutually exclusive schedules"
-        )
-    if decay_steps > 0:
-        schedule = optax.warmup_cosine_decay_schedule(
-            init_value=0.0 if warmup_steps else lr,
-            peak_value=lr,
-            warmup_steps=warmup_steps,
-            decay_steps=decay_steps,
-        )
-    elif lr_milestones:
-        if sorted(lr_milestones) != list(lr_milestones):
-            raise ValueError(f"lr_milestones must ascend: {lr_milestones}")
-        stair = optax.piecewise_constant_schedule(
-            lr, {int(m): lr_decay_factor for m in lr_milestones}
-        )
-        if warmup_steps > 0:
-            # NOT join_schedules: it re-zeroes the count past each
-            # boundary, which would silently shift every milestone by
-            # warmup_steps. Milestones are global step numbers.
-            warm = optax.linear_schedule(0.0, lr, warmup_steps)
-
-            def schedule(count):
-                return jnp.where(
-                    count < warmup_steps, warm(count), stair(count)
-                )
-        else:
-            schedule = stair
-    elif warmup_steps > 0:
-        schedule = optax.linear_schedule(0.0, lr, warmup_steps)
-    else:
-        schedule = lr
+    schedule = make_schedule(
+        lr,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        lr_milestones=lr_milestones,
+        lr_decay_factor=lr_decay_factor,
+    )
 
     if name == "sgd":
         tx = optax.sgd(schedule, momentum=momentum or None)

@@ -130,6 +130,21 @@ class Trainer:
             lr_decay_factor=config.lr_decay_factor,
         )
         self.optimizer = make_optimizer(config.optimizer, **self._opt_kwargs)
+        from ddp_tpu.train.optim import make_schedule
+
+        # The schedule alone, for logging the current lr per step —
+        # derived from the SAME kwargs the optimizer was built with so
+        # the logged lr can't drift from the trained one.
+        self._lr_schedule = make_schedule(
+            self._opt_kwargs["lr"],
+            **{
+                k: self._opt_kwargs[k]
+                for k in (
+                    "warmup_steps", "decay_steps",
+                    "lr_milestones", "lr_decay_factor",
+                )
+            },
+        )
 
         train_split, test_split = load_dataset(
             config.dataset,
@@ -602,6 +617,8 @@ class Trainer:
             # per epoch); preemption is honored between epochs.
             return self._train_epoch_fast(epoch)
         cfg = self.config
+        from ddp_tpu.train.optim import lr_at
+
         logger.info("Starting epoch %d", epoch)  # train_ddp.py:194 parity
         t0 = time.perf_counter()
         losses = []
@@ -637,6 +654,7 @@ class Trainer:
                 # syncs, so only at the log cadence.
                 loss = float(metrics.loss)
                 losses.append(loss)
+                step_now = int(self.state.step)
                 logger.info(
                     "Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss
                 )
@@ -644,8 +662,10 @@ class Trainer:
                     "step",
                     epoch=epoch,
                     batch=batch_idx,
-                    step=int(self.state.step),
+                    step=step_now,
                     loss=loss,
+                    grad_norm=round(float(metrics.grad_norm), 6),
+                    lr=round(lr_at(self._lr_schedule, max(0, step_now - 1)), 8),
                 )
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
@@ -692,18 +712,24 @@ class Trainer:
         t0 = time.perf_counter()
         self.state, metrics = self.fast_runner(self.state, epoch)
         losses_all = np.asarray(metrics.loss)
+        gnorms_all = np.asarray(metrics.grad_norm)
         seconds = time.perf_counter() - t0
         n_batches = len(losses_all)
         end_step = int(self.state.step)  # one sync, outside the loop
         losses = []
+        from ddp_tpu.train.optim import lr_at
+
         for batch_idx in range(0, n_batches, cfg.log_interval):
             loss = float(losses_all[batch_idx])
             losses.append(loss)
+            step_no = end_step - n_batches + batch_idx + 1
             logger.info("Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss)
             self.metrics_writer.write(
                 "step", epoch=epoch, batch=batch_idx,
-                step=end_step - n_batches + batch_idx + 1,
+                step=step_no,
                 loss=loss,
+                grad_norm=round(float(gnorms_all[batch_idx]), 6),
+                lr=round(lr_at(self._lr_schedule, max(0, step_no - 1)), 8),
             )
         return self._finish_epoch(epoch, losses, n_batches, seconds)
 
@@ -726,11 +752,7 @@ class Trainer:
         if use_ema:
             from ddp_tpu.train.optim import ema_params
 
-            averaged = (
-                ema_params(self.state.opt_state)
-                if self.config.ema_decay
-                else None
-            )
+            averaged = ema_params(self.state.opt_state)
             if averaged is None:
                 logger.warning(
                     "evaluate(use_ema=True) but no EMA state exists "

@@ -45,6 +45,10 @@ def test_trainer_emits_step_epoch_final_records(tmp_path):
     assert kinds == {"step", "epoch", "final"}
     steps = [r for r in records if r["kind"] == "step"]
     assert all(np.isfinite(r["loss"]) for r in steps)
+    # observability: every step row carries the grad norm and the lr
+    # the schedule prescribed for it
+    assert all(np.isfinite(r["grad_norm"]) and r["grad_norm"] >= 0 for r in steps)
+    assert all(r["lr"] > 0 for r in steps)
     epoch = next(r for r in records if r["kind"] == "epoch")
     assert epoch["images_per_sec"] > 0
     final = next(r for r in records if r["kind"] == "final")
