@@ -186,6 +186,33 @@ class TestResumeEpoch:
         t2.close()
 
 
+class TestElasticResume:
+    def test_resume_across_device_count_change(self, tmp_path):
+        """8-device checkpoint restores onto a 4-device mesh: params
+        are replicated, so device count is a free variable across
+        restarts (the reference hard-codes world_size=2 forever,
+        train_ddp.py:221). Mid-epoch positions are guarded separately
+        by the recorded steps-per-epoch."""
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        base = dict(
+            batch_size=8, synthetic_data=True, synthetic_size=256,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "d"), log_interval=8, eval_every=0,
+        )
+        t1 = Trainer(TrainConfig(epochs=1, num_devices=8, **base))
+        t1.train()
+        t1.close()
+
+        t2 = Trainer(TrainConfig(epochs=2, num_devices=4, **base))
+        assert t2.data_shards == 4
+        summary = t2.train()
+        t2.close()
+        assert summary["epochs_run"] == 1
+        assert np.isfinite(summary["final_accuracy"])
+
+
 class TestResetOptState:
     def test_recipe_change_keeps_weights(self, tmp_path):
         """sgd checkpoint → adamw+EMA+staircase training: weights carry
@@ -320,6 +347,29 @@ class TestInferenceRestore:
         assert preds.shape == (40,)
         # trained on the same synthetic distribution → mostly right
         assert (preds == batch.labels).mean() > 0.5
+
+        # model soup: average two checkpoints, predict from the result
+        r = run(
+            "train.py", "--epochs", "2", "--batch_size", "8",
+            "--emulate_devices", "8", "--synthetic_data",
+            "--synthetic_size", "512", "--checkpoint_dir", ck,
+            "--data_root", str(tmp_path / "d"), "--log_interval", "16",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        r = run(
+            "scripts/soup.py", "--checkpoint_dir", ck,
+            "--epochs", "0,1", "--out_epoch", "50",
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        r = run(
+            "scripts/predict.py", "--checkpoint_dir", ck, "--epoch", "50",
+            "--dataset", "mnist", "--synthetic_data",
+            "--data_root", str(tmp_path / "d"),
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        soup_out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert soup_out["epoch"] == 50
+        assert soup_out["accuracy"] > 0.5
 
         # AOT export: serialized StableHLO round-trips numerically
         artifact = str(tmp_path / "model.stablehlo")
