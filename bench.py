@@ -32,6 +32,12 @@ def run_bench(
     # Defaults from a sweep on the v4 chip (2026-07): 16384 beat 4096
     # (419k) and 32768 (430k) at 462k images/sec/chip; 10 timed epochs
     # amortize dispatch/timer noise that dominates sub-second windows.
+    # Profiled (xprof op_profile, 2026-07): >50% of device time is the
+    # conv2 fwd/grad fusions at ~7% MXU util — the 16384×28×28×32
+    # bf16 activations (~0.8 GB/tensor) make the step HBM-bandwidth
+    # bound, so batch size and kernel tweaks move it little; the
+    # remaining headroom would need an architecture change, not
+    # scheduling.
     import jax
     import jax.numpy as jnp
     import optax

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from collections import deque
 from typing import Any
@@ -63,6 +64,20 @@ class Trainer:
             emulate_devices=config.emulate_devices,
         )
         setup_logging(self.ctx.process_id)
+
+        if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            # Repeat CLI runs skip the first-compile wait (~20-40s on
+            # TPU). Compiled programs are keyed by HLO+flags, so a
+            # config change recompiles correctly. "" explicitly
+            # disables — including un-setting a cache a previous
+            # Trainer in this process enabled (the config is
+            # process-global).
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.expanduser(config.compile_cache_dir)
+                if config.compile_cache_dir
+                else None,
+            )
 
         devices = jax.devices()
         if config.num_devices > 0:
