@@ -173,6 +173,10 @@ class TrainConfig:
         p.add_argument(
             "--watchdog_timeout", type=float, default=cls.watchdog_timeout
         )
+        # Discovery: print the registries and exit (handled in train.py
+        # before config construction).
+        p.add_argument("--list_models", action="store_true")
+        p.add_argument("--list_datasets", action="store_true")
         p.add_argument("--spawn", type=int, default=cls.spawn)
         p.add_argument("--coordinator_address", default=None)
         p.add_argument("--num_processes", type=int, default=None)
@@ -180,8 +184,14 @@ class TrainConfig:
         return p
 
     @classmethod
-    def from_args(cls, argv=None) -> "TrainConfig":
-        ns = cls.parser().parse_args(argv)
-        kwargs = vars(ns)
+    def from_namespace(cls, ns) -> "TrainConfig":
+        kwargs = dict(vars(ns))
         kwargs["shuffle"] = not kwargs.pop("no_shuffle")
+        # action flags, not config state (handled by train.py)
+        kwargs.pop("list_models", None)
+        kwargs.pop("list_datasets", None)
         return cls(**kwargs)
+
+    @classmethod
+    def from_args(cls, argv=None) -> "TrainConfig":
+        return cls.from_namespace(cls.parser().parse_args(argv))

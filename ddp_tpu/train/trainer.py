@@ -65,7 +65,7 @@ class Trainer:
         )
         setup_logging(self.ctx.process_id)
 
-        if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
             # Repeat CLI runs skip the first-compile wait (~20-40s on
             # TPU). Compiled programs are keyed by HLO+flags, so a
             # config change recompiles correctly. "" explicitly

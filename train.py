@@ -47,7 +47,21 @@ def _spawned_worker(rank: int, world_size: int, argv) -> None:
 
 
 def main(argv=None) -> int:
-    config = TrainConfig.from_args(argv)
+    args = sys.argv[1:] if argv is None else list(argv)
+    # Parse once: the namespace drives both the action flags (robust
+    # to argparse prefix abbreviation) and the config.
+    ns = TrainConfig.parser().parse_args(args)
+    if ns.list_models:
+        from ddp_tpu.models import available
+
+        print("\n".join(available()))
+        return 0
+    if ns.list_datasets:
+        from ddp_tpu.data.registry import NUM_CLASSES
+
+        print("\n".join(f"{k} ({v} classes)" for k, v in sorted(NUM_CLASSES.items())))
+        return 0
+    config = TrainConfig.from_namespace(ns)
     if config.spawn > 1:
         # Reference parity: torch.multiprocessing.spawn(ddp_train,
         # nprocs=world_size) at train_ddp.py:222-224. Each rank gets
@@ -62,7 +76,7 @@ def main(argv=None) -> int:
         spawn(
             _spawned_worker,
             config.spawn,
-            (sys.argv[1:] if argv is None else list(argv),),
+            (args,),
             devices_per_process=config.emulate_devices or 1,
             timeout=None,  # a training run may legitimately take hours
         )
