@@ -109,6 +109,11 @@ class ShardedLoader:
         # the single-thread Python gather; >0 tries the native path and
         # falls back (with a warning) if no toolchain is available.
         self._prefetcher = None
+        if num_workers > 0 and images.dtype != np.uint8:
+            # The C++ gather ring is a byte-pipeline (uint8 images);
+            # float feature streams (e.g. the long-context sequences)
+            # use the Python gather, which is not the bottleneck there.
+            num_workers = 0
         if num_workers > 0:
             from ddp_tpu import native
 

@@ -221,12 +221,41 @@ def make_seq_parallel_train_step(
         )
         params = optax.apply_updates(state.params, updates)
         correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
+        # _replace keeps the caller's state type: SeqTrainState from
+        # this module's API, or the trainer's TrainState (which adds a
+        # model_state field this model never uses).
         return (
-            SeqTrainState(state.step + 1, params, opt_state),
-            StepMetrics(loss=loss, accuracy=correct),
+            state._replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            ),
+            StepMetrics(
+                loss=loss, accuracy=correct,
+                grad_norm=optax.global_norm(grads),
+            ),
         )
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_seq_parallel_eval_step(spec: SeqTransformerSpec, mesh: Mesh):
+    """Trainer-compatible eval step over the dp×sp mesh.
+
+    Signature matches the image eval steps —
+    ``(params, model_state, x, labels, weights) → (correct, loss_sum)``
+    (``model_state`` ignored; the model is stateless) — so
+    ``Trainer.evaluate`` drives it unchanged. ``weights`` mask the
+    wraparound padding of the final partial batch.
+    """
+    apply_fn = make_seq_parallel_apply(spec, mesh)
+
+    def step(params, model_state, x, labels, weights):
+        del model_state
+        logits = apply_fn(params, x).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
+        return correct, (loss * weights).sum()
+
+    return jax.jit(step)
 
 
 def create_seq_train_state(

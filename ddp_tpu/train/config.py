@@ -36,7 +36,10 @@ class TrainConfig:
     model: str = "simple_cnn"
     model_depth: int | None = None  # None = family default (e.g. ViT 12)
     augment: str | None = None  # data/augment.py: "crop_flip" | "flip"
-    dataset: str = "mnist"
+    # "auto" resolves per model family: mnist normally, synthetic_seq
+    # for --model long_context. An explicit image dataset with the
+    # long-context model is an error, not a silent substitution.
+    dataset: str = "auto"
     num_classes: int | None = None  # None = infer from dataset
     optimizer: str = "sgd"  # sgd | adam | adamw
     weight_decay: float = 0.0
@@ -60,6 +63,13 @@ class TrainConfig:
     mesh_model: int = 1  # tensor parallelism
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
+    # Sequence/context parallelism: tokens shard over the seq axis
+    # (ring or Ulysses attention). Requires --model long_context with
+    # the synthetic_seq dataset — the long-context path end to end.
+    mesh_seq: int = 1
+    seq_len: int = 2048  # total sequence length (long_context)
+    seq_dim: int = 16  # input feature channels per token
+    seq_strategy: str = "ring"  # ring | ulysses
     zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
@@ -150,6 +160,13 @@ class TrainConfig:
         p.add_argument("--mesh_model", type=int, default=cls.mesh_model)
         p.add_argument("--mesh_fsdp", type=int, default=cls.mesh_fsdp)
         p.add_argument("--mesh_expert", type=int, default=cls.mesh_expert)
+        p.add_argument("--mesh_seq", type=int, default=cls.mesh_seq)
+        p.add_argument("--seq_len", type=int, default=cls.seq_len)
+        p.add_argument("--seq_dim", type=int, default=cls.seq_dim)
+        p.add_argument(
+            "--seq_strategy", default=cls.seq_strategy,
+            choices=("ring", "ulysses"),
+        )
         p.add_argument("--zero1", action="store_true")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)

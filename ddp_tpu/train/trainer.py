@@ -82,6 +82,14 @@ class Trainer:
         devices = jax.devices()
         if config.num_devices > 0:
             devices = devices[: config.num_devices]
+        # Long-context mode: sequence-parallel transformer over the seq
+        # axis (ring/Ulysses attention), its own step/eval builders.
+        self.seq_mode = config.model == "long_context"
+        if config.mesh_seq > 1 and not self.seq_mode:
+            raise ValueError(
+                "--mesh_seq shards tokens, which only the long-context "
+                "model has: use --model long_context"
+            )
         # Any non-data axis > 1 switches to the GSPMD step — tensor/
         # fsdp/expert sharding by annotation (parallel/spmd.py). A pure
         # data mesh keeps the explicit shard_map DDP step.
@@ -91,12 +99,31 @@ class Trainer:
             or config.mesh_expert > 1
             or config.zero1  # opt-state sharding rides the GSPMD step
         )
+        from ddp_tpu.data.augment import get_augmentation
+
+        self.dataset = config.dataset
+        if self.dataset == "auto":
+            self.dataset = "synthetic_seq" if self.seq_mode else "mnist"
+        if self.seq_mode and (
+            self.use_spmd
+            or config.grad_accum_steps > 1
+            or config.fast_epoch
+            or get_augmentation(config.augment) is not None
+            or config.label_smoothing
+            or config.compute_dtype != "float32"
+        ):
+            raise ValueError(
+                "--model long_context composes with data+seq mesh axes "
+                "only (no tp/fsdp/expert/zero1, accumulation, augment, "
+                "label smoothing, fast path, or bf16 yet)"
+            )
         self.mesh = make_mesh(
             MeshSpec(
                 data=-1,
                 model=config.mesh_model,
                 fsdp=config.mesh_fsdp,
                 expert=config.mesh_expert,
+                seq=config.mesh_seq,
             ),
             devices=devices,
         )
@@ -112,24 +139,53 @@ class Trainer:
         from ddp_tpu.data.registry import NUM_CLASSES
         from ddp_tpu.train.optim import make_optimizer
 
-        model_kw = {}
-        if config.model_depth is not None:
-            model_kw["depth"] = config.model_depth
-        if config.remat:
-            model_kw["remat"] = True
-        try:
-            self.model = get_model(
-                config.model,
-                num_classes=config.num_classes or NUM_CLASSES.get(config.dataset, 10),
-                **model_kw,
-            )
-        except TypeError as e:
-            if config.remat and "remat" in str(e):
+        if self.seq_mode:
+            from ddp_tpu.models.seq_transformer import SeqTransformerSpec
+
+            if config.seq_len % max(1, config.mesh_seq):
                 raise ValueError(
-                    f"--remat is not supported by model {config.model!r} "
-                    "(no block stack to rematerialize)"
-                ) from e
-            raise
+                    f"--seq_len {config.seq_len} not divisible by "
+                    f"--mesh_seq {config.mesh_seq}"
+                )
+            self.seq_spec = SeqTransformerSpec(
+                num_classes=config.num_classes or 10,
+                total_len=config.seq_len,
+                d_in=config.seq_dim,
+                depth=config.model_depth or 2,
+                strategy=config.seq_strategy,
+                remat=config.remat,
+            )
+            if (
+                config.seq_strategy == "ulysses"
+                and self.seq_spec.num_heads % max(1, config.mesh_seq)
+            ):
+                # Ulysses re-shards heads over seq — fail at
+                # construction, not at first trace (parallel/ring.py).
+                raise ValueError(
+                    f"ulysses shards attention heads: "
+                    f"{self.seq_spec.num_heads} heads not divisible by "
+                    f"--mesh_seq {config.mesh_seq}"
+                )
+            self.model = None  # spec-driven; no registry module
+        else:
+            model_kw = {}
+            if config.model_depth is not None:
+                model_kw["depth"] = config.model_depth
+            if config.remat:
+                model_kw["remat"] = True
+            try:
+                self.model = get_model(
+                    config.model,
+                    num_classes=config.num_classes or NUM_CLASSES.get(self.dataset, 10),
+                    **model_kw,
+                )
+            except TypeError as e:
+                if config.remat and "remat" in str(e):
+                    raise ValueError(
+                        f"--remat is not supported by model {config.model!r} "
+                        "(no block stack to rematerialize)"
+                    ) from e
+                raise
         milestones = tuple(
             int(m) for m in config.lr_milestones.split(",") if m.strip()
         )
@@ -161,12 +217,33 @@ class Trainer:
             },
         )
 
-        train_split, test_split = load_dataset(
-            config.dataset,
-            config.data_root,
-            allow_synthetic=config.synthetic_data,
-            synthetic_size=config.synthetic_size,
-        )
+        if self.seq_mode:
+            if self.dataset != "synthetic_seq":
+                raise ValueError(
+                    f"--model long_context trains on sequences, not "
+                    f"{self.dataset!r}: use --dataset synthetic_seq "
+                    "(or leave --dataset unset)"
+                )
+            from ddp_tpu.data import sequences
+
+            n = config.synthetic_size or 2048
+            train_split = sequences.synthetic(
+                n, total_len=config.seq_len, d_in=config.seq_dim,
+                num_classes=self.seq_spec.num_classes, seed=config.seed,
+            )
+            test_split = sequences.synthetic(
+                max(1, n // 6), total_len=config.seq_len,
+                d_in=config.seq_dim,
+                num_classes=self.seq_spec.num_classes,
+                seed=config.seed + 1,
+            )
+        else:
+            train_split, test_split = load_dataset(
+                self.dataset,
+                config.data_root,
+                allow_synthetic=config.synthetic_data,
+                synthetic_size=config.synthetic_size,
+            )
         self.train_split, self.test_split = train_split, test_split
         self.loader = ShardedLoader(
             train_split.images,
@@ -187,7 +264,35 @@ class Trainer:
         sample = jnp.zeros(
             (1, *train_split.images.shape[1:]), jnp.float32
         )
-        if self.use_spmd:
+        if self.seq_mode:
+            from ddp_tpu.models.seq_transformer import (
+                create_seq_train_state,
+                make_seq_parallel_eval_step,
+                make_seq_parallel_train_step,
+            )
+            from ddp_tpu.parallel.ddp import TrainState
+
+            self.train_step = make_seq_parallel_train_step(
+                self.seq_spec, self.optimizer, self.mesh
+            )
+            self.eval_step = make_seq_parallel_eval_step(
+                self.seq_spec, self.mesh
+            )
+            st = create_seq_train_state(
+                self.seq_spec, self.optimizer, self.mesh, seed=config.seed
+            )
+            # The trainer's state type (checkpoint schema parity);
+            # model_state stays {} — the model is stateless. Replicate
+            # EVERY leaf (incl. the step scalar) over the mesh so
+            # restored checkpoints come back with uniform shardings.
+            self.state = replicate_state(
+                TrainState(
+                    step=st.step, params=st.params,
+                    opt_state=st.opt_state, model_state={},
+                ),
+                self.mesh,
+            )
+        elif self.use_spmd:
             from ddp_tpu.parallel.spmd import (
                 create_spmd_state,
                 make_spmd_eval_step,

@@ -1,0 +1,111 @@
+"""Trainer-level long-context training: --model long_context --mesh_seq.
+
+SURVEY.md §5 lists long-context/sequence parallelism as absent from the
+reference; parallel/ring.py + models/seq_transformer.py supply the
+machinery, and this pins the USER-facing path: the same Trainer/CLI
+that runs MNIST drives a ring-attention transformer with tokens
+sharded over the ``seq`` mesh axis — training, eval, checkpointing,
+resume.
+"""
+
+import numpy as np
+import pytest
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def seq_config(tmp_path, **kw):
+    base = dict(
+        model="long_context",
+        mesh_seq=4,
+        seq_len=64,
+        seq_dim=8,
+        epochs=2,
+        batch_size=4,
+        synthetic_size=256,
+        lr=1e-3,
+        optimizer="adam",
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "d"),
+        log_interval=8,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_cli_flags_parse():
+    cfg = TrainConfig.from_args(
+        ["--model", "long_context", "--mesh_seq", "4", "--seq_len", "128",
+         "--seq_strategy", "ulysses"]
+    )
+    assert cfg.mesh_seq == 4 and cfg.seq_len == 128
+    assert cfg.seq_strategy == "ulysses"
+
+
+def test_mesh_seq_requires_long_context(tmp_path):
+    with pytest.raises(ValueError, match="long_context"):
+        Trainer(seq_config(tmp_path, model="simple_cnn"))
+
+
+def test_seq_len_divisibility_checked(tmp_path):
+    with pytest.raises(ValueError, match="divisible"):
+        Trainer(seq_config(tmp_path, seq_len=66))
+
+
+def test_explicit_image_dataset_rejected(tmp_path):
+    with pytest.raises(ValueError, match="synthetic_seq"):
+        Trainer(seq_config(tmp_path, dataset="mnist"))
+
+
+def test_augment_none_is_accepted(tmp_path):
+    t = Trainer(seq_config(tmp_path, augment="none", epochs=1))
+    t.close()
+
+
+def test_ulysses_head_divisibility_checked_at_construction(tmp_path):
+    # spec has 4 heads; mesh_seq=8 cannot shard them
+    with pytest.raises(ValueError, match="heads"):
+        Trainer(
+            seq_config(
+                tmp_path, seq_strategy="ulysses", mesh_seq=8, seq_len=64,
+            )
+        )
+
+
+def test_train_eval_checkpoint_resume(tmp_path):
+    """dp=2 × sp=4 over 8 devices: loss drops, eval works, resume
+    continues from the saved epoch."""
+    t = Trainer(seq_config(tmp_path))
+    assert dict(t.mesh.shape)["seq"] == 4
+    assert dict(t.mesh.shape)["data"] == 2
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 2
+    # the synthetic task is separable: a converging pipeline clears
+    # 80% easily, a broken gradient path stays at ~10%
+    assert summary["final_accuracy"] > 0.8
+
+    t2 = Trainer(seq_config(tmp_path, epochs=3))
+    summary2 = t2.train()
+    t2.close()
+    assert summary2["epochs_run"] == 1  # epochs 0-1 restored
+
+
+def test_ulysses_strategy_trains(tmp_path):
+    t = Trainer(
+        seq_config(
+            tmp_path, seq_strategy="ulysses", epochs=1, mesh_seq=2,
+        )
+    )
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["final_loss"])
+
+
+def test_remat_composes(tmp_path):
+    t = Trainer(seq_config(tmp_path, remat=True, epochs=1))
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
