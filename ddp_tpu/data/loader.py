@@ -113,6 +113,14 @@ class ShardedLoader:
             # The C++ gather ring is a byte-pipeline (uint8 images);
             # float feature streams (e.g. the long-context sequences)
             # use the Python gather, which is not the bottleneck there.
+            import logging
+
+            logging.getLogger("ddp_tpu").warning(
+                "num_workers=%d requested but the native pipeline is "
+                "uint8-only (%s data); using Python gather",
+                num_workers,
+                images.dtype,
+            )
             num_workers = 0
         if num_workers > 0:
             from ddp_tpu import native

@@ -151,12 +151,17 @@ def dense_apply(spec: SeqTransformerSpec, params, x):
     return _dense_model(spec).apply({"params": params}, x)
 
 
-def make_seq_parallel_apply(spec: SeqTransformerSpec, mesh: Mesh):
+def make_seq_parallel_apply(
+    spec: SeqTransformerSpec, mesh: Mesh, *, compute_dtype=jnp.float32
+):
     """Jitted ``apply(params, x) -> logits`` with tokens on ``seq``.
 
     ``x``: [B, T_global, d_in] global array — batch shards over
     ``data``, tokens over ``seq``; logits come back sharded over
     ``data`` only (identical on every seq member).
+    ``compute_dtype=jnp.bfloat16`` runs the blocks (and the ring
+    collectives' payloads) in bf16 — LayerNorms and the head stay fp32
+    by module dtype; master params remain fp32 outside.
     """
     model = _sharded_model(spec)
     has_data = mesh.shape.get("data", 1) > 1
@@ -166,6 +171,11 @@ def make_seq_parallel_apply(spec: SeqTransformerSpec, mesh: Mesh):
     def per_shard(params, x_shard):
         t_local = x_shard.shape[1]
         offset = lax.axis_index("seq") * t_local
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(
+                lambda p: p.astype(compute_dtype), params
+            )
+            x_shard = x_shard.astype(compute_dtype)
         return model.apply({"params": params}, x_shard, pos_offset=offset)
 
     sharded = jax.shard_map(
@@ -190,14 +200,17 @@ def make_seq_parallel_train_step(
     mesh: Mesh,
     *,
     donate: bool = True,
+    compute_dtype=jnp.float32,
 ):
     """Full dp×sp train step: loss/grad through the collective forward.
 
     Params replicate; their gradients arrive correctly psum'd over both
     axes by the shard_map transpose. Batch shards over ``data``, tokens
-    over ``seq``.
+    over ``seq``. ``compute_dtype=jnp.bfloat16`` = mixed precision
+    (fp32 master params, bf16 blocks/collectives, fp32 grads out of
+    the cast's transpose).
     """
-    apply_fn = make_seq_parallel_apply(spec, mesh)
+    apply_fn = make_seq_parallel_apply(spec, mesh, compute_dtype=compute_dtype)
     has_data = mesh.shape.get("data", 1) > 1
     lspec = P("data") if has_data else P(None)
 
@@ -237,7 +250,9 @@ def make_seq_parallel_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_seq_parallel_eval_step(spec: SeqTransformerSpec, mesh: Mesh):
+def make_seq_parallel_eval_step(
+    spec: SeqTransformerSpec, mesh: Mesh, *, compute_dtype=jnp.float32
+):
     """Trainer-compatible eval step over the dp×sp mesh.
 
     Signature matches the image eval steps —
@@ -246,7 +261,7 @@ def make_seq_parallel_eval_step(spec: SeqTransformerSpec, mesh: Mesh):
     ``Trainer.evaluate`` drives it unchanged. ``weights`` mask the
     wraparound padding of the final partial batch.
     """
-    apply_fn = make_seq_parallel_apply(spec, mesh)
+    apply_fn = make_seq_parallel_apply(spec, mesh, compute_dtype=compute_dtype)
 
     def step(params, model_state, x, labels, weights):
         del model_state

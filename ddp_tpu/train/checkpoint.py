@@ -121,6 +121,10 @@ class CheckpointManager:
         """Discovery: the reference's "latest file in ./checkpoints"."""
         return self._mgr.latest_step()
 
+    def all_epochs(self) -> list[int]:
+        """Every saved epoch tag, ascending."""
+        return sorted(self._mgr.all_steps() or [])
+
     def save(
         self,
         epoch: int,
@@ -228,28 +232,20 @@ class CheckpointManager:
             self._mgr.delete(e)
         return stale
 
-    def restore_for_inference(
-        self, epoch: int | None = None
-    ) -> tuple[Any, Any, int]:
-        """Template-free restore → ``(params, model_state, epoch)``.
+    _pytree_mgr = None
 
-        Builds the abstract tree from the checkpoint's own metadata, so
-        no model/optimizer construction is needed — inference tooling
-        (scripts/predict.py) can load ANY run's checkpoint without
-        knowing which optimizer produced it. The optimizer state is
-        read and discarded.
+    def read_partial(self, epoch: int, keys: tuple[str, ...]) -> dict:
+        """Read ONLY ``keys`` of a checkpoint, topology-independent.
+
+        The abstract tree comes from the checkpoint's own metadata (no
+        model/optimizer construction); explicit single-device shardings
+        replace the recorded ones, which reference the topology the
+        checkpoint was WRITTEN under (e.g. an 8-device emulated mesh)
+        and cannot deserialize elsewhere. Skipped entries pay no I/O
+        (``partial_restore`` — an Adam opt_state is 2× the params).
         """
-        if epoch is None:
-            epoch = self.latest_epoch()
-            if epoch is None:
-                raise FileNotFoundError(f"no checkpoints in {self._dir}")
         meta = dict(self._mgr.item_metadata(epoch))
-        wanted = {
-            k: meta[k] for k in ("params", "model_state") if k in meta
-        }
-        # Explicit single-device sharding: the checkpoint's recorded
-        # shardings reference the topology it was WRITTEN under (e.g.
-        # an 8-device emulated mesh) and cannot deserialize elsewhere.
+        wanted = {k: meta[k] for k in keys if k in meta}
         dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         abstract = jax.tree.map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=dev),
@@ -258,29 +254,40 @@ class CheckpointManager:
         restore_args = jax.tree.map(
             lambda _: ocp.ArrayRestoreArgs(sharding=dev), abstract
         )
-        # A partial (params-only) read: an Adam-family opt_state is 2×
-        # the params (plus the EMA) — pointless I/O and host memory for
-        # inference. PyTreeRestore(partial_restore=True) skips those
-        # entries; a throwaway manager because the main one is
-        # registered for the Standard handler.
-        sub = ocp.CheckpointManager(
-            self._dir,
-            options=ocp.CheckpointManagerOptions(step_prefix="epoch"),
-            item_handlers=ocp.PyTreeCheckpointHandler(),
-        )
-        try:
-            restored = dict(
-                sub.restore(
-                    epoch,
-                    args=ocp.args.PyTreeRestore(
-                        item=abstract,
-                        restore_args=restore_args,
-                        partial_restore=True,
-                    ),
-                )
+        if self._pytree_mgr is None:
+            # The main manager is registered for the Standard handler;
+            # partial restore needs the PyTree one. One lazy instance
+            # serves every read (scripts iterate all epochs).
+            self._pytree_mgr = ocp.CheckpointManager(
+                self._dir,
+                options=ocp.CheckpointManagerOptions(step_prefix="epoch"),
+                item_handlers=ocp.PyTreeCheckpointHandler(),
             )
-        finally:
-            sub.close()
+        return dict(
+            self._pytree_mgr.restore(
+                epoch,
+                args=ocp.args.PyTreeRestore(
+                    item=abstract,
+                    restore_args=restore_args,
+                    partial_restore=True,
+                ),
+            )
+        )
+
+    def restore_for_inference(
+        self, epoch: int | None = None
+    ) -> tuple[Any, Any, int]:
+        """Template-free restore → ``(params, model_state, epoch)``.
+
+        Inference tooling (scripts/predict.py) loads ANY run's
+        checkpoint without knowing which optimizer produced it; the
+        optimizer state is never read.
+        """
+        if epoch is None:
+            epoch = self.latest_epoch()
+            if epoch is None:
+                raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        restored = self.read_partial(epoch, ("params", "model_state"))
         return restored["params"], restored.get("model_state", {}), epoch
 
     def restore_or_init(
@@ -306,3 +313,6 @@ class CheckpointManager:
     def close(self) -> None:
         self._mgr.wait_until_finished()
         self._mgr.close()
+        if self._pytree_mgr is not None:
+            self._pytree_mgr.close()
+            self._pytree_mgr = None

@@ -110,12 +110,11 @@ class Trainer:
             or config.fast_epoch
             or get_augmentation(config.augment) is not None
             or config.label_smoothing
-            or config.compute_dtype != "float32"
         ):
             raise ValueError(
                 "--model long_context composes with data+seq mesh axes "
                 "only (no tp/fsdp/expert/zero1, accumulation, augment, "
-                "label smoothing, fast path, or bf16 yet)"
+                "or label smoothing yet); bf16 IS supported"
             )
         self.mesh = make_mesh(
             MeshSpec(
@@ -258,8 +257,6 @@ class Trainer:
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32
-        from ddp_tpu.data.augment import get_augmentation
-
         augment_fn = get_augmentation(config.augment)
         sample = jnp.zeros(
             (1, *train_split.images.shape[1:]), jnp.float32
@@ -273,10 +270,11 @@ class Trainer:
             from ddp_tpu.parallel.ddp import TrainState
 
             self.train_step = make_seq_parallel_train_step(
-                self.seq_spec, self.optimizer, self.mesh
+                self.seq_spec, self.optimizer, self.mesh,
+                compute_dtype=compute_dtype,
             )
             self.eval_step = make_seq_parallel_eval_step(
-                self.seq_spec, self.mesh
+                self.seq_spec, self.mesh, compute_dtype=compute_dtype,
             )
             st = create_seq_train_state(
                 self.seq_spec, self.optimizer, self.mesh, seed=config.seed

@@ -57,7 +57,7 @@ def main() -> None:
     from ddp_tpu.train.optim import make_optimizer
 
     mgr = CheckpointManager(args.checkpoint_dir)
-    existing = mgr._mgr.all_steps() or []
+    existing = mgr.all_epochs()
     if args.out_epoch in existing:
         mgr.close()
         raise SystemExit(

@@ -371,6 +371,17 @@ class TestInferenceRestore:
         assert soup_out["epoch"] == 50
         assert soup_out["accuracy"] > 0.5
 
+        # inspection tool: one JSON record per epoch, right counts
+        r = run("scripts/inspect_checkpoint.py", "--checkpoint_dir", ck)
+        assert r.returncode == 0, r.stderr[-2000:]
+        rows = [json.loads(l) for l in r.stdout.strip().splitlines()]
+        tags = {row["epoch"] for row in rows}
+        assert {0, 1, 50} <= tags
+        for row in rows:
+            assert row["params"] == 520586  # SimpleCNN, model.py:4-20
+            if row["epoch"] in (0, 1):
+                assert row["steps_per_epoch"] == row["step"] / (row["epoch"] + 1)
+
         # AOT export: serialized StableHLO round-trips numerically
         artifact = str(tmp_path / "model.stablehlo")
         r = run(

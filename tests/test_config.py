@@ -23,7 +23,10 @@ def test_reference_defaults():
     assert cfg.log_interval == 100  # train_ddp.py:201
     assert cfg.shuffle is True  # data.py:18
     assert cfg.num_workers == 2  # data.py:22
-    assert cfg.dataset == "mnist"
+    # "auto" resolves to mnist for every image model (data.py:11
+    # parity); it exists so --model long_context can't silently train
+    # sequences under an explicitly image dataset name.
+    assert cfg.dataset == "auto"
     assert cfg.model == "simple_cnn"
 
 

@@ -109,3 +109,17 @@ def test_remat_composes(tmp_path):
     summary = t.train()
     t.close()
     assert summary["epochs_run"] == 1
+
+
+def test_bf16_mixed_precision(tmp_path):
+    t = Trainer(seq_config(tmp_path, compute_dtype="bfloat16"))
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 2
+    assert summary["final_accuracy"] > 0.8  # bf16 must still converge
+    # master params stay fp32
+    import jax
+
+    assert all(
+        leaf.dtype == np.float32 for leaf in jax.tree.leaves(t.state.params)
+    )
