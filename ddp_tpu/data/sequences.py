@@ -50,3 +50,25 @@ def synthetic(
     x += 0.5 * biases[labels][:, None, :]
     x += rng.normal(0.0, 0.3, size=x.shape).astype(np.float32)
     return Split(x.astype(np.float32), labels)
+
+
+def synthetic_tokens(
+    num: int,
+    *,
+    total_len: int = 2048,
+    vocab_size: int = 64,
+    seed: int = 0,
+) -> np.ndarray:
+    """Deterministic token streams for causal-LM training: arithmetic
+    progressions ``(start + stride·t) mod V`` with per-sample start and
+    stride. After two tokens the continuation is fully determined, so a
+    working attention/LM path drives next-token accuracy toward 1 —
+    and a broken causal mask (peeking at the future) shows up as
+    suspiciously instant perfection. Returns ``[num, total_len]`` int32.
+    """
+    rng = np.random.default_rng(seed)
+    strides = np.asarray([1, 2, 3, 5, 7])
+    start = rng.integers(0, vocab_size, size=(num, 1))
+    stride = strides[rng.integers(0, len(strides), size=(num, 1))]
+    t = np.arange(total_len)[None, :]
+    return ((start + stride * t) % vocab_size).astype(np.int32)

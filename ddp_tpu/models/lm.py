@@ -1,0 +1,233 @@
+"""Decoder-only causal language model with sequence parallelism.
+
+The reference is a vision classifier (model.py:4-20); this is the
+framework's demonstration that its long-context machinery carries a
+*language-model* workload: causal ring/Ulysses attention
+(parallel/ring.py, global triangular mask exact across shard
+boundaries), tokens sharded over the ``seq`` mesh axis end to end, and
+a next-token loss whose label shift happens on the global sequence
+before sharding — so the shard-boundary token's label (the NEXT
+shard's first token) is correct by construction.
+
+Layout: token embedding → learned position embedding → pre-LN causal
+blocks (models/vit.py EncoderBlock with a causal attention_fn) → final
+LN → logits through the TIED embedding transpose (the standard
+weight-tying trick; halves the embedding parameters).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.models.vit import EncoderBlock
+from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.parallel.ddp import StepMetrics
+from ddp_tpu.parallel.ring import sequence_sharded_attention
+
+
+class CausalLM(nn.Module):
+    """[B, T_local] int32 tokens → [B, T_local, vocab] fp32 logits."""
+
+    vocab_size: int
+    total_len: int
+    d_model: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    attention_fn: Callable = partial(dot_product_attention, causal=True)
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        embed = self.param(
+            "embed",
+            nn.initializers.normal(stddev=0.02),
+            (self.vocab_size, self.d_model),
+        )
+        x = embed[tokens]  # [B, T_local, d]
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, self.total_len, self.d_model),
+        )
+        x = x + lax.dynamic_slice_in_dim(
+            pos.astype(x.dtype), pos_offset, x.shape[1], axis=1
+        )
+        block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        for i in range(self.depth):
+            x = block_cls(
+                num_heads=self.num_heads,
+                mlp_dim=self.d_model * self.mlp_ratio,
+                attention_fn=self.attention_fn,
+                name=f"block{i + 1}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+        # Tied head: logits through the embedding transpose.
+        return (x @ embed.T.astype(x.dtype)).astype(jnp.float32)
+
+
+class LMSpec(NamedTuple):
+    vocab_size: int
+    total_len: int
+    d_model: int = 64
+    depth: int = 2
+    num_heads: int = 4
+    strategy: str = "ring"  # ring | ulysses
+    remat: bool = False
+
+
+def _dense_lm(spec: LMSpec) -> CausalLM:
+    return CausalLM(
+        vocab_size=spec.vocab_size,
+        total_len=spec.total_len,
+        d_model=spec.d_model,
+        depth=spec.depth,
+        num_heads=spec.num_heads,
+        remat=spec.remat,
+    )
+
+
+def _sharded_lm(spec: LMSpec) -> CausalLM:
+    def attention(q, k, v):
+        return sequence_sharded_attention(
+            q, k, v, axis_name="seq", strategy=spec.strategy, causal=True
+        )
+
+    return CausalLM(
+        vocab_size=spec.vocab_size,
+        total_len=spec.total_len,
+        d_model=spec.d_model,
+        depth=spec.depth,
+        num_heads=spec.num_heads,
+        attention_fn=attention,
+        remat=spec.remat,
+    )
+
+
+def init_lm(spec: LMSpec, *, seed: int = 0):
+    """Params from a short stub — every shape is length-independent."""
+    stub = min(spec.total_len, 128)
+    return _dense_lm(spec).init(
+        jax.random.key(seed), jnp.zeros((1, stub), jnp.int32)
+    )["params"]
+
+
+def dense_lm_apply(spec: LMSpec, params, tokens):
+    """Single-device reference forward over the full sequence."""
+    return _dense_lm(spec).apply({"params": params}, tokens)
+
+
+def next_token_loss(logits, tokens):
+    """Mean causal-LM loss: position t predicts token t+1.
+
+    ``logits``/``tokens`` are GLOBAL ([B, T, V] / [B, T]); the final
+    position has no target and is masked out.
+    """
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    weights = jnp.concatenate(
+        [
+            jnp.ones(tokens[:, 1:].shape, jnp.float32),
+            jnp.zeros(tokens[:, :1].shape, jnp.float32),
+        ],
+        axis=1,
+    )
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
+    return (per_tok * weights).sum() / weights.sum()
+
+
+# One step/params/opt_state state shape serves every sequence-model
+# family (models/seq_transformer.py defines it + the replication
+# factory — uniform shardings on every leaf).
+from ddp_tpu.models.seq_transformer import (  # noqa: E402
+    SeqTrainState as LMTrainState,
+    replicated_train_state,
+)
+
+
+def create_lm_train_state(
+    spec: LMSpec,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+) -> LMTrainState:
+    return replicated_train_state(init_lm(spec, seed=seed), optimizer, mesh)
+
+
+def make_lm_train_step(
+    spec: LMSpec,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    donate: bool = True,
+    compute_dtype=jnp.float32,
+):
+    """dp×sp causal-LM step: ``step(state, tokens) -> (state, metrics)``.
+
+    ``tokens``: [B, T_global] int32. The label shift and loss masking
+    happen on GLOBAL arrays before/after the sharded forward, so shard
+    boundaries need no special cases; gradients for the replicated
+    params arrive psum'd by the shard_map transpose. Metrics: loss is
+    the mean next-token cross-entropy, accuracy the next-token top-1.
+    """
+    model = _sharded_lm(spec)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P(None)
+    xspec = P(bspec[0], "seq")
+
+    def per_shard_forward(params, tok_shard):
+        t_local = tok_shard.shape[1]
+        offset = lax.axis_index("seq") * t_local
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        return model.apply({"params": params}, tok_shard, pos_offset=offset)
+
+    sharded_forward = jax.shard_map(
+        per_shard_forward,
+        mesh=mesh,
+        in_specs=(P(), xspec),
+        out_specs=xspec,
+        check_vma=False,
+    )
+
+    def step(state: LMTrainState, tokens):
+        tokens = lax.with_sharding_constraint(
+            tokens, NamedSharding(mesh, xspec)
+        )
+
+        def loss_fn(params):
+            logits = sharded_forward(params, tokens)
+            return next_token_loss(logits, tokens), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
+        accuracy = (pred == tokens[:, 1:]).mean()
+        return (
+            state._replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            ),
+            StepMetrics(
+                loss=loss, accuracy=accuracy,
+                grad_norm=optax.global_norm(grads),
+            ),
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -194,6 +194,24 @@ class SeqTrainState(NamedTuple):
     opt_state: Any
 
 
+def replicated_train_state(
+    params, optimizer: optax.GradientTransformation, mesh: Mesh
+) -> SeqTrainState:
+    """Replicate EVERY leaf (params, optimizer state, the step scalar)
+    over the mesh. Shared by the sequence-model families; uniform
+    shardings matter — a restore templated on this state must not mix
+    single-device scalars with mesh-replicated tensors.
+    """
+    rep = NamedSharding(mesh, P())
+    put = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
+    params = put(params)
+    return SeqTrainState(
+        step=put(jnp.zeros((), jnp.int32)),
+        params=params,
+        opt_state=put(optimizer.init(params)),
+    )
+
+
 def make_seq_parallel_train_step(
     spec: SeqTransformerSpec,
     optimizer: optax.GradientTransformation,
@@ -280,11 +298,6 @@ def create_seq_train_state(
     *,
     seed: int = 0,
 ) -> SeqTrainState:
-    params = init_seq_transformer(spec, seed=seed)
-    rep = NamedSharding(mesh, P())
-    params = jax.tree.map(lambda x: jax.device_put(x, rep), params)
-    return SeqTrainState(
-        step=jnp.zeros((), jnp.int32),
-        params=params,
-        opt_state=optimizer.init(params),
+    return replicated_train_state(
+        init_seq_transformer(spec, seed=seed), optimizer, mesh
     )

@@ -17,16 +17,27 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def dot_product_attention(q, k, v):
+# Large-negative mask value: -inf would produce NaN through the
+# online-softmax correction terms when a whole block is masked.
+MASK_VALUE = -0.5 * jnp.finfo(jnp.float32).max
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False):
     """Plain softmax attention, fp32 accumulation.
 
     [B, T, H, D] in/out. Softmax runs in fp32 regardless of input dtype
     (bf16-safe); the two matmuls stay in the input dtype for the MXU.
+    ``causal=True`` masks position t from keys s > t (q and k must
+    cover the same positions).
     """
     dtype = q.dtype
     scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bthd,bshd->bhts", q, k) * scale
-    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        T, S = logits.shape[-2:]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(mask, logits, MASK_VALUE)
+    weights = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhts,bshd->bthd", weights.astype(dtype), v)
 
 

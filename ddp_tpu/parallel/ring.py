@@ -39,22 +39,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def ring_attention(q, k, v, *, axis_name: str = "seq"):
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = False):
     """Exact attention with K/V rotating around the ``axis_name`` ring.
 
     Args: q, k, v — [B, T_local, H, D] shards (inside shard_map, tokens
-    sharded over ``axis_name``). Non-causal (bidirectional), matching
-    ``ops.attention.dot_product_attention`` over the full sequence.
+    sharded over ``axis_name``). Matches
+    ``ops.attention.dot_product_attention`` over the gathered sequence;
+    ``causal=True`` applies the global causal mask — position masking
+    uses each hop's GLOBAL block offset, so the triangular structure is
+    exact across shard boundaries (the diagonal block arrives at hop 0,
+    so every query row is live before any fully-masked block folds in).
     """
+    from ddp_tpu.ops.attention import MASK_VALUE
+
     axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
     B, T, H, D = q.shape
     qf = q.astype(jnp.float32)
     scale = D**-0.5
     # Send to the next device, receive from the previous: after hop j,
     # this device holds the K/V block of (my_index - j) mod n.
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    q_pos = my_idx * T + jnp.arange(T)  # global query positions
 
-    def fold(carry, _):
+    def fold(carry, hop):
         acc, row_max, row_sum, kb, vb = carry
         # Rotate first and let XLA overlap the ppermute with the block
         # compute on the *current* kb/vb (no data dependence between them).
@@ -63,6 +71,11 @@ def ring_attention(q, k, v, *, axis_name: str = "seq"):
         logits = (
             jnp.einsum("bthd,bshd->bhts", qf, kb.astype(jnp.float32)) * scale
         )  # [B, H, T_local, S_block]
+        if causal:
+            src = (my_idx - hop) % axis_size  # whose block this is
+            k_pos = src * kb.shape[1] + jnp.arange(kb.shape[1])
+            mask = q_pos[:, None] >= k_pos[None, :]  # [T_local, S_block]
+            logits = jnp.where(mask, logits, MASK_VALUE)
         new_max = jnp.maximum(row_max, logits.max(axis=-1))
         corr = jnp.exp(row_max - new_max)
         p = jnp.exp(logits - new_max[..., None])
@@ -76,23 +89,29 @@ def ring_attention(q, k, v, *, axis_name: str = "seq"):
     max0 = jnp.full((B, H, T), -jnp.inf, jnp.float32)
     sum0 = jnp.zeros((B, H, T), jnp.float32)
     (acc, _, row_sum, _, _), _ = lax.scan(
-        fold, (acc0, max0, sum0, k, v), None, length=axis_size
+        fold, (acc0, max0, sum0, k, v), jnp.arange(axis_size)
     )
     out = acc / row_sum[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, *, axis_name: str = "seq", attention_fn=None):
+def ulysses_attention(
+    q, k, v, *, axis_name: str = "seq", attention_fn=None,
+    causal: bool = False,
+):
     """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
 
     Re-shards [B, T/n, H, D] → [B, T, H/n, D] with one ``all_to_all``,
-    runs ``attention_fn`` (dense by default) over the full sequence on
-    the local head subset, then re-shards back. Requires H divisible by
-    the axis size.
+    runs ``attention_fn`` (dense by default; causal dense when
+    ``causal``) over the full sequence on the local head subset, then
+    re-shards back. Requires H divisible by the axis size.
     """
     from ddp_tpu.ops.attention import dot_product_attention
 
-    attention_fn = attention_fn or dot_product_attention
+    if attention_fn is None:
+        attention_fn = partial(dot_product_attention, causal=causal)
+    elif causal:
+        raise ValueError("pass causality through your attention_fn")
     n = lax.psum(1, axis_name)
     H = q.shape[2]
     if H % n:
@@ -111,11 +130,12 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq", attention_fn=None):
 
 
 def sequence_sharded_attention(
-    q, k, v, *, axis_name: str = "seq", strategy: str = "ring"
+    q, k, v, *, axis_name: str = "seq", strategy: str = "ring",
+    causal: bool = False,
 ):
     """Dispatch: ``strategy`` ∈ {"ring", "ulysses"}."""
     if strategy == "ring":
-        return ring_attention(q, k, v, axis_name=axis_name)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
     if strategy == "ulysses":
-        return ulysses_attention(q, k, v, axis_name=axis_name)
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
     raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")

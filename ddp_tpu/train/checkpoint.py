@@ -125,6 +125,10 @@ class CheckpointManager:
         """Every saved epoch tag, ascending."""
         return sorted(self._mgr.all_steps() or [])
 
+    def metadata(self, epoch: int) -> dict:
+        """Shape/dtype metadata tree for one epoch (no array reads)."""
+        return dict(self._mgr.item_metadata(epoch))
+
     def save(
         self,
         epoch: int,

@@ -251,9 +251,12 @@ class Trainer:
             self.global_batch_size,
             shuffle=config.shuffle,
             seed=config.seed,
-            # The fast path never drains the loader — don't spin up a
-            # native worker pool that would idle for the whole run.
-            num_workers=0 if config.fast_epoch else config.num_workers,
+            # The fast path never drains the loader, and the seq path
+            # feeds float sequences the byte-pipeline can't serve —
+            # don't spin up (or warn about) a pool that can't be used.
+            num_workers=0
+            if (config.fast_epoch or self.seq_mode)
+            else config.num_workers,
         )
 
         compute_dtype = jnp.bfloat16 if config.compute_dtype == "bfloat16" else jnp.float32

@@ -59,7 +59,7 @@ def main() -> None:
         epochs = [args.epoch]
 
     for e in epochs:
-        meta = dict(mgr._mgr.item_metadata(e))
+        meta = mgr.metadata(e)
         n_params, params_bytes = _tree_stats(meta.get("params", {}))
         _, opt_bytes = _tree_stats(meta.get("opt_state", {}))
         _, ms_bytes = _tree_stats(meta.get("model_state", {}))

@@ -1,0 +1,115 @@
+"""Causal LM over sequence parallelism: causality, parity, learning.
+
+The reference has no language modeling anywhere; this pins the
+framework's decoder path (models/lm.py): the causal mask must actually
+prevent future leakage, the seq-sharded forward must match the dense
+one bit-close across shard boundaries, and the dp×sp train step must
+learn next-token prediction on deterministic progressions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.data.sequences import synthetic_tokens
+from ddp_tpu.models.lm import (
+    LMSpec,
+    create_lm_train_state,
+    dense_lm_apply,
+    init_lm,
+    make_lm_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+SPEC = LMSpec(vocab_size=32, total_len=64, d_model=32, depth=2, num_heads=4)
+
+
+def test_forward_shape_and_tied_embedding():
+    params = init_lm(SPEC, seed=0)
+    toks = jnp.asarray(synthetic_tokens(2, total_len=64, vocab_size=32))
+    logits = dense_lm_apply(SPEC, params, toks)
+    assert logits.shape == (2, 64, 32)
+    # tied head: no separate output projection in the tree
+    assert "embed" in params and "head" not in params
+
+
+def test_causality_no_future_leakage():
+    """Changing tokens after position t must not change logits ≤ t."""
+    params = init_lm(SPEC, seed=1)
+    toks = synthetic_tokens(1, total_len=64, vocab_size=32, seed=2)
+    logits_a = np.asarray(dense_lm_apply(SPEC, params, jnp.asarray(toks)))
+    perturbed = toks.copy()
+    perturbed[:, 40:] = (perturbed[:, 40:] + 11) % 32
+    logits_b = np.asarray(dense_lm_apply(SPEC, params, jnp.asarray(perturbed)))
+    np.testing.assert_allclose(
+        logits_a[:, :40], logits_b[:, :40], atol=1e-5
+    )
+    assert not np.allclose(logits_a[:, 40:], logits_b[:, 40:], atol=1e-3)
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_sharded_forward_matches_dense(devices, strategy):
+    spec = SPEC._replace(strategy=strategy)
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    tx = optax.adam(1e-3)
+    state = create_lm_train_state(spec, tx, mesh, seed=3)
+    toks = jnp.asarray(synthetic_tokens(2, total_len=64, vocab_size=32, seed=4))
+
+    # one non-donating step to get logits path exercised, then compare
+    # the sharded forward against the dense reference directly
+    from ddp_tpu.models.lm import _sharded_lm  # forward only
+
+    import jax as _jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    model = _sharded_lm(spec)
+
+    def per_shard(params, tok):
+        off = lax.axis_index("seq") * tok.shape[1]
+        return model.apply({"params": params}, tok, pos_offset=off)
+
+    fwd = _jax.jit(
+        _jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(), P("data", "seq")), out_specs=P("data", "seq"),
+            check_vma=False,
+        )
+    )
+    got = np.asarray(fwd(state.params, toks))
+    want = np.asarray(dense_lm_apply(spec, state.params, toks))
+    np.testing.assert_allclose(got, want, atol=3e-5)
+
+
+def test_lm_learns_progressions(devices):
+    """dp2×sp4: next-token accuracy far above chance within a few steps."""
+    mesh = make_mesh(MeshSpec(data=2, seq=4), devices=devices)
+    spec = SPEC
+    tx = optax.adam(3e-3)
+    state = create_lm_train_state(spec, tx, mesh, seed=0)
+    step = make_lm_train_step(spec, tx, mesh)
+    toks = synthetic_tokens(256, total_len=64, vocab_size=32, seed=5)
+    first = last = None
+    for i in range(100):
+        batch = jnp.asarray(toks[(i * 8) % 256 : (i * 8) % 256 + 8])
+        state, m = step(state, batch)
+        if first is None:
+            first = float(m.loss)
+        last = m
+    assert int(state.step) == 100
+    # measured trajectory (seed 0): 3.47 → ~1.4 by step 100
+    assert float(last.loss) < first * 0.6
+    assert float(last.accuracy) > 0.25  # chance is 1/32 ≈ 0.03
+
+
+def test_remat_variant_runs(devices):
+    mesh = make_mesh(MeshSpec(data=1, seq=8), devices=devices)
+    spec = SPEC._replace(remat=True)
+    tx = optax.adam(1e-3)
+    state = create_lm_train_state(spec, tx, mesh, seed=0)
+    step = make_lm_train_step(spec, tx, mesh)
+    toks = jnp.asarray(synthetic_tokens(4, total_len=64, vocab_size=32))
+    state, m = step(state, toks)
+    assert np.isfinite(float(m.loss))

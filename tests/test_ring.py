@@ -78,6 +78,74 @@ def test_ulysses_rejects_indivisible_heads(devices):
         _seq_sharded(ulysses_attention, mesh)(q, k, v)
 
 
+def _dense_causal_reference(q, k, v):
+    """Explicitly-masked softmax — independent of the kernels under test."""
+    qf, kf, vf = (np.asarray(a, np.float64) for a in (q, k, v))
+    B, T, H, D = qf.shape
+    logits = np.einsum("bthd,bshd->bhts", qf, kf) / np.sqrt(D)
+    mask = np.tril(np.ones((T, T), bool))
+    logits = np.where(mask, logits, -np.inf)
+    logits -= logits.max(-1, keepdims=True)
+    w = np.exp(logits)
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("bhts,bshd->bthd", w, vf).astype(np.float32)
+
+
+class TestCausal:
+    def test_dense_causal_matches_reference(self):
+        q, k, v = _qkv(2, 16, 2, 8, seed=4)
+        out = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), _dense_causal_reference(q, k, v), atol=2e-5
+        )
+
+    def test_dense_causal_first_token_sees_only_itself(self):
+        q, k, v = _qkv(1, 8, 1, 4, seed=5)
+        out = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out[0, 0]), np.asarray(v[0, 0]), atol=1e-6
+        )
+
+    def test_ring_causal_matches_dense_8way(self, devices):
+        """The global triangular mask must be exact across shard
+        boundaries (the hop offset arithmetic)."""
+        mesh = Mesh(np.asarray(devices), ("seq",))
+        q, k, v = _qkv(2, 64, 3, 8, seed=6)
+        fn = _seq_sharded(
+            lambda a, b, c: ring_attention(a, b, c, causal=True), mesh
+        )
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v)), np.asarray(ref), atol=2e-5
+        )
+
+    def test_ulysses_causal_matches_dense(self, devices):
+        mesh = Mesh(np.asarray(devices[:4]), ("seq",))
+        q, k, v = _qkv(2, 32, 4, 8, seed=7)
+        fn = _seq_sharded(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=True), mesh
+        )
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v)), np.asarray(ref), atol=2e-5
+        )
+
+    def test_dispatch_causal(self, devices):
+        mesh = Mesh(np.asarray(devices[:4]), ("seq",))
+        q, k, v = _qkv(1, 32, 4, 8, seed=8)
+        ref = dot_product_attention(q, k, v, causal=True)
+        for strategy in ("ring", "ulysses"):
+            fn = _seq_sharded(
+                lambda a, b, c: sequence_sharded_attention(
+                    a, b, c, strategy=strategy, causal=True
+                ),
+                mesh,
+            )
+            np.testing.assert_allclose(
+                np.asarray(fn(q, k, v)), np.asarray(ref), atol=2e-5
+            )
+
+
 def test_dispatch_strategies(devices):
     mesh = Mesh(np.asarray(devices[:4]), ("seq",))
     q, k, v = _qkv(1, 32, 4, 8, seed=3)
