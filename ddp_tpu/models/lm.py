@@ -166,6 +166,60 @@ def create_lm_train_state(
     return replicated_train_state(init_lm(spec, seed=seed), optimizer, mesh)
 
 
+def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
+    model = _sharded_lm(spec)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P(None)
+    xspec = P(bspec[0], "seq")
+
+    def per_shard_forward(params, tok_shard):
+        t_local = tok_shard.shape[1]
+        offset = lax.axis_index("seq") * t_local
+        if compute_dtype != jnp.float32:
+            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        return model.apply({"params": params}, tok_shard, pos_offset=offset)
+
+    return (
+        jax.shard_map(
+            per_shard_forward,
+            mesh=mesh,
+            in_specs=(P(), xspec),
+            out_specs=xspec,
+            check_vma=False,
+        ),
+        xspec,
+    )
+
+
+def make_lm_eval_step(
+    spec: LMSpec, mesh: Mesh, *, compute_dtype=jnp.float32
+):
+    """Trainer-compatible eval: next-token metrics over held-out tokens.
+
+    Signature matches the classifier eval steps —
+    ``(params, model_state, tokens, labels, weights) →
+    (weighted Σ per-sequence token accuracy, weighted Σ per-sequence
+    mean loss)`` — so ``Trainer.evaluate`` divides by n and reports
+    average next-token accuracy where classifiers report top-1.
+    ``labels`` is ignored (targets are the shifted tokens themselves).
+    """
+    sharded_forward, _ = _make_sharded_forward(spec, mesh, compute_dtype)
+
+    def step(params, model_state, tokens, labels, weights):
+        del model_state, labels
+        logits = sharded_forward(params, tokens)
+        targets = tokens[:, 1:]
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), targets
+        )  # [B, T-1]
+        seq_loss = per_tok.mean(axis=1)
+        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
+        seq_acc = (pred == targets).mean(axis=1)
+        return (seq_acc * weights).sum(), (seq_loss * weights).sum()
+
+    return jax.jit(step)
+
+
 def make_lm_train_step(
     spec: LMSpec,
     optimizer: optax.GradientTransformation,
@@ -182,25 +236,7 @@ def make_lm_train_step(
     params arrive psum'd by the shard_map transpose. Metrics: loss is
     the mean next-token cross-entropy, accuracy the next-token top-1.
     """
-    model = _sharded_lm(spec)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P(None)
-    xspec = P(bspec[0], "seq")
-
-    def per_shard_forward(params, tok_shard):
-        t_local = tok_shard.shape[1]
-        offset = lax.axis_index("seq") * t_local
-        if compute_dtype != jnp.float32:
-            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
-        return model.apply({"params": params}, tok_shard, pos_offset=offset)
-
-    sharded_forward = jax.shard_map(
-        per_shard_forward,
-        mesh=mesh,
-        in_specs=(P(), xspec),
-        out_specs=xspec,
-        check_vma=False,
-    )
+    sharded_forward, xspec = _make_sharded_forward(spec, mesh, compute_dtype)
 
     def step(state: LMTrainState, tokens):
         tokens = lax.with_sharding_constraint(

@@ -64,12 +64,14 @@ class TrainConfig:
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
     # Sequence/context parallelism: tokens shard over the seq axis
-    # (ring or Ulysses attention). Requires --model long_context with
-    # the synthetic_seq dataset — the long-context path end to end.
+    # (ring or Ulysses attention). For the sequence models —
+    # --model long_context (classifier) or causal_lm (decoder LM) —
+    # on the synthetic_seq dataset.
     mesh_seq: int = 1
-    seq_len: int = 2048  # total sequence length (long_context)
+    seq_len: int = 2048  # total sequence length (long_context/causal_lm)
     seq_dim: int = 16  # input feature channels per token
     seq_strategy: str = "ring"  # ring | ulysses
+    vocab_size: int = 256  # causal_lm token vocabulary
     zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
@@ -167,6 +169,7 @@ class TrainConfig:
             "--seq_strategy", default=cls.seq_strategy,
             choices=("ring", "ulysses"),
         )
+        p.add_argument("--vocab_size", type=int, default=cls.vocab_size)
         p.add_argument("--zero1", action="store_true")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)

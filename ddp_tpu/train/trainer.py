@@ -82,13 +82,15 @@ class Trainer:
         devices = jax.devices()
         if config.num_devices > 0:
             devices = devices[: config.num_devices]
-        # Long-context mode: sequence-parallel transformer over the seq
-        # axis (ring/Ulysses attention), its own step/eval builders.
-        self.seq_mode = config.model == "long_context"
+        # Sequence family: token-sharded models over the seq axis
+        # (ring/Ulysses attention) with their own step/eval builders —
+        # the long-context classifier and the causal LM.
+        self.lm_mode = config.model == "causal_lm"
+        self.seq_mode = config.model == "long_context" or self.lm_mode
         if config.mesh_seq > 1 and not self.seq_mode:
             raise ValueError(
-                "--mesh_seq shards tokens, which only the long-context "
-                "model has: use --model long_context"
+                "--mesh_seq shards tokens, which only the sequence "
+                "models have: use --model long_context or causal_lm"
             )
         # Any non-data axis > 1 switches to the GSPMD step — tensor/
         # fsdp/expert sharding by annotation (parallel/spmd.py). A pure
@@ -112,9 +114,9 @@ class Trainer:
             or config.label_smoothing
         ):
             raise ValueError(
-                "--model long_context composes with data+seq mesh axes "
-                "only (no tp/fsdp/expert/zero1, accumulation, augment, "
-                "or label smoothing yet); bf16 IS supported"
+                f"--model {config.model} composes with data+seq mesh "
+                "axes only (no tp/fsdp/expert/zero1, accumulation, "
+                "augment, or label smoothing yet); bf16 IS supported"
             )
         self.mesh = make_mesh(
             MeshSpec(
@@ -139,21 +141,34 @@ class Trainer:
         from ddp_tpu.train.optim import make_optimizer
 
         if self.seq_mode:
-            from ddp_tpu.models.seq_transformer import SeqTransformerSpec
-
             if config.seq_len % max(1, config.mesh_seq):
                 raise ValueError(
                     f"--seq_len {config.seq_len} not divisible by "
                     f"--mesh_seq {config.mesh_seq}"
                 )
-            self.seq_spec = SeqTransformerSpec(
-                num_classes=config.num_classes or 10,
-                total_len=config.seq_len,
-                d_in=config.seq_dim,
-                depth=config.model_depth or 2,
-                strategy=config.seq_strategy,
-                remat=config.remat,
-            )
+            if self.lm_mode:
+                from ddp_tpu.models.lm import LMSpec
+
+                self.seq_spec = LMSpec(
+                    vocab_size=config.vocab_size,
+                    total_len=config.seq_len,
+                    depth=config.model_depth or 2,
+                    strategy=config.seq_strategy,
+                    remat=config.remat,
+                )
+            else:
+                from ddp_tpu.models.seq_transformer import (
+                    SeqTransformerSpec,
+                )
+
+                self.seq_spec = SeqTransformerSpec(
+                    num_classes=config.num_classes or 10,
+                    total_len=config.seq_len,
+                    d_in=config.seq_dim,
+                    depth=config.model_depth or 2,
+                    strategy=config.seq_strategy,
+                    remat=config.remat,
+                )
             if (
                 config.seq_strategy == "ulysses"
                 and self.seq_spec.num_heads % max(1, config.mesh_seq)
@@ -219,23 +234,30 @@ class Trainer:
         if self.seq_mode:
             if self.dataset != "synthetic_seq":
                 raise ValueError(
-                    f"--model long_context trains on sequences, not "
+                    f"--model {config.model} trains on sequences, not "
                     f"{self.dataset!r}: use --dataset synthetic_seq "
                     "(or leave --dataset unset)"
                 )
             from ddp_tpu.data import sequences
+            from ddp_tpu.data.mnist import Split
 
             n = config.synthetic_size or 2048
-            train_split = sequences.synthetic(
-                n, total_len=config.seq_len, d_in=config.seq_dim,
-                num_classes=self.seq_spec.num_classes, seed=config.seed,
-            )
-            test_split = sequences.synthetic(
-                max(1, n // 6), total_len=config.seq_len,
-                d_in=config.seq_dim,
-                num_classes=self.seq_spec.num_classes,
-                seed=config.seed + 1,
-            )
+
+            def seq_split(count, seed):
+                if self.lm_mode:
+                    toks = sequences.synthetic_tokens(
+                        count, total_len=config.seq_len,
+                        vocab_size=config.vocab_size, seed=seed,
+                    )
+                    # labels unused: targets are the shifted tokens
+                    return Split(toks, np.zeros(count, np.int32))
+                return sequences.synthetic(
+                    count, total_len=config.seq_len, d_in=config.seq_dim,
+                    num_classes=self.seq_spec.num_classes, seed=seed,
+                )
+
+            train_split = seq_split(n, config.seed)
+            test_split = seq_split(max(1, n // 6), config.seed + 1)
         else:
             train_split, test_split = load_dataset(
                 self.dataset,
@@ -265,23 +287,47 @@ class Trainer:
             (1, *train_split.images.shape[1:]), jnp.float32
         )
         if self.seq_mode:
-            from ddp_tpu.models.seq_transformer import (
-                create_seq_train_state,
-                make_seq_parallel_eval_step,
-                make_seq_parallel_train_step,
-            )
             from ddp_tpu.parallel.ddp import TrainState
 
-            self.train_step = make_seq_parallel_train_step(
-                self.seq_spec, self.optimizer, self.mesh,
-                compute_dtype=compute_dtype,
-            )
-            self.eval_step = make_seq_parallel_eval_step(
-                self.seq_spec, self.mesh, compute_dtype=compute_dtype,
-            )
-            st = create_seq_train_state(
-                self.seq_spec, self.optimizer, self.mesh, seed=config.seed
-            )
+            if self.lm_mode:
+                from ddp_tpu.models.lm import (
+                    create_lm_train_state,
+                    make_lm_eval_step,
+                    make_lm_train_step,
+                )
+
+                lm_step = make_lm_train_step(
+                    self.seq_spec, self.optimizer, self.mesh,
+                    compute_dtype=compute_dtype,
+                )
+                # labels ride the loader but the LM has no use for
+                # them — targets are the shifted tokens.
+                self.train_step = lambda s, toks, lbls: lm_step(s, toks)
+                self.eval_step = make_lm_eval_step(
+                    self.seq_spec, self.mesh, compute_dtype=compute_dtype,
+                )
+                st = create_lm_train_state(
+                    self.seq_spec, self.optimizer, self.mesh,
+                    seed=config.seed,
+                )
+            else:
+                from ddp_tpu.models.seq_transformer import (
+                    create_seq_train_state,
+                    make_seq_parallel_eval_step,
+                    make_seq_parallel_train_step,
+                )
+
+                self.train_step = make_seq_parallel_train_step(
+                    self.seq_spec, self.optimizer, self.mesh,
+                    compute_dtype=compute_dtype,
+                )
+                self.eval_step = make_seq_parallel_eval_step(
+                    self.seq_spec, self.mesh, compute_dtype=compute_dtype,
+                )
+                st = create_seq_train_state(
+                    self.seq_spec, self.optimizer, self.mesh,
+                    seed=config.seed,
+                )
             # The trainer's state type (checkpoint schema parity);
             # model_state stays {} — the model is stateless. Replicate
             # EVERY leaf (incl. the step scalar) over the mesh so
