@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Decoder-only causal LM with sequence parallelism, via the same CLI
+# as the image configs: tokens shard over the seq axis, attention runs
+# as a causal ring collective, loss is next-token cross-entropy, eval
+# reports average next-token accuracy. Re-running resumes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example8}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+python train.py --model causal_lm \
+    --mesh_seq 4 --seq_len 512 --vocab_size 64 \
+    --epochs 3 --batch_size 4 --optimizer adam --lr 0.003 \
+    --emulate_devices 8 --synthetic_size 512 \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --log_interval 16
+
+# Ulysses strategy + rematerialization (HBM for FLOPs at long context):
+python train.py --model causal_lm \
+    --mesh_seq 4 --seq_len 512 --vocab_size 64 --seq_strategy ulysses \
+    --remat --epochs 1 --batch_size 4 --optimizer adam --lr 0.003 \
+    --emulate_devices 8 --synthetic_size 256 \
+    --checkpoint_dir "$WORK/checkpoints_ulysses" --data_root "$WORK/data" \
+    --log_interval 16

@@ -54,12 +54,20 @@ def main(argv=None) -> int:
     if ns.list_models:
         from ddp_tpu.models import available
 
-        print("\n".join(available()))
+        # Registry models plus the spec-driven sequence family the
+        # trainer accepts without a registry entry.
+        seq_family = [
+            "causal_lm (sequence: --mesh_seq/--seq_len/--vocab_size)",
+            "long_context (sequence: --mesh_seq/--seq_len/--seq_dim)",
+        ]
+        print("\n".join(sorted(available() + seq_family)))
         return 0
     if ns.list_datasets:
         from ddp_tpu.data.registry import NUM_CLASSES
 
-        print("\n".join(f"{k} ({v} classes)" for k, v in sorted(NUM_CLASSES.items())))
+        rows = [f"{k} ({v} classes)" for k, v in NUM_CLASSES.items()]
+        rows.append("synthetic_seq (sequence models only)")
+        print("\n".join(sorted(rows)))
         return 0
     config = TrainConfig.from_namespace(ns)
     if config.spawn > 1:
