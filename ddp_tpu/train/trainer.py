@@ -857,6 +857,13 @@ class Trainer:
             seconds,
             stats.images_per_sec,
         )
+        extra = {}
+        if self.seq_mode:
+            # For sequence models the sample rate is sequences/sec;
+            # tokens/sec is the number the field actually compares.
+            extra["tokens_per_sec"] = round(
+                stats.images_per_sec * self.config.seq_len, 1
+            )
         self.metrics_writer.write(
             "epoch",
             epoch=epoch,
@@ -864,6 +871,7 @@ class Trainer:
             seconds=round(seconds, 3),
             images_per_sec=round(stats.images_per_sec, 1),
             mean_loss=stats.mean_loss,
+            **extra,
         )
         return stats
 
