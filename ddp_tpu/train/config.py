@@ -35,6 +35,9 @@ class TrainConfig:
     # Framework knobs (no reference analogue)
     model: str = "simple_cnn"
     model_depth: int | None = None  # None = family default (e.g. ViT 12)
+    # Width for the sequence family (long_context/causal_lm d_model);
+    # registry models fix their widths per family name.
+    model_dim: int | None = None
     augment: str | None = None  # data/augment.py: "crop_flip" | "flip"
     # "auto" resolves per model family: mnist normally, synthetic_seq
     # for --model long_context. An explicit image dataset with the
@@ -134,6 +137,7 @@ class TrainConfig:
         p.add_argument("--num_workers", type=int, default=cls.num_workers)
         p.add_argument("--model", default=cls.model)
         p.add_argument("--model_depth", type=int, default=None)
+        p.add_argument("--model_dim", type=int, default=None)
         p.add_argument(
             "--augment", default=None, choices=("none", "crop_flip", "flip")
         )

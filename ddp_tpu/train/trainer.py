@@ -152,6 +152,7 @@ class Trainer:
                 self.seq_spec = LMSpec(
                     vocab_size=config.vocab_size,
                     total_len=config.seq_len,
+                    d_model=config.model_dim or 64,
                     depth=config.model_depth or 2,
                     strategy=config.seq_strategy,
                     remat=config.remat,
@@ -165,6 +166,7 @@ class Trainer:
                     num_classes=config.num_classes or 10,
                     total_len=config.seq_len,
                     d_in=config.seq_dim,
+                    d_model=config.model_dim or 64,
                     depth=config.model_depth or 2,
                     strategy=config.seq_strategy,
                     remat=config.remat,
