@@ -1,0 +1,58 @@
+"""Byte-level text corpus for the causal LM — real data, no tokenizer.
+
+The reference trains on images only (/root/reference/data.py); round 1
+gave the LM nothing but synthetic token streams (VERDICT.md "do this"
+#3: "add one real text dataset — byte-level corpus file is enough").
+This reads ANY file as a uint8 byte stream and chunks it into fixed-
+length training sequences: vocab = 256 raw bytes, zero external
+dependencies, zero egress.
+
+Chunking is non-overlapping (the standard LM epoch layout); the
+train/test split cuts by SEQUENCE index after chunking, so the test
+tail never leaks into training windows.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddp_tpu.data.mnist import Split
+
+
+def load_text_corpus(
+    path: str,
+    seq_len: int,
+    *,
+    vocab_size: int = 256,
+    test_fraction: float = 0.1,
+) -> tuple[Split, Split]:
+    """File of bytes → (train, test) Splits of [N, seq_len] int32 tokens.
+
+    ``vocab_size`` must cover every byte present (≥ 256 always works;
+    smaller vocabularies are validated so an out-of-range byte fails
+    here, not as a garbage embedding lookup). Labels are zeros — the
+    LM's targets are the shifted tokens themselves (models/lm.py).
+    """
+    data = np.fromfile(path, dtype=np.uint8)
+    n_seq = len(data) // seq_len
+    if n_seq < 2:
+        raise ValueError(
+            f"{path}: {len(data)} bytes yield {n_seq} sequences of "
+            f"length {seq_len}; need at least 2 (shrink --seq_len?)"
+        )
+    if vocab_size < 256:
+        hi = int(data.max())
+        if hi >= vocab_size:
+            raise ValueError(
+                f"{path} contains byte {hi} ≥ --vocab_size {vocab_size}; "
+                "use --vocab_size 256 for arbitrary files"
+            )
+    tokens = (
+        data[: n_seq * seq_len].reshape(n_seq, seq_len).astype(np.int32)
+    )
+    n_test = max(1, int(n_seq * test_fraction))
+    n_train = n_seq - n_test
+    if n_train < 1:
+        raise ValueError(f"{path}: corpus too small to split ({n_seq} seqs)")
+    mk = lambda t: Split(t, np.zeros(len(t), np.int32))
+    return mk(tokens[:n_train]), mk(tokens[n_train:])

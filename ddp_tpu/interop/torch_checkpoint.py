@@ -148,12 +148,22 @@ def import_torch_checkpoint(path: str) -> tuple[dict, int]:
     return params_from_torch_state_dict(ckpt["model"]), int(ckpt.get("epoch", 0))
 
 
-def export_torch_checkpoint(path: str, params: Mapping[str, Any], epoch: int) -> None:
+def export_torch_checkpoint(
+    path: str,
+    params: Mapping[str, Any],
+    epoch: int,
+    *,
+    lr: float = 0.01,
+    momentum: float = 0,
+) -> None:
     """Write ``{epoch, model, optimizer}`` the reference can consume.
 
     The optimizer entry mirrors the reference's momentum-less SGD save:
     empty ``state``, one param group listing the six tensors — enough
     for its (never actually restored — train_ddp.py:88) optimizer slot.
+    ``lr``/``momentum`` default to the reference's hard-coded recipe
+    (train_ddp.py:41); pass the run's actual values so the artifact
+    doesn't misstate the training config to other consumers.
     """
     import torch
 
@@ -165,7 +175,11 @@ def export_torch_checkpoint(path: str, params: Mapping[str, Any], epoch: int) ->
             "optimizer": {
                 "state": {},
                 "param_groups": [
-                    {"lr": 0.01, "momentum": 0, "params": list(range(len(state_dict)))}
+                    {
+                        "lr": lr,
+                        "momentum": momentum,
+                        "params": list(range(len(state_dict))),
+                    }
                 ],
             },
         },

@@ -17,8 +17,7 @@ weight-tying trick; halves the embedding parameters).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -28,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddp_tpu.models.vit import EncoderBlock
-from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.ops.attention import best_attention
 from ddp_tpu.parallel.ddp import StepMetrics
 from ddp_tpu.parallel.ring import sequence_sharded_attention
 
@@ -42,7 +41,9 @@ class CausalLM(nn.Module):
     depth: int = 2
     num_heads: int = 4
     mlp_ratio: int = 4
-    attention_fn: Callable = partial(dot_product_attention, causal=True)
+    # None → ops.attention.best_attention(causal=True): Pallas flash
+    # kernel on TPU, dense XLA elsewhere.
+    attention_fn: Optional[Callable] = None
     remat: bool = False
 
     @nn.compact
@@ -62,11 +63,12 @@ class CausalLM(nn.Module):
             pos.astype(x.dtype), pos_offset, x.shape[1], axis=1
         )
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        attn_fn = self.attention_fn or best_attention(causal=True)
         for i in range(self.depth):
             x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.d_model * self.mlp_ratio,
-                attention_fn=self.attention_fn,
+                attention_fn=attn_fn,
                 name=f"block{i + 1}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
@@ -125,11 +127,13 @@ def dense_lm_apply(spec: LMSpec, params, tokens):
     return _dense_lm(spec).apply({"params": params}, tokens)
 
 
-def next_token_loss(logits, tokens):
+def next_token_loss(logits, tokens, *, label_smoothing: float = 0.0):
     """Mean causal-LM loss: position t predicts token t+1.
 
     ``logits``/``tokens`` are GLOBAL ([B, T, V] / [B, T]); the final
-    position has no target and is masked out.
+    position has no target and is masked out. ``label_smoothing=ε``
+    trains against (1−ε)·one-hot + ε·uniform, computed directly from
+    log-probs (no [B, T, V] one-hot materialized).
     """
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
@@ -141,9 +145,18 @@ def next_token_loss(logits, tokens):
         ],
         axis=1,
     )
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), targets
-    )
+    logits32 = logits.astype(jnp.float32)
+    if label_smoothing:
+        eps = label_smoothing
+        logp = jax.nn.log_softmax(logits32, axis=-1)
+        nll_target = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        per_tok = (1.0 - eps) * nll_target - (
+            eps / logits.shape[-1]
+        ) * logp.sum(-1)
+    else:
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits32, targets
+        )
     return (per_tok * weights).sum() / weights.sum()
 
 
@@ -163,32 +176,46 @@ def create_lm_train_state(
     *,
     seed: int = 0,
 ) -> LMTrainState:
-    return replicated_train_state(init_lm(spec, seed=seed), optimizer, mesh)
+    """Replicated state, or fsdp-sharded at rest when the mesh has an
+    ``fsdp`` axis > 1 (parallel/seq_fsdp.py — moments shard with the
+    params, so optimizer memory drops by the axis size too)."""
+    from ddp_tpu.models.seq_transformer import sharded_or_replicated_state
+
+    return sharded_or_replicated_state(
+        init_lm(spec, seed=seed), optimizer, mesh
+    )
 
 
 def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
+    from ddp_tpu.models.seq_transformer import _batch_axes
+    from ddp_tpu.parallel.seq_fsdp import fsdp_specs, gather_fsdp
+
     model = _sharded_lm(spec)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P(None)
-    xspec = P(bspec[0], "seq")
+    baxes = _batch_axes(mesh)
+    xspec = P(baxes, "seq")
 
-    def per_shard_forward(params, tok_shard):
-        t_local = tok_shard.shape[1]
-        offset = lax.axis_index("seq") * t_local
-        if compute_dtype != jnp.float32:
-            params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
-        return model.apply({"params": params}, tok_shard, pos_offset=offset)
+    def forward(params, tokens):
+        pspecs = fsdp_specs(params, mesh)
 
-    return (
-        jax.shard_map(
+        def per_shard_forward(params, tok_shard):
+            params = gather_fsdp(params, pspecs)
+            t_local = tok_shard.shape[1]
+            offset = lax.axis_index("seq") * t_local
+            if compute_dtype != jnp.float32:
+                params = jax.tree.map(
+                    lambda p: p.astype(compute_dtype), params
+                )
+            return model.apply({"params": params}, tok_shard, pos_offset=offset)
+
+        return jax.shard_map(
             per_shard_forward,
             mesh=mesh,
-            in_specs=(P(), xspec),
+            in_specs=(pspecs, xspec),
             out_specs=xspec,
             check_vma=False,
-        ),
-        xspec,
-    )
+        )(params, tokens)
+
+    return forward, xspec
 
 
 def make_lm_eval_step(
@@ -227,35 +254,73 @@ def make_lm_train_step(
     *,
     donate: bool = True,
     compute_dtype=jnp.float32,
+    grad_accum_steps: int = 1,
+    label_smoothing: float = 0.0,
 ):
-    """dp×sp causal-LM step: ``step(state, tokens) -> (state, metrics)``.
+    """dp×sp[×fsdp] causal-LM step: ``step(state, tokens)``.
 
     ``tokens``: [B, T_global] int32. The label shift and loss masking
     happen on GLOBAL arrays before/after the sharded forward, so shard
-    boundaries need no special cases; gradients for the replicated
-    params arrive psum'd by the shard_map transpose. Metrics: loss is
-    the mean next-token cross-entropy, accuracy the next-token top-1.
+    boundaries need no special cases; gradients arrive psum'd (and,
+    for fsdp-sharded params, scatter-reduced — parallel/seq_fsdp.py)
+    by the shard_map transpose. ``grad_accum_steps=k`` splits the
+    batch into k STRIDED microbatches (rows i::k — contiguous splits
+    would reshard the data-axis layout every step, parallel/spmd.py)
+    and accumulates gradients through one ``lax.scan``. Metrics: loss
+    is the mean next-token cross-entropy, accuracy the next-token
+    top-1.
     """
     sharded_forward, xspec = _make_sharded_forward(spec, mesh, compute_dtype)
+
+    def loss_and_logits(params, tokens):
+        logits = sharded_forward(params, tokens)
+        loss = next_token_loss(
+            logits, tokens, label_smoothing=label_smoothing
+        )
+        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
+        correct = (pred == tokens[:, 1:]).sum().astype(jnp.float32)
+        return loss, correct
 
     def step(state: LMTrainState, tokens):
         tokens = lax.with_sharding_constraint(
             tokens, NamedSharding(mesh, xspec)
         )
+        if grad_accum_steps == 1:
+            (loss, correct), grads = jax.value_and_grad(
+                loss_and_logits, has_aux=True
+            )(state.params, tokens)
+        else:
+            from ddp_tpu.parallel.common import check_accum_divisible
 
-        def loss_fn(params):
-            logits = sharded_forward(params, tokens)
-            return next_token_loss(logits, tokens), logits
+            mb = check_accum_divisible(tokens.shape[0], grad_accum_steps)
+            micro_toks = lax.with_sharding_constraint(
+                tokens.reshape(mb, grad_accum_steps, tokens.shape[1])
+                .swapaxes(0, 1),
+                NamedSharding(mesh, P(None, *xspec)),
+            )
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+            def micro(carry, toks):
+                g_acc, loss_acc, correct_acc = carry
+                (loss, correct), g = jax.value_and_grad(
+                    loss_and_logits, has_aux=True
+                )(state.params, toks)
+                return (
+                    jax.tree.map(jnp.add, g_acc, g),
+                    loss_acc + loss,
+                    correct_acc + correct,
+                ), None
+
+            zero_g = jax.tree.map(jnp.zeros_like, state.params)
+            (g_sum, loss_sum, correct), _ = lax.scan(
+                micro, (zero_g, jnp.float32(0.0), jnp.float32(0.0)), micro_toks
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
+            loss = loss_sum / grad_accum_steps
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         params = optax.apply_updates(state.params, updates)
-        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
-        accuracy = (pred == tokens[:, 1:]).mean()
+        accuracy = correct / (tokens.shape[0] * (tokens.shape[1] - 1))
         return (
             state._replace(
                 step=state.step + 1, params=params, opt_state=opt_state

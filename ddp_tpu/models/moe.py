@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from ddp_tpu.models.vit import AttentionFn, EncoderBlock, MultiHeadAttention
-from ddp_tpu.ops.attention import dot_product_attention
 
 
 class MoEMLP(nn.Module):
@@ -127,7 +126,7 @@ class MoEEncoderBlock(nn.Module):
     top_k: int = 2
     capacity_factor: float = 2.0
     dropout_rate: float = 0.0
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     deterministic: bool = True  # attribute, not call kwarg — remat-safe
 
     @nn.compact
@@ -169,7 +168,7 @@ class MoEViT(nn.Module):
     capacity_factor: float = 2.0
     moe_every: int = 2
     dropout_rate: float = 0.0
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     remat: bool = False  # jax.checkpoint each block (see models/vit.py)
 
     @nn.compact
@@ -232,6 +231,6 @@ def MoEViTTiny(
         depth=depth,
         num_heads=3,
         num_experts=num_experts,
-        attention_fn=attention_fn or dot_product_attention,
+        attention_fn=attention_fn,
         **kwargs,
     )

@@ -15,7 +15,7 @@ transpose, derived by AD).
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -25,7 +25,6 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddp_tpu.models.vit import AttentionFn, EncoderBlock
-from ddp_tpu.ops.attention import dot_product_attention
 from ddp_tpu.parallel.ddp import StepMetrics
 from ddp_tpu.parallel.common import _preprocess
 from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
@@ -40,7 +39,7 @@ class PipeViTConfig(NamedTuple):
     num_stages: int = 4
     depth_per_stage: int = 1
     num_microbatches: int = 4
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     remat: bool = False  # jax.checkpoint each stage's blocks
 
 
@@ -74,7 +73,7 @@ class StageBlocks(nn.Module):
     depth: int
     num_heads: int
     mlp_dim: int
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     remat: bool = False  # jax.checkpoint each block (see models/vit.py)
 
     @nn.compact

@@ -21,7 +21,7 @@ replicated-in broadcast into a psum over the mesh.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional
 
 import flax.linen as nn
 import jax
@@ -31,7 +31,6 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddp_tpu.models.vit import EncoderBlock
-from ddp_tpu.ops.attention import dot_product_attention
 from ddp_tpu.parallel.ddp import StepMetrics
 from ddp_tpu.parallel.ring import sequence_sharded_attention
 
@@ -51,7 +50,8 @@ class LongContextTransformer(nn.Module):
     depth: int = 2
     num_heads: int = 4
     mlp_ratio: int = 4
-    attention_fn: Callable = dot_product_attention
+    # None → best_attention(): flash on TPU, dense XLA elsewhere.
+    attention_fn: Optional[Callable] = None
     pool_fn: Callable = lambda x: x.mean(axis=1)
     # jax.checkpoint each block — the natural pairing with sequence
     # parallelism: long contexts are exactly where activations dominate
@@ -151,41 +151,57 @@ def dense_apply(spec: SeqTransformerSpec, params, x):
     return _dense_model(spec).apply({"params": params}, x)
 
 
+def _batch_axes(mesh: Mesh):
+    """Mesh axes the batch shards over (fsdp is a data axis)."""
+    axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    return axes if axes else None
+
+
 def make_seq_parallel_apply(
     spec: SeqTransformerSpec, mesh: Mesh, *, compute_dtype=jnp.float32
 ):
     """Jitted ``apply(params, x) -> logits`` with tokens on ``seq``.
 
-    ``x``: [B, T_global, d_in] global array — batch shards over
-    ``data``, tokens over ``seq``; logits come back sharded over
-    ``data`` only (identical on every seq member).
+    ``x``: [B, T_global, d_in] global array — batch shards over the
+    data axes (``data`` and, when present, ``fsdp``), tokens over
+    ``seq``; logits come back sharded over the data axes only
+    (identical on every seq member). Params may rest fsdp-sharded
+    (parallel/seq_fsdp.py) — they are all-gathered inside the shard
+    and their gradients psum_scatter back automatically.
     ``compute_dtype=jnp.bfloat16`` runs the blocks (and the ring
     collectives' payloads) in bf16 — LayerNorms and the head stay fp32
     by module dtype; master params remain fp32 outside.
     """
+    from ddp_tpu.parallel.seq_fsdp import fsdp_specs, gather_fsdp
+
     model = _sharded_model(spec)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P(None)
-    xspec = P(bspec[0], "seq")
+    baxes = _batch_axes(mesh)
+    bspec = P(baxes)
+    xspec = P(baxes, "seq")
 
-    def per_shard(params, x_shard):
-        t_local = x_shard.shape[1]
-        offset = lax.axis_index("seq") * t_local
-        if compute_dtype != jnp.float32:
-            params = jax.tree.map(
-                lambda p: p.astype(compute_dtype), params
-            )
-            x_shard = x_shard.astype(compute_dtype)
-        return model.apply({"params": params}, x_shard, pos_offset=offset)
+    def apply_fn(params, x):
+        pspecs = fsdp_specs(params, mesh)
 
-    sharded = jax.shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=(P(), xspec),
-        out_specs=bspec,
-        check_vma=False,
-    )
-    return jax.jit(sharded)
+        def per_shard(params, x_shard):
+            params = gather_fsdp(params, pspecs)
+            t_local = x_shard.shape[1]
+            offset = lax.axis_index("seq") * t_local
+            if compute_dtype != jnp.float32:
+                params = jax.tree.map(
+                    lambda p: p.astype(compute_dtype), params
+                )
+                x_shard = x_shard.astype(compute_dtype)
+            return model.apply({"params": params}, x_shard, pos_offset=offset)
+
+        return jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(pspecs, xspec),
+            out_specs=bspec,
+            check_vma=False,
+        )(params, x)
+
+    return jax.jit(apply_fn)
 
 
 class SeqTrainState(NamedTuple):
@@ -212,6 +228,36 @@ def replicated_train_state(
     )
 
 
+def sharded_or_replicated_state(
+    params, optimizer: optax.GradientTransformation, mesh: Mesh
+) -> SeqTrainState:
+    """FSDP-sharded state when the mesh has ``fsdp`` > 1, else
+    replicated. Sharded path: params rest dim-0 sharded over ``fsdp``
+    (parallel/seq_fsdp.py) and ``optimizer.init`` on them makes the
+    moments inherit the same placement (``zeros_like`` preserves
+    shardings), so Adam memory shards too; unshardable leaves and
+    scalars replicate.
+    """
+    from ddp_tpu.parallel.seq_fsdp import fsdp_size, shard_fsdp_params
+
+    if fsdp_size(mesh) <= 1:
+        return replicated_train_state(params, optimizer, mesh)
+    rep = NamedSharding(mesh, P())
+    params = shard_fsdp_params(params, mesh)
+    opt_state = optimizer.init(params)
+    # Scalars (Adam's count, schedule steps) came out uncommitted —
+    # pin them replicated so the state's shardings are deterministic.
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
+        opt_state,
+    )
+    return SeqTrainState(
+        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        params=params,
+        opt_state=opt_state,
+    )
+
+
 def make_seq_parallel_train_step(
     spec: SeqTransformerSpec,
     optimizer: optax.GradientTransformation,
@@ -219,39 +265,83 @@ def make_seq_parallel_train_step(
     *,
     donate: bool = True,
     compute_dtype=jnp.float32,
+    grad_accum_steps: int = 1,
+    label_smoothing: float = 0.0,
 ):
-    """Full dp×sp train step: loss/grad through the collective forward.
+    """Full dp×sp[×fsdp] train step through the collective forward.
 
-    Params replicate; their gradients arrive correctly psum'd over both
-    axes by the shard_map transpose. Batch shards over ``data``, tokens
-    over ``seq``. ``compute_dtype=jnp.bfloat16`` = mixed precision
-    (fp32 master params, bf16 blocks/collectives, fp32 grads out of
-    the cast's transpose).
+    Gradients arrive correctly psum'd over the mesh by the shard_map
+    transpose (scatter-reduced for fsdp-sharded params). Batch shards
+    over the data axes, tokens over ``seq``.
+    ``compute_dtype=jnp.bfloat16`` = mixed precision (fp32 master
+    params, bf16 blocks/collectives, fp32 grads out of the cast's
+    transpose). ``grad_accum_steps=k``: strided microbatches through
+    one ``lax.scan`` (parallel/spmd.py rationale);
+    ``label_smoothing=ε``: optax smoothed cross-entropy.
     """
     apply_fn = make_seq_parallel_apply(spec, mesh, compute_dtype=compute_dtype)
-    has_data = mesh.shape.get("data", 1) > 1
-    lspec = P("data") if has_data else P(None)
+    lspec = P(_batch_axes(mesh))
+
+    def loss_and_correct(params, x, labels):
+        logits = apply_fn(params, x).astype(jnp.float32)
+        if label_smoothing:
+            one_hot = optax.smooth_labels(
+                jax.nn.one_hot(labels, spec.num_classes), label_smoothing
+            )
+            loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+        correct = (jnp.argmax(logits, -1) == labels).sum().astype(jnp.float32)
+        return loss, correct
 
     def step(state: SeqTrainState, x, labels):
         labels = lax.with_sharding_constraint(
             labels, NamedSharding(mesh, lspec)
         )
+        if grad_accum_steps == 1:
+            (loss, correct), grads = jax.value_and_grad(
+                loss_and_correct, has_aux=True
+            )(state.params, x, labels)
+        else:
+            from ddp_tpu.parallel.common import check_accum_divisible
 
-        def loss_fn(params):
-            logits = apply_fn(params, x)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), labels
-            ).mean()
-            return loss, logits
+            mb = check_accum_divisible(x.shape[0], grad_accum_steps)
+            xm = lax.with_sharding_constraint(
+                x.reshape(mb, grad_accum_steps, *x.shape[1:]).swapaxes(0, 1),
+                NamedSharding(mesh, P(None, *P(_batch_axes(mesh), "seq"))),
+            )
+            lm_ = lax.with_sharding_constraint(
+                labels.reshape(mb, grad_accum_steps).swapaxes(0, 1),
+                NamedSharding(mesh, P(None, *lspec)),
+            )
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+            def micro(carry, xy):
+                g_acc, loss_acc, correct_acc = carry
+                xi, yi = xy
+                (loss, correct), g = jax.value_and_grad(
+                    loss_and_correct, has_aux=True
+                )(state.params, xi, yi)
+                return (
+                    jax.tree.map(jnp.add, g_acc, g),
+                    loss_acc + loss,
+                    correct_acc + correct,
+                ), None
+
+            zero_g = jax.tree.map(jnp.zeros_like, state.params)
+            (g_sum, loss_sum, correct), _ = lax.scan(
+                micro,
+                (zero_g, jnp.float32(0.0), jnp.float32(0.0)),
+                (xm, lm_),
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
+            loss = loss_sum / grad_accum_steps
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         params = optax.apply_updates(state.params, updates)
-        correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).mean()
+        accuracy = correct / x.shape[0]
         # _replace keeps the caller's state type: SeqTrainState from
         # this module's API, or the trainer's TrainState (which adds a
         # model_state field this model never uses).
@@ -260,7 +350,7 @@ def make_seq_parallel_train_step(
                 step=state.step + 1, params=params, opt_state=opt_state
             ),
             StepMetrics(
-                loss=loss, accuracy=correct,
+                loss=loss, accuracy=accuracy,
                 grad_norm=optax.global_norm(grads),
             ),
         )
@@ -298,6 +388,6 @@ def create_seq_train_state(
     *,
     seed: int = 0,
 ) -> SeqTrainState:
-    return replicated_train_state(
+    return sharded_or_replicated_state(
         init_seq_transformer(spec, seed=seed), optimizer, mesh
     )

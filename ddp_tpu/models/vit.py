@@ -22,16 +22,22 @@ from typing import Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.ops.attention import best_attention
 
 AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
 
 
 class MultiHeadAttention(nn.Module):
-    """QKV projection + pluggable attention kernel + output projection."""
+    """QKV projection + pluggable attention kernel + output projection.
+
+    ``attention_fn=None`` (the default everywhere in the model zoo)
+    resolves to ``ops.attention.best_attention()`` at call time: the
+    Pallas flash kernel on TPU, dense XLA elsewhere. Passing a callable
+    overrides it (ring/Ulysses collectives, causal variants, tests).
+    """
 
     num_heads: int
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -41,7 +47,8 @@ class MultiHeadAttention(nn.Module):
         qkv = nn.Dense(3 * C, name="qkv")(x)
         qkv = qkv.reshape(B, T, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = self.attention_fn(q, k, v)  # [B, T, H, D]
+        fn = self.attention_fn or best_attention()
+        out = fn(q, k, v)  # [B, T, H, D]
         out = out.reshape(B, T, C)
         return nn.Dense(C, name="proj")(out)
 
@@ -54,7 +61,7 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout_rate: float = 0.0
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     deterministic: bool = True
 
     @nn.compact
@@ -83,7 +90,7 @@ class ViT(nn.Module):
     num_heads: int = 3
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
-    attention_fn: AttentionFn = dot_product_attention
+    attention_fn: Optional[AttentionFn] = None
     use_cls_token: bool = True
     # Rematerialize each encoder block in the backward pass
     # (jax.checkpoint): activations are recomputed instead of stored,
@@ -143,6 +150,6 @@ def ViTTiny(
         embed_dim=192,
         depth=depth,
         num_heads=3,
-        attention_fn=attention_fn or dot_product_attention,
+        attention_fn=attention_fn,
         **kwargs,
     )

@@ -12,6 +12,8 @@ this exists for the ViT extension config and the long-context path.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -20,6 +22,27 @@ from jax import lax
 # Large-negative mask value: -inf would produce NaN through the
 # online-softmax correction terms when a whole block is masked.
 MASK_VALUE = -0.5 * jnp.finfo(jnp.float32).max
+
+
+def best_attention(*, causal: bool = False, block_q: int = 512,
+                   block_k: int = 512):
+    """Platform-resolved default attention: flash kernel on TPU.
+
+    On TPU this returns the compiled Pallas flash kernel (fused
+    forward + backward, O(T) memory — ops/flash.py); elsewhere the
+    dense XLA path, which is faster than interpreting the kernel on
+    CPU dev boxes. The model factories (vit/lm/seq/moe) call this when
+    no explicit ``attention_fn`` is given, so models are flash-by-
+    default on the hardware that has the kernel. Resolution happens at
+    model-construction time (the platform is fixed per process).
+    """
+    from ddp_tpu.ops.flash import make_flash_attention
+
+    if jax.devices()[0].platform == "tpu":
+        return make_flash_attention(
+            causal=causal, block_q=block_q, block_k=block_k, interpret=False
+        )
+    return partial(dot_product_attention, causal=causal)
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False):

@@ -61,9 +61,11 @@ class StepMetrics(NamedTuple):
     loss: jax.Array
     accuracy: jax.Array
     # Global L2 norm of the (already all-reduced) gradient — the
-    # standard divergence/clipping dashboard signal. Defaults keep the
-    # two-field constructors (pipeline/seq steps) valid.
-    grad_norm: jax.Array | float = 0.0
+    # standard divergence/clipping dashboard signal. ``None`` (the
+    # default, kept by step builders that don't compute it) makes the
+    # metrics stream omit the field — a missing norm must not read as
+    # a vanished (0.0) gradient.
+    grad_norm: jax.Array | float | None = None
 
 
 def create_train_state(

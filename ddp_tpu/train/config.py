@@ -75,6 +75,9 @@ class TrainConfig:
     seq_dim: int = 16  # input feature channels per token
     seq_strategy: str = "ring"  # ring | ulysses
     vocab_size: int = 256  # causal_lm token vocabulary
+    # Real LM data: a file read as raw bytes (--dataset text),
+    # chunked into seq_len sequences (data/text.py). No tokenizer dep.
+    text_file: str | None = None
     zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
@@ -174,6 +177,10 @@ class TrainConfig:
             choices=("ring", "ulysses"),
         )
         p.add_argument("--vocab_size", type=int, default=cls.vocab_size)
+        p.add_argument(
+            "--text_file", default=cls.text_file,
+            help="byte-level corpus for --dataset text (causal_lm)",
+        )
         p.add_argument("--zero1", action="store_true")
         p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)

@@ -42,7 +42,26 @@ def main() -> None:
     p.add_argument(
         "--compute_dtype", default="float32", choices=("float32", "bfloat16")
     )
+    # Causal-LM generation (--model causal_lm): KV-cache decode.
+    p.add_argument("--prompt", default=None, help="text prompt (byte tokens)")
+    p.add_argument(
+        "--prompt_tokens", default=None, help="comma-separated token ids"
+    )
+    p.add_argument("--max_new_tokens", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--gen_seed", type=int, default=0)
+    # Architecture is derived from the checkpoint's param shapes; only
+    # the head count (invisible in shapes) is a flag.
+    p.add_argument("--num_heads", type=int, default=4)
     args = p.parse_args()
+    if args.model == "causal_lm":
+        if (args.prompt is None) == (args.prompt_tokens is None):
+            p.error(
+                "--model causal_lm needs exactly one of "
+                "--prompt / --prompt_tokens"
+            )
+        _generate_lm(args)
+        return
     if (args.dataset is None) == (args.images is None):
         p.error("exactly one of --dataset / --images is required")
 
@@ -127,6 +146,86 @@ def main() -> None:
                 }
             )
         )
+
+
+def _generate_lm(args) -> None:
+    """Restore a causal-LM checkpoint and decode from it (KV cache).
+
+    vocab_size, total_len, d_model and depth are DERIVED from the
+    restored parameter shapes (embed [V, d], pos_embed [1, L, d],
+    blockN count) — trusting CLI flags here would silently clamp
+    positions past the real table (JAX OOB-slice semantics) and emit
+    garbage. Only --num_heads (not recoverable from shapes) comes from
+    the flag, validated against d_model. With a byte vocabulary
+    (≥256), --prompt text is encoded as raw bytes and the continuation
+    decoded back to text.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddp_tpu.models.generate import generate
+    from ddp_tpu.models.lm import LMSpec
+    from ddp_tpu.train.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(args.checkpoint_dir)
+    params, _, epoch = mgr.restore_for_inference(args.epoch)
+    mgr.close()
+    try:
+        vocab_size, d_model = params["embed"].shape
+        total_len = params["pos_embed"].shape[1]
+        depth = sum(1 for k in params if str(k).startswith("block"))
+    except (KeyError, AttributeError) as e:
+        raise SystemExit(
+            f"checkpoint in {args.checkpoint_dir} is not a causal_lm "
+            f"checkpoint (missing {e})"
+        )
+    num_heads = args.num_heads
+    if d_model % num_heads:
+        raise SystemExit(
+            f"--num_heads {num_heads} does not divide the checkpoint's "
+            f"d_model {d_model}"
+        )
+    spec = LMSpec(
+        vocab_size=int(vocab_size),
+        total_len=int(total_len),
+        d_model=int(d_model),
+        depth=int(depth),
+        num_heads=num_heads,
+    )
+
+    if args.prompt_tokens is not None:
+        toks = [int(t) for t in args.prompt_tokens.split(",") if t.strip()]
+    else:
+        toks = list(args.prompt.encode("utf-8"))
+        bad = [t for t in toks if t >= spec.vocab_size]
+        if bad:
+            raise SystemExit(
+                f"--prompt bytes {sorted(set(bad))} exceed vocab_size "
+                f"{spec.vocab_size}; use --prompt_tokens"
+            )
+    prompt = jnp.asarray([toks], jnp.int32)
+    out = np.asarray(
+        generate(
+            spec,
+            params,
+            prompt,
+            max_new_tokens=args.max_new_tokens,
+            temperature=args.temperature,
+            seed=args.gen_seed,
+        )
+    )[0]
+    new = out[len(toks):]
+    record = {
+        "epoch": epoch,
+        "prompt_tokens": toks,
+        "tokens": new.tolist(),
+        "temperature": args.temperature,
+    }
+    if spec.vocab_size >= 256 and max(new.tolist(), default=0) < 256:
+        record["text"] = bytes(int(t) for t in new).decode(
+            "utf-8", errors="replace"
+        )
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

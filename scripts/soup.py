@@ -73,6 +73,23 @@ def main() -> None:
         mean = sum(np.asarray(l, np.float64) for l in ls) / len(ls)
         return jnp.asarray(mean, dtype=ls[0].dtype)
 
+    # Every ingredient must share a tree structure before averaging —
+    # mixing a legacy checkpoint (empty model_state) with a newer one
+    # would otherwise surface as an opaque tree-map error.
+    for label, trees in (
+        ("params", [p_ for p_, _, _ in loaded]),
+        ("model_state", [ms for _, ms, _ in loaded]),
+    ):
+        structs = [jax.tree_util.tree_structure(t) for t in trees]
+        bad = [e for e, st in zip(tags, structs) if st != structs[0]]
+        if bad:
+            mgr.close()
+            raise SystemExit(
+                f"{label} tree structure differs between epoch {tags[0]} "
+                f"and epoch(s) {bad} — these checkpoints cannot be souped "
+                f"together (legacy vs current format?)"
+            )
+
     params = jax.tree.map(avg_leaf, *[p_ for p_, _, _ in loaded])
     model_state = jax.tree.map(avg_leaf, *[ms for _, ms, _ in loaded])
 

@@ -1,0 +1,34 @@
+"""Trainer.evaluate guards its process-divisibility assumption.
+
+VERDICT.md round-1 weak #7: ``local = bs // procs`` silently evaluated
+a truncated split when batch_size × data_shards wasn't divisible by the
+process count. It must error like the loader does (data/loader.py).
+"""
+
+import pytest
+
+from ddp_tpu.train.config import TrainConfig
+from ddp_tpu.train.trainer import Trainer
+
+
+def test_evaluate_rejects_indivisible_process_count(tmp_path, monkeypatch):
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=8,
+        model="simple_cnn",
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        eval_every=0,
+    )
+    t = Trainer(cfg)
+    try:
+        # 8 × data_shards is divisible by the real process count (1);
+        # fake a 3-process world to hit the guard.
+        monkeypatch.setattr("jax.process_count", lambda: 3)
+        with pytest.raises(ValueError, match="not divisible"):
+            t.evaluate()
+    finally:
+        monkeypatch.undo()
+        t.close()

@@ -1,0 +1,167 @@
+"""KV-cache decode == dense full-sequence forward; sampling contracts.
+
+VERDICT.md round-1 "do this" #5: cached decode must match full
+recompute logits to tolerance, and predict.py must generate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_tpu.models.generate import (
+    cached_logits,
+    generate,
+    init_cache,
+    prefill,
+)
+from ddp_tpu.models.lm import LMSpec, dense_lm_apply, init_lm
+
+SPEC = LMSpec(vocab_size=37, total_len=24, d_model=32, depth=2, num_heads=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_lm(SPEC, seed=0)
+
+
+def test_cached_logits_match_dense(params):
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, SPEC.vocab_size, size=(2, SPEC.total_len)), jnp.int32
+    )
+    dense = dense_lm_apply(SPEC, params, tokens)
+    cached = cached_logits(SPEC, params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(cached), np.asarray(dense), atol=1e-4
+    )
+
+
+def test_prefill_matches_dense_last_position(params):
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, SPEC.vocab_size, size=(3, 7)), jnp.int32)
+    last, cache = prefill(SPEC, params, prompt)
+    dense = dense_lm_apply(SPEC, params, prompt)
+    np.testing.assert_allclose(
+        np.asarray(last), np.asarray(dense[:, -1]), atol=1e-4
+    )
+    assert int(cache.pos) == 7
+
+
+def test_greedy_generation_is_deterministic_and_in_range(params):
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out1 = generate(SPEC, params, prompt, max_new_tokens=8)
+    out2 = generate(SPEC, params, prompt, max_new_tokens=8)
+    assert out1.shape == (1, 11)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert np.asarray(out1).min() >= 0
+    assert np.asarray(out1).max() < SPEC.vocab_size
+
+
+def test_greedy_matches_stepwise_dense_argmax(params):
+    """Greedy decode == argmax over the dense forward, token by token."""
+    prompt = jnp.asarray([[5, 11]], jnp.int32)
+    out = np.asarray(generate(SPEC, params, prompt, max_new_tokens=5))
+    toks = np.asarray(prompt)
+    for _ in range(5):
+        logits = dense_lm_apply(SPEC, params, jnp.asarray(toks))
+        nxt = int(np.argmax(np.asarray(logits[0, -1])))
+        toks = np.concatenate([toks, [[nxt]]], axis=1)
+    np.testing.assert_array_equal(out, toks)
+
+
+def test_temperature_sampling_seeded(params):
+    prompt = jnp.asarray([[0]], jnp.int32)
+    a = generate(
+        SPEC, params, prompt, max_new_tokens=6, temperature=1.0, seed=1
+    )
+    b = generate(
+        SPEC, params, prompt, max_new_tokens=6, temperature=1.0, seed=1
+    )
+    c = generate(
+        SPEC, params, prompt, max_new_tokens=6, temperature=1.0, seed=2
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_generate_rejects_overlong(params):
+    prompt = jnp.zeros((1, 20), jnp.int32)
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(SPEC, params, prompt, max_new_tokens=10)
+
+
+def test_cache_shapes():
+    cache = init_cache(SPEC, batch=3)
+    assert cache.k.shape == (2, 3, 24, 4, 8)
+    assert int(cache.pos) == 0
+
+
+def test_generate_is_jittable(params):
+    """The decode loop compiles as one function (scan, static shapes)."""
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    f = jax.jit(
+        lambda p, t: generate(SPEC, p, t, max_new_tokens=4)
+    )
+    out = f(params, prompt)
+    ref = generate(SPEC, params, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_predict_cli_generates_from_trained_checkpoint(tmp_path):
+    """Train a tiny causal LM via the Trainer, then decode with the
+    predict.py CLI (the VERDICT #5 'predict.py generates' contract)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=8,
+        model="causal_lm",
+        vocab_size=32,
+        seq_len=16,
+        model_depth=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        log_interval=4,
+        eval_every=0,
+        optimizer="adam",
+        lr=1e-3,
+    )
+    t = Trainer(cfg)
+    t.train()
+    t.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+    )
+    res = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "scripts", "predict.py"),
+            "--model", "causal_lm",
+            "--checkpoint_dir", cfg.checkpoint_dir,
+            # no architecture flags: derived from the checkpoint shapes
+            "--prompt_tokens", "1,2,3",
+            "--max_new_tokens", "5",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    record = json.loads(res.stdout.strip().splitlines()[-1])
+    assert record["prompt_tokens"] == [1, 2, 3]
+    assert len(record["tokens"]) == 5
+    assert all(0 <= t_ < 32 for t_ in record["tokens"])

@@ -1,0 +1,198 @@
+"""The sequence family composes: fsdp sharding, grad accumulation,
+label smoothing, real text data (VERDICT.md round-1 "do this" #3).
+
+All on the 8-device emulated CPU mesh (conftest.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ddp_tpu.models.lm import (
+    LMSpec,
+    create_lm_train_state,
+    make_lm_train_step,
+    next_token_loss,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+SPEC = LMSpec(vocab_size=32, total_len=16, d_model=32, depth=2, num_heads=4)
+
+
+@pytest.fixture(scope="module")
+def devices():
+    ds = jax.devices()
+    if len(ds) < 8:
+        pytest.skip("needs 8 emulated devices")
+    return ds[:8]
+
+
+def _tokens(batch, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, SPEC.vocab_size, size=(batch, SPEC.total_len)),
+        jnp.int32,
+    )
+
+
+def _gathered_params(state):
+    return jax.tree.map(lambda x: np.asarray(x), state.params)
+
+
+def test_fsdp_seq_step_matches_replicated(devices):
+    """One dp×sp×fsdp step == one dp×sp step with replicated params."""
+    tx = optax.adam(1e-3)
+    toks = _tokens(8)
+
+    mesh_rep = make_mesh(MeshSpec(data=4, seq=2), devices=devices)
+    st_rep = create_lm_train_state(SPEC, tx, mesh_rep, seed=0)
+    step_rep = make_lm_train_step(SPEC, tx, mesh_rep, donate=False)
+    st_rep, m_rep = step_rep(st_rep, toks)
+
+    mesh_fsdp = make_mesh(MeshSpec(data=2, fsdp=2, seq=2), devices=devices)
+    st_f = create_lm_train_state(SPEC, tx, mesh_fsdp, seed=0)
+    step_f = make_lm_train_step(SPEC, tx, mesh_fsdp, donate=False)
+    st_f, m_f = step_f(st_f, toks)
+
+    np.testing.assert_allclose(
+        float(m_f.loss), float(m_rep.loss), atol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        st_f.params,
+        st_rep.params,
+    )
+
+
+def test_fsdp_actually_shards_params_and_moments(devices):
+    """At rest, dim-0-divisible params (and their Adam moments) shard
+    over fsdp — per-device bytes drop by the axis size."""
+    tx = optax.adam(1e-3)
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, seq=2), devices=devices)
+    st = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    embed = st.params["embed"]  # [32, 32] — divisible by fsdp=2
+    spec = embed.sharding.spec
+    assert spec == P("fsdp"), spec
+    assert (
+        embed.addressable_shards[0].data.shape[0] == embed.shape[0] // 2
+    )
+    # pos_embed [1, L, d] can't shard dim 0 — stays replicated.
+    assert st.params["pos_embed"].sharding.spec in (P(), P(None, None, None))
+    # Adam's mu inherits the layout.
+    flat, _ = jax.tree_util.tree_flatten(st.opt_state)
+    sharded = [
+        x for x in flat
+        if hasattr(x, "sharding") and x.ndim >= 1
+        and x.sharding.spec == P("fsdp")
+    ]
+    assert sharded, "no optimizer moment came out fsdp-sharded"
+
+
+def test_grad_accum_matches_single_step(devices):
+    """k=2 accumulation == one full-batch step (loss is a mean)."""
+    tx = optax.sgd(0.1)
+    toks = _tokens(8, seed=3)
+    mesh = make_mesh(MeshSpec(data=2, seq=2), devices=devices[:4])
+
+    st1 = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step1 = make_lm_train_step(SPEC, tx, mesh, donate=False)
+    st1, m1 = step1(st1, toks)
+
+    st2 = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step2 = make_lm_train_step(
+        SPEC, tx, mesh, donate=False, grad_accum_steps=2
+    )
+    st2, m2 = step2(st2, toks)
+
+    np.testing.assert_allclose(float(m1.loss), float(m2.loss), atol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        st1.params,
+        st2.params,
+    )
+
+
+def test_label_smoothing_formula():
+    """next_token_loss(ε) == cross-entropy against smoothed one-hots."""
+    rng = np.random.default_rng(5)
+    B, T, V = 2, 6, 11
+    logits = jnp.asarray(rng.normal(size=(B, T, V)), jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, V, size=(B, T)), jnp.int32)
+    eps = 0.1
+    got = float(next_token_loss(logits, tokens, label_smoothing=eps))
+
+    targets = np.asarray(tokens)[:, 1:]
+    one_hot = jax.nn.one_hot(targets, V)
+    smoothed = optax.smooth_labels(one_hot, eps)
+    ref = float(
+        optax.softmax_cross_entropy(logits[:, :-1], smoothed).mean()
+    )
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_text_corpus_loader(tmp_path):
+    from ddp_tpu.data.text import load_text_corpus
+
+    path = tmp_path / "corpus.txt"
+    path.write_bytes(bytes(range(256)) * 10)  # 2560 bytes
+    train, test = load_text_corpus(str(path), seq_len=64)
+    assert train.images.shape[1] == 64
+    assert train.images.dtype == np.int32
+    assert len(train.images) + len(test.images) == 2560 // 64
+    assert len(test.images) >= 1
+    # Sequences preserve byte identity.
+    assert train.images.min() >= 0 and train.images.max() <= 255
+
+    with pytest.raises(ValueError, match="vocab_size"):
+        load_text_corpus(str(path), seq_len=64, vocab_size=32)
+    small = tmp_path / "small.txt"
+    small.write_bytes(b"x" * 60)
+    with pytest.raises(ValueError, match="at least 2"):
+        load_text_corpus(str(small), seq_len=64)
+
+
+def test_trainer_composes_fsdp_accum_smoothing_text(tmp_path, devices):
+    """The CLI surface: --model causal_lm --mesh_seq 2 --mesh_fsdp 2
+    --grad_accum_steps 2 --label_smoothing 0.05 --dataset text trains
+    end to end on a real byte corpus."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    corpus = tmp_path / "corpus.txt"
+    # Highly learnable byte patterns: repeated ASCII phrases.
+    corpus.write_bytes(b"the quick brown fox jumps over the lazy dog. " * 200)
+
+    cfg = TrainConfig(
+        epochs=2,
+        batch_size=4,
+        model="causal_lm",
+        dataset="text",
+        text_file=str(corpus),
+        vocab_size=256,
+        seq_len=16,
+        model_depth=1,
+        mesh_seq=2,
+        mesh_fsdp=2,
+        grad_accum_steps=2,
+        label_smoothing=0.05,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        log_interval=4,
+        eval_every=0,
+        optimizer="adam",
+        lr=3e-3,
+    )
+    t = Trainer(cfg)
+    assert dict(t.mesh.shape)["fsdp"] == 2
+    summary = t.train()
+    t.close()
+    hist = summary["history"]
+    assert np.isfinite(hist[-1]["mean_loss"])
+    assert hist[-1]["mean_loss"] < hist[0]["mean_loss"]
