@@ -154,38 +154,62 @@ def sequential_apply(cfg: PipeViTConfig, params: PipeViTParams, images):
 def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
     """Jitted pipelined ``apply(params, images) -> logits``.
 
-    Batch shards over the mesh's ``data`` axis (if present) and
-    microbatches stream over ``pipe``. Differentiable end to end.
+    The WHOLE model rides the pipeline: the patch-embed front runs
+    inside stage 0 (``first_fn``) and the norm+head back inside stage
+    S-1 (``last_fn``) — non-uniform stages with raw-pixel inputs,
+    token activations, and logit outputs all of different shapes
+    (round-1 version ran embed/head outside, data-parallel). The
+    microbatch stream is sharded over ``pipe`` (microbatch m rests on
+    device m mod S; per-device buffers O(M/S) — parallel/pipeline.py).
+    Batch additionally shards over the mesh's ``data`` axis.
+    Differentiable end to end. GPipe bubble: ``bubble_fraction(S, M)``.
     """
     embed, stage, head = _modules(cfg)
     has_data = mesh.shape.get("data", 1) > 1
     bspec = P("data") if has_data else P()
-    mbspec = P(None, "data") if has_data else P()
+    mbspec = (
+        P(None, "pipe", "data") if has_data else P(None, "pipe")
+    )
 
     def stage_fn(p, x):
         return stage.apply({"params": p}, x)
+
+    def first_fn(p, raw):
+        return embed.apply({"params": p}, raw)
+
+    def last_fn(p, x):
+        return head.apply({"params": p}, x)
+
+    S = mesh.shape["pipe"]
 
     def apply_fn(params: PipeViTParams, images):
         images = lax.with_sharding_constraint(
             images, NamedSharding(mesh, bspec)
         )
-        feats = embed.apply({"params": params.embed}, images)
-        B = feats.shape[0]
+        B = images.shape[0]
         M = cfg.num_microbatches
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        mb = feats.reshape(M, B // M, *feats.shape[1:])
+        if M % S:
+            raise ValueError(
+                f"{M} microbatches not divisible by {S} pipeline stages "
+                "(the sharded stream rests microbatch m on device m mod S)"
+            )
+        mb = images.reshape(M // S, S, B // M, *images.shape[1:])
 
         pipelined = jax.shard_map(
-            lambda p, m: spmd_pipeline(stage_fn, p, m, axis_name="pipe"),
+            lambda sp, ep, hp, m: spmd_pipeline(
+                stage_fn, sp, m, axis_name="pipe",
+                first_fn=first_fn, first_params=ep,
+                last_fn=last_fn, last_params=hp,
+            ),
             mesh=mesh,
-            in_specs=(P("pipe"), mbspec),
+            in_specs=(P("pipe"), P(), P(), mbspec),
             out_specs=mbspec,
             check_vma=False,
         )
-        out = pipelined(params.stages, mb)
-        out = out.reshape(B, *out.shape[2:])
-        return head.apply({"params": params.head}, out)
+        out = pipelined(params.stages, params.embed, params.head, mb)
+        return out.reshape(B, *out.shape[3:])
 
     return apply_fn
 
@@ -235,6 +259,118 @@ def make_pipe_vit_train_step(
         return (
             PipeViTState(state.step + 1, params, opt_state),
             StepMetrics(loss=loss, accuracy=correct),
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_pipe_vit_1f1b_train_step(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+):
+    """``step(state, images, labels)`` under the 1F1B schedule.
+
+    Same contract and (to numerics) same result as
+    ``make_pipe_vit_train_step``, but the backward is hand-scheduled
+    (parallel/one_f1b.py): the loss runs inside the last stage, the
+    activation stash is O(S) instead of the AD-GPipe path's O(M), and
+    gradients come straight out of the schedule — no ``jax.grad``
+    around the pipeline. Pinned equal to the GPipe step by
+    tests/test_one_f1b.py / test_pipeline_vit.py.
+    """
+    from ddp_tpu.parallel.one_f1b import schedule_1f1b, spmd_pipeline_1f1b
+
+    embed, stage, head = _modules(cfg)
+    S = mesh.shape["pipe"]
+    M = cfg.num_microbatches
+    if M % S:
+        raise ValueError(f"{M} microbatches not divisible by {S} stages")
+    sched = schedule_1f1b(S, M)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P()
+    mbspec = P(None, "pipe", "data") if has_data else P(None, "pipe")
+    lblspec = P(None, "data") if has_data else P()
+    stage_sharding = NamedSharding(mesh, P("pipe"))
+
+    def stage_fn(p, x):
+        return stage.apply({"params": p}, x)
+
+    def first_fn(p, raw):
+        return embed.apply({"params": p}, raw)
+
+    def last_fn(p, x):
+        return head.apply({"params": p}, x)
+
+    def loss_fn(logits, lbl):
+        logits = logits.astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, lbl
+        ).sum()
+        correct = (jnp.argmax(logits, -1) == lbl).sum().astype(jnp.float32)
+        return loss, correct
+
+    def inner(sp, ep, hp, m, l):
+        loss, aux, gs, gf, gl = spmd_pipeline_1f1b(
+            stage_fn, sp, m, l, loss_fn, sched, axis_name="pipe",
+            first_fn=first_fn, first_params=ep,
+            last_fn=last_fn, last_params=hp,
+        )
+        if has_data:
+            loss = lax.psum(loss, "data")
+            aux = lax.psum(aux, "data")
+            gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
+            gf = jax.tree.map(lambda g: lax.psum(g, "data"), gf)
+            gl = jax.tree.map(lambda g: lax.psum(g, "data"), gl)
+        return loss, aux, gs, gf, gl
+
+    run = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(), P(), mbspec, lblspec),
+        out_specs=(P(), P(), P("pipe"), P(), P()),
+        check_vma=False,
+    )
+
+    def constrain(params: PipeViTParams) -> PipeViTParams:
+        return params._replace(
+            stages=jax.tree.map(
+                lambda x: lax.with_sharding_constraint(x, stage_sharding),
+                params.stages,
+            )
+        )
+
+    def step(state: PipeViTState, images, labels):
+        images = lax.with_sharding_constraint(
+            _preprocess(images, compute_dtype),
+            NamedSharding(mesh, bspec),
+        )
+        B = images.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
+        lbl_mb = labels.reshape(M, B // M)
+        loss_sum, correct, gs, gf, gl = run(
+            state.params.stages, state.params.embed, state.params.head,
+            mbs, lbl_mb,
+        )
+        # The schedule accumulates per-example SUMS; the optimizer
+        # contract (like every other step) is the batch MEAN.
+        grads = jax.tree.map(
+            lambda g: (g / B).astype(jnp.float32),
+            PipeViTParams(embed=gf, stages=gs, head=gl),
+        )
+        grads = constrain(grads)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = constrain(optax.apply_updates(state.params, updates))
+        return (
+            PipeViTState(state.step + 1, params, opt_state),
+            StepMetrics(loss=loss_sum / B, accuracy=correct / B),
         )
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

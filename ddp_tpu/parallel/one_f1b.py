@@ -1,0 +1,376 @@
+"""1F1B (PipeDream-flush) pipeline schedule with O(S) activation stash.
+
+The GPipe schedule in ``parallel/pipeline.py`` derives its backward
+from AD: simple and exact, but the forward scan stashes residuals for
+every tick — O(M) microbatch activations per device. 1F1B interleaves
+one backward between forwards as soon as the first microbatch returns,
+so a device never holds more than S − d in-flight microbatches: the
+activation stash is O(S), independent of M. That is the schedule's
+entire point (the bubble fraction is the same (S−1)/(M+S−1) as GPipe);
+it is what lets M grow to amortize the bubble without activation
+memory growing with it.
+
+Because the backward slots are hand-scheduled, this module does NOT go
+through ``jax.grad``. The schedule is computed once on the host by a
+greedy simulator (``schedule_1f1b`` — prefer-backward policy, which
+reproduces the canonical PipeDream-flush timetable; the simulator
+*asserts* the two properties the kernel relies on: cotangents hop
+exactly one slot per stage, and at most one produced-but-unconsumed
+forward activation waits per device). The device program is one
+``lax.scan`` over the slot tables: each slot every device runs its
+scheduled op via ``lax.switch`` — a forward (stash the stage input,
+one microbatch) or a backward (recompute the stage from the stashed
+input and apply its VJP — the remat-style 1F1B that stores inputs
+only). The loss runs INSIDE the last stage's backward, which is what
+frees outputs from ever being collected.
+
+Data movement per slot (all uniform, collectives outside the switch):
+fresh microbatches reach stage 0 by a masked ``psum`` from their home
+shard (inputs rest sharded over ``pipe`` as in the GPipe path);
+forward activations hop down the ring by ``ppermute`` into a single
+pending slot; cotangents hop up the ring the same way. Non-uniform
+``first_fn``/``last_fn`` (embed/head) run inside stages 0/S−1 exactly
+as in the GPipe path, and their parameter gradients come out of the
+same VJPs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+class Schedule(NamedTuple):
+    """Host-computed 1F1B timetable.
+
+    ``op``/``mb``: [n_slots, S] int32 — what each device does per slot
+    (0 idle, 1 forward, 2 backward) and on which microbatch.
+    """
+
+    op: np.ndarray
+    mb: np.ndarray
+
+    @property
+    def n_slots(self) -> int:
+        return self.op.shape[0]
+
+    def bubble_fraction(self) -> float:
+        """Measured idle fraction of the timetable."""
+        total = self.op.size
+        return float((self.op == 0).sum()) / total
+
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def schedule_1f1b(num_stages: int, num_microbatches: int) -> Schedule:
+    """Greedy prefer-backward simulation of PipeDream-flush.
+
+    Device d may keep at most S − d microbatches in flight (the 1F1B
+    stash cap) and takes a ready backward over a ready forward. The
+    simulation also verifies the kernel's transport assumptions (see
+    module docstring) so a policy regression fails HERE, loudly, not
+    as silently-wrong gradients on device.
+    """
+    S, M = num_stages, num_microbatches
+    F_done = [[None] * S for _ in range(M)]
+    B_done = [[None] * S for _ in range(M)]
+    next_f, next_b = [0] * S, [0] * S
+    ops, mbs = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        if t > 4 * (M + S) + 16:
+            raise RuntimeError(f"1F1B schedule did not converge (S={S}, M={M})")
+        row_op, row_mb = [IDLE] * S, [0] * S
+        for d in range(S):
+            m_b = next_b[d]
+            can_b = m_b < M and (
+                (d == S - 1 and F_done[m_b][d] is not None and F_done[m_b][d] < t)
+                or (d < S - 1 and B_done[m_b][d + 1] is not None
+                    and B_done[m_b][d + 1] < t)
+            )
+            m_f = next_f[d]
+            can_f = (
+                m_f < M
+                and (d == 0 or (F_done[m_f][d - 1] is not None
+                                and F_done[m_f][d - 1] < t))
+                and m_f - next_b[d] < S - d
+            )
+            if can_b:
+                row_op[d], row_mb[d] = BWD, m_b
+                B_done[m_b][d] = t
+                next_b[d] += 1
+            elif can_f:
+                row_op[d], row_mb[d] = FWD, m_f
+                F_done[m_f][d] = t
+                next_f[d] += 1
+        ops.append(row_op)
+        mbs.append(row_mb)
+        t += 1
+    # Kernel transport invariant 1: cotangents hop exactly one slot.
+    for m in range(M):
+        for d in range(S - 1):
+            assert B_done[m][d] == B_done[m][d + 1] + 1, (
+                f"bwd({m},{d}) at {B_done[m][d]} != bwd({m},{d + 1}) "
+                f"{B_done[m][d + 1]} + 1"
+            )
+    # Invariant 2: at most one unconsumed forward activation per device.
+    for d in range(1, S):
+        pending = []
+        for m in range(M):
+            arrive = F_done[m][d - 1] + 1
+            consume = F_done[m][d]
+            pending.append((arrive, consume))
+        for (a1, c1), (a2, _) in zip(pending, pending[1:]):
+            # Strict: the kernel latches the arrival at the END of slot
+            # a2-1, while the pending value is read at the TOP of c1 —
+            # a2 == c1 would overwrite one slot early.
+            assert a2 > c1, (
+                f"device {d}: activation for a later microbatch arrives at "
+                f"{a2} before the previous one is consumed at {c1}"
+            )
+    return Schedule(np.asarray(ops, np.int32), np.asarray(mbs, np.int32))
+
+
+def spmd_pipeline_1f1b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    labels: jax.Array,
+    loss_fn: Callable[[jax.Array, jax.Array], tuple[jax.Array, Any]],
+    schedule: Schedule,
+    *,
+    axis_name: str = "pipe",
+    first_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    first_params: Any = None,
+    last_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    last_params: Any = None,
+):
+    """Run the combined forward+backward 1F1B timetable.
+
+    Call INSIDE shard_map over ``axis_name``. Arguments mirror
+    ``parallel.pipeline.spmd_pipeline`` plus:
+
+      labels: [M, mb_label...] — replicated (tiny); indexed by
+        microbatch at the last stage's backward.
+      loss_fn: ``(out_mb, label_mb) -> (scalar_loss, aux_scalar)`` —
+        the per-microbatch loss (summed over microbatches here) and
+        one auxiliary scalar (e.g. correct-prediction count), both
+        accumulated.
+      schedule: from ``schedule_1f1b(S, M)``.
+
+    Returns ``(loss_sum, aux_sum, g_stage, g_first, g_last)``:
+    ``g_stage`` is this device's stage-gradient slice (leading dim 1,
+    for ``out_specs=P(axis_name)``); ``g_first``/``g_last``/scalars are
+    psum'd (replicated). Gradients are SUMS over microbatches — divide
+    by M outside for a mean-of-means loss.
+    """
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    stage = lax.axis_index(axis_name)
+    S = lax.psum(1, axis_name)
+    if S < 2:
+        # With one stage the first/last roles collide and the role
+        # switch would route around the loss VJP entirely (silent zero
+        # gradients). A 1-stage pipeline is not a pipeline — use the
+        # plain step (or the GPipe path, which degrades gracefully).
+        raise ValueError("1F1B needs a pipe axis of at least 2 stages")
+    local_in = microbatches[:, 0]  # [R, mb, ...]
+    R = local_in.shape[0]
+    M = R * S
+    assert schedule.op.shape[1] == S, (schedule.op.shape, S)
+
+    if first_fn is None:
+        first_fn = lambda p, x: x
+    if last_fn is None:
+        last_fn = lambda p, x: x
+    raw_shape = jax.eval_shape(lambda x: x, local_in[0])
+    act_shape = jax.eval_shape(first_fn, first_params, local_in[0])
+
+    fwd_shift = [(i, i + 1) for i in range(S - 1)]
+    bwd_shift = [(i + 1, i) for i in range(S - 1)]
+
+    op_tab = jnp.asarray(schedule.op)
+    mb_tab = jnp.asarray(schedule.mb)
+
+    def zero_grads():
+        zg = jax.tree.map(jnp.zeros_like, params)
+        zf = jax.tree.map(jnp.zeros_like, first_params)
+        zl = jax.tree.map(jnp.zeros_like, last_params)
+        return zg, zf, zl
+
+    def slot(carry, xs):
+        (pend_act, pend_cot, stash_act,
+         g_stage, g_first, g_last, loss_acc, aux_acc) = carry
+        op_row, mb_row, m0 = xs
+        my_op = op_row[stage]
+        my_m = mb_row[stage]
+
+        # Stage-0 input fetch: microbatch m0 (stage 0's scheduled mb,
+        # forward OR backward — a stage-0 backward re-fetches its raw
+        # input here instead of stashing it) broadcast from its home
+        # shard. Uniform collective.
+        fresh = lax.psum(
+            jnp.where(
+                stage == m0 % S,
+                lax.dynamic_index_in_dim(
+                    local_in, jnp.clip(m0 // S, 0, R - 1), 0, keepdims=False
+                ),
+                jnp.zeros(raw_shape.shape, raw_shape.dtype),
+            ),
+            axis_name,
+        )
+
+        slot_idx = my_m % S
+
+        def do_idle(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last, loss_acc, aux_acc,
+                zero_act, zero_act,
+            )
+
+        def do_fwd(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            # Stage 0 embeds the fetched raw microbatch; others consume
+            # the pending ring activation. Stash the stage INPUT (the
+            # remat residual) in the microbatch's slot.
+            x_in = lax.cond(
+                stage == 0,
+                lambda: first_fn(first_params, fresh).astype(pend_act.dtype),
+                lambda: pend_act,
+            )
+            stash_act = lax.dynamic_update_index_in_dim(
+                stash_act, x_in, slot_idx, 0
+            )
+            y = stage_fn(params, x_in)
+            zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last, loss_acc, aux_acc,
+                y, zero_act,
+            )
+
+        def do_bwd(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            # Stage 0's raw input is re-fetched (``fresh``: m0 == my_m
+            # at a stage-0 backward slot) rather than stashed.
+            raw_m = fresh
+            act_m = lax.dynamic_index_in_dim(
+                stash_act, slot_idx, 0, keepdims=False
+            )
+            lbl_m = lax.dynamic_index_in_dim(
+                labels, jnp.clip(my_m, 0, labels.shape[0] - 1), 0,
+                keepdims=False,
+            )
+
+            # Three stage roles → one uniform grads pytree. Each
+            # recomputes its stage from the stashed input (remat) and
+            # runs the VJP; only its own entries are nonzero.
+            def bwd_first(_):
+                def f(sp, fp):
+                    return stage_fn(sp, first_fn(fp, raw_m).astype(act_m.dtype))
+
+                _, vjp = jax.vjp(f, params, first_params)
+                gs, gf = vjp(pend_cot)
+                _, zf, zl = zero_grads()
+                zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+                return gs, gf, zl, zero_act, jnp.float32(0), jnp.float32(0)
+
+            def bwd_mid(_):
+                def f(sp, x):
+                    return stage_fn(sp, x)
+
+                _, vjp = jax.vjp(f, params, act_m)
+                gs, gx = vjp(pend_cot)
+                _, zf, zl = zero_grads()
+                return gs, zf, zl, gx, jnp.float32(0), jnp.float32(0)
+
+            def bwd_last(_):
+                def f(sp, lp, x):
+                    out = last_fn(lp, stage_fn(sp, x))
+                    loss, aux = loss_fn(out, lbl_m)
+                    return loss, aux
+
+                loss, vjp, aux = jax.vjp(
+                    f, params, last_params, act_m, has_aux=True
+                )
+                gs, gl, gx = vjp(jnp.float32(1.0))
+                _, zf, _ = zero_grads()
+                return (
+                    gs, zf, gl, gx,
+                    loss.astype(jnp.float32), jnp.asarray(aux, jnp.float32),
+                )
+
+            role = jnp.where(stage == 0, 0, jnp.where(stage == S - 1, 2, 1))
+            gs, gf, gl, gx, loss, aux = lax.switch(
+                role, [bwd_first, bwd_mid, bwd_last], None
+            )
+            g_stage = jax.tree.map(jnp.add, g_stage, gs)
+            g_first = jax.tree.map(jnp.add, g_first, gf)
+            g_last = jax.tree.map(jnp.add, g_last, gl)
+            zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last,
+                loss_acc + loss, aux_acc + aux,
+                zero_act, gx,
+            )
+
+        out = lax.switch(
+            my_op, [do_idle, do_fwd, do_bwd],
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc),
+        )
+        (pend_act, pend_cot, stash_act,
+         g_stage, g_first, g_last, loss_acc, aux_acc,
+         act_msg, cot_msg) = out
+
+        # Uniform ring transport; receivers latch only when their
+        # neighbor actually produced this slot (known from the table).
+        act_arrived = lax.ppermute(act_msg, axis_name, fwd_shift)
+        cot_arrived = lax.ppermute(cot_msg, axis_name, bwd_shift)
+        up_op = op_row[jnp.clip(stage - 1, 0, S - 1)]
+        down_op = op_row[jnp.clip(stage + 1, 0, S - 1)]
+        pend_act = jnp.where(
+            (stage > 0) & (up_op == FWD), act_arrived, pend_act
+        )
+        pend_cot = jnp.where(
+            (stage < S - 1) & (down_op == BWD), cot_arrived, pend_cot
+        )
+        return (
+            pend_act, pend_cot, stash_act,
+            g_stage, g_first, g_last, loss_acc, aux_acc,
+        ), None
+
+    zg, zf, zl = zero_grads()
+    carry = (
+        jnp.zeros(act_shape.shape, act_shape.dtype),
+        jnp.zeros(act_shape.shape, act_shape.dtype),
+        jnp.zeros((S, *act_shape.shape), act_shape.dtype),
+        zg, zf, zl,
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+    )
+    m0_seq = jnp.asarray(schedule.mb[:, 0])
+    carry, _ = lax.scan(slot, carry, (op_tab, mb_tab, m0_seq))
+    (_, _, _, g_stage, g_first, g_last, loss_acc, aux_acc) = carry
+
+    # Loss/aux live on the last stage; first/last grads on their ends.
+    loss_sum = lax.psum(loss_acc, axis_name)
+    aux_sum = lax.psum(aux_acc, axis_name)
+    g_first = lax.psum(g_first, axis_name)
+    g_last = lax.psum(g_last, axis_name)
+    return (
+        loss_sum, aux_sum,
+        jax.tree.map(lambda g: g[None], g_stage),
+        g_first, g_last,
+    )

@@ -82,3 +82,67 @@ class TestTrain:
         params = init_pipe_vit(CFG, images[:1], seed=0)
         with pytest.raises(ValueError, match="not divisible"):
             jax.jit(make_pipe_vit_apply(CFG, mesh))(params, images)
+
+
+class Test1F1B:
+    def test_1f1b_step_matches_gpipe_step(self, devices):
+        """One 1F1B train step == one AD-GPipe train step (params,
+        loss, accuracy) on the dp×pp mesh."""
+        import optax
+        from jax.sharding import Mesh
+        import numpy as np_
+        from ddp_tpu.models.pipeline_vit import (
+            make_pipe_vit_1f1b_train_step,
+            make_pipe_vit_train_step,
+            create_pipe_vit_state,
+        )
+        from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=4), devices=devices)
+        tx = optax.sgd(0.05)
+        images, labels = _batch(16, seed=9)
+        st_a = create_pipe_vit_state(
+            CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+        )
+        st_b = create_pipe_vit_state(
+            CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+        )
+        step_a = make_pipe_vit_train_step(CFG, tx, mesh, donate=False)
+        step_b = make_pipe_vit_1f1b_train_step(CFG, tx, mesh, donate=False)
+        st_a, m_a = step_a(st_a, images, labels)
+        st_b, m_b = step_b(st_b, images, labels)
+        np_.testing.assert_allclose(
+            float(m_a.loss), float(m_b.loss), rtol=1e-5
+        )
+        np_.testing.assert_allclose(
+            float(m_a.accuracy), float(m_b.accuracy), atol=1e-6
+        )
+        jax.tree.map(
+            lambda a, b: np_.testing.assert_allclose(
+                np_.asarray(a), np_.asarray(b), atol=2e-5
+            ),
+            st_a.params,
+            st_b.params,
+        )
+
+    def test_1f1b_trains(self, devices):
+        """Loss decreases over a few 1F1B steps."""
+        import optax
+        from ddp_tpu.models.pipeline_vit import (
+            make_pipe_vit_1f1b_train_step,
+            create_pipe_vit_state,
+        )
+        from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(data=2, pipe=4), devices=devices)
+        tx = optax.adam(1e-3)
+        st = create_pipe_vit_state(
+            CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+        )
+        step = make_pipe_vit_1f1b_train_step(CFG, tx, mesh, donate=False)
+        images, labels = _batch(16, seed=10)
+        losses = []
+        for _ in range(6):
+            st, m = step(st, images, labels)
+            losses.append(float(m.loss))
+        assert losses[-1] < losses[0], losses
