@@ -283,6 +283,69 @@ def run_lm_bench(
     }
 
 
+def run_loader_bench(
+    *, n: int = 4096, side: int = 96, batch: int = 256, epochs: int = 3
+) -> dict:
+    """Native C++ worker pool vs single-thread Python gather.
+
+    ImageNet-shaped uint8 rows (the regime the pool exists for —
+    reference data.py:21-25 ``num_workers=2``); measures host-side
+    batch assembly only (no device work). This measurement is what
+    sets the loader's auto-disable policy (data/loader.py
+    POOL_MIN_BATCH_BYTES + the >1-core requirement).
+    """
+    import time
+
+    import numpy as np
+
+    from ddp_tpu import native
+
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, size=(n, side, side, 3), dtype=np.uint8)
+    labels = rng.integers(0, 1000, size=(n,)).astype(np.int32)
+    idx = rng.permutation(n)
+    steps = n // batch
+
+    def python_gather():
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for b in range(steps):
+                sel = idx[b * batch : (b + 1) * batch]
+                _ = images[sel], labels[sel]
+        return epochs * steps / (time.perf_counter() - t0)
+
+    import os
+
+    result = {
+        "metric": "loader_batch_assembly",
+        "shape": [batch, side, side, 3],
+        "python_batches_per_sec": round(python_gather(), 1),
+        "native_available": native.available(),
+        # The pool's win conditions are (a) >1 host core and (b)
+        # overlap with device compute; a raw assembly race on a 1-core
+        # box measures its ring overhead instead. Record the context.
+        "cpu_count": os.cpu_count(),
+    }
+    if native.available():
+        pre = native.NativePrefetcher(images, labels, batch, num_workers=2)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(epochs):
+                for _ in pre.epoch(idx):
+                    pass
+            result["native_batches_per_sec"] = round(
+                epochs * steps / (time.perf_counter() - t0), 1
+            )
+            result["native_speedup"] = round(
+                result["native_batches_per_sec"]
+                / result["python_batches_per_sec"],
+                2,
+            )
+        finally:
+            pre.close()
+    return result
+
+
 def _run_extra_benches() -> None:
     """MXU-bound side benches → BENCH_EXTRA.json + stderr (TPU only)."""
     import pathlib
@@ -294,7 +357,11 @@ def _run_extra_benches() -> None:
     if jax.devices()[0].platform != "tpu":
         return
     extra = {}
-    for name, fn in [("vit", run_vit_bench), ("lm", run_lm_bench)]:
+    for name, fn in [
+        ("vit", run_vit_bench),
+        ("lm", run_lm_bench),
+        ("loader", run_loader_bench),
+    ]:
         try:
             extra[name] = fn()
         except Exception:  # record, never break the headline bench

@@ -41,6 +41,12 @@ class Batch(NamedTuple):
 class ShardedLoader:
     """Deterministic, epoch-reshuffled, device-sharded batch stream."""
 
+    # Below this many bytes per local batch the native worker pool is
+    # auto-disabled — the handoff overhead exceeds the gather it
+    # offloads (bench.py loader micro-bench: MNIST-sized rows lose,
+    # ImageNet-sized rows win).
+    POOL_MIN_BATCH_BYTES = 1 << 20
+
     def __init__(
         self,
         images: np.ndarray,
@@ -122,6 +128,33 @@ class ShardedLoader:
                 images.dtype,
             )
             num_workers = 0
+        if num_workers > 0:
+            import os as _os
+
+            batch_bytes = self.local_batch_size * int(
+                np.prod(images.shape[1:])
+            )
+            if (
+                batch_bytes < self.POOL_MIN_BATCH_BYTES
+                or (_os.cpu_count() or 1) < 2
+            ):
+                # A worker pool is overhead, not help, when one batch
+                # gathers in microseconds (MNIST-sized rows) or when
+                # there is no spare core to run it on — the ticket/
+                # slot handoff costs more than the memcpy it offloads
+                # (both regimes measured: bench.py loader micro-bench).
+                # Auto-disable instead of making the reference's
+                # num_workers=2 default a pessimization.
+                import logging
+
+                logging.getLogger("ddp_tpu").info(
+                    "num_workers=%d auto-disabled: %d-byte batches, "
+                    "%s host cores (pool threshold: %d bytes and >1 "
+                    "core)",
+                    num_workers, batch_bytes, _os.cpu_count(),
+                    self.POOL_MIN_BATCH_BYTES,
+                )
+                num_workers = 0
         if num_workers > 0:
             from ddp_tpu import native
 

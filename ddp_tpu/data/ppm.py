@@ -1,0 +1,111 @@
+"""Raw-image decode without any imaging dependency: binary PPM/PGM.
+
+The ImageNet ingest (scripts/preprocess_imagenet.py) decodes JPEG/PNG
+through PIL when it is installed — but the framework must be able to
+start from raw images with NOTHING beyond numpy (VERDICT.md round-1
+"do this" #6: "a raw-JPEG (or PPM) decode path ... so
+preprocess_imagenet can start from images, not arrays"). Binary
+PPM (P6, RGB) and PGM (P5, grayscale) are the classic zero-dependency
+interchange formats every image tool can emit (``convert x.jpg
+x.ppm``). Decode order: the native C++ reader (native/dataio.cpp
+``dt_ppm_read``) when the toolchain is available, else the pure-Python
+parser below — both pinned equal by tests/test_ppm.py.
+
+``resize_bilinear`` + ``center_crop`` supply the preprocessing the PIL
+path gets from ``Image.resize``/``crop``, in plain numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def parse_ppm(raw: bytes) -> np.ndarray:
+    """Binary PPM (P6) / PGM (P5) bytes → uint8 [H, W, C] array.
+
+    Header: magic, then width/height/maxval separated by whitespace
+    and ``#`` comments, then ONE whitespace byte, then the payload.
+    maxval must fit a byte (the 16-bit variant is not accepted).
+    """
+    if len(raw) < 2 or raw[:1] != b"P" or raw[1:2] not in (b"5", b"6"):
+        raise ValueError("not a binary PPM/PGM (magic P5/P6)")
+    channels = 3 if raw[1:2] == b"6" else 1
+    pos = 2
+    fields = []
+    while len(fields) < 3:
+        while pos < len(raw) and raw[pos : pos + 1].isspace():
+            pos += 1
+        if pos < len(raw) and raw[pos : pos + 1] == b"#":
+            while pos < len(raw) and raw[pos : pos + 1] != b"\n":
+                pos += 1
+            continue
+        start = pos
+        while pos < len(raw) and raw[pos : pos + 1].isdigit():
+            pos += 1
+        if start == pos:
+            raise ValueError("malformed PPM header")
+        fields.append(int(raw[start:pos]))
+    if pos >= len(raw) or not raw[pos : pos + 1].isspace():
+        raise ValueError("malformed PPM header (no payload separator)")
+    pos += 1
+    w, h, maxval = fields
+    if w <= 0 or h <= 0 or not 0 < maxval <= 255:
+        raise ValueError(f"unsupported PPM dims/maxval {fields}")
+    n = h * w * channels
+    payload = raw[pos : pos + n]
+    if len(payload) < n:
+        raise ValueError(f"truncated PPM payload: {len(payload)} < {n}")
+    # copy(): a writable array, matching the native path's contract.
+    return np.frombuffer(payload, np.uint8).reshape(h, w, channels).copy()
+
+
+def read_ppm(path: str) -> np.ndarray:
+    """Decode a PPM/PGM file → uint8 [H, W, C]; native fast path."""
+    from ddp_tpu import native
+
+    if native.available(build=False):
+        try:
+            return native.read_ppm(path)
+        except Exception:  # fall through to the pure-Python parser
+            pass
+    with open(path, "rb") as f:
+        return parse_ppm(f.read())
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """uint8 [H, W, C] → uint8 [out_h, out_w, C], bilinear, pixel-center
+    aligned (the standard image-resize convention)."""
+    h, w = img.shape[:2]
+    y = np.clip((np.arange(out_h) + 0.5) * h / out_h - 0.5, 0, h - 1)
+    x = np.clip((np.arange(out_w) + 0.5) * w / out_w - 0.5, 0, w - 1)
+    y0 = np.floor(y).astype(np.int64)
+    x0 = np.floor(x).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (y - y0).astype(np.float32)[:, None, None]
+    wx = (x - x0).astype(np.float32)[None, :, None]
+    f = img.astype(np.float32)
+    top = f[y0][:, x0] * (1 - wx) + f[y0][:, x1] * wx
+    bot = f[y1][:, x0] * (1 - wx) + f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return np.clip(np.rint(out), 0, 255).astype(np.uint8)
+
+
+def center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top, left = (h - size) // 2, (w - size) // 2
+    return img[top : top + size, left : left + size]
+
+
+def decode_resized(path: str, resize: int, size: int) -> np.ndarray:
+    """PPM/PGM file → [size, size, 3] uint8: shorter side to ``resize``,
+    center-crop ``size`` — the same recipe as the PIL decode path."""
+    img = read_ppm(path)
+    if img.shape[2] == 1:  # grayscale → RGB
+        img = np.repeat(img, 3, axis=2)
+    h, w = img.shape[:2]
+    scale = resize / min(w, h)
+    img = resize_bilinear(
+        img, max(size, round(h * scale)), max(size, round(w * scale))
+    )
+    return center_crop(img, size)

@@ -117,6 +117,14 @@ def _load() -> ctypes.CDLL | None:
         ]
         lib.dt_loader_destroy.restype = None
         lib.dt_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.dt_ppm_read.restype = ctypes.c_int
+        lib.dt_ppm_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
         _LIB = lib
         return _LIB
 
@@ -168,6 +176,36 @@ def read_idx(path: str | os.PathLike) -> np.ndarray:
         dt = _IDX_DTYPES[dtype_code.value]
         flat = np.ctypeslib.as_array(data, shape=(length.value,)).view(dt)
         return flat.reshape(tuple(dims[i] for i in range(ndim.value))).copy()
+    finally:
+        lib.dt_free(data)
+
+
+def read_ppm(path: str | os.PathLike) -> np.ndarray:
+    """Decode a binary PPM (P6) / PGM (P5) file natively → [H, W, C].
+
+    Same contract as the pure-Python ``ddp_tpu.data.ppm.parse_ppm`` —
+    used as its fast path by the raw-image ImageNet ingest.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    data = ctypes.POINTER(ctypes.c_uint8)()
+    h = ctypes.c_int32()
+    w = ctypes.c_int32()
+    c = ctypes.c_int32()
+    rc = lib.dt_ppm_read(
+        os.fspath(path).encode(), ctypes.byref(data), ctypes.byref(h),
+        ctypes.byref(w), ctypes.byref(c),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"dt_ppm_read({path!r}) failed: "
+            f"{ {1: 'io error', 3: 'bad header', 4: 'truncated payload'}.get(rc, rc) }"
+        )
+    try:
+        n = h.value * w.value * c.value
+        flat = np.ctypeslib.as_array(data, shape=(n,))
+        return flat.reshape(h.value, w.value, c.value).copy()
     finally:
         lib.dt_free(data)
 

@@ -22,6 +22,7 @@
 #include <zlib.h>
 
 #include <atomic>
+#include <cctype>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -144,6 +145,59 @@ int dt_idx_read(const char* path, uint8_t** out_data, int64_t* out_len,
 }
 
 void dt_free(void* p) { std::free(p); }
+
+// Decode a binary PPM (P6, RGB) or PGM (P5, gray) image at `path` —
+// the zero-dependency raw-image format for the ImageNet ingest path
+// (scripts/preprocess_imagenet.py): header `P6`, whitespace- and
+// `#`-comment-separated width/height/maxval (maxval <= 255), then a
+// raw payload of h*w*channels bytes.
+// On success returns 0 and fills *out_data (malloc'd [h, w, c]
+// interleaved uint8; caller frees with dt_free), *out_h/*out_w/*out_c.
+// Error codes: 1 io, 3 header/format, 4 size mismatch.
+int dt_ppm_read(const char* path, uint8_t** out_data, int32_t* out_h,
+                int32_t* out_w, int32_t* out_c) {
+  std::vector<uint8_t> raw;
+  if (!read_file(path, raw)) return 1;
+  if (raw.size() < 2 || raw[0] != 'P' || (raw[1] != '5' && raw[1] != '6'))
+    return 3;
+  int channels = raw[1] == '6' ? 3 : 1;
+  size_t pos = 2;
+  long fields[3];  // width, height, maxval
+  for (int f = 0; f < 3; ++f) {
+    // Skip whitespace and `#` comments (which run to end of line).
+    for (;;) {
+      while (pos < raw.size() && std::isspace(raw[pos])) ++pos;
+      if (pos < raw.size() && raw[pos] == '#') {
+        while (pos < raw.size() && raw[pos] != '\n') ++pos;
+        continue;
+      }
+      break;
+    }
+    if (pos >= raw.size() || !std::isdigit(raw[pos])) return 3;
+    long v = 0;
+    while (pos < raw.size() && std::isdigit(raw[pos])) {
+      v = v * 10 + (raw[pos] - '0');
+      if (v > (1l << 30)) return 3;
+      ++pos;
+    }
+    fields[f] = v;
+  }
+  // Exactly ONE whitespace byte separates the header from the payload.
+  if (pos >= raw.size() || !std::isspace(raw[pos])) return 3;
+  ++pos;
+  long w = fields[0], h = fields[1], maxval = fields[2];
+  if (w <= 0 || h <= 0 || maxval <= 0 || maxval > 255) return 3;
+  int64_t payload = int64_t(w) * h * channels;
+  if (static_cast<int64_t>(raw.size() - pos) < payload) return 4;
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(payload));
+  if (!buf) return 1;
+  std::memcpy(buf, raw.data() + pos, static_cast<size_t>(payload));
+  *out_data = buf;
+  *out_h = static_cast<int32_t>(h);
+  *out_w = static_cast<int32_t>(w);
+  *out_c = channels;
+  return 0;
+}
 
 // Decode a CIFAR binary batch (already in memory — the files live
 // inside tarballs): n records of

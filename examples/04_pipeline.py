@@ -1,9 +1,11 @@
-"""Pipelined ViT over the GPipe schedule (models/pipeline_vit.py).
+"""Pipelined ViT (models/pipeline_vit.py): GPipe and 1F1B schedules.
 
-Patch-embed and head run data-parallel; the encoder stack is cut into
-4 same-shaped stages sharded on the pipe axis. Microbatches stream
-through the stage ring via ppermute; the backward schedule is the AD
-transpose of the forward scan — dp×pp in one jitted train step.
+The WHOLE model rides the pipeline — patch-embed inside stage 0, the
+norm+head inside stage S-1 — over a microbatch stream whose buffers
+are sharded on the pipe axis (per-device memory O(M/S)). GPipe's
+backward is the AD transpose of the forward scan; the 1F1B variant
+(parallel/one_f1b.py) hand-schedules fwd/bwd slots with an O(S)
+activation stash and is pinned to produce identical updates.
 """
 
 import os

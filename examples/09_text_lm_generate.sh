@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Real text in, generated text out — the full LM loop with zero
+# external deps: a byte-level corpus file (--dataset text) trains a
+# causal LM whose params rest fsdp-sharded (parallel/seq_fsdp.py),
+# with gradient accumulation and label smoothing composed in; then
+# scripts/predict.py decodes from the checkpoint with a KV cache,
+# deriving the architecture from the saved parameter shapes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example9}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+python - <<PY
+corpus = b"the five boxing wizards jump quickly. " * 400
+open("$WORK/corpus.txt", "wb").write(corpus)
+PY
+
+python train.py --model causal_lm \
+    --dataset text --text_file "$WORK/corpus.txt" \
+    --vocab_size 256 --seq_len 32 --model_depth 2 \
+    --mesh_seq 2 --mesh_fsdp 2 --grad_accum_steps 2 --label_smoothing 0.05 \
+    --epochs 3 --batch_size 4 --optimizer adam --lr 0.003 \
+    --emulate_devices 8 \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --log_interval 16
+
+python scripts/predict.py --model causal_lm \
+    --checkpoint_dir "$WORK/checkpoints" \
+    --prompt "the five boxing " --max_new_tokens 16

@@ -44,7 +44,10 @@ import sys
 
 import numpy as np
 
-_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+# Runnable as a bare script: the PPM decode path imports ddp_tpu.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp", ".ppm", ".pgm"}
 
 
 def class_dirs(split_dir: str) -> list[str]:
@@ -75,6 +78,12 @@ def list_split(
 
 
 def decode(path: str, resize: int, size: int) -> np.ndarray:
+    # PPM/PGM decode needs nothing beyond numpy (data/ppm.py — native
+    # C++ fast path when built); PIL handles the compressed formats.
+    if path.lower().endswith((".ppm", ".pgm")):
+        from ddp_tpu.data.ppm import decode_resized
+
+        return decode_resized(path, resize, size)
     from PIL import Image
 
     with Image.open(path) as im:

@@ -1,0 +1,135 @@
+"""Elastic resume for SHARDED states: a checkpoint written under one
+mesh shape restores onto a different one, bitwise-equal (VERDICT.md
+round-1 "do this" #9).
+
+The restore path is templated on the LIVE state's shardings (Orbax
+StandardRestore with abstract arrays carrying the new mesh's
+placements), so resharding happens on load — zero1 moments saved
+data=8 come back on data=4, fsdp-sharded LM params saved fsdp=2 come
+back on fsdp=4, etc.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ddp_tpu.parallel.ddp import TrainState
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+from ddp_tpu.train.checkpoint import CheckpointManager
+
+
+def _tree_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)
+        ),
+        a,
+        b,
+    )
+
+
+def test_zero1_checkpoint_restores_on_smaller_mesh(tmp_path, devices):
+    """Adam moments sharded over data=8 → restored sharded over data=4."""
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.spmd import create_spmd_state, make_spmd_train_step
+
+    model = get_model("simple_cnn")
+    tx = optax.adam(1e-3)
+    sample = jnp.zeros((1, 28, 28, 1))
+
+    mesh8 = make_mesh(MeshSpec(data=8), devices=devices)
+    st8 = create_spmd_state(model, tx, sample, mesh8, seed=0, zero1=True)
+    # One real step so the moments are non-trivial.
+    step = make_spmd_train_step(model, tx, mesh8, zero1=True, donate=False)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.integers(0, 256, size=(16, 28, 28, 1), dtype=np.uint8)
+    )
+    labels = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
+    st8, _ = step(st8, images, labels)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(0, TrainState(st8.step, st8.params, st8.opt_state, {}))
+    mgr.wait()
+
+    mesh4 = make_mesh(MeshSpec(data=4), devices=devices[:4])
+    st4 = create_spmd_state(model, tx, sample, mesh4, seed=1, zero1=True)
+    template = TrainState(st4.step, st4.params, st4.opt_state, {})
+    restored, epoch = mgr.restore(template)
+    mgr.close()
+
+    assert epoch == 0
+    _tree_equal(restored.params, st8.params)
+    _tree_equal(restored.opt_state, st8.opt_state)
+    # And the restored leaves actually live on the 4-device mesh.
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert set(leaf.sharding.device_set) <= set(devices[:4])
+
+
+def test_fsdp_lm_checkpoint_restores_on_wider_fsdp(tmp_path, devices):
+    """Causal-LM params sharded fsdp=2 → restored sharded fsdp=4,
+    bitwise equal after gathering."""
+    from ddp_tpu.models.lm import (
+        LMSpec,
+        create_lm_train_state,
+        make_lm_train_step,
+    )
+
+    spec = LMSpec(vocab_size=32, total_len=16, d_model=32, depth=2,
+                  num_heads=4)
+    tx = optax.adam(1e-3)
+
+    mesh_a = make_mesh(MeshSpec(data=2, fsdp=2, seq=2), devices=devices)
+    st_a = create_lm_train_state(spec, tx, mesh_a, seed=0)
+    step = make_lm_train_step(spec, tx, mesh_a, donate=False)
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(0, 32, size=(8, 16)), jnp.int32)
+    st_a, _ = step(st_a, toks)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, TrainState(st_a.step, st_a.params, st_a.opt_state, {}))
+    mgr.wait()
+
+    mesh_b = make_mesh(MeshSpec(data=1, fsdp=4, seq=2), devices=devices)
+    st_b = create_lm_train_state(spec, tx, mesh_b, seed=9)
+    template = TrainState(st_b.step, st_b.params, st_b.opt_state, {})
+    restored, epoch = mgr.restore(template)
+    mgr.close()
+
+    assert epoch == 3
+    _tree_equal(restored.params, st_a.params)
+    _tree_equal(restored.opt_state, st_a.opt_state)
+    # Restored embed is sharded 4 ways on fsdp (8 rows / 4 = 2 each).
+    embed = restored.params["embed"]
+    from jax.sharding import PartitionSpec as P
+
+    assert embed.sharding.spec == P("fsdp")
+    assert embed.addressable_shards[0].data.shape[0] == embed.shape[0] // 4
+
+
+def test_replicated_checkpoint_restores_onto_fsdp_mesh(tmp_path, devices):
+    """A replicated-era checkpoint adopts the new fsdp layout on load
+    (recipe upgrade: turn --mesh_fsdp on mid-run)."""
+    from ddp_tpu.models.lm import LMSpec, create_lm_train_state
+
+    spec = LMSpec(vocab_size=32, total_len=16, d_model=32, depth=2,
+                  num_heads=4)
+    tx = optax.adam(1e-3)
+
+    mesh_rep = make_mesh(MeshSpec(data=4, seq=2), devices=devices)
+    st_rep = create_lm_train_state(spec, tx, mesh_rep, seed=0)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(0, TrainState(st_rep.step, st_rep.params, st_rep.opt_state, {}))
+    mgr.wait()
+
+    mesh_f = make_mesh(MeshSpec(data=2, fsdp=2, seq=2), devices=devices)
+    st_f = create_lm_train_state(spec, tx, mesh_f, seed=7)
+    restored, _ = mgr.restore(
+        TrainState(st_f.step, st_f.params, st_f.opt_state, {})
+    )
+    mgr.close()
+    _tree_equal(restored.params, st_rep.params)
+    from jax.sharding import PartitionSpec as P
+
+    assert restored.params["embed"].sharding.spec == P("fsdp")

@@ -82,3 +82,49 @@ def test_rejects_undersharded_multiprocess_mesh(monkeypatch, mnist_synthetic, de
     monkeypatch.setattr(jax, "process_index", lambda: 0)
     with pytest.raises(ValueError, match="cannot be fed by"):
         ShardedLoader(train.images, train.labels, mesh, 8)
+
+
+def test_pool_auto_disabled_for_tiny_batches(devices, caplog, monkeypatch):
+    """MNIST-sized rows: num_workers>0 is auto-disabled (the ring
+    handoff costs more than the microsecond gather it offloads)."""
+    import logging
+
+    from jax.sharding import Mesh
+
+    # The framework's logging setup turns propagation off once a
+    # Trainer has run in this process; caplog needs it back on.
+    monkeypatch.setattr(logging.getLogger("ddp_tpu"), "propagate", True)
+    mesh = Mesh(np.asarray(devices[:1]), ("data",))
+    images = np.zeros((64, 28, 28, 1), np.uint8)
+    labels = np.zeros(64, np.int32)
+    with caplog.at_level(logging.INFO, logger="ddp_tpu"):
+        loader = ShardedLoader(
+            images, labels, mesh, 32, num_workers=2, shuffle=False
+        )
+    assert loader._prefetcher is None
+    assert any("auto-disabled" in r.message for r in caplog.records)
+
+
+def test_pool_enabled_for_large_batches(devices, monkeypatch):
+    """ImageNet-shaped rows clear the threshold → pool engages (when
+    the native toolchain exists and a spare core too — faked here,
+    this box has one)."""
+    import os
+
+    from jax.sharding import Mesh
+
+    from ddp_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    mesh = Mesh(np.asarray(devices[:1]), ("data",))
+    images = np.zeros((128, 96, 96, 3), np.uint8)
+    labels = np.zeros(128, np.int32)
+    loader = ShardedLoader(
+        images, labels, mesh, 64, num_workers=2, shuffle=False
+    )
+    assert loader._prefetcher is not None
+    batches = list(loader._host_batches(0))
+    assert len(batches) == 2 and batches[0][0].shape == (64, 96, 96, 3)
+    loader.close()

@@ -130,12 +130,17 @@ def test_prefetcher_index_validation():
         pf.close()
 
 
-def test_sharded_loader_native_matches_python(mesh8):
+def test_sharded_loader_native_matches_python(mesh8, monkeypatch):
+    import os
+
     from ddp_tpu.data.loader import ShardedLoader
 
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)  # 1-core box: gate
     rng = np.random.default_rng(4)
-    images = rng.integers(0, 256, size=(512, 6, 6, 1), dtype=np.uint8)
-    labels = rng.integers(0, 10, size=512).astype(np.int32)
+    # Rows sized to clear the pool's payoff threshold (below it,
+    # num_workers auto-disables — tests/test_loader.py pins that).
+    images = rng.integers(0, 256, size=(256, 96, 96, 3), dtype=np.uint8)
+    labels = rng.integers(0, 10, size=256).astype(np.int32)
     py = ShardedLoader(images, labels, mesh8, 64, seed=7, num_workers=0)
     nat = ShardedLoader(images, labels, mesh8, 64, seed=7, num_workers=2)
     assert nat._prefetcher is not None

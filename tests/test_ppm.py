@@ -1,0 +1,128 @@
+"""PPM/PGM raw-image decode: Python parser == native C++ reader, and
+the numpy resize/crop path feeds preprocess_imagenet without PIL."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from ddp_tpu.data.ppm import (
+    center_crop,
+    decode_resized,
+    parse_ppm,
+    read_ppm,
+    resize_bilinear,
+)
+
+
+def _ppm_bytes(img: np.ndarray, comment: bool = False) -> bytes:
+    h, w, c = img.shape
+    magic = b"P6" if c == 3 else b"P5"
+    hdr = magic + b"\n"
+    if comment:
+        hdr += b"# a comment line\n"
+    hdr += f"{w} {h}\n255\n".encode()
+    return hdr + img.tobytes()
+
+
+def _img(h=11, w=7, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+
+
+def test_parse_roundtrip_p6_and_p5():
+    for c in (3, 1):
+        img = _img(c=c, seed=c)
+        out = parse_ppm(_ppm_bytes(img))
+        np.testing.assert_array_equal(out, img)
+
+
+def test_parse_with_comments():
+    img = _img(seed=2)
+    np.testing.assert_array_equal(parse_ppm(_ppm_bytes(img, comment=True)), img)
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError, match="magic"):
+        parse_ppm(b"JFIF....")
+    img = _img()
+    with pytest.raises(ValueError, match="truncated"):
+        parse_ppm(_ppm_bytes(img)[:-5])
+
+
+def test_native_matches_python(tmp_path):
+    from ddp_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    img = _img(h=33, w=17, seed=3)
+    path = tmp_path / "x.ppm"
+    path.write_bytes(_ppm_bytes(img, comment=True))
+    np.testing.assert_array_equal(native.read_ppm(str(path)), img)
+    np.testing.assert_array_equal(read_ppm(str(path)), img)
+
+
+def test_resize_and_crop_sanity():
+    img = _img(h=20, w=10, seed=4)
+    up = resize_bilinear(img, 40, 20)
+    assert up.shape == (40, 20, 3)
+    # Constant images stay constant under bilinear resampling.
+    const = np.full((8, 8, 3), 77, np.uint8)
+    np.testing.assert_array_equal(resize_bilinear(const, 16, 12), 77)
+    assert center_crop(up, 16).shape == (16, 16, 3)
+
+
+def test_resize_matches_pil_closely():
+    pil = pytest.importorskip("PIL.Image")
+    img = _img(h=37, w=23, seed=5)
+    ours = resize_bilinear(img, 64, 48)
+    theirs = np.asarray(
+        pil.fromarray(img).resize((48, 64), pil.BILINEAR), np.uint8
+    )
+    # Same convention → small rounding differences only.
+    diff = np.abs(ours.astype(int) - theirs.astype(int))
+    assert diff.mean() < 2.0 and diff.max() <= 16, (diff.mean(), diff.max())
+
+
+def test_preprocess_imagenet_from_ppm_without_pil(tmp_path, monkeypatch):
+    """The full ImageNet ingest runs on .ppm inputs with PIL BLOCKED —
+    raw images → .npy arrays → the data loader, zero imaging deps."""
+    import ddp_tpu.data.imagenet as imagenet
+
+    import os
+
+    scripts = os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        import preprocess_imagenet as pp
+    finally:
+        sys.path.remove(scripts)
+
+    # Two classes × three images each, train + val.
+    rng = np.random.default_rng(6)
+    for split in ("train", "val"):
+        for cls in ("n01", "n02"):
+            d = tmp_path / "raw" / split / cls
+            d.mkdir(parents=True)
+            for i in range(3):
+                img = rng.integers(0, 256, size=(40, 30, 3), dtype=np.uint8)
+                (d / f"{i}.ppm").write_bytes(_ppm_bytes(img))
+
+    monkeypatch.setitem(sys.modules, "PIL", None)  # import PIL → error
+    monkeypatch.setitem(sys.modules, "PIL.Image", None)
+    out = tmp_path / "arrays"
+    rc = pp.main(
+        [
+            "--src", str(tmp_path / "raw"),
+            "--out", str(out),
+            "--size", "16",
+            "--resize", "20",
+            "--workers", "1",
+        ]
+    )
+    assert rc == 0
+    train = imagenet.load(str(out), "train")
+    test = imagenet.load(str(out), "test")
+    assert train.images.shape == (6, 16, 16, 3)
+    assert test.images.shape == (6, 16, 16, 3)
+    assert sorted(set(train.labels)) == [0, 1]

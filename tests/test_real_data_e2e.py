@@ -1,0 +1,109 @@
+"""train.py end to end on REAL on-disk IDX files — no --synthetic_data.
+
+VERDICT.md round-1 "what's missing" #2: every e2e test passed
+``synthetic_data=True``, so the real-MNIST path (IDX decode → sampler
+→ loader → trainer) had never been driven through the CLI. These
+fixtures are byte-exact MNIST-format files (gzip IDX, the same four
+names torchvision downloads — reference data.py:11-14), so the run
+exercises the full real-data path except the network fetch (zero
+egress here; the downloader itself is unit-tested with mirrors).
+"""
+
+import gzip
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+
+FILES = {
+    "train-images-idx3-ubyte.gz": ("images", "train"),
+    "train-labels-idx1-ubyte.gz": ("labels", "train"),
+    "t10k-images-idx3-ubyte.gz": ("images", "test"),
+    "t10k-labels-idx1-ubyte.gz": ("labels", "test"),
+}
+
+
+def _idx_bytes(arr: np.ndarray) -> bytes:
+    """Serialize uint8 array in IDX format (magic 0x08, big-endian dims)."""
+    header = struct.pack(
+        ">BBBB", 0, 0, 0x08, arr.ndim
+    ) + b"".join(struct.pack(">I", d) for d in arr.shape)
+    return header + arr.astype(np.uint8).tobytes()
+
+
+def _write_fixtures(root, n_train=256, n_test=64):
+    """Separable digits: class k = a bright 8×8 block at a distinct
+    spatial position (strongly linearly separable after flatten)."""
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(0)
+
+    def make(n, seed):
+        labels = np.arange(n) % 10
+        images = rng.integers(0, 32, size=(n, 28, 28), dtype=np.uint8)
+        for i, k in enumerate(labels):
+            r, c = (int(k) // 5) * 14, (int(k) % 5) * 5
+            images[i, r : r + 8, c : c + 8] = 255
+        return images, labels.astype(np.uint8)
+
+    tr_img, tr_lbl = make(n_train, 0)
+    te_img, te_lbl = make(n_test, 1)
+    data = {
+        "train-images-idx3-ubyte.gz": tr_img,
+        "train-labels-idx1-ubyte.gz": tr_lbl,
+        "t10k-images-idx3-ubyte.gz": te_img,
+        "t10k-labels-idx1-ubyte.gz": te_lbl,
+    }
+    for name, arr in data.items():
+        with gzip.open(os.path.join(root, name), "wb") as f:
+            f.write(_idx_bytes(arr))
+
+
+def test_train_cli_on_real_idx_files(tmp_path):
+    data_root = str(tmp_path / "data")
+    _write_fixtures(data_root, n_train=512)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+    )
+    cmd = [
+        sys.executable,
+        os.path.join(repo, "train.py"),
+        "--epochs", "3",
+        "--batch_size", "8",
+        "--lr", "0.05",
+        "--emulate_devices", "8",
+        "--data_root", data_root,
+        "--checkpoint_dir", str(tmp_path / "ck"),
+        "--log_interval", "2",
+        "--metrics_file", str(tmp_path / "m.jsonl"),
+        # NO --synthetic_data: must read the IDX files.
+    ]
+    res = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=900
+    )
+    assert res.returncode == 0, res.stderr[-3000:]
+    assert "synthetic" not in res.stderr.lower(), res.stderr[-2000:]
+    # The separable fixture digits are learnable in a few epochs.
+    final = [
+        json.loads(line)
+        for line in open(tmp_path / "m.jsonl")
+        if json.loads(line).get("kind") == "final"
+    ]
+    assert final, "no final metrics record"
+    assert final[-1]["accuracy"] > 0.8, final[-1]
+
+    # Re-run resumes from the real-data checkpoint (README.md:74 flow).
+    cmd2 = list(cmd)
+    cmd2[cmd2.index("--epochs") + 1] = "5"
+    res2 = subprocess.run(
+        cmd2, env=env, capture_output=True, text=True, timeout=900
+    )
+    assert res2.returncode == 0, res2.stderr[-3000:]
+    assert "Resumed from checkpoint epoch 2" in res2.stderr + res2.stdout, (
+        res2.stderr[-1500:]
+    )

@@ -167,3 +167,85 @@ def test_bf16_mixed_precision(tmp_path):
     assert all(
         leaf.dtype == np.float32 for leaf in jax.tree.leaves(t.state.params)
     )
+
+
+class TestSeqOptimExtras:
+    """Scheduled LR + EMA drive the sequence family too (VERDICT #10)."""
+
+    def _cfg(self, tmp_path, **kw):
+        from ddp_tpu.train.config import TrainConfig
+
+        defaults = dict(
+            epochs=1,
+            batch_size=4,
+            model="causal_lm",
+            vocab_size=32,
+            seq_len=16,
+            model_depth=1,
+            mesh_seq=2,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=64,
+            log_interval=2,
+            eval_every=0,
+            optimizer="adam",
+            lr=1e-3,
+            metrics_file=str(tmp_path / "metrics.jsonl"),
+        )
+        defaults.update(kw)
+        return TrainConfig(**defaults)
+
+    def test_lr_schedule_values_in_metrics(self, tmp_path, devices):
+        """The JSONL lr stream matches the warmup+cosine schedule
+        exactly, through --model causal_lm."""
+        import json
+
+        from ddp_tpu.train.optim import lr_at, make_schedule
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = self._cfg(tmp_path, warmup_steps=4, decay_steps=16)
+        t = Trainer(cfg)
+        t.train()
+        t.close()
+        sched = make_schedule(
+            cfg.lr, warmup_steps=4, decay_steps=16,
+            lr_milestones=None, lr_decay_factor=0.1,
+        )
+        steps = [
+            json.loads(line)
+            for line in open(cfg.metrics_file)
+            if json.loads(line).get("kind") == "step"
+        ]
+        assert steps, "no step records"
+        for rec in steps:
+            want = lr_at(sched, max(0, rec["step"] - 1))
+            assert abs(rec["lr"] - want) < 1e-9, (rec, want)
+
+    def test_ema_recurrence_through_lm_trainer(self, tmp_path, devices):
+        """EMA params after training == the closed-form recurrence is
+        already pinned elsewhere; here: the LM trainer populates an
+        EMA, eval can use it, and it differs from the raw params."""
+        import jax
+        import numpy as np_
+
+        from ddp_tpu.train.optim import ema_params
+        from ddp_tpu.train.trainer import Trainer
+
+        cfg = self._cfg(tmp_path, ema_decay=0.5, eval_every=1)
+        t = Trainer(cfg)
+        t.train()
+        ema = ema_params(t.state.opt_state)
+        assert ema is not None
+        raw = t.state.params
+        diffs = [
+            float(np_.abs(np_.asarray(a) - np_.asarray(b)).max())
+            for a, b in zip(jax.tree.leaves(ema), jax.tree.leaves(raw))
+        ]
+        assert max(diffs) > 0, "EMA never diverged from raw params"
+        acc_ema, loss_ema = t.evaluate(use_ema=True)
+        acc_raw, loss_raw = t.evaluate(use_ema=False)
+        t.close()
+        assert np_.isfinite(loss_ema) and np_.isfinite(loss_raw)
+        # Different weights → (generically) different eval loss.
+        assert loss_ema != loss_raw
