@@ -60,14 +60,19 @@ def parse_ppm(raw: bytes) -> np.ndarray:
 
 
 def read_ppm(path: str) -> np.ndarray:
-    """Decode a PPM/PGM file → uint8 [H, W, C]; native fast path."""
-    from ddp_tpu import native
+    """Decode a PPM/PGM file → uint8 [H, W, C]; native fast path.
 
-    if native.available(build=False):
-        try:
+    On a host without the full framework environment (importing
+    ``ddp_tpu`` pulls jax), the native binding is unreachable — the
+    pure-Python parser serves alone, keeping this path numpy-only.
+    """
+    try:
+        from ddp_tpu import native
+
+        if native.available(build=False):
             return native.read_ppm(path)
-        except Exception:  # fall through to the pure-Python parser
-            pass
+    except Exception:  # jax-free host or native decode failure
+        pass
     with open(path, "rb") as f:
         return parse_ppm(f.read())
 

@@ -394,8 +394,18 @@ def create_pipe_vit_state(
         ),
         head=jax.tree.map(lambda x: jax.device_put(x, rep), params.head),
     )
+    opt_state = optimizer.init(params)
+    # Scalars (Adam's count, schedule steps) come out uncommitted —
+    # pin them (and the step counter) replicated on THIS mesh, so a
+    # restore templated on this state places everything mesh-wide
+    # (a single-device step scalar would clash with the sharded
+    # params at the first jitted step after resume).
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
+        opt_state,
+    )
     return PipeViTState(
-        step=jnp.zeros((), jnp.int32),
+        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
         params=params,
-        opt_state=optimizer.init(params),
+        opt_state=opt_state,
     )

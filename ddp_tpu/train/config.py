@@ -64,6 +64,13 @@ class TrainConfig:
     # Any axis > 1 switches the trainer to the GSPMD step
     # (parallel/spmd.py): tensor / ZeRO-style / expert parallelism.
     mesh_model: int = 1  # tensor parallelism
+    # Pipeline parallelism (--model pipe_vit): stages over the pipe
+    # axis; microbatches stream through (parallel/pipeline.py), with
+    # --pipe_schedule picking differentiable GPipe or hand-scheduled
+    # 1F1B (parallel/one_f1b.py — O(S) activation stash).
+    mesh_pipe: int = 1
+    pipe_schedule: str = "gpipe"  # gpipe | 1f1b
+    num_microbatches: int = 4
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
     # Sequence/context parallelism: tokens shard over the seq axis
@@ -167,6 +174,14 @@ class TrainConfig:
         p.add_argument("--backend", default=None, choices=(None, "tpu", "cpu"))
         p.add_argument("--num_devices", type=int, default=cls.num_devices)
         p.add_argument("--mesh_model", type=int, default=cls.mesh_model)
+        p.add_argument("--mesh_pipe", type=int, default=cls.mesh_pipe)
+        p.add_argument(
+            "--pipe_schedule", default=cls.pipe_schedule,
+            choices=("gpipe", "1f1b"),
+        )
+        p.add_argument(
+            "--num_microbatches", type=int, default=cls.num_microbatches
+        )
         p.add_argument("--mesh_fsdp", type=int, default=cls.mesh_fsdp)
         p.add_argument("--mesh_expert", type=int, default=cls.mesh_expert)
         p.add_argument("--mesh_seq", type=int, default=cls.mesh_seq)

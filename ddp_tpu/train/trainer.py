@@ -92,6 +92,42 @@ class Trainer:
                 "--mesh_seq shards tokens, which only the sequence "
                 "models have: use --model long_context or causal_lm"
             )
+        # Pipeline family: the whole ViT rides the pipe axis
+        # (models/pipeline_vit.py) under GPipe or 1F1B.
+        self.pipe_mode = config.model == "pipe_vit"
+        if config.mesh_pipe > 1 and not self.pipe_mode:
+            raise ValueError(
+                "--mesh_pipe cuts a model into stages, which only the "
+                "pipeline family has: use --model pipe_vit"
+            )
+        if self.pipe_mode and config.mesh_pipe < 2:
+            raise ValueError(
+                "--model pipe_vit needs --mesh_pipe >= 2 (a 1-stage "
+                "pipeline is the plain step — drop the flag)"
+            )
+        if self.pipe_mode and (
+            config.mesh_model > 1
+            or config.mesh_fsdp > 1
+            or config.mesh_expert > 1
+            or config.mesh_seq > 1
+            or config.zero1
+            or config.grad_accum_steps > 1
+            or config.fast_epoch
+            or config.augment not in (None, "none")
+            or config.label_smoothing
+        ):
+            raise ValueError(
+                "--model pipe_vit composes with the data axis, bf16, "
+                "remat, EMA and LR schedules — not tp/fsdp/expert/seq/"
+                "zero1, accumulation (use --num_microbatches), augment, "
+                "label smoothing, or --fast_epoch"
+            )
+        if self.pipe_mode and config.num_microbatches % config.mesh_pipe:
+            raise ValueError(
+                f"--num_microbatches {config.num_microbatches} must be "
+                f"a multiple of --mesh_pipe {config.mesh_pipe} (the "
+                "sharded stream rests microbatch m on device m mod S)"
+            )
         # Any non-data axis > 1 switches to the GSPMD step — tensor/
         # fsdp/expert sharding by annotation (parallel/spmd.py). A pure
         # data mesh keeps the explicit shard_map DDP step.
@@ -129,6 +165,7 @@ class Trainer:
         self.mesh = make_mesh(
             MeshSpec(
                 data=-1,
+                pipe=config.mesh_pipe,
                 model=config.mesh_model,
                 fsdp=config.mesh_fsdp,
                 expert=config.mesh_expert,
@@ -191,6 +228,10 @@ class Trainer:
                     f"--mesh_seq {config.mesh_seq}"
                 )
             self.model = None  # spec-driven; no registry module
+        elif self.pipe_mode:
+            # Spec built after the data split is known (patch size
+            # follows the image side); no registry module.
+            self.model = None
         else:
             model_kw = {}
             if config.model_depth is not None:
@@ -372,6 +413,108 @@ class Trainer:
                 st_tr
                 if config.mesh_fsdp > 1
                 else replicate_state(st_tr, self.mesh)
+            )
+        elif self.pipe_mode:
+            from ddp_tpu.models.pipeline_vit import (
+                PipeViTConfig,
+                PipeViTState,
+                create_pipe_vit_state,
+                make_pipe_vit_1f1b_train_step,
+                make_pipe_vit_apply,
+                make_pipe_vit_train_step,
+            )
+            import optax
+
+            from ddp_tpu.parallel.common import _preprocess
+            from ddp_tpu.parallel.ddp import TrainState
+            from ddp_tpu.parallel.pipeline import bubble_fraction
+
+            if self.global_batch_size % config.num_microbatches:
+                raise ValueError(
+                    f"global batch {self.global_batch_size} (batch_size "
+                    f"× data shards) not divisible by "
+                    f"--num_microbatches {config.num_microbatches}"
+                )
+            mb_size = self.global_batch_size // config.num_microbatches
+            if mb_size % self.data_shards:
+                raise ValueError(
+                    f"microbatch size {mb_size} (global batch "
+                    f"{self.global_batch_size} / {config.num_microbatches} "
+                    f"microbatches) not divisible by {self.data_shards} "
+                    "data shards — each microbatch shards over the data "
+                    "axis"
+                )
+            H = int(train_split.images.shape[1])
+            self.pipe_cfg = PipeViTConfig(
+                num_classes=config.num_classes
+                or NUM_CLASSES.get(self.dataset, 10),
+                patch_size=7 if H % 7 == 0 else 4,
+                embed_dim=config.model_dim or 64,
+                num_heads=4,
+                num_stages=config.mesh_pipe,
+                depth_per_stage=config.model_depth or 1,
+                num_microbatches=config.num_microbatches,
+                remat=config.remat,
+            )
+            logger.info(
+                "Pipeline: %d stages × %d blocks, %d microbatches, "
+                "%s schedule, bubble fraction %.3f",
+                self.pipe_cfg.num_stages, self.pipe_cfg.depth_per_stage,
+                self.pipe_cfg.num_microbatches, config.pipe_schedule,
+                bubble_fraction(
+                    self.pipe_cfg.num_stages,
+                    self.pipe_cfg.num_microbatches,
+                ),
+            )
+            make_step = (
+                make_pipe_vit_1f1b_train_step
+                if config.pipe_schedule == "1f1b"
+                else make_pipe_vit_train_step
+            )
+            pipe_step = make_step(
+                self.pipe_cfg, self.optimizer, self.mesh,
+                compute_dtype=compute_dtype,
+            )
+
+            def step(ts, images, labels):
+                ps, metrics = pipe_step(
+                    PipeViTState(ts.step, ts.params, ts.opt_state),
+                    images, labels,
+                )
+                return (
+                    ts._replace(
+                        step=ps.step, params=ps.params,
+                        opt_state=ps.opt_state,
+                    ),
+                    metrics,
+                )
+
+            self.train_step = step
+            apply_fn = jax.jit(make_pipe_vit_apply(self.pipe_cfg, self.mesh))
+
+            def eval_step(params, model_state, images, labels, weights):
+                del model_state
+                logits = apply_fn(
+                    params, _preprocess(images, compute_dtype)
+                ).astype(jnp.float32)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                )
+                correct = ((jnp.argmax(logits, -1) == labels) * weights).sum()
+                return correct, (loss * weights).sum()
+
+            self.eval_step = jax.jit(eval_step)
+            st = create_pipe_vit_state(
+                self.pipe_cfg, self.optimizer, sample, self.mesh,
+                seed=config.seed,
+            )
+            # Stage params rest sharded over pipe — those placements
+            # are the contract (like fsdp above); don't replicate.
+            self.state = TrainState(
+                step=st.step,
+                params=st.params,
+                opt_state=st.opt_state,
+                model_state={},
             )
         elif self.use_spmd:
             from ddp_tpu.parallel.spmd import (

@@ -77,13 +77,34 @@ def list_split(
     return samples
 
 
+_PPM_MOD = None
+
+
+def _ppm():
+    """Load data/ppm.py by FILE PATH — importing the ddp_tpu package
+    would pull jax, and this script's contract is numpy-only for raw
+    images. Cached per process (the decode pool calls per job)."""
+    global _PPM_MOD
+    if _PPM_MOD is None:
+        import importlib.util
+
+        ppm_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), os.pardir,
+            "ddp_tpu", "data", "ppm.py",
+        )
+        spec = importlib.util.spec_from_file_location("_ddp_tpu_ppm", ppm_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _PPM_MOD = mod
+    return _PPM_MOD
+
+
 def decode(path: str, resize: int, size: int) -> np.ndarray:
     # PPM/PGM decode needs nothing beyond numpy (data/ppm.py — native
-    # C++ fast path when built); PIL handles the compressed formats.
+    # C++ fast path when the framework env is present); PIL handles
+    # the compressed formats.
     if path.lower().endswith((".ppm", ".pgm")):
-        from ddp_tpu.data.ppm import decode_resized
-
-        return decode_resized(path, resize, size)
+        return _ppm().decode_resized(path, resize, size)
     from PIL import Image
 
     with Image.open(path) as im:
