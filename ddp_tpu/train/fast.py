@@ -40,10 +40,26 @@ from ddp_tpu.runtime.mesh import data_axes
 
 
 def device_put_dataset(images, labels, mesh: Mesh):
-    """Stage the full dataset on device, replicated across the mesh."""
+    """Stage the full dataset on device, replicated across the mesh.
+
+    Multi-process meshes can't ``device_put`` onto non-addressable
+    devices; there every process supplies the SAME full array (dataset
+    loading is deterministic) and
+    ``make_array_from_process_local_data`` assembles the replicated
+    global — which is also the runner's correctness precondition: the
+    per-epoch permutation is computed from the same key on every
+    device, so identical staging ⇒ identical batches.
+    """
     rep = NamedSharding(mesh, P())
-    return jax.device_put(jnp.asarray(images), rep), jax.device_put(
-        jnp.asarray(labels), rep
+    if jax.process_count() == 1:
+        return jax.device_put(jnp.asarray(images), rep), jax.device_put(
+            jnp.asarray(labels), rep
+        )
+    import numpy as np
+
+    return (
+        jax.make_array_from_process_local_data(rep, np.asarray(images)),
+        jax.make_array_from_process_local_data(rep, np.asarray(labels)),
     )
 
 

@@ -122,6 +122,11 @@ class Trainer:
                 "zero1, accumulation (use --num_microbatches), augment, "
                 "label smoothing, or --fast_epoch"
             )
+        if self.pipe_mode and config.num_microbatches < 1:
+            raise ValueError(
+                f"--num_microbatches must be >= 1, got "
+                f"{config.num_microbatches}"
+            )
         if self.pipe_mode and config.num_microbatches % config.mesh_pipe:
             raise ValueError(
                 f"--num_microbatches {config.num_microbatches} must be "
@@ -445,12 +450,20 @@ class Trainer:
                     "axis"
                 )
             H = int(train_split.images.shape[1])
+            pipe_heads = 4
+            if (config.model_dim or 64) % pipe_heads:
+                # Fail at construction, not as a bare assert in flax
+                # init (seq family convention, trainer guards above).
+                raise ValueError(
+                    f"--model_dim {config.model_dim} not divisible by "
+                    f"the pipe family's {pipe_heads} attention heads"
+                )
             self.pipe_cfg = PipeViTConfig(
                 num_classes=config.num_classes
                 or NUM_CLASSES.get(self.dataset, 10),
                 patch_size=7 if H % 7 == 0 else 4,
                 embed_dim=config.model_dim or 64,
-                num_heads=4,
+                num_heads=pipe_heads,
                 num_stages=config.mesh_pipe,
                 depth_per_stage=config.model_depth or 1,
                 num_microbatches=config.num_microbatches,
@@ -560,11 +573,6 @@ class Trainer:
                 raise ValueError(
                     "--fast_epoch supports the pure-DDP step without "
                     "gradient accumulation"
-                )
-            if self.ctx.num_processes > 1:
-                raise ValueError(
-                    "--fast_epoch is single-process (the dataset is "
-                    "staged device-resident, replicated)"
                 )
             if not config.shuffle:
                 raise ValueError(

@@ -373,3 +373,83 @@ def test_multihost_preemption_agreement_and_resume(tmp_path):
     )
     second = _read(out2, 2)
     assert all(np.isfinite(r["acc"]) for r in second)
+
+
+# -------------------------------------------- multi-process fast epoch
+
+
+def _fast_epoch_worker(rank, world, ckpt_dir, data_root, out_dir):
+    """--fast_epoch across REAL process boundaries: the dataset stages
+    replicated via make_array_from_process_local_data and the whole
+    epoch runs as one multi-controller dispatch (round-1 weak #8 lifted
+    the single-process restriction)."""
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=2,
+        batch_size=8,
+        synthetic_data=True,
+        synthetic_size=128,
+        checkpoint_dir=ckpt_dir,
+        data_root=data_root,
+        log_interval=4,
+        num_workers=0,
+        fast_epoch=True,
+        eval_every=0,
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "epochs_run": summary["epochs_run"],
+                "acc": summary["final_accuracy"],
+                "losses": [h["mean_loss"] for h in summary["history"]],
+            },
+            f,
+        )
+
+
+def test_spawn_fast_epoch_matches_single_process(tmp_path):
+    """2-process fast epoch == 1-process fast epoch (2 devices): the
+    same seed drives the same on-device permutation over identically
+    staged data, so the loss trajectory must agree exactly."""
+    out = tmp_path / "mp"
+    out.mkdir()
+    spawn(
+        _fast_epoch_worker,
+        2,
+        (str(tmp_path / "ck_mp"), str(tmp_path / "data"), str(out)),
+        timeout=420,
+    )
+    ranks = _read(out, 2)
+    assert [r["epochs_run"] for r in ranks] == [2, 2]
+    assert ranks[0]["losses"] == ranks[1]["losses"]
+
+    # Single-process reference with the same global batch (2 devices).
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=2,
+        batch_size=8,
+        synthetic_data=True,
+        synthetic_size=128,
+        checkpoint_dir=str(tmp_path / "ck_sp"),
+        data_root=str(tmp_path / "data"),
+        log_interval=4,
+        num_workers=0,
+        fast_epoch=True,
+        eval_every=0,
+        num_devices=2,
+    )
+    t = Trainer(cfg)
+    summary = t.train()
+    t.close()
+    sp_losses = [h["mean_loss"] for h in summary["history"]]
+    np.testing.assert_allclose(ranks[0]["losses"], sp_losses, rtol=1e-5)

@@ -59,6 +59,8 @@ def main(argv=None) -> int:
         seq_family = [
             "causal_lm (sequence: --mesh_seq/--seq_len/--vocab_size)",
             "long_context (sequence: --mesh_seq/--seq_len/--seq_dim)",
+            "pipe_vit (pipeline: --mesh_pipe/--pipe_schedule/"
+            "--num_microbatches)",
         ]
         print("\n".join(sorted(available() + seq_family)))
         return 0
