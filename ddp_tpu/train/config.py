@@ -38,6 +38,10 @@ class TrainConfig:
     # Width for the sequence family (long_context/causal_lm d_model);
     # registry models fix their widths per family name.
     model_dim: int | None = None
+    # Attention heads for the spec-driven families (seq + pipe);
+    # registry models fix theirs. head_dim = model_dim / num_heads —
+    # 128-wide heads measurably fill the MXU better (bench.py).
+    num_heads: int = 4
     augment: str | None = None  # data/augment.py: "crop_flip" | "flip"
     # "auto" resolves per model family: mnist normally, synthetic_seq
     # for --model long_context. An explicit image dataset with the
@@ -148,6 +152,7 @@ class TrainConfig:
         p.add_argument("--model", default=cls.model)
         p.add_argument("--model_depth", type=int, default=None)
         p.add_argument("--model_dim", type=int, default=None)
+        p.add_argument("--num_heads", type=int, default=cls.num_heads)
         p.add_argument(
             "--augment", default=None, choices=("none", "crop_flip", "flip")
         )

@@ -122,6 +122,16 @@ class Trainer:
                 "zero1, accumulation (use --num_microbatches), augment, "
                 "label smoothing, or --fast_epoch"
             )
+        if (self.seq_mode or self.pipe_mode) and (
+            config.num_heads < 1
+            or (config.model_dim or 64) % config.num_heads
+        ):
+            # One guard for both spec-driven families (the registry
+            # models fix their own head counts).
+            raise ValueError(
+                f"--num_heads {config.num_heads} must be >= 1 and "
+                f"divide --model_dim {config.model_dim or 64}"
+            )
         if self.pipe_mode and config.num_microbatches < 1:
             raise ValueError(
                 f"--num_microbatches must be >= 1, got "
@@ -204,6 +214,7 @@ class Trainer:
                     total_len=config.seq_len,
                     d_model=config.model_dim or 64,
                     depth=config.model_depth or 2,
+                    num_heads=config.num_heads,
                     strategy=config.seq_strategy,
                     remat=config.remat,
                 )
@@ -218,6 +229,7 @@ class Trainer:
                     d_in=config.seq_dim,
                     d_model=config.model_dim or 64,
                     depth=config.model_depth or 2,
+                    num_heads=config.num_heads,
                     strategy=config.seq_strategy,
                     remat=config.remat,
                 )
@@ -450,14 +462,7 @@ class Trainer:
                     "axis"
                 )
             H = int(train_split.images.shape[1])
-            pipe_heads = 4
-            if (config.model_dim or 64) % pipe_heads:
-                # Fail at construction, not as a bare assert in flax
-                # init (seq family convention, trainer guards above).
-                raise ValueError(
-                    f"--model_dim {config.model_dim} not divisible by "
-                    f"the pipe family's {pipe_heads} attention heads"
-                )
+            pipe_heads = config.num_heads  # validated in __init__ above
             self.pipe_cfg = PipeViTConfig(
                 num_classes=config.num_classes
                 or NUM_CLASSES.get(self.dataset, 10),
@@ -969,6 +974,15 @@ class Trainer:
         self.metrics_writer.write(
             "final", accuracy=final_acc, loss=final_loss,
             epochs_run=len(self.history),
+            # The LM community's headline eval number; loss is the
+            # mean next-token cross-entropy, so this is exp(loss).
+            **(
+                {"perplexity": round(float(np.exp(final_loss)), 4)}
+                if self.lm_mode
+                and np.isfinite(final_loss)
+                and np.isfinite(np.exp(final_loss))
+                else {}
+            ),
         )
         return {
             "epochs_run": len(self.history),

@@ -249,3 +249,81 @@ class TestSeqOptimExtras:
         assert np_.isfinite(loss_ema) and np_.isfinite(loss_raw)
         # Different weights → (generically) different eval loss.
         assert loss_ema != loss_raw
+
+
+class TestSeqHeadsAndUlysses:
+    def test_num_heads_flag_and_perplexity(self, tmp_path, devices):
+        """--num_heads shapes the LM; the final metrics record carries
+        perplexity = exp(next-token loss)."""
+        import json
+
+        cfg = TrainConfig(
+            epochs=1,
+            batch_size=4,
+            model="causal_lm",
+            vocab_size=32,
+            seq_len=16,
+            model_depth=1,
+            model_dim=32,
+            num_heads=2,
+            mesh_seq=2,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=64,
+            log_interval=4,
+            eval_every=1,
+            optimizer="adam",
+            lr=1e-3,
+            metrics_file=str(tmp_path / "m.jsonl"),
+        )
+        t = Trainer(cfg)
+        assert t.seq_spec.num_heads == 2
+        t.train()
+        t.close()
+        final = [
+            json.loads(line)
+            for line in open(cfg.metrics_file)
+            if json.loads(line).get("kind") == "final"
+        ][-1]
+        assert final["perplexity"] == pytest.approx(
+            np.exp(final["loss"]), rel=1e-4
+        )
+
+    def test_bad_heads_rejected(self, tmp_path, devices):
+        with pytest.raises(ValueError, match="num_heads"):
+            Trainer(
+                TrainConfig(
+                    model="causal_lm", model_dim=30, num_heads=4,
+                    mesh_seq=2, synthetic_data=True, synthetic_size=64,
+                    seq_len=16, checkpoint_dir=str(tmp_path / "ck"),
+                    data_root=str(tmp_path / "data"),
+                )
+            )
+
+    def test_ulysses_composes_with_fsdp(self, tmp_path, devices):
+        """Ulysses strategy × fsdp sharding through the CLI surface."""
+        cfg = TrainConfig(
+            epochs=1,
+            batch_size=4,
+            model="causal_lm",
+            vocab_size=32,
+            seq_len=16,
+            model_depth=1,
+            num_heads=4,
+            mesh_seq=2,
+            mesh_fsdp=2,
+            seq_strategy="ulysses",
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=64,
+            log_interval=4,
+            eval_every=0,
+            optimizer="adam",
+            lr=1e-3,
+        )
+        t = Trainer(cfg)
+        summary = t.train()
+        t.close()
+        assert np.isfinite(summary["history"][0]["mean_loss"])
