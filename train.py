@@ -69,6 +69,7 @@ def main(argv=None) -> int:
 
         rows = [f"{k} ({v} classes)" for k, v in NUM_CLASSES.items()]
         rows.append("synthetic_seq (sequence models only)")
+        rows.append("text (causal_lm byte corpus: --text_file PATH)")
         print("\n".join(sorted(rows)))
         return 0
     config = TrainConfig.from_namespace(ns)
