@@ -255,11 +255,22 @@ class Trainer:
                 model_kw["depth"] = config.model_depth
             if config.remat:
                 model_kw["remat"] = True
+            if self.use_spmd:
+                # The GSPMD step partitions by annotation; a compiled
+                # Mosaic custom call (the flash default on TPU) has no
+                # partitioning rule there, unlike the shard_map paths
+                # (DDP/seq/pipe) where Pallas is first-class. Pin the
+                # attention-bearing families to dense XLA under GSPMD —
+                # their attention is small (T≤197) and XLA partitions
+                # einsums exactly. (On CPU this is what best_attention
+                # resolves to anyway, so the branch is identical there.)
+                from ddp_tpu.ops.attention import dot_product_attention
+
+                model_kw["attention_fn"] = dot_product_attention
+            n_classes = config.num_classes or NUM_CLASSES.get(self.dataset, 10)
             try:
                 self.model = get_model(
-                    config.model,
-                    num_classes=config.num_classes or NUM_CLASSES.get(self.dataset, 10),
-                    **model_kw,
+                    config.model, num_classes=n_classes, **model_kw
                 )
             except TypeError as e:
                 if config.remat and "remat" in str(e):
@@ -267,7 +278,14 @@ class Trainer:
                         f"--remat is not supported by model {config.model!r} "
                         "(no block stack to rematerialize)"
                     ) from e
-                raise
+                if "attention_fn" in str(e):
+                    # Attention-free families (simple_cnn, resnet*).
+                    model_kw.pop("attention_fn", None)
+                    self.model = get_model(
+                        config.model, num_classes=n_classes, **model_kw
+                    )
+                else:
+                    raise
         milestones = tuple(
             int(m) for m in config.lr_milestones.split(",") if m.strip()
         )

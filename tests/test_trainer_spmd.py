@@ -95,3 +95,30 @@ def test_expert_parallel_trainer(tmp_path):
 def test_cli_mesh_flags():
     cfg = TrainConfig.from_args(["--mesh_model", "2", "--mesh_fsdp", "4"])
     assert cfg.mesh_model == 2 and cfg.mesh_fsdp == 4
+
+
+def test_attention_free_model_under_gspmd(tmp_path, devices):
+    """simple_cnn (no attention_fn parameter) under a GSPMD config:
+    the trainer's dense-attention pin must fall back cleanly rather
+    than crash at construction (half the zoo is attention-free)."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=4,
+        model="simple_cnn",
+        zero1=True,
+        optimizer="adam",
+        lr=1e-3,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        log_interval=4,
+        eval_every=0,
+    )
+    t = Trainer(cfg)
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
