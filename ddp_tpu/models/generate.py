@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ddp_tpu.models.lm import LMSpec
+from ddp_tpu.ops.attention import dot_product_attention
 
 
 class DecodeCache(NamedTuple):
@@ -67,6 +68,24 @@ def _dense(x, p):
     return x @ p["kernel"] + p["bias"]
 
 
+def _block_qkv(p, x, H, Dh):
+    """ln1 → qkv projection → ([B,T,H,Dh] q, k, v). Shared by the
+    incremental decode (T=1) and the parallel prefill (T=P) so the two
+    paths cannot drift numerically."""
+    h = _layer_norm(x, p["ln1"]).astype(x.dtype)
+    qkv = _dense(h, p["attn"]["qkv"]).reshape(*x.shape[:2], 3, H, Dh)
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _block_finish(p, x, attn_vec):
+    """Output projection residual + MLP residual (the block's back half)."""
+    x = x + _dense(attn_vec, p["attn"]["proj"])
+    h = _layer_norm(x, p["ln2"]).astype(x.dtype)
+    h = _dense(h, p["mlp1"])
+    h = jax.nn.gelu(h)  # tanh approximation — Flax's default
+    return x + _dense(h, p["mlp2"])
+
+
 def decode_step(
     spec: LMSpec, params: Any, cache: DecodeCache, token: jax.Array
 ) -> tuple[jax.Array, DecodeCache]:
@@ -90,9 +109,7 @@ def decode_step(
     ck, cv = cache.k, cache.v
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
-        h = _layer_norm(x, p["ln1"]).astype(x.dtype)
-        qkv = _dense(h, p["attn"]["qkv"]).reshape(B, 1, 3, H, Dh)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = _block_qkv(p, x, H, Dh)
         ck = lax.dynamic_update_slice(ck, k[None], (i, 0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[None], (i, 0, pos, 0, 0))
         logits = (
@@ -107,11 +124,7 @@ def decode_step(
         w = jax.nn.softmax(logits, axis=-1)
         attn = jnp.einsum("bhl,blhd->bhd", w, cv[i].astype(jnp.float32))
         attn = attn.reshape(B, 1, spec.d_model).astype(x.dtype)
-        x = x + _dense(attn, p["attn"]["proj"])
-        h = _layer_norm(x, p["ln2"]).astype(x.dtype)
-        h = _dense(h, p["mlp1"])
-        h = jax.nn.gelu(h)  # tanh approximation — Flax's default
-        x = x + _dense(h, p["mlp2"])
+        x = _block_finish(p, x, attn)
     x = _layer_norm(x, params["ln_final"])
     out_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
     return out_logits, DecodeCache(k=ck, v=cv, pos=pos + 1)
@@ -120,20 +133,40 @@ def decode_step(
 def prefill(
     spec: LMSpec, params: Any, prompt: jax.Array
 ) -> tuple[jax.Array, DecodeCache]:
-    """Run the prompt through the cache → (last logits, warm cache).
+    """Warm the cache from the prompt in ONE parallel forward.
 
-    ``prompt``: [B, P] int32, P ≥ 1. Tokens feed one per scan step —
-    at the demo scales the O(P·L·d) cost is irrelevant and the path is
-    byte-identical to decoding (one code path to trust).
+    ``prompt``: [B, P] int32, P ≥ 1. The standard two-phase decode
+    architecture: prefill processes all prompt positions at once
+    (dense causal attention, MXU-shaped [B, P, ...] matmuls) and
+    writes every position's K/V into the cache; generation then
+    proceeds token-by-token. Returns (last position's logits, cache
+    with pos = P). Pinned equal to sequential ``decode_step`` feeding
+    by tests/test_generate.py.
     """
-    cache = init_cache(spec, prompt.shape[0])
-
-    def step(cache, tok):
-        logits, cache = decode_step(spec, params, cache, tok)
-        return cache, logits
-
-    cache, all_logits = lax.scan(step, cache, prompt.T)
-    return all_logits[-1], cache
+    B, P = prompt.shape
+    H = spec.num_heads
+    Dh = spec.d_model // H
+    cache = init_cache(spec, B)
+    embed = params["embed"]
+    x = embed[prompt]  # [B, P, d]
+    x = x + params["pos_embed"].astype(x.dtype)[:, :P]
+    ck, cv = cache.k, cache.v
+    for i in range(spec.depth):
+        p = params[f"block{i + 1}"]
+        q, k, v = _block_qkv(p, x, H, Dh)
+        ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v[None], (i, 0, 0, 0, 0))
+        attn = dot_product_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=True,
+        )
+        attn = attn.reshape(B, P, spec.d_model).astype(x.dtype)
+        x = _block_finish(p, x, attn)
+    x = _layer_norm(x[:, -1:], params["ln_final"])
+    last_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
+    return last_logits, DecodeCache(
+        k=ck, v=cv, pos=jnp.asarray(P, jnp.int32)
+    )
 
 
 def generate(

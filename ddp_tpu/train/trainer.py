@@ -45,6 +45,29 @@ from ddp_tpu.utils.watchdog import StepWatchdog
 logger = logging.getLogger("ddp_tpu")
 
 
+def _ctor_accepts(model_name: str, kwarg: str) -> bool:
+    """Does the registry model's constructor take ``kwarg``?
+
+    Signature inspection (explicit parameter or **kwargs) — a
+    capability check, not exception-message sniffing, so a genuine
+    TypeError from construction is never misread as "drop the kwarg".
+    """
+    import inspect
+
+    from ddp_tpu.models import _REGISTRY
+
+    ctor = _REGISTRY.get(model_name)
+    if ctor is None:
+        return False
+    try:
+        params = inspect.signature(ctor).parameters
+    except (TypeError, ValueError):
+        return False
+    return kwarg in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
 @dataclasses.dataclass
 class EpochStats:
     epoch: int
@@ -255,7 +278,9 @@ class Trainer:
                 model_kw["depth"] = config.model_depth
             if config.remat:
                 model_kw["remat"] = True
-            if self.use_spmd:
+            if self.use_spmd and _ctor_accepts(
+                config.model, "attention_fn"
+            ):
                 # The GSPMD step partitions by annotation; a compiled
                 # Mosaic custom call (the flash default on TPU) has no
                 # partitioning rule there, unlike the shard_map paths
@@ -263,7 +288,9 @@ class Trainer:
                 # attention-bearing families to dense XLA under GSPMD —
                 # their attention is small (T≤197) and XLA partitions
                 # einsums exactly. (On CPU this is what best_attention
-                # resolves to anyway, so the branch is identical there.)
+                # resolves to anyway, so the branch is identical there.
+                # Attention-free families — the capability check — are
+                # simply left alone.)
                 from ddp_tpu.ops.attention import dot_product_attention
 
                 model_kw["attention_fn"] = dot_product_attention
@@ -278,14 +305,7 @@ class Trainer:
                         f"--remat is not supported by model {config.model!r} "
                         "(no block stack to rematerialize)"
                     ) from e
-                if "attention_fn" in str(e):
-                    # Attention-free families (simple_cnn, resnet*).
-                    model_kw.pop("attention_fn", None)
-                    self.model = get_model(
-                        config.model, num_classes=n_classes, **model_kw
-                    )
-                else:
-                    raise
+                raise
         milestones = tuple(
             int(m) for m in config.lr_milestones.split(",") if m.strip()
         )

@@ -165,3 +165,36 @@ def test_predict_cli_generates_from_trained_checkpoint(tmp_path):
     assert record["prompt_tokens"] == [1, 2, 3]
     assert len(record["tokens"]) == 5
     assert all(0 <= t_ < 32 for t_ in record["tokens"])
+
+
+def test_parallel_prefill_matches_sequential_decode(params):
+    """The one-pass prefill's cache and logits equal feeding the
+    prompt token-by-token through decode_step."""
+    from ddp_tpu.models.generate import decode_step, init_cache
+
+    rng = np.random.default_rng(13)
+    prompt = jnp.asarray(
+        rng.integers(0, SPEC.vocab_size, size=(2, 9)), jnp.int32
+    )
+    last_par, cache_par = prefill(SPEC, params, prompt)
+
+    cache_seq = init_cache(SPEC, 2)
+    for t in range(9):
+        last_seq, cache_seq = decode_step(
+            SPEC, params, cache_seq, prompt[:, t]
+        )
+    np.testing.assert_allclose(
+        np.asarray(last_par), np.asarray(last_seq), atol=1e-4
+    )
+    assert int(cache_par.pos) == int(cache_seq.pos) == 9
+    # K/V identical for the filled positions (zeros beyond).
+    np.testing.assert_allclose(
+        np.asarray(cache_par.k[:, :, :9]),
+        np.asarray(cache_seq.k[:, :, :9]),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(cache_par.v[:, :, :9]),
+        np.asarray(cache_seq.v[:, :, :9]),
+        atol=1e-5,
+    )
