@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ddp_tpu.models.lm import LMSpec
-from ddp_tpu.ops.attention import dot_product_attention
+from ddp_tpu.ops.attention import best_attention
 
 
 class DecodeCache(NamedTuple):
@@ -151,14 +151,17 @@ def prefill(
     x = embed[prompt]  # [B, P, d]
     x = x + params["pos_embed"].astype(x.dtype)[:, :P]
     ck, cv = cache.k, cache.v
+    # Flash kernel on TPU, dense XLA elsewhere — prefill is a full
+    # causal attention over the prompt. Resolved once, like CausalLM.
+    attn_fn = best_attention(causal=True)
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh)
         ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[None], (i, 0, 0, 0, 0))
-        attn = dot_product_attention(
+        attn = attn_fn(
             q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), causal=True,
+            v.astype(jnp.float32),
         )
         attn = attn.reshape(B, P, spec.d_model).astype(x.dtype)
         x = _block_finish(p, x, attn)

@@ -33,7 +33,15 @@ from ddp_tpu.parallel.ring import sequence_sharded_attention
 
 
 class CausalLM(nn.Module):
-    """[B, T_local] int32 tokens → [B, T_local, vocab] fp32 logits."""
+    """[B, T_local] int32 tokens → [B, T_local, vocab] fp32 logits.
+
+    ``num_experts > 0`` makes every ``moe_every``-th block a routed
+    MoE block (models/moe.py MoEEncoderBlock — GShard top-k with
+    capacity); the load-balance aux losses land in the ``losses``
+    collection when it is marked mutable. Under sequence parallelism
+    each token shard routes independently (standard local routing —
+    the router never sees remote tokens).
+    """
 
     vocab_size: int
     total_len: int
@@ -44,6 +52,8 @@ class CausalLM(nn.Module):
     # None → ops.attention.best_attention(causal=True): Pallas flash
     # kernel on TPU, dense XLA elsewhere.
     attention_fn: Optional[Callable] = None
+    num_experts: int = 0  # 0 = dense MLPs everywhere
+    moe_every: int = 2
     remat: bool = False
 
     @nn.compact
@@ -62,15 +72,29 @@ class CausalLM(nn.Module):
         x = x + lax.dynamic_slice_in_dim(
             pos.astype(x.dtype), pos_offset, x.shape[1], axis=1
         )
+        from ddp_tpu.models.moe import MoEEncoderBlock
+
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        moe_cls = (
+            nn.remat(MoEEncoderBlock) if self.remat else MoEEncoderBlock
+        )
         attn_fn = self.attention_fn or best_attention(causal=True)
         for i in range(self.depth):
-            x = block_cls(
-                num_heads=self.num_heads,
-                mlp_dim=self.d_model * self.mlp_ratio,
-                attention_fn=attn_fn,
-                name=f"block{i + 1}",
-            )(x)
+            if self.num_experts and (i + 1) % self.moe_every == 0:
+                x = moe_cls(
+                    num_heads=self.num_heads,
+                    mlp_dim=self.d_model * self.mlp_ratio,
+                    num_experts=self.num_experts,
+                    attention_fn=attn_fn,
+                    name=f"block{i + 1}",
+                )(x)
+            else:
+                x = block_cls(
+                    num_heads=self.num_heads,
+                    mlp_dim=self.d_model * self.mlp_ratio,
+                    attention_fn=attn_fn,
+                    name=f"block{i + 1}",
+                )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
         # Tied head: logits through the embedding transpose.
         return (x @ embed.T.astype(x.dtype)).astype(jnp.float32)
@@ -84,6 +108,9 @@ class LMSpec(NamedTuple):
     num_heads: int = 4
     strategy: str = "ring"  # ring | ulysses
     remat: bool = False
+    num_experts: int = 0  # >0: MoE MLPs every moe_every-th block
+    moe_every: int = 2
+    aux_loss_weight: float = 0.01  # GShard load-balance loss weight
 
 
 def _dense_lm(spec: LMSpec) -> CausalLM:
@@ -93,6 +120,8 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
         d_model=spec.d_model,
         depth=spec.depth,
         num_heads=spec.num_heads,
+        num_experts=spec.num_experts,
+        moe_every=spec.moe_every,
         remat=spec.remat,
     )
 
@@ -110,6 +139,8 @@ def _sharded_lm(spec: LMSpec) -> CausalLM:
         depth=spec.depth,
         num_heads=spec.num_heads,
         attention_fn=attention,
+        num_experts=spec.num_experts,
+        moe_every=spec.moe_every,
         remat=spec.remat,
     )
 
@@ -194,8 +225,13 @@ def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
     baxes = _batch_axes(mesh)
     xspec = P(baxes, "seq")
 
-    def forward(params, tokens):
+    def forward(params, tokens, want_aux: bool = True):
+        """→ (logits sharded like the tokens, replicated MoE aux loss
+        scalar — 0.0 for dense specs or ``want_aux=False``, which also
+        skips the aux collection and its cross-device mean: eval has
+        no use for the routing penalty)."""
         pspecs = fsdp_specs(params, mesh)
+        collect_aux = bool(spec.num_experts) and want_aux
 
         def per_shard_forward(params, tok_shard):
             params = gather_fsdp(params, pspecs)
@@ -205,13 +241,30 @@ def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
                 params = jax.tree.map(
                     lambda p: p.astype(compute_dtype), params
                 )
-            return model.apply({"params": params}, tok_shard, pos_offset=offset)
+            if collect_aux:
+                logits, variables = model.apply(
+                    {"params": params}, tok_shard, pos_offset=offset,
+                    mutable=["losses"],
+                )
+                leaves = jax.tree.leaves(variables.get("losses", {}))
+                aux = (
+                    sum(leaves) / len(leaves) if leaves else jnp.float32(0.0)
+                )
+                # Replicate: each shard routed its own tokens; the
+                # batch aux is the mean over every shard's groups.
+                aux = lax.pmean(aux, mesh.axis_names)
+            else:
+                logits = model.apply(
+                    {"params": params}, tok_shard, pos_offset=offset
+                )
+                aux = jnp.float32(0.0)
+            return logits, aux
 
         return jax.shard_map(
             per_shard_forward,
             mesh=mesh,
             in_specs=(pspecs, xspec),
-            out_specs=xspec,
+            out_specs=(xspec, P()),
             check_vma=False,
         )(params, tokens)
 
@@ -234,7 +287,7 @@ def make_lm_eval_step(
 
     def step(params, model_state, tokens, labels, weights):
         del model_state, labels
-        logits = sharded_forward(params, tokens)
+        logits, _ = sharded_forward(params, tokens, want_aux=False)
         targets = tokens[:, 1:]
         per_tok = optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1].astype(jnp.float32), targets
@@ -273,10 +326,12 @@ def make_lm_train_step(
     sharded_forward, xspec = _make_sharded_forward(spec, mesh, compute_dtype)
 
     def loss_and_logits(params, tokens):
-        logits = sharded_forward(params, tokens)
+        logits, aux = sharded_forward(params, tokens)
         loss = next_token_loss(
             logits, tokens, label_smoothing=label_smoothing
         )
+        if spec.num_experts:
+            loss = loss + spec.aux_loss_weight * aux
         pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
         correct = (pred == tokens[:, 1:]).sum().astype(jnp.float32)
         return loss, correct

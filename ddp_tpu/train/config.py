@@ -86,6 +86,9 @@ class TrainConfig:
     seq_dim: int = 16  # input feature channels per token
     seq_strategy: str = "ring"  # ring | ulysses
     vocab_size: int = 256  # causal_lm token vocabulary
+    # >0: causal_lm routes every 2nd block's MLP through this many
+    # experts (GShard top-k, replicated experts, per-shard routing).
+    moe_experts: int = 0
     # Real LM data: a file read as raw bytes (--dataset text),
     # chunked into seq_len sequences (data/text.py). No tokenizer dep.
     text_file: str | None = None
@@ -197,6 +200,7 @@ class TrainConfig:
             choices=("ring", "ulysses"),
         )
         p.add_argument("--vocab_size", type=int, default=cls.vocab_size)
+        p.add_argument("--moe_experts", type=int, default=cls.moe_experts)
         p.add_argument(
             "--text_file", default=cls.text_file,
             help="byte-level corpus for --dataset text (causal_lm)",

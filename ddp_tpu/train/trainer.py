@@ -109,6 +109,11 @@ class Trainer:
         # (ring/Ulysses attention) with their own step/eval builders —
         # the long-context classifier and the causal LM.
         self.lm_mode = config.model == "causal_lm"
+        if config.moe_experts and not self.lm_mode:
+            raise ValueError(
+                "--moe_experts routes the causal LM's MLPs: use "
+                "--model causal_lm (images have --model vit_moe_tiny)"
+            )
         self.seq_mode = config.model == "long_context" or self.lm_mode
         if config.mesh_seq > 1 and not self.seq_mode:
             raise ValueError(
@@ -240,6 +245,7 @@ class Trainer:
                     num_heads=config.num_heads,
                     strategy=config.seq_strategy,
                     remat=config.remat,
+                    num_experts=config.moe_experts,
                 )
             else:
                 from ddp_tpu.models.seq_transformer import (

@@ -1,0 +1,129 @@
+"""MoE causal LM: routed MLPs inside the sequence-parallel decoder.
+
+Every moe_every-th block of CausalLM routes its MLP through GShard
+top-k experts (models/moe.py MoEMLP) with the load-balance aux loss
+folded into the training objective. Experts replicate; each seq shard
+routes its own tokens (local routing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models.lm import (
+    LMSpec,
+    create_lm_train_state,
+    init_lm,
+    make_lm_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+SPEC = LMSpec(
+    vocab_size=32, total_len=16, d_model=32, depth=2, num_heads=4,
+    num_experts=4,
+)
+
+
+def _tokens(batch, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        rng.integers(0, SPEC.vocab_size, size=(batch, SPEC.total_len)),
+        jnp.int32,
+    )
+
+
+def test_moe_params_present():
+    params = init_lm(SPEC, seed=0)
+    assert "moe" in params["block2"], sorted(params["block2"])
+    assert "moe" not in params["block1"]
+    assert params["block2"]["moe"]["wi"].shape[0] == 4  # experts
+
+
+def test_moe_lm_trains_and_aux_contributes(devices):
+    mesh = make_mesh(MeshSpec(data=2, seq=2), devices=devices[:4])
+    tx = optax.adam(3e-3)
+    st = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step = make_lm_train_step(SPEC, tx, mesh, donate=False)
+    toks = _tokens(8)
+    losses = []
+    for _ in range(5):
+        st, m = step(st, toks)
+        losses.append(float(m.loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+    # The aux term is part of the objective: a zero-weight spec yields
+    # a strictly different loss on the same params/tokens.
+    spec0 = SPEC._replace(aux_loss_weight=0.0)
+    st0 = create_lm_train_state(spec0, tx, mesh, seed=0)
+    step0 = make_lm_train_step(spec0, tx, mesh, donate=False)
+    _, m0 = step0(st0, toks)
+    st1 = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step1 = make_lm_train_step(SPEC, tx, mesh, donate=False)
+    _, m1 = step1(st1, toks)
+    assert float(m1.loss) > float(m0.loss)  # aux >= 1, weight > 0
+
+
+def test_moe_lm_composes_with_fsdp(devices):
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, seq=2), devices=devices[:8])
+    tx = optax.adam(1e-3)
+    st = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step = make_lm_train_step(SPEC, tx, mesh, donate=False)
+    st, m = step(st, _tokens(8, seed=2))
+    assert np.isfinite(float(m.loss))
+    # Expert weights [E, d, mlp] shard dim 0 over fsdp (E=4 % 2 == 0).
+    from jax.sharding import PartitionSpec as P
+
+    assert st.params["block2"]["moe"]["wi"].sharding.spec == P("fsdp")
+
+
+def test_moe_lm_through_trainer(tmp_path, devices):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=2,
+        batch_size=4,
+        model="causal_lm",
+        vocab_size=32,
+        seq_len=16,
+        model_depth=2,
+        moe_experts=4,
+        mesh_seq=2,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        log_interval=4,
+        eval_every=1,
+        optimizer="adam",
+        lr=3e-3,
+    )
+    t = Trainer(cfg)
+    summary = t.train()
+    t.close()
+    hist = summary["history"]
+    assert hist[-1]["mean_loss"] < hist[0]["mean_loss"]
+
+    # Resume continues cleanly (MoE state checkpoints like any other).
+    t2 = Trainer(TrainConfig(**{**cfg.__dict__, "epochs": 3}))
+    s2 = t2.train()
+    t2.close()
+    assert s2["epochs_run"] == 1
+
+
+def test_moe_rejected_outside_lm(tmp_path):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="moe_experts"):
+        Trainer(
+            TrainConfig(
+                model="simple_cnn", moe_experts=4, emulate_devices=8,
+                synthetic_data=True, synthetic_size=64,
+                checkpoint_dir=str(tmp_path / "ck"),
+                data_root=str(tmp_path / "data"),
+            )
+        )
