@@ -23,3 +23,14 @@ python train.py --model causal_lm \
     --emulate_devices 8 --synthetic_size 256 \
     --checkpoint_dir "$WORK/checkpoints_ulysses" --data_root "$WORK/data" \
     --log_interval 16
+
+# Mixture-of-Experts LM: every 2nd block's MLP routes through 4
+# GShard experts (aux load-balance loss in the objective), composed
+# with fsdp-sharded params:
+python train.py --model causal_lm \
+    --mesh_seq 2 --mesh_fsdp 2 --moe_experts 4 \
+    --seq_len 256 --vocab_size 64 \
+    --epochs 1 --batch_size 4 --optimizer adam --lr 0.003 \
+    --emulate_devices 8 --synthetic_size 256 \
+    --checkpoint_dir "$WORK/checkpoints_moe" --data_root "$WORK/data" \
+    --log_interval 16
