@@ -24,25 +24,40 @@ from jax import lax
 MASK_VALUE = -0.5 * jnp.finfo(jnp.float32).max
 
 
+# Below this many key/query positions the dense path wins on TPU: the
+# flash kernel pays per-grid-cell DMA/dispatch overhead that tiny
+# blocks never amortize (measured on a v5e, 2026-07: ViT-Tiny at
+# T=65 runs 2× FASTER dense; isolated attention crosses over between
+# T=1024 and 2048, where flash reaches 2.8× by T=4096 and the O(T²)
+# dense memory starts to matter anyway).
+FLASH_MIN_LEN = 1024
+
+
 def best_attention(*, causal: bool = False, block_q: int = 512,
                    block_k: int = 512):
-    """Platform-resolved default attention: flash kernel on TPU.
+    """Platform- and SIZE-resolved default attention.
 
-    On TPU this returns the compiled Pallas flash kernel (fused
-    forward + backward, O(T) memory — ops/flash.py); elsewhere the
-    dense XLA path, which is faster than interpreting the kernel on
-    CPU dev boxes. The model factories (vit/lm/seq/moe) call this when
-    no explicit ``attention_fn`` is given, so models are flash-by-
-    default on the hardware that has the kernel. Resolution happens at
-    model-construction time (the platform is fixed per process).
+    Returns a ``(q, k, v) -> out`` fn that picks per call (shapes are
+    static at trace time): the compiled Pallas flash kernel on TPU for
+    sequences of at least ``FLASH_MIN_LEN`` keys — fused
+    forward+backward, O(T) memory (ops/flash.py) — and the dense XLA
+    path otherwise (short sequences, where the kernel's per-block
+    overhead loses to one fused einsum chain, and every non-TPU
+    platform). The model factories (vit/lm/seq/moe) call this when no
+    explicit ``attention_fn`` is given.
     """
-    from ddp_tpu.ops.flash import make_flash_attention
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        return partial(dot_product_attention, causal=causal)
 
-    if jax.devices()[0].platform == "tpu":
-        return make_flash_attention(
-            causal=causal, block_q=block_q, block_k=block_k, interpret=False
-        )
-    return partial(dot_product_attention, causal=causal)
+    from ddp_tpu.ops.flash import flash_attention
+
+    def fn(q, k, v):
+        if k.shape[1] >= FLASH_MIN_LEN:
+            return flash_attention(q, k, v, causal, block_q, block_k, False)
+        return dot_product_attention(q, k, v, causal=causal)
+
+    return fn
 
 
 def dot_product_attention(q, k, v, *, causal: bool = False):

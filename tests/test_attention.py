@@ -45,3 +45,59 @@ def test_bf16_inputs_fp32_softmax(qkv):
     np.testing.assert_allclose(
         np.asarray(blk, np.float32), np.asarray(dense, np.float32), rtol=3e-2, atol=3e-2
     )
+
+
+class TestBestAttentionDispatch:
+    """The TPU size dispatch (FLASH_MIN_LEN) is CPU-testable via a
+    faked platform + recording stub — the comparison direction and the
+    positional kernel call can't silently regress."""
+
+    def _fake_tpu(self, monkeypatch):
+        import types
+
+        import jax
+
+        from ddp_tpu.ops import attention as attn_mod
+
+        monkeypatch.setattr(
+            jax, "devices",
+            lambda *a, **k: [types.SimpleNamespace(platform="tpu")],
+        )
+        calls = []
+
+        def fake_flash(q, k, v, causal, block_q, block_k, interpret):
+            calls.append(
+                dict(
+                    T=q.shape[1], causal=causal, block_q=block_q,
+                    block_k=block_k, interpret=interpret,
+                )
+            )
+            return attn_mod.dot_product_attention(q, k, v, causal=causal)
+
+        import ddp_tpu.ops.flash as flash_mod
+
+        monkeypatch.setattr(flash_mod, "flash_attention", fake_flash)
+        return calls
+
+    def test_long_sequences_use_flash(self, monkeypatch):
+        from ddp_tpu.ops.attention import FLASH_MIN_LEN, best_attention
+
+        calls = self._fake_tpu(monkeypatch)
+        fn = best_attention(causal=True)
+        T = FLASH_MIN_LEN
+        q = jnp.zeros((1, T, 2, 8))
+        fn(q, q, q)
+        assert calls and calls[0]["T"] == T
+        assert calls[0]["causal"] is True
+        assert calls[0]["interpret"] is False
+        assert calls[0]["block_q"] == 512 and calls[0]["block_k"] == 512
+
+    def test_short_sequences_use_dense(self, monkeypatch):
+        from ddp_tpu.ops.attention import FLASH_MIN_LEN, best_attention
+
+        calls = self._fake_tpu(monkeypatch)
+        fn = best_attention()
+        q = jnp.zeros((1, FLASH_MIN_LEN - 1, 2, 8))
+        out = fn(q, q, q)
+        assert calls == []  # dense path: the kernel never invoked
+        assert out.shape == q.shape
