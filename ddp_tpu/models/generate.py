@@ -151,8 +151,9 @@ def prefill(
     x = embed[prompt]  # [B, P, d]
     x = x + params["pos_embed"].astype(x.dtype)[:, :P]
     ck, cv = cache.k, cache.v
-    # Flash kernel on TPU, dense XLA elsewhere — prefill is a full
-    # causal attention over the prompt. Resolved once, like CausalLM.
+    # Size-dispatched (flash on TPU past FLASH_MIN_LEN, dense
+    # otherwise) — prefill is a full causal attention over the
+    # prompt. Resolved once, like CausalLM.
     attn_fn = best_attention(causal=True)
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]

@@ -49,8 +49,9 @@ class CausalLM(nn.Module):
     depth: int = 2
     num_heads: int = 4
     mlp_ratio: int = 4
-    # None → ops.attention.best_attention(causal=True): Pallas flash
-    # kernel on TPU, dense XLA elsewhere.
+    # None → ops.attention.best_attention(causal=True): size-
+    # dispatched — flash kernel on TPU past FLASH_MIN_LEN, dense
+    # XLA otherwise.
     attention_fn: Optional[Callable] = None
     num_experts: int = 0  # 0 = dense MLPs everywhere
     moe_every: int = 2

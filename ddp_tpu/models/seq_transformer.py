@@ -50,7 +50,8 @@ class LongContextTransformer(nn.Module):
     depth: int = 2
     num_heads: int = 4
     mlp_ratio: int = 4
-    # None → best_attention(): flash on TPU, dense XLA elsewhere.
+    # None → best_attention(): size-dispatched (flash on TPU past
+    # FLASH_MIN_LEN, dense XLA otherwise).
     attention_fn: Optional[Callable] = None
     pool_fn: Callable = lambda x: x.mean(axis=1)
     # jax.checkpoint each block — the natural pairing with sequence

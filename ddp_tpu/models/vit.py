@@ -31,9 +31,11 @@ class MultiHeadAttention(nn.Module):
     """QKV projection + pluggable attention kernel + output projection.
 
     ``attention_fn=None`` (the default everywhere in the model zoo)
-    resolves to ``ops.attention.best_attention()`` at call time: the
-    Pallas flash kernel on TPU, dense XLA elsewhere. Passing a callable
-    overrides it (ring/Ulysses collectives, causal variants, tests).
+    resolves to ``ops.attention.best_attention()`` at call time: on
+    TPU the Pallas flash kernel for sequences past FLASH_MIN_LEN and
+    dense XLA below it (where the kernel's per-block overhead loses);
+    dense everywhere else. Passing a callable overrides it
+    (ring/Ulysses collectives, causal variants, tests).
     """
 
     num_heads: int

@@ -65,15 +65,20 @@ def dot_product_attention(q, k, v, *, causal: bool = False):
 
     [B, T, H, D] in/out. Softmax runs in fp32 regardless of input dtype
     (bf16-safe); the two matmuls stay in the input dtype for the MXU.
-    ``causal=True`` masks position t from keys s > t (q and k must
-    cover the same positions).
+    ``causal=True`` masks strictly-future keys, END-anchored when
+    T != S (query t sees keys up to t + S − T — the KV-cache/chunked
+    convention, and exactly the flash kernel's mask, so the size
+    dispatch in ``best_attention`` can never change the attention
+    pattern); for square T == S this is the ordinary lower triangle.
     """
     dtype = q.dtype
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
     if causal:
         T, S = logits.shape[-2:]
-        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+        mask = (
+            jnp.arange(T)[:, None] + (S - T) >= jnp.arange(S)[None, :]
+        )
         logits = jnp.where(mask, logits, MASK_VALUE)
     weights = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhts,bshd->bthd", weights.astype(dtype), v)

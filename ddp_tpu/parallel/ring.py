@@ -186,9 +186,9 @@ def ulysses_attention(
     from ddp_tpu.ops.attention import best_attention
 
     if attention_fn is None:
-        # Flash kernel on TPU, dense XLA elsewhere — after the
-        # all-to-all the local [B, T, H/n, D] tensor is an ordinary
-        # full-sequence attention problem.
+        # Size-dispatched (flash on TPU past FLASH_MIN_LEN, dense
+        # otherwise) — after the all-to-all the local [B, T, H/n, D]
+        # tensor is an ordinary full-sequence attention problem.
         attention_fn = best_attention(causal=causal)
     elif causal:
         raise ValueError("pass causality through your attention_fn")

@@ -101,3 +101,20 @@ class TestBestAttentionDispatch:
         out = fn(q, q, q)
         assert calls == []  # dense path: the kernel never invoked
         assert out.shape == q.shape
+
+
+def test_causal_rectangular_is_end_anchored():
+    """dot_product_attention's rectangular causal mask matches the
+    flash kernel's KV-cache convention (query t sees keys up to
+    t + S − T) — the size dispatch can never change the pattern."""
+    from ddp_tpu.ops.flash import flash_attention
+
+    rng = np.random.default_rng(17)
+    q = jnp.asarray(rng.normal(size=(1, 4, 2, 8)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 16, 2, 8)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 16, 2, 8)).astype(np.float32))
+    dense = dot_product_attention(q, k, v, causal=True)
+    flash = flash_attention(q, k, v, True, 4, 8, True)
+    np.testing.assert_allclose(
+        np.asarray(dense), np.asarray(flash), atol=2e-5
+    )
