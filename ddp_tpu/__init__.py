@@ -21,6 +21,20 @@ Layer map (mirrors SURVEY.md §1, re-homed for TPU):
 
 __version__ = "0.2.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Some TPU platform plugins (e.g. the axon tunnel) pin
+    # jax_platforms at import, overriding the JAX_PLATFORMS env var.
+    # Honor the env var explicitly, once, for every consumer of the
+    # package — offline/CPU-forced invocations (tests, scripts, dev
+    # boxes) must never touch the TPU tunnel, and must not hang when
+    # it is unreachable. Safe here: importing jax does not initialize
+    # a backend, and this runs before any jax USE by the package.
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from ddp_tpu.runtime.dist import DistContext, setup, cleanup  # noqa: F401
 from ddp_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
 

@@ -27,6 +27,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Importing the package applies the JAX_PLATFORMS env pin (see
+# ddp_tpu/__init__.py): CPU-forced invocations never touch the TPU
+# tunnel, and never hang when it is unreachable.
+import ddp_tpu  # noqa: F401,E402
+
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)

@@ -22,6 +22,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Importing the package applies the JAX_PLATFORMS env pin (see
+# ddp_tpu/__init__.py): CPU-forced invocations never touch the TPU
+# tunnel, and never hang when it is unreachable.
+import ddp_tpu  # noqa: F401,E402
+
 
 def _tree_stats(meta) -> tuple[int, int]:
     """(leaf element count, bytes) for a metadata subtree."""
