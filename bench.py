@@ -50,9 +50,21 @@ def run_bench(
 
     devices = jax.devices()
     platform = devices[0].platform
+    if platform != "tpu":
+        # Off-TPU this bench is a smoke/fallback record, not a perf
+        # claim — shrink the workload so a CPU run (e.g. the tunnel-
+        # outage fallback in __main__) finishes in minutes, not an
+        # hour. The record's ``platform`` field marks it.
+        global_batch_size = min(global_batch_size, 256)
+        warmup_epochs = min(warmup_epochs, 1)
+        timed_epochs = min(timed_epochs, 1)
     mesh = make_mesh(MeshSpec(data=len(devices)), devices=devices)
 
     train = mnist.load("./data", "train", allow_synthetic=True)
+    if platform != "tpu":
+        train = train._replace(
+            images=train.images[:2048], labels=train.labels[:2048]
+        )
     n = (train.images.shape[0] // global_batch_size) * global_batch_size
     images, labels = device_put_dataset(
         train.images[:n], train.labels[:n], mesh
@@ -374,8 +386,81 @@ def _run_extra_benches() -> None:
     print(json.dumps(extra), file=sys.stderr)
 
 
+def _ensure_live_backend(probe_timeout: float = 120.0) -> None:
+    """Fall back to CPU if TPU backend init would hang.
+
+    The axon tunnel, when unreachable, makes backend initialization
+    sleep forever — a hang where the driver expects a JSON line.
+    Probe device discovery in a THROWAWAY subprocess with a timeout;
+    on failure, force this process onto CPU (the headline record
+    carries ``platform`` so a fallback run is self-describing).
+    """
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("JAX_PLATFORMS"):
+        return  # caller already pinned a platform
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout,
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        print(
+            "bench: TPU backend unreachable — falling back to CPU",
+            file=sys.stderr,
+        )
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _cpu_reexec(reason: str) -> None:
+    """Replace this process with a CPU-pinned re-run of the bench."""
+    import os
+    import sys
+
+    print(f"bench: {reason} — re-exec on CPU", file=sys.stderr)
+    os.execve(
+        sys.executable,
+        [sys.executable, os.path.abspath(__file__)],
+        dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+
+
 if __name__ == "__main__":
+    import os
+    import threading
+
+    pinned = bool(os.environ.get("JAX_PLATFORMS"))
+    _ensure_live_backend()
+    # A flapping tunnel can pass the probe and still hang (not raise)
+    # in the real backend init — `except` can't catch a hang, so a
+    # watchdog re-execs on CPU if the headline run exceeds a window
+    # far above its normal ~2-3 min. Caller-pinned platforms opt out
+    # of every fallback: a pin means that-platform-or-fail.
+    watchdog = threading.Timer(
+        900.0, _cpu_reexec, args=("TPU run exceeded 900s (hung backend?)",)
+    )
+    watchdog.daemon = True
+    if not pinned:
+        watchdog.start()
+    try:
+        result = run_bench()
+    except Exception:
+        # The flapping tunnel's OTHER failure mode: a fast error.
+        # The backend registry cannot be re-initialized in-process —
+        # re-exec once, pinned to CPU, so the driver still gets its
+        # JSON line (the record's platform field marks it).
+        if pinned:
+            raise
+        _cpu_reexec("TPU backend failed mid-run")
+    watchdog.cancel()
     # Headline line FIRST — a crash in the heavier side benches must
     # not lose the already-computed driver-contract output.
-    print(json.dumps(run_bench()), flush=True)
+    print(json.dumps(result), flush=True)
     _run_extra_benches()
