@@ -330,6 +330,10 @@ def run_loader_bench(
 
     import os
 
+    from ddp_tpu.data.loader import ShardedLoader
+
+    batch_bytes = batch * side * side * 3
+    pool_engaged = ShardedLoader.pool_would_engage(batch_bytes)
     result = {
         "metric": "loader_batch_assembly",
         "shape": [batch, side, side, 3],
@@ -337,8 +341,11 @@ def run_loader_bench(
         "native_available": native.available(),
         # The pool's win conditions are (a) >1 host core and (b)
         # overlap with device compute; a raw assembly race on a 1-core
-        # box measures its ring overhead instead. Record the context.
+        # box measures its ring overhead instead. Record the context
+        # and what ShardedLoader's gate (bytes >= POOL_MIN_BATCH_BYTES
+        # AND >1 core) would decide for this shape on this host.
         "cpu_count": os.cpu_count(),
+        "pool_gate_would_engage": pool_engaged,
     }
     if native.available():
         pre = native.NativePrefetcher(images, labels, batch, num_workers=2)
@@ -371,6 +378,7 @@ def _run_extra_benches() -> None:
     if jax.devices()[0].platform != "tpu":
         return
     extra = {}
+    out = pathlib.Path(__file__).with_name("BENCH_EXTRA.json")
     for name, fn in [
         ("vit", run_vit_bench),
         ("lm", run_lm_bench),
@@ -380,87 +388,226 @@ def _run_extra_benches() -> None:
             extra[name] = fn()
         except Exception:  # record, never break the headline bench
             extra[name] = {"error": traceback.format_exc(limit=3)}
-    pathlib.Path(__file__).with_name("BENCH_EXTRA.json").write_text(
-        json.dumps(extra, indent=2)
-    )
+        # Write after every entry: a supervisor timeout mid-extras
+        # keeps whatever completed instead of losing the whole file.
+        out.write_text(json.dumps(extra, indent=2))
     print(json.dumps(extra), file=sys.stderr)
 
 
-def _ensure_live_backend(probe_timeout: float = 120.0) -> None:
-    """Fall back to CPU if TPU backend init would hang.
+# --- capture supervision (VERDICT.md round-2 "do this" #1) -----------
+#
+# Round 2 lost its driver-verified TPU record to a transient tunnel
+# outage: the environment pre-pins JAX_PLATFORMS, the old fallbacks all
+# opted out when pinned ("a pin means that-platform-or-fail"), and the
+# backend-init exception propagated as rc=1 / parsed=null. The contract
+# is now: this script ALWAYS prints one parseable JSON line and exits 0.
+# Architecture: __main__ is a supervisor that runs the measurement in a
+# worker subprocess (``bench.py --worker``) — a subprocess boundary is
+# the only way to retry backend init (the in-process registry cannot be
+# re-initialized) and the only way to bound a *hang* (the tunnel's other
+# failure mode: backend init sleeps forever, which no `except` catches).
 
-    The axon tunnel, when unreachable, makes backend initialization
-    sleep forever — a hang where the driver expects a JSON line.
-    Probe device discovery in a THROWAWAY subprocess with a timeout;
-    on failure, force this process onto CPU (the headline record
-    carries ``platform`` so a fallback run is self-describing).
+# The probe imports ddp_tpu first: platform plugins (the axon tunnel)
+# pin jax_platforms at import time, overriding the JAX_PLATFORMS env
+# var — the package re-applies the env var so a CPU-pinned probe (and
+# the CPU fallback worker) really stays off the tunnel.
+_PROBE_SRC = "import ddp_tpu, jax; print(jax.devices()[0].platform)"
+
+
+def _probe_backend(timeout: float) -> bool:
+    """Can a fresh process see a device under the current env?
+
+    Runs from this file's directory so ``import ddp_tpu`` resolves
+    regardless of the caller's cwd — a probe that fails on ImportError
+    would be indistinguishable from a tunnel outage and mislabel a
+    healthy-TPU run as a CPU fallback.
     """
     import os
     import subprocess
     import sys
 
-    if os.environ.get("JAX_PLATFORMS"):
-        return  # caller already pinned a platform
     try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout,
-            check=True,
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            timeout=timeout,
             capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print(
-            "bench: TPU backend unreachable — falling back to CPU",
-            file=sys.stderr,
+    except subprocess.TimeoutExpired:
+        return False
+    if proc.returncode == 0:
+        return True
+    if "ImportError" in proc.stderr or "ModuleNotFoundError" in proc.stderr:
+        # Not a backend problem — surface it instead of retrying/
+        # falling back with a misleading record.
+        raise RuntimeError(
+            f"bench probe failed to import: {proc.stderr[-1500:]}"
         )
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    return False
 
 
-def _cpu_reexec(reason: str) -> None:
-    """Replace this process with a CPU-pinned re-run of the bench."""
+def _run_worker(env: dict, timeout: float) -> dict | None:
+    """Run ``bench.py --worker``; return its parsed headline record.
+
+    Relays the worker's stderr (extras, notes). Returns None on
+    timeout, non-zero exit, or unparseable stdout — the supervisor
+    decides what to try next.
+    """
     import os
+    import subprocess
     import sys
 
-    print(f"bench: {reason} — re-exec on CPU", file=sys.stderr)
-    os.execve(
-        sys.executable,
-        [sys.executable, os.path.abspath(__file__)],
-        dict(os.environ, JAX_PLATFORMS="cpu"),
+    def _decode(s) -> str:
+        return s.decode(errors="replace") if isinstance(s, bytes) else (s or "")
+
+    def _scan_for_record(stdout: str) -> dict | None:
+        for line in reversed(stdout.splitlines()):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+        return None
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired as e:
+        print(f"bench: worker timed out after {timeout:.0f}s", file=sys.stderr)
+        print(_decode(e.stderr)[-2000:], file=sys.stderr)
+        # The worker prints the headline record FIRST, then runs the
+        # heavy side benches — a timeout in the extras must not discard
+        # an already-valid headline (the round-2 loss mode).
+        rec = _scan_for_record(_decode(e.stdout))
+        if rec is not None:
+            rec["note"] = f"worker timed out after record ({timeout:.0f}s)"
+        return rec
+    print(proc.stderr[-8000:], file=sys.stderr, end="")
+    rec = _scan_for_record(proc.stdout)
+    if rec is not None:
+        if proc.returncode != 0:
+            rec["note"] = f"worker exited rc={proc.returncode} after record"
+        return rec
+    # (The stderr tail was already relayed above.)
+    print(
+        f"bench: worker rc={proc.returncode}, no JSON record",
+        file=sys.stderr,
     )
+    return None
+
+
+# Global wall-clock budget for the whole capture. Every stage draws
+# from one deadline so the worst case is bounded by construction
+# (probes + retries + worker + CPU fallback all fit), not by summing
+# per-stage timeouts. 35 min total; the CPU fallback's reservation
+# guarantees it always gets a usable window even after a worker that
+# burns its whole allowance.
+_TOTAL_BUDGET_S = 2100.0
+_CPU_RESERVE_S = 700.0
+
+
+def _supervise() -> dict:
+    """Bounded-retry capture: pinned env first, then CPU, never fail.
+
+    Plan, all drawing on one ``_TOTAL_BUDGET_S`` deadline:
+      1-3. probe the inherited env (120 s timeout each, 45 s backoff) —
+           a flapping tunnel often comes back within minutes;
+      4.   first probe success → worker run with every remaining
+           second except the CPU reservation (the budget covers the
+           headline AND the side benches; a timeout after the headline
+           line still keeps the headline, see _run_worker);
+      5.   worker failed or probes exhausted → CPU worker on the rest
+           of the budget (no extras run off-TPU); ``platform: "cpu"``
+           marks the fallback;
+      6.   even that failed → structured error record, still rc 0.
+    """
+    import os
+    import sys
+    import time
+
+    deadline = time.monotonic() + _TOTAL_BUDGET_S
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    env = dict(os.environ)
+    attempts: list[str] = []
+    for i in range(3):
+        probe_budget = max(5.0, min(120.0, remaining() - _CPU_RESERVE_S))
+        if _probe_backend(timeout=probe_budget):
+            attempts.append(f"probe[{i}]: ok")
+            worker_budget = max(60.0, remaining() - _CPU_RESERVE_S)
+            rec = _run_worker(env, timeout=worker_budget)
+            if rec is not None:
+                label = "worker: " + rec.get("note", "ok")
+                rec["capture_attempts"] = attempts + [label]
+                return rec
+            attempts.append("worker: failed")
+            break
+        attempts.append(f"probe[{i}]: backend unreachable")
+        print(
+            f"bench: backend probe {i} failed under "
+            f"JAX_PLATFORMS={env.get('JAX_PLATFORMS') or '(unset)'!s}; "
+            "retrying in 45s",
+            file=sys.stderr,
+        )
+        if remaining() <= _CPU_RESERVE_S + 120.0:
+            attempts.append("probes: budget exhausted")
+            break
+        if i < 2:
+            time.sleep(45.0)
+    cpu_env = dict(env, JAX_PLATFORMS="cpu")
+    rec = _run_worker(cpu_env, timeout=max(60.0, remaining()))
+    if rec is not None:
+        rec["capture_attempts"] = attempts + [
+            "cpu worker: " + rec.get("note", "ok")
+        ]
+        return rec
+    attempts.append("cpu worker: failed")
+    return _error_record("all capture attempts failed", attempts)
+
+
+def _error_record(error: str, attempts: list[str]) -> dict:
+    return {
+        "metric": "mnist_ddp_train_throughput",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": error,
+        "capture_attempts": attempts,
+    }
 
 
 if __name__ == "__main__":
-    import os
-    import threading
+    import sys
 
-    pinned = bool(os.environ.get("JAX_PLATFORMS"))
-    _ensure_live_backend()
-    # A flapping tunnel can pass the probe and still hang (not raise)
-    # in the real backend init — `except` can't catch a hang, so a
-    # watchdog re-execs on CPU if the headline run exceeds a window
-    # far above its normal ~2-3 min. Caller-pinned platforms opt out
-    # of every fallback: a pin means that-platform-or-fail.
-    watchdog = threading.Timer(
-        900.0, _cpu_reexec, args=("TPU run exceeded 900s (hung backend?)",)
-    )
-    watchdog.daemon = True
-    if not pinned:
-        watchdog.start()
-    try:
+    if "--worker" in sys.argv:
+        # Measurement process: no fallbacks here — the supervisor owns
+        # retry/timeout policy. Headline line FIRST so a crash in the
+        # heavier side benches cannot lose the driver-contract output.
         result = run_bench()
-    except Exception:
-        # The flapping tunnel's OTHER failure mode: a fast error.
-        # The backend registry cannot be re-initialized in-process —
-        # re-exec once, pinned to CPU, so the driver still gets its
-        # JSON line (the record's platform field marks it).
-        if pinned:
-            raise
-        _cpu_reexec("TPU backend failed mid-run")
-    watchdog.cancel()
-    # Headline line FIRST — a crash in the heavier side benches must
-    # not lose the already-computed driver-contract output.
-    print(json.dumps(result), flush=True)
-    _run_extra_benches()
+        print(json.dumps(result), flush=True)
+        _run_extra_benches()
+    else:
+        # The one-parseable-line / rc-0 contract holds even if the
+        # supervisor itself blows up (OSError from subprocess spawn
+        # under memory pressure, etc.).
+        try:
+            record = _supervise()
+        except BaseException as e:  # noqa: BLE001 — contract over purity
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            record = _error_record(
+                f"supervisor crashed: {type(e).__name__}: {e}", []
+            )
+        print(json.dumps(record), flush=True)
+        sys.exit(0)

@@ -47,6 +47,22 @@ class ShardedLoader:
     # ImageNet-sized rows win).
     POOL_MIN_BATCH_BYTES = 1 << 20
 
+    @classmethod
+    def pool_would_engage(cls, batch_bytes: int) -> bool:
+        """The native-pool gate: big-enough batches AND a spare core.
+
+        Single source of the policy — the loader consults it at
+        construction and bench.py reports it alongside the loader
+        micro-bench so the recorded context cannot drift from the
+        code.
+        """
+        import os
+
+        return (
+            batch_bytes >= cls.POOL_MIN_BATCH_BYTES
+            and (os.cpu_count() or 1) >= 2
+        )
+
     def __init__(
         self,
         images: np.ndarray,
@@ -134,10 +150,7 @@ class ShardedLoader:
             batch_bytes = self.local_batch_size * int(
                 np.prod(images.shape[1:])
             )
-            if (
-                batch_bytes < self.POOL_MIN_BATCH_BYTES
-                or (_os.cpu_count() or 1) < 2
-            ):
+            if not self.pool_would_engage(batch_bytes):
                 # A worker pool is overhead, not help, when one batch
                 # gathers in microseconds (MNIST-sized rows) or when
                 # there is no spare core to run it on — the ticket/
