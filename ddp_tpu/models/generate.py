@@ -73,8 +73,11 @@ def _block_qkv(p, x, H, Dh):
     incremental decode (T=1) and the parallel prefill (T=P) so the two
     paths cannot drift numerically."""
     h = _layer_norm(x, p["ln1"]).astype(x.dtype)
-    qkv = _dense(h, p["attn"]["qkv"]).reshape(*x.shape[:2], 3, H, Dh)
-    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    # HEAD-MAJOR fused layout, mirroring models/vit.py
+    # MultiHeadAttention: columns ordered [head, (q|k|v), head_dim] so
+    # TP shards of the kernel are whole heads.
+    qkv = _dense(h, p["attn"]["qkv"]).reshape(*x.shape[:2], H, 3, Dh)
+    return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
 
 def _block_finish(p, x, attn_vec):

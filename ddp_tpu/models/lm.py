@@ -56,9 +56,18 @@ class CausalLM(nn.Module):
     num_experts: int = 0  # 0 = dense MLPs everywhere
     moe_every: int = 2
     remat: bool = False
+    # Megatron TP over the ``model`` mesh axis (shard_map-only):
+    # attention heads + MLP hidden shard, embeddings/LNs/tied head
+    # replicate (parallel/tp.py). Dense blocks only — expert
+    # parallelism owns the MoE sharding story.
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
+        assert not (self.num_experts and self.tp_size > 1), (
+            "TP shards dense blocks; shard experts with --mesh_expert"
+        )
         embed = self.param(
             "embed",
             nn.initializers.normal(stddev=0.02),
@@ -94,6 +103,8 @@ class CausalLM(nn.Module):
                     num_heads=self.num_heads,
                     mlp_dim=self.d_model * self.mlp_ratio,
                     attention_fn=attn_fn,
+                    tp_axis=self.tp_axis,
+                    tp_size=self.tp_size,
                     name=f"block{i + 1}",
                 )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
@@ -127,7 +138,7 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
     )
 
 
-def _sharded_lm(spec: LMSpec) -> CausalLM:
+def _sharded_lm(spec: LMSpec, *, tp_size: int = 1) -> CausalLM:
     def attention(q, k, v):
         return sequence_sharded_attention(
             q, k, v, axis_name="seq", strategy=spec.strategy, causal=True
@@ -143,6 +154,8 @@ def _sharded_lm(spec: LMSpec) -> CausalLM:
         num_experts=spec.num_experts,
         moe_every=spec.moe_every,
         remat=spec.remat,
+        tp_axis="model" if tp_size > 1 else None,
+        tp_size=tp_size,
     )
 
 
@@ -220,9 +233,13 @@ def create_lm_train_state(
 
 def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
     from ddp_tpu.models.seq_transformer import _batch_axes
-    from ddp_tpu.parallel.seq_fsdp import fsdp_specs, gather_fsdp
+    from ddp_tpu.parallel.tp import (
+        gather_sharded,
+        seq_param_specs,
+        tp_size as mesh_tp_size,
+    )
 
-    model = _sharded_lm(spec)
+    model = _sharded_lm(spec, tp_size=mesh_tp_size(mesh))
     baxes = _batch_axes(mesh)
     xspec = P(baxes, "seq")
 
@@ -231,11 +248,11 @@ def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
         scalar — 0.0 for dense specs or ``want_aux=False``, which also
         skips the aux collection and its cross-device mean: eval has
         no use for the routing penalty)."""
-        pspecs = fsdp_specs(params, mesh)
+        pspecs = seq_param_specs(params, mesh)
         collect_aux = bool(spec.num_experts) and want_aux
 
         def per_shard_forward(params, tok_shard):
-            params = gather_fsdp(params, pspecs)
+            params = gather_sharded(params, pspecs)
             t_local = tok_shard.shape[1]
             offset = lax.axis_index("seq") * t_local
             if compute_dtype != jnp.float32:

@@ -58,6 +58,10 @@ class LongContextTransformer(nn.Module):
     # parallelism: long contexts are exactly where activations dominate
     # HBM (see models/vit.py ViT.remat).
     remat: bool = False
+    # Megatron TP over ``model`` (parallel/tp.py): blocks shard heads
+    # + MLP hidden; embed/head/LNs/pos stay replicated.
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
@@ -77,6 +81,8 @@ class LongContextTransformer(nn.Module):
                 num_heads=self.num_heads,
                 mlp_dim=self.d_model * self.mlp_ratio,
                 attention_fn=self.attention_fn,
+                tp_axis=self.tp_axis,
+                tp_size=self.tp_size,
                 name=f"block{i + 1}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
@@ -108,7 +114,9 @@ def _dense_model(spec: SeqTransformerSpec) -> LongContextTransformer:
     )
 
 
-def _sharded_model(spec: SeqTransformerSpec) -> LongContextTransformer:
+def _sharded_model(
+    spec: SeqTransformerSpec, *, tp_size: int = 1
+) -> LongContextTransformer:
     def attention(q, k, v):
         return sequence_sharded_attention(
             q, k, v, axis_name="seq", strategy=spec.strategy
@@ -127,6 +135,8 @@ def _sharded_model(spec: SeqTransformerSpec) -> LongContextTransformer:
         attention_fn=attention,
         pool_fn=pool,
         remat=spec.remat,
+        tp_axis="model" if tp_size > 1 else None,
+        tp_size=tp_size,
     )
 
 
@@ -173,18 +183,22 @@ def make_seq_parallel_apply(
     collectives' payloads) in bf16 — LayerNorms and the head stay fp32
     by module dtype; master params remain fp32 outside.
     """
-    from ddp_tpu.parallel.seq_fsdp import fsdp_specs, gather_fsdp
+    from ddp_tpu.parallel.tp import (
+        gather_sharded,
+        seq_param_specs,
+        tp_size as mesh_tp_size,
+    )
 
-    model = _sharded_model(spec)
+    model = _sharded_model(spec, tp_size=mesh_tp_size(mesh))
     baxes = _batch_axes(mesh)
     bspec = P(baxes)
     xspec = P(baxes, "seq")
 
     def apply_fn(params, x):
-        pspecs = fsdp_specs(params, mesh)
+        pspecs = seq_param_specs(params, mesh)
 
         def per_shard(params, x_shard):
-            params = gather_fsdp(params, pspecs)
+            params = gather_sharded(params, pspecs)
             t_local = x_shard.shape[1]
             offset = lax.axis_index("seq") * t_local
             if compute_dtype != jnp.float32:
@@ -232,19 +246,20 @@ def replicated_train_state(
 def sharded_or_replicated_state(
     params, optimizer: optax.GradientTransformation, mesh: Mesh
 ) -> SeqTrainState:
-    """FSDP-sharded state when the mesh has ``fsdp`` > 1, else
-    replicated. Sharded path: params rest dim-0 sharded over ``fsdp``
-    (parallel/seq_fsdp.py) and ``optimizer.init`` on them makes the
-    moments inherit the same placement (``zeros_like`` preserves
-    shardings), so Adam memory shards too; unshardable leaves and
-    scalars replicate.
+    """Sharded state when the mesh has ``fsdp`` or ``model`` > 1, else
+    replicated. Sharded path: params rest per parallel/tp.py
+    ``seq_param_specs`` (fsdp dim-0 + Megatron model dims) and
+    ``optimizer.init`` on them makes the moments inherit the same
+    placement (``zeros_like`` preserves shardings), so Adam memory
+    shards too; unshardable leaves and scalars replicate.
     """
-    from ddp_tpu.parallel.seq_fsdp import fsdp_size, shard_fsdp_params
+    from ddp_tpu.parallel.seq_fsdp import fsdp_size
+    from ddp_tpu.parallel.tp import shard_seq_params, tp_size
 
-    if fsdp_size(mesh) <= 1:
+    if fsdp_size(mesh) <= 1 and tp_size(mesh) <= 1:
         return replicated_train_state(params, optimizer, mesh)
     rep = NamedSharding(mesh, P())
-    params = shard_fsdp_params(params, mesh)
+    params = shard_seq_params(params, mesh)
     opt_state = optimizer.init(params)
     # Scalars (Adam's count, schedule steps) came out uncommitted —
     # pin them replicated so the state's shardings are deterministic.

@@ -21,10 +21,38 @@ from typing import Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from ddp_tpu.ops.attention import best_attention
 
 AttentionFn = Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+class RowParallelDense(nn.Module):
+    """Megatron row-parallel Dense for use inside ``shard_map``.
+
+    The kernel's INPUT dim is sharded over ``axis_name`` — each mesh
+    member holds [d_in/tp, features] and contributes a partial
+    product, combined by one ``lax.psum``; the bias (replicated) is
+    added once, after the sum. Param tree paths (``kernel``/``bias``
+    under the module name) match ``nn.Dense`` exactly, so a densely
+    initialized checkpoint shards onto this module without renaming
+    (parallel/tp.py ``seq_param_specs``).
+    """
+
+    features: int
+    axis_name: str
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (x.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        y = lax.psum(x @ kernel.astype(x.dtype), self.axis_name)
+        return y + bias.astype(y.dtype)
 
 
 class MultiHeadAttention(nn.Module):
@@ -36,48 +64,84 @@ class MultiHeadAttention(nn.Module):
     dense XLA below it (where the kernel's per-block overhead loses);
     dense everywhere else. Passing a callable overrides it
     (ring/Ulysses collectives, causal variants, tests).
+
+    ``tp_axis``/``tp_size`` (shard_map-only): Megatron tensor
+    parallelism — qkv goes column-parallel (this member computes
+    ``num_heads/tp_size`` heads; the attention kernel sees only local
+    heads, so TP composes freely with ring/Ulysses over ``seq``) and
+    the output projection row-parallel with one psum (parallel/tp.py).
     """
 
     num_heads: int
     attention_fn: Optional[AttentionFn] = None
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         B, T, C = x.shape
         assert C % self.num_heads == 0, (C, self.num_heads)
+        assert self.num_heads % self.tp_size == 0, (
+            self.num_heads, self.tp_size,
+        )
         head_dim = C // self.num_heads
-        qkv = nn.Dense(3 * C, name="qkv")(x)
-        qkv = qkv.reshape(B, T, 3, self.num_heads, head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        heads_local = self.num_heads // self.tp_size
+        # HEAD-MAJOR qkv layout: the fused kernel's output columns are
+        # ordered [head, (q|k|v), head_dim], so a contiguous shard of
+        # the output dim — what P(..., "model") hands each TP member —
+        # is a whole number of heads with their complete q, k, AND v.
+        # (A (q|k|v)-major layout would hand member 0 "all of Q plus
+        # half of K" under TP.) generate.py mirrors this layout.
+        qkv = nn.Dense(3 * C // self.tp_size, name="qkv")(x)
+        qkv = qkv.reshape(B, T, heads_local, 3, head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         fn = self.attention_fn or best_attention()
-        out = fn(q, k, v)  # [B, T, H, D]
-        out = out.reshape(B, T, C)
+        out = fn(q, k, v)  # [B, T, H_local, D]
+        out = out.reshape(B, T, C // self.tp_size)
+        if self.tp_size > 1:
+            return RowParallelDense(C, self.tp_axis, name="proj")(out)
         return nn.Dense(C, name="proj")(out)
 
 
 class EncoderBlock(nn.Module):
     """Pre-LN block. ``deterministic`` is a module attribute (not a call
     kwarg) so ``nn.remat(EncoderBlock)`` traces only the activation —
-    a traced bool would break Dropout/BatchNorm's Python branching."""
+    a traced bool would break Dropout/BatchNorm's Python branching.
+
+    ``tp_axis``/``tp_size``: Megatron tensor parallelism inside a
+    shard_map — attention heads and the MLP hidden dim shard over the
+    ``model`` mesh axis, two psums per block (after attn/proj and
+    mlp2); LayerNorms and the residual stream stay replicated
+    (parallel/tp.py has the layout + gradient-exactness story)."""
 
     num_heads: int
     mlp_dim: int
     dropout_rate: float = 0.0
     attention_fn: Optional[AttentionFn] = None
     deterministic: bool = True
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x):
+        assert self.mlp_dim % self.tp_size == 0, (self.mlp_dim, self.tp_size)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
         y = MultiHeadAttention(
-            self.num_heads, attention_fn=self.attention_fn, name="attn"
+            self.num_heads,
+            attention_fn=self.attention_fn,
+            tp_axis=self.tp_axis,
+            tp_size=self.tp_size,
+            name="attn",
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(x.dtype)
-        y = nn.Dense(self.mlp_dim, name="mlp1")(y)
+        y = nn.Dense(self.mlp_dim // self.tp_size, name="mlp1")(y)
         y = nn.gelu(y)
-        y = nn.Dense(x.shape[-1], name="mlp2")(y)
+        if self.tp_size > 1:
+            y = RowParallelDense(x.shape[-1], self.tp_axis, name="mlp2")(y)
+        else:
+            y = nn.Dense(x.shape[-1], name="mlp2")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         return x + y
 

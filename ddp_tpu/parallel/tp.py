@@ -1,0 +1,146 @@
+"""Tensor parallelism for the shard_map sequence family (Megatron
+column/row pairing).
+
+The image/GSPMD family gets TP by annotation (parallel/spmd.py
+ShardingRules); the sequence family cannot ride that path — ring/
+Ulysses attention needs an explicit ``shard_map`` over ``seq`` — so
+this module supplies TP *inside* the shard_map body, the layout the
+reference's stack inherits from Megatron-style sharded layers
+(generalizing /root/reference/train_ddp.py:199's inherited parallel
+machinery; SURVEY.md §2c TP row: "mesh design should leave a `model`
+axis possible").
+
+Layout per transformer block, ``model`` axis of size ``tp``:
+
+- ``attn/qkv``  — COLUMN parallel: kernel [d, 3d] shards its output
+  dim, each member holds ``num_heads/tp`` heads end to end through
+  the attention kernel (heads are embarrassingly parallel — ring/
+  Ulysses hop over ``seq`` per head, so the two axes compose freely);
+- ``attn/proj`` — ROW parallel: kernel [d, d] shards its input dim;
+  each member contributes a partial [B, T, d] product, combined by
+  ONE ``lax.psum`` over ``model`` (models/vit.py RowParallelDense);
+- ``mlp1``      — COLUMN parallel on the hidden dim;
+- ``mlp2``      — ROW parallel, the block's second psum.
+
+Everything else (LayerNorms, embeddings, the tied LM head, position
+tables) stays replicated over ``model``. No Megatron-style f/g
+custom-VJP ops and no gradient rescaling are needed: ``shard_map``'s
+transpose handles the whole structure exactly — replicated-input
+cotangents are psum'd over unmentioned axes only where the forward
+actually diverged, which was verified numerically (dense-reference
+gradient parity for replicated LN/head/pos params, sharded kernels,
+and the residual stream; adding f/g double-counts — see
+tests/test_tp.py).
+
+FSDP composes orthogonally: a leaf can shard dim 0 over ``fsdp`` and
+dim 1 over ``model`` (or vice versa). ``gather_sharded`` all-gathers
+ONLY the fsdp dims inside the step — model dims stay local, that is
+the point of TP — and AD transposes each gather into a psum_scatter
+(the ZeRO reduce-scatter, parallel/seq_fsdp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.parallel.seq_fsdp import fsdp_size
+
+
+def tp_size(mesh: Mesh) -> int:
+    return int(mesh.shape.get("model", 1))
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+    )
+
+
+# Tree-path suffixes → which dim the ``model`` axis shards. The names
+# are the flax module names models/vit.py and models/lm.py create.
+_COLUMN_KERNELS = ("attn/qkv/kernel", "mlp1/kernel")  # dim 1 (output)
+_COLUMN_BIASES = ("attn/qkv/bias", "mlp1/bias")  # dim 0
+_ROW_KERNELS = ("attn/proj/kernel", "mlp2/kernel")  # dim 0 (input)
+
+
+def seq_param_specs(params: Any, mesh: Mesh) -> Any:
+    """Per-leaf PartitionSpec combining ``model`` (TP) and ``fsdp``.
+
+    With ``model`` size 1 this reduces exactly to
+    parallel/seq_fsdp.py ``fsdp_specs`` (dim 0 over ``fsdp`` where it
+    divides). With TP active, block kernels/biases take their
+    Megatron dim on ``model`` and the *other* kernel dim takes
+    ``fsdp`` where divisible; everything else falls back to the fsdp
+    rule. Pure function of leaf shapes+paths — step builder and state
+    builder recompute it independently and always agree.
+    """
+    tp = tp_size(mesh)
+    n = fsdp_size(mesh)
+
+    def fsdp_dim0(shape):
+        if n > 1 and len(shape) >= 1 and shape[0] > 0 and shape[0] % n == 0:
+            return P("fsdp")
+        return P()
+
+    def spec(path, leaf):
+        shape = jnp.shape(leaf)
+        if tp > 1:
+            p = _path_str(path)
+            if p.endswith(_COLUMN_KERNELS):
+                _check_divides(p, shape[1], tp)
+                d0 = (
+                    "fsdp" if n > 1 and shape[0] % n == 0 else None
+                )
+                return P(d0, "model")
+            if p.endswith(_COLUMN_BIASES):
+                _check_divides(p, shape[0], tp)
+                return P("model")
+            if p.endswith(_ROW_KERNELS):
+                _check_divides(p, shape[0], tp)
+                d1 = (
+                    "fsdp" if n > 1 and shape[1] % n == 0 else None
+                )
+                return P("model", d1)
+        return fsdp_dim0(shape)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def _check_divides(path: str, dim: int, tp: int) -> None:
+    if dim % tp:
+        raise ValueError(
+            f"tensor parallelism: {path} dim {dim} not divisible by "
+            f"the model-axis size {tp} (num_heads and mlp_dim must "
+            f"both divide by --mesh_model)"
+        )
+
+
+def shard_seq_params(params: Any, mesh: Mesh) -> Any:
+    """Place params at rest per ``seq_param_specs``."""
+    specs = seq_param_specs(params, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def gather_sharded(params: Any, specs: Any) -> Any:
+    """Inside shard_map: all-gather every ``fsdp`` dim; ``model`` dims
+    stay local (they ARE the tensor parallelism).
+
+    fp32 gather before any compute-dtype cast, so the transpose — the
+    gradient psum_scatter — reduces in fp32 (parallel/seq_fsdp.py
+    rationale).
+    """
+
+    def g(leaf, s):
+        for i, ax in enumerate(s):
+            if ax == "fsdp":
+                leaf = lax.all_gather(leaf, "fsdp", axis=i, tiled=True)
+        return leaf
+
+    return jax.tree.map(g, params, specs)

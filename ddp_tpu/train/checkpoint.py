@@ -42,6 +42,41 @@ from ddp_tpu.parallel.ddp import TrainState
 
 logger = logging.getLogger("ddp_tpu")
 
+# Checkpoint format version, saved as a ``fmt`` scalar alongside the
+# state. 2 = HEAD-MAJOR fused qkv layout (models/vit.py
+# MultiHeadAttention: kernel columns ordered [head, q|k|v, head_dim] so
+# contiguous TP shards are whole heads). Format-1 checkpoints (no
+# ``fmt`` key; q/k/v-major columns) have IDENTICAL shapes, so a silent
+# restore would scramble attention — restore refuses attention-bearing
+# format-1 trees and points at scripts/convert_qkv_layout.py instead.
+CHECKPOINT_FORMAT = 2
+
+
+def _has_fused_qkv(tree: Any) -> bool:
+    """Does any leaf path contain an ``attn/qkv`` projection?"""
+    found = False
+
+    def visit(path, _):
+        nonlocal found
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if "qkv" in keys:
+            found = True
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return found
+
+
+def _check_qkv_format(fmt: int | None, tree: Any, source: str) -> None:
+    if (fmt or 1) < 2 and _has_fused_qkv(tree):
+        raise RuntimeError(
+            f"{source} predates the head-major fused-qkv layout "
+            f"(format {fmt or 1} < {CHECKPOINT_FORMAT}) and contains "
+            "attention weights — restoring it here would silently "
+            "scramble q/k/v across heads (same shapes, different "
+            "column order). Convert it once with "
+            "scripts/convert_qkv_layout.py --num_heads <H>."
+        )
+
 
 class CheckpointManager:
     """Per-epoch checkpoints with latest-epoch auto-resume.
@@ -171,6 +206,7 @@ class CheckpointManager:
             state._asdict(),
             spe=np.int32(steps_per_epoch),
             mid_batch=np.int32(mid_batch),
+            fmt=np.int32(CHECKPOINT_FORMAT),
         )
         self._mgr.save(
             epoch, args=ocp.args.StandardSave(tree), metrics=metrics
@@ -187,14 +223,16 @@ class CheckpointManager:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
         abstract["spe"] = jax.ShapeDtypeStruct((), np.int32)
         abstract["mid_batch"] = jax.ShapeDtypeStruct((), np.int32)
-        # Migration ladder: older checkpoints lack "mid_batch" (and
-        # before that "spe", and before that "model_state"); retry
-        # dropping the optional keys oldest-format-last.
+        abstract["fmt"] = jax.ShapeDtypeStruct((), np.int32)
+        # Migration ladder: older checkpoints lack "fmt" (and before
+        # that "mid_batch", "spe", "model_state"); retry dropping the
+        # optional keys oldest-format-last.
         ladder = (
             (),
-            ("mid_batch",),
-            ("mid_batch", "spe"),
-            ("mid_batch", "spe", "model_state"),
+            ("fmt",),
+            ("fmt", "mid_batch"),
+            ("fmt", "mid_batch", "spe"),
+            ("fmt", "mid_batch", "spe", "model_state"),
         )
         for drop in ladder:
             attempt = {k: v for k, v in abstract.items() if k not in drop}
@@ -209,6 +247,10 @@ class CheckpointManager:
                 if drop == ladder[-1]:
                     raise
         restored.setdefault("model_state", state_like.model_state)
+        fmt = int(restored.pop("fmt", 1))
+        _check_qkv_format(
+            fmt, restored["params"], f"checkpoint epoch {epoch}"
+        )
         self.last_restored_spe = int(restored.pop("spe", 0)) or None
         if "mid_batch" in restored:
             self.last_restored_mid_batch = int(restored.pop("mid_batch"))
@@ -291,7 +333,13 @@ class CheckpointManager:
             epoch = self.latest_epoch()
             if epoch is None:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
-        restored = self.read_partial(epoch, ("params", "model_state"))
+        restored = self.read_partial(epoch, ("params", "model_state", "fmt"))
+        fmt = restored.pop("fmt", None)
+        _check_qkv_format(
+            int(fmt) if fmt is not None else None,
+            restored["params"],
+            f"checkpoint epoch {epoch}",
+        )
         return restored["params"], restored.get("model_state", {}), epoch
 
     def restore_or_init(

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Convert format-1 checkpoints to the head-major fused-qkv layout.
+
+Round 3 reordered the fused qkv projection's output columns from
+[q|k|v, head, head_dim] to [head, q|k|v, head_dim] (models/vit.py
+MultiHeadAttention) so contiguous tensor-parallel shards of the kernel
+are whole heads. Shapes are identical, so old checkpoints would restore
+without error and silently scramble attention — the restore path
+refuses them (train/checkpoint.py ``_check_qkv_format``) and points
+here.
+
+    python scripts/convert_qkv_layout.py --checkpoint_dir ./checkpoints \
+        --num_heads 4 [--epoch N] [--out_dir ./checkpoints_fmt2]
+
+NON-DESTRUCTIVE: converted epochs are written to ``--out_dir``
+(default ``<checkpoint_dir>_fmt2``); the source directory is never
+touched, so a crash mid-conversion cannot destroy the only copy of an
+irreplaceable checkpoint. Point the trainer at the new directory when
+done. Every ``attn/qkv`` kernel (last dim) and bias is permuted in
+``params`` AND the optimizer state (Adam moments share the layout).
+
+Same-topology note: the source is read template-free (the conversion
+deliberately knows nothing about which model/optimizer produced it),
+which requires running under a device topology that can host the saved
+shardings — e.g. the same ``--xla_force_host_platform_device_count``
+the training run used, or any single-host layout for replicated saves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ddp_tpu  # noqa: F401,E402  (applies the JAX_PLATFORMS env pin)
+import numpy as np  # noqa: E402
+
+
+def permute_qkv_columns(tree, num_heads: int):
+    """[..., 3, H, Dh]-ordered trailing axis → [..., H, 3, Dh]."""
+    import jax
+
+    def fix(path, leaf):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if "qkv" not in keys:
+            return leaf
+        arr = np.asarray(leaf)
+        if arr.ndim == 0 or arr.shape[-1] % (3 * num_heads):
+            return leaf
+        dh = arr.shape[-1] // (3 * num_heads)
+        shaped = arr.reshape(*arr.shape[:-1], 3, num_heads, dh)
+        return np.swapaxes(shaped, -3, -2).reshape(arr.shape)
+
+    return jax.tree_util.tree_map_with_path(fix, tree)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint_dir", default="./checkpoints")
+    p.add_argument(
+        "--out_dir", default=None,
+        help="destination (default: <checkpoint_dir>_fmt2); the source "
+        "is left untouched",
+    )
+    p.add_argument("--num_heads", type=int, required=True)
+    p.add_argument(
+        "--epoch", type=int, default=None,
+        help="convert one epoch (default: every epoch in the dir)",
+    )
+    args = p.parse_args()
+    out_dir = args.out_dir or args.checkpoint_dir.rstrip("/\\") + "_fmt2"
+    if os.path.abspath(out_dir) == os.path.abspath(args.checkpoint_dir):
+        print("--out_dir must differ from --checkpoint_dir", file=sys.stderr)
+        return 2
+
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.train.checkpoint import (
+        CHECKPOINT_FORMAT,
+        CheckpointManager,
+    )
+
+    src = CheckpointManager(args.checkpoint_dir, async_save=False)
+    dst = CheckpointManager(out_dir, async_save=False)
+    epochs = [args.epoch] if args.epoch is not None else src.all_epochs()
+    if not epochs:
+        print(f"no checkpoints in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    for epoch in epochs:
+        # Template-free read: preserves the saved tree structure
+        # (optimizer states with empty nodes included) without knowing
+        # which model/optimizer wrote it. read_partial is no good here
+        # — its metadata-derived abstract tree chokes on the optimizer
+        # state's empty (None) nodes.
+        tree = dict(src._mgr.restore(epoch))
+        fmt = int(np.asarray(tree.pop("fmt", 1)))
+        if fmt >= CHECKPOINT_FORMAT:
+            print(f"epoch {epoch}: already format {fmt}, skipping")
+            continue
+        for key in ("params", "opt_state"):
+            if key in tree:
+                tree[key] = permute_qkv_columns(tree[key], args.num_heads)
+        state = TrainState(
+            step=tree["step"],
+            params=tree["params"],
+            opt_state=tree.get("opt_state", {}),
+            model_state=tree.get("model_state", {}),
+        )
+        dst.save(
+            epoch, state,
+            steps_per_epoch=int(np.asarray(tree.get("spe", 0))),
+            mid_batch=int(np.asarray(tree.get("mid_batch", 0))),
+        )
+        print(f"epoch {epoch}: converted to format {CHECKPOINT_FORMAT} "
+              f"→ {out_dir}")
+    src.close()
+    dst.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

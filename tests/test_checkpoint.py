@@ -6,6 +6,8 @@ epoch+1; optimizer state must actually round-trip (fixing the
 reference's silent drop at train_ddp.py:88).
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -198,3 +200,148 @@ class TestKeepBest:
         assert summary["epochs_run"] == 2
         kept = [d for d in os.listdir(cfg.checkpoint_dir) if "epoch" in d]
         assert 1 <= len(kept) <= 2  # best-1 plus (possibly same) latest
+
+
+class TestQkvFormat:
+    """Round-3 head-major qkv layout: format-1 attention checkpoints
+    are refused (same shapes, different column meaning) and the
+    conversion script's permutation is the exact inverse mapping."""
+
+    def _lm_state(self, mesh8):
+        from ddp_tpu.models.lm import LMSpec, create_lm_train_state
+
+        spec = LMSpec(vocab_size=32, total_len=16, d_model=16, depth=1,
+                      num_heads=2)
+        return create_lm_train_state(
+            spec, optax.sgd(0.01), mesh8, seed=0
+        )
+
+    def test_format1_attention_checkpoint_refused(
+        self, mesh8, tmp_ckpt_dir, monkeypatch
+    ):
+        import ddp_tpu.train.checkpoint as ckpt_mod
+        from ddp_tpu.parallel.ddp import TrainState
+
+        st = self._lm_state(mesh8)
+        state = TrainState(step=st.step, params=st.params,
+                           opt_state=st.opt_state, model_state={})
+        monkeypatch.setattr(ckpt_mod, "CHECKPOINT_FORMAT", 1)
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        with pytest.raises(RuntimeError, match="head-major"):
+            mgr.restore(state)
+        with pytest.raises(RuntimeError, match="head-major"):
+            mgr.restore_for_inference()
+        mgr.close()
+
+    def test_format2_checkpoint_restores(self, mesh8, tmp_ckpt_dir):
+        from ddp_tpu.parallel.ddp import TrainState
+
+        st = self._lm_state(mesh8)
+        state = TrainState(step=st.step, params=st.params,
+                           opt_state=st.opt_state, model_state={})
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        restored, _ = mgr.restore(state)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["block1"]["attn"]["qkv"]["kernel"]),
+            np.asarray(state.params["block1"]["attn"]["qkv"]["kernel"]),
+        )
+        mgr.close()
+
+    def test_convert_script_end_to_end(
+        self, mesh8, tmp_path, monkeypatch
+    ):
+        """main(): a format-1 LM checkpoint (Adam opt_state with empty
+        nodes included) converts into a restorable format-2 copy in a
+        NEW directory, source untouched, qkv columns permuted."""
+        import subprocess
+        import sys
+
+        import ddp_tpu.train.checkpoint as ckpt_mod
+        from ddp_tpu.parallel.ddp import TrainState
+
+        src_dir = str(tmp_path / "ck")
+        st = self._lm_state(mesh8)
+        state = TrainState(step=st.step, params=st.params,
+                           opt_state=st.opt_state, model_state={})
+        monkeypatch.setattr(ckpt_mod, "CHECKPOINT_FORMAT", 1)
+        src = CheckpointManager(src_dir, async_save=False)
+        src.save(0, state, steps_per_epoch=7)
+        src.close()
+        monkeypatch.undo()
+
+        script = os.path.join(
+            os.path.dirname(__file__), os.pardir, "scripts",
+            "convert_qkv_layout.py",
+        )
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        )
+        proc = subprocess.run(
+            [sys.executable, script, "--checkpoint_dir", src_dir,
+             "--num_heads", "2"],
+            capture_output=True, text=True, env=env, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
+        dst = CheckpointManager(src_dir + "_fmt2", async_save=False)
+        restored, epoch = dst.restore(state)  # format gate passes
+        assert epoch == 0
+        assert dst.last_restored_spe == 7
+        old_k = np.asarray(
+            state.params["block1"]["attn"]["qkv"]["kernel"]
+        )
+        new_k = np.asarray(
+            restored.params["block1"]["attn"]["qkv"]["kernel"]
+        )
+        H, dh = 2, old_k.shape[1] // 6
+        expect = (
+            old_k.reshape(-1, 3, H, dh).swapaxes(1, 2)
+            .reshape(old_k.shape)
+        )
+        np.testing.assert_array_equal(new_k, expect)
+        # Adam moments got the same permutation; non-qkv left alone.
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["block1"]["mlp1"]["kernel"]),
+            np.asarray(state.params["block1"]["mlp1"]["kernel"]),
+        )
+        dst.close()
+        # Source still format 1 (untouched): the gate still refuses it.
+        src2 = CheckpointManager(src_dir, async_save=False)
+        with pytest.raises(RuntimeError, match="head-major"):
+            src2.restore(state)
+        src2.close()
+
+    def test_convert_script_permutation_inverts_layout_change(self):
+        import importlib.util
+        import os
+
+        spec_ = importlib.util.spec_from_file_location(
+            "convert_qkv_layout",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "scripts", "convert_qkv_layout.py"),
+        )
+        mod = importlib.util.module_from_spec(spec_)
+        spec_.loader.exec_module(mod)
+
+        H, dh, d = 2, 4, 8
+        rng = np.random.default_rng(0)
+        new_kernel = rng.normal(size=(d, 3 * H * dh))  # head-major truth
+        # A format-1 save laid the same weights out q/k/v-major:
+        old = (
+            new_kernel.reshape(d, H, 3, dh).swapaxes(1, 2)
+            .reshape(d, 3 * H * dh)
+        )
+        tree = {"block1": {"attn": {"qkv": {"kernel": old}}}}
+        fixed = mod.permute_qkv_columns(tree, num_heads=H)
+        np.testing.assert_array_equal(
+            fixed["block1"]["attn"]["qkv"]["kernel"], new_kernel
+        )
+        # Non-qkv leaves pass through untouched.
+        tree2 = {"mlp1": {"kernel": old}}
+        np.testing.assert_array_equal(
+            mod.permute_qkv_columns(tree2, num_heads=H)["mlp1"]["kernel"],
+            old,
+        )

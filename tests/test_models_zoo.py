@@ -54,7 +54,10 @@ def test_vit_tiny_forward_shape():
 ])
 def test_ddp_step_trains_with_model_state(model_fn, mesh8):
     model = model_fn()
-    tx = optax.sgd(0.05)
+    # 0.01, not 0.05: the check below is "the update is applied", and
+    # at 0.05 a ViT step on this tiny batch can legitimately overshoot
+    # (loss up, not down) depending on the init draw.
+    tx = optax.sgd(0.01)
     state = create_train_state(model, tx, jnp.zeros((1, 32, 32, 3)), seed=0)
     state = replicate_state(state, mesh8)
     step = make_train_step(model, tx, mesh8, donate=False)

@@ -1,0 +1,229 @@
+"""Tensor parallelism for the shard_map sequence family (parallel/tp.py).
+
+VERDICT round-2 "do this" #2: Megatron column/row TP over the ``model``
+mesh axis, composing with ``seq`` (ring/Ulysses) and ``fsdp``. The
+contract tested here:
+
+- loss-trajectory parity vs the replicated single-device step (the
+  strongest check — covers forward, gradients, and optimizer updates
+  for EVERY param class at once);
+- params at rest are genuinely sharded (per-device shard bytes drop by
+  the tp factor for the block kernels);
+- spec assignment: Megatron dims on ``model``, the orthogonal dim on
+  ``fsdp``, everything else replicated / dim-0 fsdp;
+- the classifier family (seq_transformer) gets the same treatment;
+- clear errors for non-divisible head counts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ddp_tpu.models.lm import (
+    LMSpec,
+    create_lm_train_state,
+    init_lm,
+    make_lm_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+SPEC = LMSpec(vocab_size=64, total_len=32, d_model=32, depth=2, num_heads=4)
+
+
+def _mesh(n, **axes):
+    return make_mesh(MeshSpec(**axes), devices=jax.devices()[:n])
+
+
+def _run_losses(mesh, *, steps=3, accum=1, dtype=jnp.float32):
+    tx = optax.adam(1e-3)
+    state = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step = make_lm_train_step(
+        SPEC, tx, mesh, donate=False, compute_dtype=dtype,
+        grad_accum_steps=accum,
+    )
+    toks = jax.random.randint(jax.random.key(7), (4, 32), 0, 64)
+    out = []
+    for _ in range(steps):
+        state, m = step(state, toks)
+        out.append(float(m.loss))
+    return np.array(out), state
+
+
+@pytest.fixture(scope="module")
+def ref_losses():
+    losses, _ = _run_losses(_mesh(1, data=1))
+    return losses
+
+
+@pytest.mark.parametrize(
+    "axes,n",
+    [
+        ({"data": 1, "model": 2}, 2),
+        ({"data": 1, "model": 4}, 4),
+        ({"data": 1, "model": 2, "seq": 2}, 4),
+        ({"data": 2, "model": 2, "seq": 2}, 8),
+        ({"data": 1, "model": 2, "fsdp": 2}, 4),
+    ],
+)
+def test_tp_loss_parity(ref_losses, axes, n):
+    """TP (alone and composed with dp/sp/fsdp) reproduces the
+    replicated trajectory to fp32 round-off."""
+    losses, _ = _run_losses(_mesh(n, **axes))
+    np.testing.assert_allclose(losses, ref_losses, atol=2e-5)
+
+
+def test_tp_with_accum_parity(ref_losses):
+    """TP × gradient accumulation: same mean-gradient step."""
+    losses, _ = _run_losses(_mesh(4, data=1, model=2, seq=2), accum=2)
+    np.testing.assert_allclose(losses, ref_losses, atol=5e-5)
+
+
+def test_tp_params_rest_sharded():
+    """Block kernels occupy 1/tp of their replicated bytes per device;
+    qkv also takes the fsdp dim when both axes are active."""
+    mesh = _mesh(4, data=1, model=2, fsdp=2)
+    state = _run_losses(mesh, steps=1)[1]
+    qkv = state.params["block1"]["attn"]["qkv"]["kernel"]
+    d = SPEC.d_model
+    assert qkv.shape == (d, 3 * d)
+    # fsdp halves dim 0, model halves dim 1 → each device holds 1/4.
+    shard = qkv.addressable_shards[0].data
+    assert shard.shape == (d // 2, 3 * d // 2)
+    proj = state.params["block1"]["attn"]["proj"]["kernel"]
+    assert proj.addressable_shards[0].data.shape == (d // 2, d // 2)
+    # Adam moments inherit the placement → optimizer memory shards too.
+    mu_qkv = state.opt_state[0].mu["block1"]["attn"]["qkv"]["kernel"]
+    assert mu_qkv.addressable_shards[0].data.shape == (d // 2, 3 * d // 2)
+    # Non-TP leaves keep the fsdp dim-0 rule (replicated over model):
+    # the LN scale halves over fsdp only.
+    ln = state.params["block1"]["ln1"]["scale"]
+    assert ln.addressable_shards[0].data.shape == (d // 2,)
+
+
+def test_seq_param_specs_assignment():
+    from ddp_tpu.parallel.tp import seq_param_specs
+
+    mesh = _mesh(4, data=1, model=2, fsdp=2)
+    specs = seq_param_specs(init_lm(SPEC, seed=0), mesh)
+    b = specs["block1"]
+    assert b["attn"]["qkv"]["kernel"] == P("fsdp", "model")
+    assert b["attn"]["qkv"]["bias"] == P("model")
+    assert b["attn"]["proj"]["kernel"] == P("model", "fsdp")
+    assert b["mlp1"]["kernel"] == P("fsdp", "model")
+    assert b["mlp1"]["bias"] == P("model")
+    assert b["mlp2"]["kernel"] == P("model", "fsdp")
+    # Non-TP leaves keep the round-2 fsdp dim-0 rule.
+    assert specs["embed"] == P("fsdp")
+    assert specs["pos_embed"] == P()  # dim 0 == 1, unshardable
+
+
+def test_seq_param_specs_reduces_to_fsdp_rule():
+    """With model size 1 the combined specs ARE the fsdp specs —
+    round-2 states restore unchanged."""
+    from ddp_tpu.parallel.seq_fsdp import fsdp_specs
+    from ddp_tpu.parallel.tp import seq_param_specs
+
+    mesh = _mesh(2, data=1, fsdp=2)
+    params = init_lm(SPEC, seed=0)
+    assert seq_param_specs(params, mesh) == fsdp_specs(params, mesh)
+
+
+def test_tp_rejects_indivisible_heads():
+    """3 heads can't split over model=2: the module asserts at trace
+    (kernel dims alone can still divide — 3·48=144 is even — so the
+    head check is the one that must fire)."""
+    spec3 = SPEC._replace(num_heads=3, d_model=48)
+    mesh = _mesh(2, data=1, model=2)
+    tx = optax.adam(1e-3)
+    state = create_lm_train_state(spec3, tx, mesh, seed=0)
+    step = make_lm_train_step(spec3, tx, mesh, donate=False)
+    toks = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)
+    with pytest.raises(AssertionError):
+        step(state, toks)
+
+
+def test_trainer_rejects_indivisible_heads():
+    """The CLI surfaces the constraint as a config error, before any
+    trace."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model="causal_lm", mesh_model=2, num_heads=3, model_dim=48,
+        seq_len=32, vocab_size=64, epochs=1, batch_size=4,
+    )
+    with pytest.raises(ValueError, match="heads"):
+        Trainer(cfg)
+
+
+def test_classifier_tp_parity():
+    """The seq-transformer classifier rides the same TP machinery."""
+    from ddp_tpu.models.seq_transformer import (
+        SeqTransformerSpec,
+        create_seq_train_state,
+        make_seq_parallel_train_step,
+    )
+
+    spec = SeqTransformerSpec(
+        num_classes=5, total_len=16, d_in=8, d_model=32, depth=2,
+        num_heads=4,
+    )
+    x = jax.random.normal(jax.random.key(3), (4, 16, 8))
+    y = jax.random.randint(jax.random.key(4), (4,), 0, 5)
+
+    def run(mesh):
+        tx = optax.adam(1e-3)
+        state = create_seq_train_state(spec, tx, mesh, seed=0)
+        step = make_seq_parallel_train_step(spec, tx, mesh, donate=False)
+        out = []
+        for _ in range(3):
+            state, m = step(state, x, y)
+            out.append(float(m.loss))
+        return np.array(out)
+
+    ref = run(_mesh(1, data=1))
+    tp = run(_mesh(4, data=1, model=2, seq=2))
+    np.testing.assert_allclose(tp, ref, atol=2e-5)
+
+
+def test_tp_ulysses_parity(ref_losses):
+    """TP × Ulysses: each model member re-shards its LOCAL heads over
+    seq (4 heads / tp 2 = 2 local, divisible by seq 2)."""
+    spec = SPEC._replace(strategy="ulysses")
+    tx = optax.adam(1e-3)
+    mesh = _mesh(4, data=1, model=2, seq=2)
+    state = create_lm_train_state(spec, tx, mesh, seed=0)
+    step = make_lm_train_step(spec, tx, mesh, donate=False)
+    toks = jax.random.randint(jax.random.key(7), (4, 32), 0, 64)
+    out = []
+    for _ in range(3):
+        state, m = step(state, toks)
+        out.append(float(m.loss))
+    np.testing.assert_allclose(np.array(out), ref_losses, atol=2e-5)
+
+
+def test_trainer_ulysses_guard_uses_local_heads():
+    """--num_heads 4 --mesh_model 2 --mesh_seq 4 leaves 2 local heads
+    for Ulysses to re-shard over 4 seq members: construction error,
+    not a first-trace crash."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        model="causal_lm", mesh_model=2, mesh_seq=4, num_heads=4,
+        model_dim=32, seq_len=64, vocab_size=64, epochs=1, batch_size=4,
+        seq_strategy="ulysses",
+    )
+    with pytest.raises(ValueError, match="per model shard"):
+        Trainer(cfg)
+
+
+def test_tp_bf16_runs():
+    """Mixed precision through the TP step: finite, decreasing-ish."""
+    losses, _ = _run_losses(
+        _mesh(2, data=1, model=2), dtype=jnp.bfloat16
+    )
+    assert np.all(np.isfinite(losses))
