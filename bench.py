@@ -554,14 +554,14 @@ def _supervise() -> dict:
         attempts.append(f"probe[{i}]: backend unreachable")
         print(
             f"bench: backend probe {i} failed under "
-            f"JAX_PLATFORMS={env.get('JAX_PLATFORMS') or '(unset)'!s}; "
-            "retrying in 45s",
+            f"JAX_PLATFORMS={env.get('JAX_PLATFORMS') or '(unset)'!s}",
             file=sys.stderr,
         )
         if remaining() <= _CPU_RESERVE_S + 120.0:
             attempts.append("probes: budget exhausted")
             break
         if i < 2:
+            print("bench: retrying probe in 45s", file=sys.stderr)
             time.sleep(45.0)
     cpu_env = dict(env, JAX_PLATFORMS="cpu")
     rec = _run_worker(cpu_env, timeout=max(60.0, remaining()))

@@ -62,6 +62,11 @@ class CausalLM(nn.Module):
     # parallelism owns the MoE sharding story.
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    # Expert parallelism over the ``expert`` mesh axis (shard_map-only):
+    # each member holds num_experts/ep_size experts, tokens all-to-all
+    # to their expert's owner and back (models/moe.py MoEMLP).
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -96,6 +101,8 @@ class CausalLM(nn.Module):
                     mlp_dim=self.d_model * self.mlp_ratio,
                     num_experts=self.num_experts,
                     attention_fn=attn_fn,
+                    ep_axis=self.ep_axis,
+                    ep_size=self.ep_size,
                     name=f"block{i + 1}",
                 )(x)
             else:
@@ -138,7 +145,9 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
     )
 
 
-def _sharded_lm(spec: LMSpec, *, tp_size: int = 1) -> CausalLM:
+def _sharded_lm(
+    spec: LMSpec, *, tp_size: int = 1, ep_size: int = 1
+) -> CausalLM:
     def attention(q, k, v):
         return sequence_sharded_attention(
             q, k, v, axis_name="seq", strategy=spec.strategy, causal=True
@@ -156,6 +165,8 @@ def _sharded_lm(spec: LMSpec, *, tp_size: int = 1) -> CausalLM:
         remat=spec.remat,
         tp_axis="model" if tp_size > 1 else None,
         tp_size=tp_size,
+        ep_axis="expert" if ep_size > 1 else None,
+        ep_size=ep_size,
     )
 
 
@@ -234,12 +245,15 @@ def create_lm_train_state(
 def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
     from ddp_tpu.models.seq_transformer import _batch_axes
     from ddp_tpu.parallel.tp import (
+        ep_size as mesh_ep_size,
         gather_sharded,
         seq_param_specs,
         tp_size as mesh_tp_size,
     )
 
-    model = _sharded_lm(spec, tp_size=mesh_tp_size(mesh))
+    model = _sharded_lm(
+        spec, tp_size=mesh_tp_size(mesh), ep_size=mesh_ep_size(mesh)
+    )
     baxes = _batch_axes(mesh)
     xspec = P(baxes, "seq")
 

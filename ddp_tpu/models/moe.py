@@ -34,6 +34,18 @@ class MoEMLP(nn.Module):
     Input/output: ``[B, T, d]``. Expert weights: ``wi [E, d, mlp_dim]``,
     ``wo [E, mlp_dim, d]`` (+ biases ``bi``/``bo``) — the leading dim is
     what the ``expert`` mesh axis shards.
+
+    ``ep_axis``/``ep_size`` (shard_map-only — the GSPMD/image family
+    gets EP by annotation instead, parallel/spmd.py): each mesh member
+    holds ``num_experts/ep_size`` experts and a DIFFERENT token shard
+    (``expert`` is a batch axis, runtime/mesh.py ``data_axes``). The
+    member routes its own tokens over all E experts, one
+    ``lax.all_to_all`` carries each expert's dispatched slots to the
+    member that owns it, the FFN runs on local experts over everyone's
+    slots, and the inverse all_to_all brings results home — the
+    explicit form of the token exchange XLA derives for the annotated
+    family. AD transposes each all_to_all into its inverse, so
+    gradients route themselves.
     """
 
     num_experts: int
@@ -41,11 +53,14 @@ class MoEMLP(nn.Module):
     top_k: int = 2
     capacity_factor: float = 2.0
     normalize_gates: bool = True
+    ep_axis: Optional[str] = None
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
         B, T, d = x.shape
         E = self.num_experts
+        assert E % self.ep_size == 0, (E, self.ep_size)
         n = B * T
         tokens = x.reshape(n, d)
         # Per-expert slot count; static (derived from traced shapes).
@@ -96,23 +111,38 @@ class MoEMLP(nn.Module):
             ).value = aux
 
         dtype = x.dtype
+        e_local = E // self.ep_size
         wi = self.param(
-            "wi", nn.initializers.lecun_normal(), (E, d, self.mlp_dim)
+            "wi", nn.initializers.lecun_normal(), (e_local, d, self.mlp_dim)
         )
-        bi = self.param("bi", nn.initializers.zeros, (E, 1, self.mlp_dim))
+        bi = self.param(
+            "bi", nn.initializers.zeros, (e_local, 1, self.mlp_dim)
+        )
         wo = self.param(
-            "wo", nn.initializers.lecun_normal(), (E, self.mlp_dim, d)
+            "wo", nn.initializers.lecun_normal(), (e_local, self.mlp_dim, d)
         )
-        bo = self.param("bo", nn.initializers.zeros, (E, 1, d))
+        bo = self.param("bo", nn.initializers.zeros, (e_local, 1, d))
 
-        # Dispatch → expert FFN → combine. All global einsums: with
-        # tokens batch-sharded and wi/wo expert-sharded, XLA inserts the
-        # token all-to-alls here.
+        # Dispatch → expert FFN → combine. Replicated experts: global
+        # einsums (with tokens batch-sharded and wi/wo expert-sharded
+        # under GSPMD, XLA inserts the token all-to-alls here). Expert-
+        # parallel (shard_map): the all_to_alls are written out.
         xs = jnp.einsum("nec,nd->ecd", dispatch.astype(dtype), tokens)
+        if self.ep_size > 1:
+            # [E, C, d] → [E/ep, ep·C, d]: slots for MY experts from
+            # every member, blocked by source (order is irrelevant —
+            # the FFN is slot-wise and the inverse exchange restores it).
+            xs = jax.lax.all_to_all(
+                xs, self.ep_axis, split_axis=0, concat_axis=1, tiled=True
+            )
         h = nn.gelu(
             jnp.einsum("ecd,edf->ecf", xs, wi.astype(dtype)) + bi.astype(dtype)
         )
         ys = jnp.einsum("ecf,efd->ecd", h, wo.astype(dtype)) + bo.astype(dtype)
+        if self.ep_size > 1:
+            ys = jax.lax.all_to_all(
+                ys, self.ep_axis, split_axis=1, concat_axis=0, tiled=True
+            )
         out = jnp.einsum("nec,ecd->nd", combine.astype(dtype), ys)
         return out.reshape(B, T, d)
 
@@ -128,6 +158,8 @@ class MoEEncoderBlock(nn.Module):
     dropout_rate: float = 0.0
     attention_fn: Optional[AttentionFn] = None
     deterministic: bool = True  # attribute, not call kwarg — remat-safe
+    ep_axis: Optional[str] = None  # expert parallelism (see MoEMLP)
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -143,6 +175,8 @@ class MoEEncoderBlock(nn.Module):
             mlp_dim=self.mlp_dim,
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
+            ep_axis=self.ep_axis,
+            ep_size=self.ep_size,
             name="moe",
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)

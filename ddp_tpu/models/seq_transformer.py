@@ -163,8 +163,12 @@ def dense_apply(spec: SeqTransformerSpec, params, x):
 
 
 def _batch_axes(mesh: Mesh):
-    """Mesh axes the batch shards over (fsdp is a data axis)."""
-    axes = tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    """Mesh axes the batch shards over — runtime/mesh.py ``data_axes``
+    (fsdp and expert are data axes: each group member sees different
+    rows), filtered to the axes this mesh actually splits."""
+    from ddp_tpu.runtime.mesh import data_axes
+
+    axes = tuple(a for a in data_axes(mesh) if mesh.shape[a] > 1)
     return axes if axes else None
 
 
@@ -254,9 +258,13 @@ def sharded_or_replicated_state(
     shards too; unshardable leaves and scalars replicate.
     """
     from ddp_tpu.parallel.seq_fsdp import fsdp_size
-    from ddp_tpu.parallel.tp import shard_seq_params, tp_size
+    from ddp_tpu.parallel.tp import ep_size, shard_seq_params, tp_size
 
-    if fsdp_size(mesh) <= 1 and tp_size(mesh) <= 1:
+    if (
+        fsdp_size(mesh) <= 1
+        and tp_size(mesh) <= 1
+        and ep_size(mesh) <= 1
+    ):
         return replicated_train_state(params, optimizer, mesh)
     rep = NamedSharding(mesh, P())
     params = shard_seq_params(params, mesh)

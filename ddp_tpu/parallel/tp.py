@@ -1,14 +1,17 @@
-"""Tensor parallelism for the shard_map sequence family (Megatron
-column/row pairing).
+"""Tensor + expert parallelism for the shard_map sequence family —
+the combined param-spec authority for every non-data axis the seq
+models shard over (``model``, ``expert``, ``fsdp``).
 
-The image/GSPMD family gets TP by annotation (parallel/spmd.py
+The image/GSPMD family gets TP/EP by annotation (parallel/spmd.py
 ShardingRules); the sequence family cannot ride that path — ring/
 Ulysses attention needs an explicit ``shard_map`` over ``seq`` — so
-this module supplies TP *inside* the shard_map body, the layout the
-reference's stack inherits from Megatron-style sharded layers
+this module supplies the layouts *inside* the shard_map body
 (generalizing /root/reference/train_ddp.py:199's inherited parallel
-machinery; SURVEY.md §2c TP row: "mesh design should leave a `model`
-axis possible").
+machinery; SURVEY.md §2c TP/EP rows). Tensor parallelism is the
+Megatron column/row pairing below; expert parallelism shards MoE
+expert weights' leading dim over ``expert`` with explicit all-to-all
+token dispatch (the compute lives in models/moe.py MoEMLP — this
+module owns only the at-rest/param-spec side).
 
 Layout per transformer block, ``model`` axis of size ``tp``:
 
@@ -55,6 +58,10 @@ def tp_size(mesh: Mesh) -> int:
     return int(mesh.shape.get("model", 1))
 
 
+def ep_size(mesh: Mesh) -> int:
+    return int(mesh.shape.get("expert", 1))
+
+
 def _path_str(path) -> str:
     return "/".join(
         str(getattr(k, "key", getattr(k, "idx", k))) for k in path
@@ -66,20 +73,29 @@ def _path_str(path) -> str:
 _COLUMN_KERNELS = ("attn/qkv/kernel", "mlp1/kernel")  # dim 1 (output)
 _COLUMN_BIASES = ("attn/qkv/bias", "mlp1/bias")  # dim 0
 _ROW_KERNELS = ("attn/proj/kernel", "mlp2/kernel")  # dim 0 (input)
+# MoE expert weights (models/moe.py MoEMLP): leading dim = expert index,
+# sharded over the ``expert`` axis; wi/wo additionally take ``fsdp`` on
+# a non-expert dim where divisible. The router stays with the fallback
+# rule (replicated/fsdp — every member routes with identical weights).
+_EXPERT_LEAVES = ("moe/wi", "moe/bi", "moe/wo", "moe/bo")
 
 
 def seq_param_specs(params: Any, mesh: Mesh) -> Any:
-    """Per-leaf PartitionSpec combining ``model`` (TP) and ``fsdp``.
+    """Per-leaf PartitionSpec combining ``model`` (TP), ``expert``
+    (EP), and ``fsdp``.
 
-    With ``model`` size 1 this reduces exactly to
+    With ``model`` and ``expert`` size 1 this reduces exactly to
     parallel/seq_fsdp.py ``fsdp_specs`` (dim 0 over ``fsdp`` where it
     divides). With TP active, block kernels/biases take their
-    Megatron dim on ``model`` and the *other* kernel dim takes
-    ``fsdp`` where divisible; everything else falls back to the fsdp
-    rule. Pure function of leaf shapes+paths — step builder and state
-    builder recompute it independently and always agree.
+    Megatron dim on ``model``; with EP active, MoE expert weights
+    take their leading (expert) dim on ``expert``; in both cases the
+    *other* kernel dim takes ``fsdp`` where divisible. Everything
+    else falls back to the fsdp rule. Pure function of leaf
+    shapes+paths — step builder and state builder recompute it
+    independently and always agree.
     """
     tp = tp_size(mesh)
+    ep = ep_size(mesh)
     n = fsdp_size(mesh)
 
     def fsdp_dim0(shape):
@@ -89,8 +105,18 @@ def seq_param_specs(params: Any, mesh: Mesh) -> Any:
 
     def spec(path, leaf):
         shape = jnp.shape(leaf)
+        p = _path_str(path) if (tp > 1 or ep > 1) else ""
+        if ep > 1 and p.endswith(_EXPERT_LEAVES):
+            _check_divides(p, shape[0], ep)
+            # wi [E, d, mlp] / wo [E, mlp, d]: fsdp rides dim 1 when it
+            # divides; biases [E, 1, f] shard the expert dim only.
+            if (
+                n > 1 and len(shape) > 1 and shape[1] > 1
+                and shape[1] % n == 0
+            ):
+                return P("expert", "fsdp")
+            return P("expert")
         if tp > 1:
-            p = _path_str(path)
             if p.endswith(_COLUMN_KERNELS):
                 _check_divides(p, shape[1], tp)
                 d0 = (
@@ -111,12 +137,12 @@ def seq_param_specs(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
-def _check_divides(path: str, dim: int, tp: int) -> None:
-    if dim % tp:
+def _check_divides(path: str, dim: int, axis: int) -> None:
+    if dim % axis:
         raise ValueError(
-            f"tensor parallelism: {path} dim {dim} not divisible by "
-            f"the model-axis size {tp} (num_heads and mlp_dim must "
-            f"both divide by --mesh_model)"
+            f"{path}: dim {dim} not divisible by the sharding axis "
+            f"size {axis} (num_heads and mlp_dim must divide by "
+            f"--mesh_model; num_experts by --mesh_expert)"
         )
 
 

@@ -190,21 +190,33 @@ class Trainer:
         # (parallel/seq_fsdp.py), accumulation, and label smoothing;
         # round 3 lifts tensor parallelism (parallel/tp.py — Megatron
         # column/row inside the shard_map step, composing with seq and
-        # fsdp). What remains out: expert-axis sharding, zero1
-        # (subsumed by fsdp, which shards moments too), the image-only
-        # augment pipeline, and the device-resident fast-epoch path.
+        # fsdp) and expert parallelism for the MoE-LM (models/moe.py
+        # MoEMLP all-to-all dispatch over the ``expert`` axis). What
+        # remains out: zero1 (subsumed by fsdp, which shards moments
+        # too), the image-only augment pipeline, and the
+        # device-resident fast-epoch path.
         if self.seq_mode and (
-            config.mesh_expert > 1
-            or config.zero1
+            config.zero1
             or config.fast_epoch
             or get_augmentation(config.augment) is not None
         ):
             raise ValueError(
                 f"--model {config.model} composes with data/seq/fsdp/"
-                "model mesh axes, accumulation, label smoothing and "
-                "bf16 — but not expert/zero1 (use --mesh_fsdp), "
-                "augment, or --fast_epoch"
+                "model/expert mesh axes, accumulation, label smoothing "
+                "and bf16 — but not zero1 (use --mesh_fsdp), augment, "
+                "or --fast_epoch"
             )
+        if self.seq_mode and config.mesh_expert > 1:
+            if not config.moe_experts:
+                raise ValueError(
+                    "--mesh_expert shards MoE expert weights: give the "
+                    "LM experts with --moe_experts N (or drop the axis)"
+                )
+            if config.moe_experts % config.mesh_expert:
+                raise ValueError(
+                    f"--moe_experts {config.moe_experts} not divisible "
+                    f"by --mesh_expert {config.mesh_expert}"
+                )
         if self.seq_mode and config.mesh_model > 1:
             if config.moe_experts:
                 raise ValueError(
@@ -497,7 +509,9 @@ class Trainer:
             )
             self.state = (
                 st_tr
-                if config.mesh_fsdp > 1 or config.mesh_model > 1
+                if config.mesh_fsdp > 1
+                or config.mesh_model > 1
+                or config.mesh_expert > 1
                 else replicate_state(st_tr, self.mesh)
             )
         elif self.pipe_mode:

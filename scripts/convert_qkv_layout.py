@@ -107,11 +107,18 @@ def main() -> int:
             opt_state=tree.get("opt_state", {}),
             model_state=tree.get("model_state", {}),
         )
-        dst.save(
-            epoch, state,
+        # overwrite=True: re-running the converter (e.g. after a wrong
+        # --num_heads) must replace its own previous output — the
+        # SOURCE dir is the safe copy, not the destination.
+        saved = dst.save(
+            epoch, state, overwrite=True,
             steps_per_epoch=int(np.asarray(tree.get("spe", 0))),
             mid_batch=int(np.asarray(tree.get("mid_batch", 0))),
         )
+        if not saved:
+            print(f"epoch {epoch}: save skipped unexpectedly",
+                  file=sys.stderr)
+            return 1
         print(f"epoch {epoch}: converted to format {CHECKPOINT_FORMAT} "
               f"→ {out_dir}")
     src.close()

@@ -1,0 +1,141 @@
+"""Expert-parallel MoE-LM (models/moe.py MoEMLP all-to-all path).
+
+VERDICT round-2 "do this" #3: shard the LM's expert weights over the
+``expert`` mesh axis inside the seq shard_map step, with all-to-all
+token dispatch — the explicit shard_map analogue of what GSPMD derives
+for the annotated image family. Contract:
+
+- EXACT parity with the replicated-experts step under the same batch
+  split (``expert`` is a batch axis, so (data=1, expert=2) routes
+  identically to (data=2) — the all_to_all pair is mathematically the
+  identity around the expert FFN);
+- per-device expert memory drops by the axis size (asserted on the
+  addressable shard);
+- composes with seq (ring attention), fsdp (dim-1 shards of wi/wo),
+  and bf16;
+- clear construction-time errors from the trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ddp_tpu.models.lm import (
+    LMSpec,
+    create_lm_train_state,
+    init_lm,
+    make_lm_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+SPEC = LMSpec(
+    vocab_size=64, total_len=32, d_model=32, depth=2, num_heads=4,
+    num_experts=4, moe_every=2,
+)
+
+
+def _mesh(n, **axes):
+    return make_mesh(MeshSpec(**axes), devices=jax.devices()[:n])
+
+
+def _run(mesh, *, steps=3, dtype=jnp.float32):
+    tx = optax.adam(1e-3)
+    state = create_lm_train_state(SPEC, tx, mesh, seed=0)
+    step = make_lm_train_step(SPEC, tx, mesh, donate=False,
+                              compute_dtype=dtype)
+    toks = jax.random.randint(jax.random.key(7), (4, 32), 0, 64)
+    out = []
+    for _ in range(steps):
+        state, m = step(state, toks)
+        out.append(float(m.loss))
+    return np.array(out), state
+
+
+def test_ep_exact_parity_with_replicated():
+    """(data=1, expert=2) == (data=2): same batch split, same local
+    routing — the experts merely live on their owners."""
+    ref, _ = _run(_mesh(2, data=2))
+    ep, _ = _run(_mesh(2, data=1, expert=2))
+    np.testing.assert_array_equal(ep, ref)
+
+
+def test_ep4_parity_with_dp4():
+    """4-way splits agree exactly whatever axis provides them."""
+    ref, _ = _run(_mesh(4, data=4))
+    ep, _ = _run(_mesh(4, data=1, expert=4))
+    np.testing.assert_array_equal(ep, ref)
+
+
+def test_dp_ep_sp_composition_runs():
+    losses, _ = _run(_mesh(8, data=2, expert=2, seq=2))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_ep_expert_memory_shards():
+    """wi rests 1/ep per device; with fsdp, dim 1 halves too. Adam
+    moments inherit both placements."""
+    mesh = _mesh(4, data=1, expert=2, fsdp=2)
+    _, state = _run(mesh, steps=1)
+    wi = state.params["block2"]["moe"]["wi"]
+    E, d, f = SPEC.num_experts, 32, 32 * 4
+    assert wi.shape == (E, d, f)
+    assert wi.addressable_shards[0].data.shape == (E // 2, d // 2, f)
+    mu_wi = state.opt_state[0].mu["block2"]["moe"]["wi"]
+    assert mu_wi.addressable_shards[0].data.shape == (E // 2, d // 2, f)
+    # Router weights replicate over expert (identical routing on every
+    # member); fallback fsdp dim-0 rule still applies.
+    router = state.params["block2"]["moe"]["router"]["kernel"]
+    assert "expert" not in jax.tree_util.tree_leaves(
+        [router.sharding.spec]
+    )
+
+
+def test_ep_specs_assignment():
+    from ddp_tpu.parallel.tp import seq_param_specs
+
+    mesh = _mesh(4, data=1, expert=2, fsdp=2)
+    specs = seq_param_specs(init_lm(SPEC, seed=0), mesh)
+    moe = specs["block2"]["moe"]
+    assert moe["wi"] == P("expert", "fsdp")
+    assert moe["wo"] == P("expert", "fsdp")
+    assert moe["bi"] == P("expert")  # dim 1 is 1: expert only
+    assert moe["bo"] == P("expert")
+    # Dense block 1 keeps the plain fsdp rule.
+    assert specs["block1"]["mlp1"]["kernel"] == P("fsdp")
+
+
+def test_ep_bf16_runs():
+    losses, _ = _run(_mesh(2, data=1, expert=2), dtype=jnp.bfloat16)
+    assert np.all(np.isfinite(losses))
+
+
+def test_ep_indivisible_experts_rejected():
+    from ddp_tpu.parallel.tp import seq_param_specs
+
+    spec3 = SPEC._replace(num_experts=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        seq_param_specs(
+            init_lm(spec3, seed=0), _mesh(2, data=1, expert=2)
+        )
+
+
+def test_trainer_ep_guards():
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    base = dict(
+        model="causal_lm", model_dim=32, num_heads=4, seq_len=32,
+        vocab_size=64, epochs=1, batch_size=4,
+    )
+    with pytest.raises(ValueError, match="--moe_experts"):
+        Trainer(TrainConfig(mesh_expert=2, **base))
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(TrainConfig(mesh_expert=2, moe_experts=3, **base))
+    with pytest.raises(ValueError, match="mesh_expert"):
+        Trainer(
+            TrainConfig(mesh_model=2, moe_experts=4, **base)
+        )
