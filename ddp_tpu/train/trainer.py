@@ -382,7 +382,10 @@ class Trainer:
 
         if self.seq_mode:
             if self.dataset == "text":
-                # Real data for the LM: a byte-level corpus file.
+                # Real data for the LM: a corpus file — raw bytes at
+                # --vocab_size <= 256, BPE subwords above (the trained
+                # tokenizer persists next to the checkpoints: it is
+                # part of the model, and generation needs it to decode).
                 if not self.lm_mode:
                     raise ValueError(
                         "--dataset text is causal-LM data (bytes, no "
@@ -395,6 +398,9 @@ class Trainer:
                 train_split, test_split = load_text_corpus(
                     config.text_file, config.seq_len,
                     vocab_size=config.vocab_size,
+                    tokenizer_path=os.path.join(
+                        config.checkpoint_dir, "tokenizer.json"
+                    ),
                 )
             elif self.dataset != "synthetic_seq":
                 raise ValueError(

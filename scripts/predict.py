@@ -175,6 +175,15 @@ def _generate_lm(args) -> None:
     mgr = CheckpointManager(args.checkpoint_dir)
     params, _, epoch = mgr.restore_for_inference(args.epoch)
     mgr.close()
+    # A tokenizer saved next to the checkpoints (BPE training runs,
+    # data/text.py) is part of the model: prompts encode through it
+    # and continuations decode back to text. Absent file = byte vocab.
+    tokenizer = None
+    tok_path = os.path.join(args.checkpoint_dir, "tokenizer.json")
+    if os.path.exists(tok_path):
+        from ddp_tpu.data.bpe import BPETokenizer
+
+        tokenizer = BPETokenizer.load(tok_path)
     try:
         vocab_size, d_model = params["embed"].shape
         total_len = params["pos_embed"].shape[1]
@@ -210,6 +219,13 @@ def _generate_lm(args) -> None:
 
     if args.prompt_tokens is not None:
         toks = [int(t) for t in args.prompt_tokens.split(",") if t.strip()]
+    elif tokenizer is not None:
+        toks = tokenizer.encode(args.prompt).tolist()
+        if tokenizer.vocab_size > spec.vocab_size:
+            raise SystemExit(
+                f"tokenizer at {tok_path} has {tokenizer.vocab_size} "
+                f"ids but the checkpoint embeds {spec.vocab_size}"
+            )
     else:
         toks = list(args.prompt.encode("utf-8"))
         bad = [t for t in toks if t >= spec.vocab_size]
@@ -236,7 +252,9 @@ def _generate_lm(args) -> None:
         "tokens": new.tolist(),
         "temperature": args.temperature,
     }
-    if spec.vocab_size >= 256 and max(new.tolist(), default=0) < 256:
+    if tokenizer is not None:
+        record["text"] = tokenizer.decode(new)
+    elif spec.vocab_size >= 256 and max(new.tolist(), default=0) < 256:
         record["text"] = bytes(int(t) for t in new).decode(
             "utf-8", errors="replace"
         )
