@@ -377,8 +377,16 @@ def _run_extra_benches() -> None:
 
     if jax.devices()[0].platform != "tpu":
         return
-    extra = {}
     out = pathlib.Path(__file__).with_name("BENCH_EXTRA.json")
+    # Seed from the existing record so a partially-completed run (or
+    # one interrupted by a tunnel flap) merges fresh entries over the
+    # old ones instead of erasing side benches it never reached.
+    extra = {}
+    if out.exists():
+        try:
+            extra = json.loads(out.read_text())
+        except (OSError, ValueError):
+            extra = {}
     for name, fn in [
         ("vit", run_vit_bench),
         ("lm", run_lm_bench),

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddp_tpu.models.vit import AttentionFn, EncoderBlock
 from ddp_tpu.parallel.ddp import StepMetrics
-from ddp_tpu.parallel.common import _preprocess
+from ddp_tpu.parallel.common import _preprocess, xent
 from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 
 
@@ -220,6 +220,7 @@ def make_pipe_vit_train_step(
     mesh: Mesh,
     *,
     compute_dtype=jnp.float32,
+    label_smoothing: float = 0.0,
     donate: bool = True,
 ):
     """``step(state, images, labels) -> (state, metrics)`` over dp×pp.
@@ -228,6 +229,10 @@ def make_pipe_vit_train_step(
     through the constrained update) stay sharded on ``pipe``; embed and
     head replicate, their gradients all-reduced over ``data`` by XLA.
     """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
     apply_fn = make_pipe_vit_apply(cfg, mesh)
     stage_sharding = NamedSharding(mesh, P("pipe"))
 
@@ -242,8 +247,8 @@ def make_pipe_vit_train_step(
     def step(state: PipeViTState, images, labels):
         def loss_fn(params):
             logits = apply_fn(params, _preprocess(images, compute_dtype))
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), labels
+            loss = xent(
+                logits.astype(jnp.float32), labels, label_smoothing
             ).mean()
             return loss, logits
 
@@ -270,6 +275,7 @@ def make_pipe_vit_1f1b_train_step(
     mesh: Mesh,
     *,
     compute_dtype=jnp.float32,
+    label_smoothing: float = 0.0,
     donate: bool = True,
 ):
     """``step(state, images, labels)`` under the 1F1B schedule.
@@ -284,6 +290,10 @@ def make_pipe_vit_1f1b_train_step(
     """
     from ddp_tpu.parallel.one_f1b import schedule_1f1b, spmd_pipeline_1f1b
 
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
     embed, stage, head = _modules(cfg)
     S = mesh.shape["pipe"]
     M = cfg.num_microbatches
@@ -307,9 +317,7 @@ def make_pipe_vit_1f1b_train_step(
 
     def loss_fn(logits, lbl):
         logits = logits.astype(jnp.float32)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, lbl
-        ).sum()
+        loss = xent(logits, lbl, label_smoothing).sum()
         correct = (jnp.argmax(logits, -1) == lbl).sum().astype(jnp.float32)
         return loss, correct
 

@@ -307,16 +307,10 @@ def make_seq_parallel_train_step(
     lspec = P(_batch_axes(mesh))
 
     def loss_and_correct(params, x, labels):
+        from ddp_tpu.parallel.common import xent
+
         logits = apply_fn(params, x).astype(jnp.float32)
-        if label_smoothing:
-            one_hot = optax.smooth_labels(
-                jax.nn.one_hot(labels, spec.num_classes), label_smoothing
-            )
-            loss = optax.softmax_cross_entropy(logits, one_hot).mean()
-        else:
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels
-            ).mean()
+        loss = xent(logits, labels, label_smoothing).mean()
         correct = (jnp.argmax(logits, -1) == labels).sum().astype(jnp.float32)
         return loss, correct
 

@@ -39,6 +39,27 @@ def _preprocess(images: jax.Array, compute_dtype) -> jax.Array:
     return images.astype(compute_dtype)
 
 
+def xent(logits32, labels, label_smoothing: float = 0.0):
+    """Per-example softmax cross-entropy, optionally α-smoothed.
+
+    The one definition of the smoothing semantics for every step
+    family (plain/GSPMD, seq, pipe): α > 0 trains against
+    ``(1-α)·one_hot + α/num_classes`` targets. Unreduced — callers
+    mean over the batch (or sum per microbatch and divide outside,
+    as the 1F1B schedule does).
+    """
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
+    if label_smoothing:
+        targets = optax.smooth_labels(
+            jax.nn.one_hot(labels, logits32.shape[-1]), label_smoothing
+        )
+        return optax.softmax_cross_entropy(logits32, targets)
+    return optax.softmax_cross_entropy_with_integer_labels(logits32, labels)
+
+
 def make_loss_fn(
     model,
     compute_dtype,
@@ -78,15 +99,7 @@ def make_loss_fn(
             logits = model.apply(variables, x, rngs={"dropout": rng}, **train_kw)
             new_ms = model_state
         logits32 = logits.astype(jnp.float32)
-        if label_smoothing:
-            targets = optax.smooth_labels(
-                jax.nn.one_hot(labels, logits32.shape[-1]), label_smoothing
-            )
-            loss = optax.softmax_cross_entropy(logits32, targets).mean()
-        else:
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits32, labels
-            ).mean()
+        loss = xent(logits32, labels, label_smoothing).mean()
         if "losses" in mutable:
             loss = loss + aux_loss_weight * sum(
                 jax.tree.leaves(new_ms["losses"])

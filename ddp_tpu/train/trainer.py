@@ -142,13 +142,12 @@ class Trainer:
             or config.grad_accum_steps > 1
             or config.fast_epoch
             or config.augment not in (None, "none")
-            or config.label_smoothing
         ):
             raise ValueError(
                 "--model pipe_vit composes with the data axis, bf16, "
-                "remat, EMA and LR schedules — not tp/fsdp/expert/seq/"
-                "zero1, accumulation (use --num_microbatches), augment, "
-                "label smoothing, or --fast_epoch"
+                "remat, label smoothing, EMA and LR schedules — not "
+                "tp/fsdp/expert/seq/zero1, accumulation (use "
+                "--num_microbatches), augment, or --fast_epoch"
             )
         if (self.seq_mode or self.pipe_mode) and (
             config.num_heads < 1
@@ -581,6 +580,7 @@ class Trainer:
             pipe_step = make_step(
                 self.pipe_cfg, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype,
+                label_smoothing=config.label_smoothing,
             )
 
             def step(ts, images, labels):
