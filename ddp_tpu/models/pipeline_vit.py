@@ -41,6 +41,10 @@ class PipeViTConfig(NamedTuple):
     num_microbatches: int = 4
     attention_fn: Optional[AttentionFn] = None
     remat: bool = False  # jax.checkpoint each stage's blocks
+    # Interleaved schedule only: v model chunks per device (total
+    # depth num_stages × virtual_stages × depth_per_stage blocks),
+    # placed round-robin — parallel/interleaved.py.
+    virtual_stages: int = 1
 
 
 class PatchEmbed(nn.Module):
@@ -149,6 +153,56 @@ def sequential_apply(cfg: PipeViTConfig, params: PipeViTParams, images):
         stage_p = jax.tree.map(lambda p: p[s], params.stages)
         x = stage.apply({"params": stage_p}, x)
     return head.apply({"params": params.head}, x)
+
+
+def init_pipe_vit_interleaved(
+    cfg: PipeViTConfig, sample_input, *, seed: int = 0
+) -> PipeViTParams:
+    """Interleaved layout: C = S·v chunks stacked as [v, S, …].
+
+    Chunk c = k·S + d sits at stages[k, d] — sharding dim 1 over
+    ``pipe`` places it on device c mod S, the round-robin placement
+    the interleaved schedule requires (consecutive chunks on
+    consecutive devices; a flat [C] array sharded over pipe would
+    place them BLOCKED, which is just a deeper plain pipeline). Chunk
+    c is seeded fold_in(seed, 1+c), matching ``init_pipe_vit``'s
+    per-stage seeding so v=1 interleaved == the plain layout.
+    """
+    embed, stage, head = _modules(cfg)
+    C = cfg.num_stages * cfg.virtual_stages
+    k = jax.random.key(seed)
+    embed_p = embed.init(k, sample_input)["params"]
+    feats = embed.apply({"params": embed_p}, sample_input)
+    chunk_ps = [
+        stage.init(jax.random.fold_in(k, 1 + c), feats)["params"]
+        for c in range(C)
+    ]
+    head_p = head.init(jax.random.fold_in(k, 0), feats)["params"]
+    flat = stack_stage_params(chunk_ps)  # [C, ...] in chunk order
+    stages = jax.tree.map(
+        lambda p: p.reshape(cfg.virtual_stages, cfg.num_stages, *p.shape[1:]),
+        flat,
+    )
+    return PipeViTParams(embed_p, stages, head_p)
+
+
+def sequential_apply_interleaved(
+    cfg: PipeViTConfig, params: PipeViTParams, images
+):
+    """Reference forward over the [v, S, …] chunk layout — same math
+    as the interleaved pipeline, one device. Also serves as the eval
+    forward (jitted, XLA gathers each chunk's params as it goes).
+
+    Flattens [k, d] → chunk c = k·S + d and delegates to
+    ``sequential_apply`` (StageBlocks is num_stages-agnostic), so
+    there is exactly one copy of the reference forward loop."""
+    C = cfg.num_stages * cfg.virtual_stages
+    flat = jax.tree.map(
+        lambda p: p.reshape(C, *p.shape[2:]), params.stages
+    )
+    return sequential_apply(
+        cfg._replace(num_stages=C), params._replace(stages=flat), images
+    )
 
 
 def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
@@ -384,6 +438,124 @@ def make_pipe_vit_1f1b_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
+def make_pipe_vit_interleaved_train_step(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    compute_dtype=jnp.float32,
+    label_smoothing: float = 0.0,
+    donate: bool = True,
+):
+    """``step(state, images, labels)`` under the interleaved-1F1B
+    schedule (v = cfg.virtual_stages model chunks per device).
+
+    Same contract as the other pipe steps; the model is
+    S·v·depth_per_stage blocks deep, chunk weights rest at
+    stages[k, d] sharded P(None, pipe) (round-robin placement). The
+    bubble shrinks to (S−1)/(v·M+S−1) — parallel/interleaved.py.
+    Gradient parity vs the single-device reference step is pinned by
+    tests/test_interleaved.py.
+    """
+    from ddp_tpu.parallel.interleaved import (
+        schedule_interleaved,
+        spmd_pipeline_interleaved,
+    )
+
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
+    embed, stage, head = _modules(cfg)
+    S = mesh.shape["pipe"]
+    M = cfg.num_microbatches
+    if S != cfg.num_stages:
+        raise ValueError(
+            f"mesh pipe axis {S} != cfg.num_stages {cfg.num_stages}"
+        )
+    sched = schedule_interleaved(S, M, cfg.virtual_stages)
+    has_data = mesh.shape.get("data", 1) > 1
+    bspec = P("data") if has_data else P()
+    mbspec = P(None, "pipe", "data") if has_data else P(None, "pipe")
+    lblspec = P(None, "data") if has_data else P()
+    stage_sharding = NamedSharding(mesh, P(None, "pipe"))
+
+    def stage_fn(p, x):
+        return stage.apply({"params": p}, x)
+
+    def first_fn(p, raw):
+        return embed.apply({"params": p}, raw)
+
+    def last_fn(p, x):
+        return head.apply({"params": p}, x)
+
+    def loss_fn(logits, lbl):
+        logits = logits.astype(jnp.float32)
+        loss = xent(logits, lbl, label_smoothing).sum()
+        correct = (jnp.argmax(logits, -1) == lbl).sum().astype(jnp.float32)
+        return loss, correct
+
+    def inner(sp, ep, hp, m, l):
+        loss, aux, gs, gf, gl = spmd_pipeline_interleaved(
+            stage_fn, sp, m, l, loss_fn, sched, axis_name="pipe",
+            first_fn=first_fn, first_params=ep,
+            last_fn=last_fn, last_params=hp,
+        )
+        if has_data:
+            loss = lax.psum(loss, "data")
+            aux = lax.psum(aux, "data")
+            gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
+            gf = jax.tree.map(lambda g: lax.psum(g, "data"), gf)
+            gl = jax.tree.map(lambda g: lax.psum(g, "data"), gl)
+        return loss, aux, gs, gf, gl
+
+    run = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(None, "pipe"), P(), P(), mbspec, lblspec),
+        out_specs=(P(), P(), P(None, "pipe"), P(), P()),
+        check_vma=False,
+    )
+
+    def constrain(params: PipeViTParams) -> PipeViTParams:
+        return params._replace(
+            stages=jax.tree.map(
+                lambda x: lax.with_sharding_constraint(x, stage_sharding),
+                params.stages,
+            )
+        )
+
+    def step(state: PipeViTState, images, labels):
+        images = lax.with_sharding_constraint(
+            _preprocess(images, compute_dtype),
+            NamedSharding(mesh, bspec),
+        )
+        B = images.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
+        lbl_mb = labels.reshape(M, B // M)
+        loss_sum, correct, gs, gf, gl = run(
+            state.params.stages, state.params.embed, state.params.head,
+            mbs, lbl_mb,
+        )
+        grads = jax.tree.map(
+            lambda g: (g / B).astype(jnp.float32),
+            PipeViTParams(embed=gf, stages=gs, head=gl),
+        )
+        grads = constrain(grads)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = constrain(optax.apply_updates(state.params, updates))
+        return (
+            PipeViTState(state.step + 1, params, opt_state),
+            StepMetrics(loss=loss_sum / B, accuracy=correct / B),
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
 def create_pipe_vit_state(
     cfg: PipeViTConfig,
     optimizer: optax.GradientTransformation,
@@ -408,6 +580,39 @@ def create_pipe_vit_state(
     # restore templated on this state places everything mesh-wide
     # (a single-device step scalar would clash with the sharded
     # params at the first jitted step after resume).
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
+        opt_state,
+    )
+    return PipeViTState(
+        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        params=params,
+        opt_state=opt_state,
+    )
+
+
+def create_pipe_vit_state_interleaved(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+) -> PipeViTState:
+    """Like ``create_pipe_vit_state`` but with the [v, S, …]
+    round-robin chunk layout resting sharded P(None, pipe)."""
+    params = init_pipe_vit_interleaved(cfg, sample_input, seed=seed)
+    stage_sharding = NamedSharding(mesh, P(None, "pipe"))
+    rep = NamedSharding(mesh, P())
+    params = PipeViTParams(
+        embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),
+        stages=jax.tree.map(
+            lambda x: jax.device_put(x, stage_sharding), params.stages
+        ),
+        head=jax.tree.map(lambda x: jax.device_put(x, rep), params.head),
+    )
+    opt_state = optimizer.init(params)
+    # Same scalar-placement rationale as create_pipe_vit_state.
     opt_state = jax.tree.map(
         lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
         opt_state,

@@ -1,0 +1,496 @@
+"""Interleaved 1F1B: virtual pipeline stages cutting the bubble.
+
+Plain 1F1B (parallel/one_f1b.py) keeps the activation stash O(S) but
+inherits GPipe's bubble (S−1)/(M+S−1): each device holds ONE
+contiguous model slice, so the pipe fills once per step. Interleaving
+(the Megatron-LM virtual-stage schedule) cuts the model into
+C = S·v chunks placed ROUND-ROBIN — chunk c lives on device c mod S,
+so device d holds chunks {d, d+S, …, d+(v−1)S} — and a microbatch
+visits every device v times. The pipe now fills with v·M
+microbatch-chunks instead of M, shrinking the bubble toward
+(S−1)/(v·M+S−1) at the cost of v× the activation-transport volume
+(every chunk boundary is a ring hop, including the S−1 → 0 wrap).
+
+The reference stack has no pipeline schedule at all (SURVEY.md §2c —
+its parallelism is DDP, train_ddp.py:199); this module is the
+framework's own depth, designed for the TPU execution model the same
+way one_f1b.py is:
+
+- The timetable is computed ONCE on the host (``schedule_interleaved``)
+  by simulating the canonical Megatron per-device op order (warmup
+  forwards, 1B1F steady state, cooldown) slot-synchronously with
+  explicit transport waits. Unlike the plain-1F1B simulator, which
+  asserts its transport invariants after the fact, this one makes
+  them unviolable by construction: an op only lands in the table when
+  its input message has arrived, its stash slot is free, and the
+  downstream pending ring can absorb its output. Buffer depths too
+  shallow to keep the order moving retry deeper rather than
+  deadlocking; the measured bubble equals the schedule's ideal
+  (S−1)/(v·M+S−1) at every tested (S, M, v).
+- The device program is one ``lax.scan`` over the slot tables
+  (op/microbatch/chunk per device per slot), each slot one
+  ``lax.switch`` — forward (stash the chunk input, run the chunk) or
+  backward (recompute from the stash, VJP) — exactly as in
+  one_f1b.py, plus a chunk index selecting this device's parameter
+  slice. All transport is two cyclic ``ppermute`` rings (activations
+  +1, cotangents −1); receivers latch from the schedule table, so the
+  collectives stay uniform and XLA-friendly.
+- Pending buffers are small rings per chunk (slot m mod ring_depth,
+  depth 2 in practice): a sender may run a slot ahead of its
+  receiver's consumption, which is what keeps the steady-state 1F1B
+  ping-pong dense.
+
+Memory per device: stash O(v·Z) chunk inputs (Z ≈ S — reported by the
+schedule), pending 4·v activations — independent of M, preserving
+1F1B's point while the bubble shrinks with v.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+class InterleavedSchedule(NamedTuple):
+    """Host-computed interleaved-1F1B timetable.
+
+    ``op``/``mb``/``ck``: [n_slots, S] int32 — per device per slot:
+    what to run (0 idle, 1 forward, 2 backward), on which microbatch,
+    and on which LOCAL chunk k (global chunk c = k·S + d).
+    ``stash_depth``: per-chunk stash slots the kernel must allocate
+    (slot index = microbatch mod stash_depth; collision-free by
+    construction).
+    """
+
+    op: np.ndarray
+    mb: np.ndarray
+    ck: np.ndarray
+    stash_depth: int
+    ring_depth: int
+    virtual_stages: int
+
+    @property
+    def n_slots(self) -> int:
+        return self.op.shape[0]
+
+    def bubble_fraction(self) -> float:
+        """Measured idle fraction of the timetable."""
+        return float((self.op == IDLE).sum()) / self.op.size
+
+
+class _Deadlock(Exception):
+    pass
+
+
+def _device_op_sequence(S: int, M: int, V: int) -> list[list[tuple]]:
+    """The canonical Megatron interleaved op order per device.
+
+    Forward order: groups of S microbatches, chunks ascending within a
+    group (device d runs S microbatches through its chunk k, then the
+    same S through chunk k+1, …). Backward order mirrors it with
+    chunks descending. Each device's timetable is W warmup forwards —
+    W = 2(S−d−1) + (v−1)S + 1, the depth at which its first backward
+    becomes reachable — then strict 1B1F alternation, then cooldown
+    backwards. Simulating THIS order with explicit transport waits
+    (rather than re-deriving a schedule greedily) is what reproduces
+    the schedule's ideal bubble (S−1)/(v·M+S−1); a free-form greedy
+    loses density in the steady state (measured: 0.21 vs the ideal
+    0.086 at S=4, M=16, v=2).
+    """
+    fo, bo = [], []
+    for g in range(M // S):
+        for k in range(V):
+            for i in range(S):
+                fo.append((g * S + i, k))
+        for k in reversed(range(V)):
+            for i in range(S):
+                bo.append((g * S + i, k))
+    seqs = []
+    total = M * V
+    for d in range(S):
+        W = min((S - d - 1) * 2 + (V - 1) * S + 1, total)
+        s = [(FWD, *fo[i]) for i in range(W)]
+        fi, bi = W, 0
+        while fi < total or bi < total:
+            if bi < total:
+                s.append((BWD, *bo[bi]))
+                bi += 1
+            if fi < total:
+                s.append((FWD, *fo[fi]))
+                fi += 1
+        seqs.append(s)
+    return seqs
+
+
+def _simulate(S: int, M: int, V: int, Z: int, ring: int) -> InterleavedSchedule:
+    """Slot-synchronous simulation of the canonical order with
+    transport waits; raises _Deadlock if the buffer depths cannot
+    keep the order moving."""
+    C = S * V
+    seq = _device_op_sequence(S, M, V)
+    ptr = [0] * S
+    F_done = [[None] * C for _ in range(M)]
+    # pend_*[d][k][r]: microbatch occupying ring slot r, or None.
+    # Occupied from arrival until the consuming op's slot.
+    pend_act = [[[None] * ring for _ in range(V)] for _ in range(S)]
+    pend_cot = [[[None] * ring for _ in range(V)] for _ in range(S)]
+    # stash[d][k]: occupied slots (m mod Z), chunks c>0 only (chunk
+    # 0's backward re-fetches the raw microbatch instead).
+    stash = [[set() for _ in range(V)] for _ in range(S)]
+
+    ops, mbs, cks = [], [], []
+    t = 0
+    max_slots = 8 * (V * M + S) + 64
+    while any(ptr[d] < len(seq[d]) for d in range(S)):
+        if t > max_slots:
+            raise _Deadlock(f"S={S} M={M} V={V} Z={Z} ring={ring}")
+        row_op, row_mb, row_ck = [IDLE] * S, [0] * S, [0] * S
+        effects = []  # arrivals land after every device chose
+        for d in range(S):
+            if ptr[d] >= len(seq[d]):
+                continue
+            opc, m, k = seq[d][ptr[d]]
+            c = k * S + d
+            if opc == FWD:
+                if c > 0 and pend_act[d][k][m % ring] != m:
+                    continue  # input not arrived yet
+                if c > 0 and m % Z in stash[d][k]:
+                    continue  # stash slot not yet freed
+                if c < C - 1:
+                    rd = (d + 1) % S
+                    rk = k if d < S - 1 else k + 1
+                    if pend_act[rd][rk][m % ring] is not None:
+                        continue  # downstream ring slot still full
+            else:
+                if c == C - 1:
+                    if not (F_done[m][c] is not None and F_done[m][c] < t):
+                        continue
+                elif pend_cot[d][k][m % ring] != m:
+                    continue
+                if c > 0:
+                    rd = (d - 1) % S
+                    rk = k if d > 0 else k - 1
+                    if pend_cot[rd][rk][m % ring] is not None:
+                        continue
+            ptr[d] += 1
+            row_op[d], row_mb[d], row_ck[d] = opc, m, k
+            if opc == FWD:
+                F_done[m][c] = t
+                if c > 0:
+                    pend_act[d][k][m % ring] = None  # consumed
+                    stash[d][k].add(m % Z)
+                if c < C - 1:
+                    rd = (d + 1) % S
+                    rk = k if d < S - 1 else k + 1
+                    effects.append((pend_act, rd, rk, m))
+            else:
+                if c > 0:
+                    stash[d][k].discard(m % Z)
+                if c < C - 1:
+                    pend_cot[d][k][m % ring] = None  # consumed
+                if c > 0:
+                    rd = (d - 1) % S
+                    rk = k if d > 0 else k - 1
+                    effects.append((pend_cot, rd, rk, m))
+        for buf, rd, rk, m in effects:
+            assert buf[rd][rk][m % ring] is None
+            buf[rd][rk][m % ring] = m  # arrives end of slot t
+        ops.append(row_op)
+        mbs.append(row_mb)
+        cks.append(row_ck)
+        t += 1
+    return InterleavedSchedule(
+        np.asarray(ops, np.int32),
+        np.asarray(mbs, np.int32),
+        np.asarray(cks, np.int32),
+        Z,
+        ring,
+        V,
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def schedule_interleaved(
+    num_stages: int, num_microbatches: int, virtual_stages: int
+) -> InterleavedSchedule:
+    """Interleaved-1F1B timetable for S stages × v chunks, M microbatches.
+
+    Tries (stash, ring) depths shallow-first and returns the first
+    that completes — measured: (2S, 2) suffices everywhere tested and
+    yields exactly the ideal bubble. The returned depths size the
+    kernel's buffers. Cached: the trainer computes the same table for
+    its startup log and its step factory (pure O(slots·S) Python).
+    """
+    S, M, V = num_stages, num_microbatches, virtual_stages
+    if S < 2:
+        raise ValueError("interleaved 1F1B needs a pipe axis of >= 2 stages")
+    if V < 1:
+        raise ValueError(f"virtual_stages must be >= 1, got {V}")
+    if M % S:
+        raise ValueError(f"{M} microbatches not divisible by {S} stages")
+    last = None
+    for Z in (S, 2 * S, 4 * S, max(M, 1)):
+        for ring in (2, 4):
+            try:
+                return _simulate(S, M, V, min(Z, max(M, 1)), min(ring, max(M, 1)))
+            except _Deadlock as e:  # deepen the buffers and retry
+                last = e
+    raise RuntimeError(f"interleaved schedule did not converge: {last}")
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    labels: jax.Array,
+    loss_fn: Callable[[jax.Array, jax.Array], tuple[jax.Array, Any]],
+    schedule: InterleavedSchedule,
+    *,
+    axis_name: str = "pipe",
+    first_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    first_params: Any = None,
+    last_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    last_params: Any = None,
+):
+    """Run the combined forward+backward interleaved-1F1B timetable.
+
+    Call INSIDE shard_map over ``axis_name``. Mirrors
+    ``one_f1b.spmd_pipeline_1f1b`` except ``stage_params`` carries this
+    device's v chunk slices on a leading [v, 1] dim (global layout
+    [v, S, …] sharded P(None, pipe): local chunk k is global chunk
+    k·S + d). ``first_fn`` runs inside (device 0, chunk 0);
+    ``last_fn`` + loss inside (device S−1, chunk v−1).
+
+    Returns ``(loss_sum, aux_sum, g_stage, g_first, g_last)`` with
+    ``g_stage`` shaped like the local chunk slices ([v, 1, …] for
+    ``out_specs=P(None, axis_name)``). Gradients are SUMS over
+    microbatches — divide by the global batch outside.
+    """
+    params = jax.tree.map(lambda p: p[:, 0], stage_params)  # [v, ...]
+    stage = lax.axis_index(axis_name)
+    S = lax.psum(1, axis_name)
+    if S < 2:
+        raise ValueError("interleaved 1F1B needs a pipe axis of >= 2 stages")
+    V = schedule.virtual_stages
+    Z = schedule.stash_depth
+    RD = schedule.ring_depth
+    local_in = microbatches[:, 0]  # [R, mb, ...]
+    R = local_in.shape[0]
+    assert schedule.op.shape[1] == S, (schedule.op.shape, S)
+
+    if first_fn is None:
+        first_fn = lambda p, x: x
+    if last_fn is None:
+        last_fn = lambda p, x: x
+    raw_shape = jax.eval_shape(lambda x: x, local_in[0])
+    act_shape = jax.eval_shape(first_fn, first_params, local_in[0])
+
+    fwd_shift = [(i, (i + 1) % S) for i in range(S)]
+    bwd_shift = [(i, (i - 1) % S) for i in range(S)]
+
+    op_tab = jnp.asarray(schedule.op)
+    mb_tab = jnp.asarray(schedule.mb)
+    ck_tab = jnp.asarray(schedule.ck)
+
+    def chunk_params(k):
+        return jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, k, 0, keepdims=False),
+            params,
+        )
+
+    def zero_grads():
+        zg = jax.tree.map(lambda p: jnp.zeros_like(p[0]), params)
+        zf = jax.tree.map(jnp.zeros_like, first_params)
+        zl = jax.tree.map(jnp.zeros_like, last_params)
+        return zg, zf, zl
+
+    def slot(carry, xs):
+        (pend_act, pend_cot, stash_act,
+         g_stage, g_first, g_last, loss_acc, aux_acc) = carry
+        op_row, mb_row, ck_row, m0 = xs
+        my_op = op_row[stage]
+        my_m = mb_row[stage]
+        my_k = jnp.clip(ck_row[stage], 0, V - 1)
+        ring = my_m % RD
+
+        # Device-0 raw-microbatch fetch (chunk-0 forward consumes it,
+        # chunk-0 backward re-fetches it instead of stashing) — same
+        # masked-psum transport as the plain schedules: microbatch m
+        # rests on its home shard m mod S.
+        fresh = lax.psum(
+            jnp.where(
+                stage == m0 % S,
+                lax.dynamic_index_in_dim(
+                    local_in, jnp.clip(m0 // S, 0, R - 1), 0, keepdims=False
+                ),
+                jnp.zeros(raw_shape.shape, raw_shape.dtype),
+            ),
+            axis_name,
+        )
+
+        params_k = chunk_params(my_k)
+        pend_act_k = pend_act[my_k, ring]
+        pend_cot_k = pend_cot[my_k, ring]
+        slot_idx = my_m % Z
+        is_c0 = (stage == 0) & (my_k == 0)
+        is_last = (stage == S - 1) & (my_k == V - 1)
+        zero_act = jnp.zeros(act_shape.shape, act_shape.dtype)
+
+        def do_idle(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last, loss_acc, aux_acc,
+                zero_act, zero_act,
+            )
+
+        def do_fwd(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            x_in = lax.cond(
+                is_c0,
+                lambda: first_fn(first_params, fresh).astype(zero_act.dtype),
+                lambda: pend_act_k,
+            )
+            stash_act = stash_act.at[my_k, slot_idx].set(x_in)
+            y = stage_fn(params_k, x_in)
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last, loss_acc, aux_acc,
+                y, zero_act,
+            )
+
+        def do_bwd(args):
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc) = args
+            raw_m = fresh
+            act_m = stash_act[my_k, slot_idx]
+            lbl_m = lax.dynamic_index_in_dim(
+                labels, jnp.clip(my_m, 0, labels.shape[0] - 1), 0,
+                keepdims=False,
+            )
+
+            def bwd_first(_):
+                def f(sp, fp):
+                    return stage_fn(sp, first_fn(fp, raw_m).astype(act_m.dtype))
+
+                _, vjp = jax.vjp(f, params_k, first_params)
+                gs, gf = vjp(pend_cot_k)
+                _, zf, zl = zero_grads()
+                return gs, gf, zl, zero_act, jnp.float32(0), jnp.float32(0)
+
+            def bwd_mid(_):
+                def f(sp, x):
+                    return stage_fn(sp, x)
+
+                _, vjp = jax.vjp(f, params_k, act_m)
+                gs, gx = vjp(pend_cot_k)
+                _, zf, zl = zero_grads()
+                return gs, zf, zl, gx, jnp.float32(0), jnp.float32(0)
+
+            def bwd_last(_):
+                def f(sp, lp, x):
+                    out = last_fn(lp, stage_fn(sp, x))
+                    loss, aux = loss_fn(out, lbl_m)
+                    return loss, aux
+
+                loss, vjp, aux = jax.vjp(
+                    f, params_k, last_params, act_m, has_aux=True
+                )
+                gs, gl, gx = vjp(jnp.float32(1.0))
+                _, zf, _ = zero_grads()
+                return (
+                    gs, zf, gl, gx,
+                    loss.astype(jnp.float32), jnp.asarray(aux, jnp.float32),
+                )
+
+            role = jnp.where(is_c0, 0, jnp.where(is_last, 2, 1))
+            gs, gf, gl, gx, loss, aux = lax.switch(
+                role, [bwd_first, bwd_mid, bwd_last], None
+            )
+            g_stage = jax.tree.map(
+                lambda G, g: G.at[my_k].add(g), g_stage, gs
+            )
+            g_first = jax.tree.map(jnp.add, g_first, gf)
+            g_last = jax.tree.map(jnp.add, g_last, gl)
+            return (
+                pend_act, pend_cot, stash_act,
+                g_stage, g_first, g_last,
+                loss_acc + loss, aux_acc + aux,
+                zero_act, gx,
+            )
+
+        out = lax.switch(
+            my_op, [do_idle, do_fwd, do_bwd],
+            (pend_act, pend_cot, stash_act,
+             g_stage, g_first, g_last, loss_acc, aux_acc),
+        )
+        (pend_act, pend_cot, stash_act,
+         g_stage, g_first, g_last, loss_acc, aux_acc,
+         act_msg, cot_msg) = out
+
+        # Cyclic ring transport; receivers latch per the table. The
+        # activation ring wraps S−1 → 0 carrying chunk k → k+1 (the
+        # interleaving); the final chunk's forward output has no
+        # receiver (its loss runs in its own backward) and the first
+        # chunk's backward emits no cotangent — both masked below.
+        act_arrived = lax.ppermute(act_msg, axis_name, fwd_shift)
+        cot_arrived = lax.ppermute(cot_msg, axis_name, bwd_shift)
+        up = (stage - 1) % S
+        down = (stage + 1) % S
+        up_op, up_ck, up_m = op_row[up], ck_row[up], mb_row[up]
+        down_op, down_ck, down_m = op_row[down], ck_row[down], mb_row[down]
+        act_k = jnp.clip(jnp.where(stage > 0, up_ck, up_ck + 1), 0, V - 1)
+        act_take = (up_op == FWD) & ~((up == S - 1) & (up_ck == V - 1))
+        pend_act = jnp.where(
+            act_take,
+            pend_act.at[act_k, up_m % RD].set(act_arrived),
+            pend_act,
+        )
+        cot_k = jnp.clip(
+            jnp.where(stage < S - 1, down_ck, down_ck - 1), 0, V - 1
+        )
+        cot_take = (down_op == BWD) & ~((down == 0) & (down_ck == 0))
+        pend_cot = jnp.where(
+            cot_take,
+            pend_cot.at[cot_k, down_m % RD].set(cot_arrived),
+            pend_cot,
+        )
+        return (
+            pend_act, pend_cot, stash_act,
+            g_stage, g_first, g_last, loss_acc, aux_acc,
+        ), None
+
+    zg, zf, zl = zero_grads()
+    g0 = jax.tree.map(
+        lambda z: jnp.zeros((V, *z.shape), z.dtype), zg
+    )
+    carry = (
+        jnp.zeros((V, RD, *act_shape.shape), act_shape.dtype),
+        jnp.zeros((V, RD, *act_shape.shape), act_shape.dtype),
+        jnp.zeros((V, Z, *act_shape.shape), act_shape.dtype),
+        g0, zf, zl,
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+    )
+    m0_seq = jnp.asarray(schedule.mb[:, 0])
+    carry, _ = lax.scan(slot, carry, (op_tab, mb_tab, ck_tab, m0_seq))
+    (_, _, _, g_stage, g_first, g_last, loss_acc, aux_acc) = carry
+
+    loss_sum = lax.psum(loss_acc, axis_name)
+    aux_sum = lax.psum(aux_acc, axis_name)
+    g_first = lax.psum(g_first, axis_name)
+    g_last = lax.psum(g_last, axis_name)
+    return (
+        loss_sum, aux_sum,
+        jax.tree.map(lambda g: g[:, None], g_stage),
+        g_first, g_last,
+    )

@@ -70,10 +70,13 @@ class TrainConfig:
     mesh_model: int = 1  # tensor parallelism
     # Pipeline parallelism (--model pipe_vit): stages over the pipe
     # axis; microbatches stream through (parallel/pipeline.py), with
-    # --pipe_schedule picking differentiable GPipe or hand-scheduled
-    # 1F1B (parallel/one_f1b.py — O(S) activation stash).
+    # --pipe_schedule picking differentiable GPipe, hand-scheduled
+    # 1F1B (parallel/one_f1b.py — O(S) activation stash), or
+    # interleaved 1F1B (parallel/interleaved.py — --virtual_stages v
+    # model chunks per device, bubble (S−1)/(v·M+S−1)).
     mesh_pipe: int = 1
-    pipe_schedule: str = "gpipe"  # gpipe | 1f1b
+    pipe_schedule: str = "gpipe"  # gpipe | 1f1b | interleaved
+    virtual_stages: int = 1  # interleaved only: chunks per device
     num_microbatches: int = 4
     mesh_fsdp: int = 1  # parameter+optimizer sharding
     mesh_expert: int = 1  # MoE expert parallelism
@@ -185,7 +188,10 @@ class TrainConfig:
         p.add_argument("--mesh_pipe", type=int, default=cls.mesh_pipe)
         p.add_argument(
             "--pipe_schedule", default=cls.pipe_schedule,
-            choices=("gpipe", "1f1b"),
+            choices=("gpipe", "1f1b", "interleaved"),
+        )
+        p.add_argument(
+            "--virtual_stages", type=int, default=cls.virtual_stages
         )
         p.add_argument(
             "--num_microbatches", type=int, default=cls.num_microbatches

@@ -164,6 +164,16 @@ class Trainer:
                 f"--num_microbatches must be >= 1, got "
                 f"{config.num_microbatches}"
             )
+        if config.virtual_stages < 1:
+            raise ValueError(
+                f"--virtual_stages must be >= 1, got {config.virtual_stages}"
+            )
+        if config.virtual_stages > 1 and config.pipe_schedule != "interleaved":
+            raise ValueError(
+                "--virtual_stages places multiple model chunks per "
+                "device, which only the interleaved schedule streams: "
+                "add --pipe_schedule interleaved"
+            )
         if self.pipe_mode and config.num_microbatches % config.mesh_pipe:
             raise ValueError(
                 f"--num_microbatches {config.num_microbatches} must be "
@@ -524,9 +534,12 @@ class Trainer:
                 PipeViTConfig,
                 PipeViTState,
                 create_pipe_vit_state,
+                create_pipe_vit_state_interleaved,
                 make_pipe_vit_1f1b_train_step,
                 make_pipe_vit_apply,
+                make_pipe_vit_interleaved_train_step,
                 make_pipe_vit_train_step,
+                sequential_apply_interleaved,
             )
             import optax
 
@@ -551,6 +564,7 @@ class Trainer:
                 )
             H = int(train_split.images.shape[1])
             pipe_heads = config.num_heads  # validated in __init__ above
+            interleaved = config.pipe_schedule == "interleaved"
             self.pipe_cfg = PipeViTConfig(
                 num_classes=config.num_classes
                 or NUM_CLASSES.get(self.dataset, 10),
@@ -561,22 +575,45 @@ class Trainer:
                 depth_per_stage=config.model_depth or 1,
                 num_microbatches=config.num_microbatches,
                 remat=config.remat,
+                virtual_stages=config.virtual_stages,
             )
-            logger.info(
-                "Pipeline: %d stages × %d blocks, %d microbatches, "
-                "%s schedule, bubble fraction %.3f",
-                self.pipe_cfg.num_stages, self.pipe_cfg.depth_per_stage,
-                self.pipe_cfg.num_microbatches, config.pipe_schedule,
-                bubble_fraction(
+            if interleaved:
+                from ddp_tpu.parallel.interleaved import schedule_interleaved
+
+                sched = schedule_interleaved(
                     self.pipe_cfg.num_stages,
                     self.pipe_cfg.num_microbatches,
-                ),
-            )
-            make_step = (
-                make_pipe_vit_1f1b_train_step
-                if config.pipe_schedule == "1f1b"
-                else make_pipe_vit_train_step
-            )
+                    self.pipe_cfg.virtual_stages,
+                )
+                logger.info(
+                    "Pipeline: %d stages × %d virtual × %d blocks, %d "
+                    "microbatches, interleaved schedule, bubble "
+                    "fraction %.3f (plain 1F1B: %.3f)",
+                    self.pipe_cfg.num_stages,
+                    self.pipe_cfg.virtual_stages,
+                    self.pipe_cfg.depth_per_stage,
+                    self.pipe_cfg.num_microbatches,
+                    sched.bubble_fraction(),
+                    bubble_fraction(
+                        self.pipe_cfg.num_stages,
+                        self.pipe_cfg.num_microbatches,
+                    ),
+                )
+            else:
+                logger.info(
+                    "Pipeline: %d stages × %d blocks, %d microbatches, "
+                    "%s schedule, bubble fraction %.3f",
+                    self.pipe_cfg.num_stages, self.pipe_cfg.depth_per_stage,
+                    self.pipe_cfg.num_microbatches, config.pipe_schedule,
+                    bubble_fraction(
+                        self.pipe_cfg.num_stages,
+                        self.pipe_cfg.num_microbatches,
+                    ),
+                )
+            make_step = {
+                "1f1b": make_pipe_vit_1f1b_train_step,
+                "interleaved": make_pipe_vit_interleaved_train_step,
+            }.get(config.pipe_schedule, make_pipe_vit_train_step)
             pipe_step = make_step(
                 self.pipe_cfg, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype,
@@ -597,7 +634,16 @@ class Trainer:
                 )
 
             self.train_step = step
-            apply_fn = jax.jit(make_pipe_vit_apply(self.pipe_cfg, self.mesh))
+            if interleaved:
+                # Eval rides the dense forward over the [v, S] chunk
+                # layout — XLA gathers each chunk's weights as it
+                # goes; eval is off the step's critical path.
+                pipe_cfg = self.pipe_cfg
+                apply_fn = jax.jit(
+                    lambda p, x: sequential_apply_interleaved(pipe_cfg, p, x)
+                )
+            else:
+                apply_fn = jax.jit(make_pipe_vit_apply(self.pipe_cfg, self.mesh))
 
             def eval_step(params, model_state, images, labels, weights):
                 del model_state
@@ -611,7 +657,12 @@ class Trainer:
                 return correct, (loss * weights).sum()
 
             self.eval_step = jax.jit(eval_step)
-            st = create_pipe_vit_state(
+            make_state = (
+                create_pipe_vit_state_interleaved
+                if interleaved
+                else create_pipe_vit_state
+            )
+            st = make_state(
                 self.pipe_cfg, self.optimizer, sample, self.mesh,
                 seed=config.seed,
             )
