@@ -556,16 +556,17 @@ def make_pipe_vit_interleaved_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def create_pipe_vit_state(
+def _create_state(
     cfg: PipeViTConfig,
     optimizer: optax.GradientTransformation,
     sample_input,
     mesh: Mesh,
-    *,
-    seed: int = 0,
+    seed: int,
+    init_fn,
+    stage_spec: P,
 ) -> PipeViTState:
-    params = init_pipe_vit(cfg, sample_input, seed=seed)
-    stage_sharding = NamedSharding(mesh, P("pipe"))
+    params = init_fn(cfg, sample_input, seed=seed)
+    stage_sharding = NamedSharding(mesh, stage_spec)
     rep = NamedSharding(mesh, P())
     params = PipeViTParams(
         embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),
@@ -591,6 +592,19 @@ def create_pipe_vit_state(
     )
 
 
+def create_pipe_vit_state(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+) -> PipeViTState:
+    return _create_state(
+        cfg, optimizer, sample_input, mesh, seed, init_pipe_vit, P("pipe")
+    )
+
+
 def create_pipe_vit_state_interleaved(
     cfg: PipeViTConfig,
     optimizer: optax.GradientTransformation,
@@ -601,24 +615,7 @@ def create_pipe_vit_state_interleaved(
 ) -> PipeViTState:
     """Like ``create_pipe_vit_state`` but with the [v, S, …]
     round-robin chunk layout resting sharded P(None, pipe)."""
-    params = init_pipe_vit_interleaved(cfg, sample_input, seed=seed)
-    stage_sharding = NamedSharding(mesh, P(None, "pipe"))
-    rep = NamedSharding(mesh, P())
-    params = PipeViTParams(
-        embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),
-        stages=jax.tree.map(
-            lambda x: jax.device_put(x, stage_sharding), params.stages
-        ),
-        head=jax.tree.map(lambda x: jax.device_put(x, rep), params.head),
-    )
-    opt_state = optimizer.init(params)
-    # Same scalar-placement rationale as create_pipe_vit_state.
-    opt_state = jax.tree.map(
-        lambda x: jax.device_put(x, rep) if jnp.ndim(x) == 0 else x,
-        opt_state,
-    )
-    return PipeViTState(
-        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
-        params=params,
-        opt_state=opt_state,
+    return _create_state(
+        cfg, optimizer, sample_input, mesh, seed,
+        init_pipe_vit_interleaved, P(None, "pipe"),
     )

@@ -60,6 +60,52 @@ def best_attention(*, causal: bool = False, block_q: int = 512,
     return fn
 
 
+def gspmd_flash_attention(mesh, *, causal: bool = False, block_q: int = 512,
+                          block_k: int = 512, interpret: bool = False):
+    """Size-dispatched attention usable INSIDE a GSPMD-jitted step.
+
+    The GSPMD step (parallel/spmd.py) partitions by annotation, but a
+    compiled Mosaic custom call has no partitioning rule, so the flash
+    kernel can't ride plain propagation there. This wrapper routes the
+    flash case through a ``shard_map`` island instead: batch over the
+    data-parallel axes (the same set as ``spmd.batch_spec``), heads
+    over ``model`` when tensor parallelism is on (the Megatron layout
+    already shards attention heads there, so the island's specs match
+    the activations' natural placement — no resharding), sequence and
+    head_dim whole per shard. Below ``FLASH_MIN_LEN`` keys it returns
+    the dense path exactly like ``best_attention`` (and always does on
+    non-TPU platforms unless ``interpret`` forces the kernel for
+    tests), so short-sequence models are untouched.
+    """
+    on_tpu = jax.devices()[0].platform == "tpu"
+    data_axes = tuple(
+        a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
+    )
+    tp = mesh.shape.get("model", 1)
+
+    def fn(q, k, v):
+        if (not on_tpu and not interpret) or k.shape[1] < FLASH_MIN_LEN:
+            return dot_product_attention(q, k, v, causal=causal)
+        from jax.sharding import PartitionSpec as P
+
+        from ddp_tpu.ops.flash import flash_attention
+
+        head_ax = "model" if tp > 1 and q.shape[2] % tp == 0 else None
+        spec = P(data_axes if data_axes else None, None, head_ax, None)
+        island = jax.shard_map(
+            lambda qq, kk, vv: flash_attention(
+                qq, kk, vv, causal, block_q, block_k, interpret
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return island(q, k, v)
+
+    return fn
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False):
     """Plain softmax attention, fp32 accumulation.
 

@@ -314,7 +314,8 @@ def spmd_pipeline_interleaved(
     def slot(carry, xs):
         (pend_act, pend_cot, stash_act,
          g_stage, g_first, g_last, loss_acc, aux_acc) = carry
-        op_row, mb_row, ck_row, m0 = xs
+        op_row, mb_row, ck_row = xs
+        m0 = mb_row[0]  # device 0's microbatch: the raw-fetch index
         my_op = op_row[stage]
         my_m = mb_row[stage]
         my_k = jnp.clip(ck_row[stage], 0, V - 1)
@@ -481,8 +482,7 @@ def spmd_pipeline_interleaved(
         jnp.float32(0.0),
         jnp.float32(0.0),
     )
-    m0_seq = jnp.asarray(schedule.mb[:, 0])
-    carry, _ = lax.scan(slot, carry, (op_tab, mb_tab, ck_tab, m0_seq))
+    carry, _ = lax.scan(slot, carry, (op_tab, mb_tab, ck_tab))
     (_, _, _, g_stage, g_first, g_last, loss_acc, aux_acc) = carry
 
     loss_sum = lax.psum(loss_acc, axis_name)

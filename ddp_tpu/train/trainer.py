@@ -168,6 +168,12 @@ class Trainer:
             raise ValueError(
                 f"--virtual_stages must be >= 1, got {config.virtual_stages}"
             )
+        if config.virtual_stages > 1 and not self.pipe_mode:
+            raise ValueError(
+                "--virtual_stages cuts a pipelined model into chunks: "
+                "use --model pipe_vit (with --mesh_pipe and "
+                "--pipe_schedule interleaved)"
+            )
         if config.virtual_stages > 1 and config.pipe_schedule != "interleaved":
             raise ValueError(
                 "--virtual_stages places multiple model chunks per "
@@ -336,16 +342,18 @@ class Trainer:
                 # The GSPMD step partitions by annotation; a compiled
                 # Mosaic custom call (the flash default on TPU) has no
                 # partitioning rule there, unlike the shard_map paths
-                # (DDP/seq/pipe) where Pallas is first-class. Pin the
-                # attention-bearing families to dense XLA under GSPMD —
-                # their attention is small (T≤197) and XLA partitions
-                # einsums exactly. (On CPU this is what best_attention
-                # resolves to anyway, so the branch is identical there.
-                # Attention-free families — the capability check — are
-                # simply left alone.)
-                from ddp_tpu.ops.attention import dot_product_attention
+                # (DDP/seq/pipe) where Pallas is first-class. Route
+                # attention through a shard_map ISLAND instead
+                # (ops/attention.py gspmd_flash_attention): batch over
+                # the data axes, heads over model — which resolves to
+                # plain dense XLA below FLASH_MIN_LEN keys (all the
+                # image family today, T≤197, where one fused einsum
+                # chain wins) and to the Pallas kernel above it, so a
+                # long-sequence GSPMD model keeps the kernel. On CPU
+                # both branches are the dense path, unchanged.
+                from ddp_tpu.ops.attention import gspmd_flash_attention
 
-                model_kw["attention_fn"] = dot_product_attention
+                model_kw["attention_fn"] = gspmd_flash_attention(self.mesh)
             n_classes = config.num_classes or NUM_CLASSES.get(self.dataset, 10)
             try:
                 self.model = get_model(
