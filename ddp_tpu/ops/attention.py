@@ -77,10 +77,10 @@ def gspmd_flash_attention(mesh, *, causal: bool = False, block_q: int = 512,
     non-TPU platforms unless ``interpret`` forces the kernel for
     tests), so short-sequence models are untouched.
     """
+    from ddp_tpu.runtime.mesh import data_axes
+
     on_tpu = jax.devices()[0].platform == "tpu"
-    data_axes = tuple(
-        a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
-    )
+    batch_axes = data_axes(mesh)
     tp = mesh.shape.get("model", 1)
 
     def fn(q, k, v):
@@ -91,7 +91,7 @@ def gspmd_flash_attention(mesh, *, causal: bool = False, block_q: int = 512,
         from ddp_tpu.ops.flash import flash_attention
 
         head_ax = "model" if tp > 1 and q.shape[2] % tp == 0 else None
-        spec = P(data_axes if data_axes else None, None, head_ax, None)
+        spec = P(batch_axes if batch_axes else None, None, head_ax, None)
         island = jax.shard_map(
             lambda qq, kk, vv: flash_attention(
                 qq, kk, vv, causal, block_q, block_k, interpret

@@ -191,9 +191,9 @@ def batch_spec(mesh: Mesh) -> P:
     themselves (GShard layout): tokens shard over it, and XLA turns the
     dispatch/combine einsums in models/moe.py into token all-to-alls.
     """
-    axes = tuple(
-        a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
-    )
+    from ddp_tpu.runtime.mesh import data_axes
+
+    axes = tuple(a for a in data_axes(mesh) if mesh.shape.get(a, 1) > 1)
     return P(axes if axes else None)
 
 
