@@ -141,7 +141,7 @@ def _bf16_peak(device) -> float | None:
     return None
 
 
-def _timed_device_loop(run, state, nsteps: int, *, repeats: int = 3):
+def _timed_device_loop(run, state, *, repeats: int = 3):
     """Time ``run(state, seed)`` — one dispatch scanning ``nsteps``
     training steps on device — syncing on the returned scalar.
 
@@ -179,6 +179,8 @@ def _profile_op_split(run, state) -> dict | None:
 
     import jax
 
+    if jax.devices()[0].platform != "tpu":
+        return None  # the pid filter below only knows TPU tracks
     try:
         with tempfile.TemporaryDirectory() as td:
             with jax.profiler.trace(td):
@@ -257,7 +259,7 @@ def run_vit_bench(*, batch: int = 256, nsteps: int = 30) -> dict:
         (params, opt_state), losses = lax.scan(step, state, keys)
         return losses[-1]
 
-    loss, seconds = _timed_device_loop(run, (params, opt_state), nsteps)
+    loss, seconds = _timed_device_loop(run, (params, opt_state))
     images_per_sec = batch * nsteps / seconds
 
     # Analytic train FLOPs/image (fwd ≈ blocks' matmuls + attention;
@@ -284,7 +286,7 @@ def run_vit_bench(*, batch: int = 256, nsteps: int = 30) -> dict:
         "tiling-limited at T=65/d=192: see op_time_split — matmuls "
         "('convolution fusion') vs layout copies ('data formatting', "
         "'copy-done'); est_mfu / matmul_share ≈ MXU-busy efficiency"
-    )
+    ) if split is not None else None
     return {
         "metric": "vit_tiny_bf16_train_throughput",
         "value": round(images_per_sec, 1),
@@ -348,7 +350,7 @@ def run_lm_bench(
         state, losses = lax.scan(step, state, keys)
         return losses[-1]
 
-    loss, seconds = _timed_device_loop(run, state, nsteps)
+    loss, seconds = _timed_device_loop(run, state)
     tokens_per_sec = batch * seq_len * nsteps / seconds
 
     # PaLM-style estimate: 6·N per token (fwd+bwd matmuls) + causal

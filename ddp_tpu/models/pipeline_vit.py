@@ -116,6 +116,75 @@ class PipeViTState(NamedTuple):
     opt_state: Any
 
 
+def _pipe_batch_axes(mesh) -> tuple:
+    """Axes the pipe family shards its batch over (``expert``/``seq``
+    never compose with pipe)."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
+_FSDP_MIN_SIZE = 2**12  # leaves smaller than this stay replicated
+
+
+def _stage_specs(stages, mesh, *, lead: int):
+    """Per-leaf PartitionSpec for the stacked stage tree.
+
+    ``lead`` leading dims carry the stage placement (1 for the plain
+    [S, …] layout on ``pipe``; 2 for the interleaved [v, S, …] layout
+    as P(None, pipe)). With an ``fsdp`` mesh axis, each big-enough
+    leaf additionally shards its first evenly-dividing trailing dim —
+    ZeRO-style: params and optimizer state REST sharded across the
+    batch replicas, and the step all-gathers them transiently
+    (``_gather_stages``)."""
+    fsdp = mesh.shape.get("fsdp", 1)
+    lead_axes = ("pipe",) if lead == 1 else (None, "pipe")
+
+    def spec_for(p):
+        if fsdp <= 1 or p.size < _FSDP_MIN_SIZE:
+            return P(*lead_axes)
+        spec = list(lead_axes) + [None] * (p.ndim - lead)
+        for i in range(lead, p.ndim):
+            if p.shape[i] % fsdp == 0:
+                spec[i] = "fsdp"
+                break
+        return P(*spec)
+
+    return jax.tree.map(spec_for, stages)
+
+
+def _gather_stages(sp, specs):
+    """all_gather the fsdp-sharded stage leaves INSIDE the island.
+
+    Under AD (the GPipe path) the transpose of this all_gather is a
+    psum_scatter over ``fsdp`` — ZeRO's gradient reduce-scatter falls
+    out of the schedule for free; the hand-scheduled paths apply the
+    matching ``_scatter_stage_grads`` explicitly."""
+
+    def g(p, s):
+        for i, ax in enumerate(s):
+            if ax == "fsdp":
+                return lax.all_gather(p, "fsdp", axis=i, tiled=True)
+        return p
+
+    return jax.tree.map(g, sp, specs)
+
+
+def _scatter_stage_grads(gs, specs):
+    """Reduce stage grads over ``fsdp``: sum + re-shard for leaves
+    that rest sharded (psum_scatter), plain psum for the rest —
+    exactly the transpose of ``_gather_stages`` plus the batch-axis
+    reduction every grad needs (fsdp members see different data)."""
+
+    def s(g, spec):
+        for i, ax in enumerate(spec):
+            if ax == "fsdp":
+                return lax.psum_scatter(
+                    g, "fsdp", scatter_dimension=i, tiled=True
+                )
+        return lax.psum(g, "fsdp")
+
+    return jax.tree.map(s, gs, specs)
+
+
 def _modules(cfg: PipeViTConfig):
     embed = PatchEmbed(embed_dim=cfg.embed_dim, patch_size=cfg.patch_size)
     stage = StageBlocks(
@@ -219,11 +288,9 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
     Differentiable end to end. GPipe bubble: ``bubble_fraction(S, M)``.
     """
     embed, stage, head = _modules(cfg)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P()
-    mbspec = (
-        P(None, "pipe", "data") if has_data else P(None, "pipe")
-    )
+    baxes = _pipe_batch_axes(mesh)
+    bspec = P(baxes) if baxes else P()
+    mbspec = P(None, "pipe", baxes) if baxes else P(None, "pipe")
 
     def stage_fn(p, x):
         return stage.apply({"params": p}, x)
@@ -250,15 +317,16 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
                 "(the sharded stream rests microbatch m on device m mod S)"
             )
         mb = images.reshape(M // S, S, B // M, *images.shape[1:])
+        sspecs = _stage_specs(params.stages, mesh, lead=1)
 
         pipelined = jax.shard_map(
             lambda sp, ep, hp, m: spmd_pipeline(
-                stage_fn, sp, m, axis_name="pipe",
+                stage_fn, _gather_stages(sp, sspecs), m, axis_name="pipe",
                 first_fn=first_fn, first_params=ep,
                 last_fn=last_fn, last_params=hp,
             ),
             mesh=mesh,
-            in_specs=(P("pipe"), P(), P(), mbspec),
+            in_specs=(sspecs, P(), P(), mbspec),
             out_specs=mbspec,
             check_vma=False,
         )
@@ -288,13 +356,16 @@ def make_pipe_vit_train_step(
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
         )
     apply_fn = make_pipe_vit_apply(cfg, mesh)
-    stage_sharding = NamedSharding(mesh, P("pipe"))
 
     def constrain(params: PipeViTParams) -> PipeViTParams:
+        sspecs = _stage_specs(params.stages, mesh, lead=1)
         return params._replace(
             stages=jax.tree.map(
-                lambda x: lax.with_sharding_constraint(x, stage_sharding),
+                lambda x, s: lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, s)
+                ),
                 params.stages,
+                sspecs,
             )
         )
 
@@ -344,6 +415,39 @@ def make_pipe_vit_1f1b_train_step(
     """
     from ddp_tpu.parallel.one_f1b import schedule_1f1b, spmd_pipeline_1f1b
 
+    S = mesh.shape["pipe"]
+    M = cfg.num_microbatches
+    if M % S:
+        raise ValueError(f"{M} microbatches not divisible by {S} stages")
+    return _make_handsched_step(
+        cfg, optimizer, mesh, spmd_pipeline_1f1b, schedule_1f1b(S, M),
+        lead=1, compute_dtype=compute_dtype,
+        label_smoothing=label_smoothing, donate=donate,
+    )
+
+
+def _make_handsched_step(
+    cfg: PipeViTConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    pipeline_fn,
+    sched,
+    *,
+    lead: int,
+    compute_dtype,
+    label_smoothing: float,
+    donate: bool,
+):
+    """Shared machinery of the hand-scheduled (no-jax.grad) pipe steps.
+
+    ``pipeline_fn`` is the schedule kernel (spmd_pipeline_1f1b or
+    spmd_pipeline_interleaved — same call contract) and ``lead`` the
+    number of leading stacked dims in the stage layout (1 for [S, …],
+    2 for the interleaved [v, S, …]). Everything else — the island
+    specs, the fsdp gather/scatter pair, the batch-axis reductions,
+    and the mean-gradient update — is identical across schedules and
+    lives only here.
+    """
     if not 0.0 <= label_smoothing < 1.0:
         raise ValueError(
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
@@ -351,14 +455,11 @@ def make_pipe_vit_1f1b_train_step(
     embed, stage, head = _modules(cfg)
     S = mesh.shape["pipe"]
     M = cfg.num_microbatches
-    if M % S:
-        raise ValueError(f"{M} microbatches not divisible by {S} stages")
-    sched = schedule_1f1b(S, M)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P()
-    mbspec = P(None, "pipe", "data") if has_data else P(None, "pipe")
-    lblspec = P(None, "data") if has_data else P()
-    stage_sharding = NamedSharding(mesh, P("pipe"))
+    baxes = _pipe_batch_axes(mesh)
+    has_fsdp = mesh.shape.get("fsdp", 1) > 1
+    bspec = P(baxes) if baxes else P()
+    mbspec = P(None, "pipe", baxes) if baxes else P(None, "pipe")
+    lblspec = P(None, baxes) if baxes else P()
 
     def stage_fn(p, x):
         return stage.apply({"params": p}, x)
@@ -375,33 +476,45 @@ def make_pipe_vit_1f1b_train_step(
         correct = (jnp.argmax(logits, -1) == lbl).sum().astype(jnp.float32)
         return loss, correct
 
-    def inner(sp, ep, hp, m, l):
-        loss, aux, gs, gf, gl = spmd_pipeline_1f1b(
-            stage_fn, sp, m, l, loss_fn, sched, axis_name="pipe",
-            first_fn=first_fn, first_params=ep,
-            last_fn=last_fn, last_params=hp,
-        )
-        if has_data:
-            loss = lax.psum(loss, "data")
-            aux = lax.psum(aux, "data")
-            gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
-            gf = jax.tree.map(lambda g: lax.psum(g, "data"), gf)
-            gl = jax.tree.map(lambda g: lax.psum(g, "data"), gl)
-        return loss, aux, gs, gf, gl
+    def make_run(sspecs):
+        def inner(sp, ep, hp, m, l):
+            loss, aux, gs, gf, gl = pipeline_fn(
+                stage_fn, _gather_stages(sp, sspecs), m, l, loss_fn,
+                sched, axis_name="pipe",
+                first_fn=first_fn, first_params=ep,
+                last_fn=last_fn, last_params=hp,
+            )
+            if baxes:
+                loss = lax.psum(loss, baxes)
+                aux = lax.psum(aux, baxes)
+                gf = jax.tree.map(lambda g: lax.psum(g, baxes), gf)
+                gl = jax.tree.map(lambda g: lax.psum(g, baxes), gl)
+            if "data" in baxes:
+                gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
+            if has_fsdp:
+                # Sum over the fsdp batch replicas AND re-shard the
+                # resting leaves — the explicit twin of the gather's
+                # AD transpose on the GPipe path.
+                gs = _scatter_stage_grads(gs, sspecs)
+            return loss, aux, gs, gf, gl
 
-    run = jax.shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(P("pipe"), P(), P(), mbspec, lblspec),
-        out_specs=(P(), P(), P("pipe"), P(), P()),
-        check_vma=False,
-    )
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(sspecs, P(), P(), mbspec, lblspec),
+            out_specs=(P(), P(), sspecs, P(), P()),
+            check_vma=False,
+        )
 
     def constrain(params: PipeViTParams) -> PipeViTParams:
+        sspecs = _stage_specs(params.stages, mesh, lead=lead)
         return params._replace(
             stages=jax.tree.map(
-                lambda x: lax.with_sharding_constraint(x, stage_sharding),
+                lambda x, s: lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, s)
+                ),
                 params.stages,
+                sspecs,
             )
         )
 
@@ -415,6 +528,7 @@ def make_pipe_vit_1f1b_train_step(
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
         mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
         lbl_mb = labels.reshape(M, B // M)
+        run = make_run(_stage_specs(state.params.stages, mesh, lead=lead))
         loss_sum, correct, gs, gf, gl = run(
             state.params.stages, state.params.embed, state.params.head,
             mbs, lbl_mb,
@@ -462,98 +576,19 @@ def make_pipe_vit_interleaved_train_step(
         spmd_pipeline_interleaved,
     )
 
-    if not 0.0 <= label_smoothing < 1.0:
-        raise ValueError(
-            f"label_smoothing must be in [0, 1), got {label_smoothing}"
-        )
-    embed, stage, head = _modules(cfg)
     S = mesh.shape["pipe"]
-    M = cfg.num_microbatches
     if S != cfg.num_stages:
         raise ValueError(
             f"mesh pipe axis {S} != cfg.num_stages {cfg.num_stages}"
         )
-    sched = schedule_interleaved(S, M, cfg.virtual_stages)
-    has_data = mesh.shape.get("data", 1) > 1
-    bspec = P("data") if has_data else P()
-    mbspec = P(None, "pipe", "data") if has_data else P(None, "pipe")
-    lblspec = P(None, "data") if has_data else P()
-    stage_sharding = NamedSharding(mesh, P(None, "pipe"))
-
-    def stage_fn(p, x):
-        return stage.apply({"params": p}, x)
-
-    def first_fn(p, raw):
-        return embed.apply({"params": p}, raw)
-
-    def last_fn(p, x):
-        return head.apply({"params": p}, x)
-
-    def loss_fn(logits, lbl):
-        logits = logits.astype(jnp.float32)
-        loss = xent(logits, lbl, label_smoothing).sum()
-        correct = (jnp.argmax(logits, -1) == lbl).sum().astype(jnp.float32)
-        return loss, correct
-
-    def inner(sp, ep, hp, m, l):
-        loss, aux, gs, gf, gl = spmd_pipeline_interleaved(
-            stage_fn, sp, m, l, loss_fn, sched, axis_name="pipe",
-            first_fn=first_fn, first_params=ep,
-            last_fn=last_fn, last_params=hp,
-        )
-        if has_data:
-            loss = lax.psum(loss, "data")
-            aux = lax.psum(aux, "data")
-            gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
-            gf = jax.tree.map(lambda g: lax.psum(g, "data"), gf)
-            gl = jax.tree.map(lambda g: lax.psum(g, "data"), gl)
-        return loss, aux, gs, gf, gl
-
-    run = jax.shard_map(
-        inner,
-        mesh=mesh,
-        in_specs=(P(None, "pipe"), P(), P(), mbspec, lblspec),
-        out_specs=(P(), P(), P(None, "pipe"), P(), P()),
-        check_vma=False,
+    sched = schedule_interleaved(
+        S, cfg.num_microbatches, cfg.virtual_stages
     )
-
-    def constrain(params: PipeViTParams) -> PipeViTParams:
-        return params._replace(
-            stages=jax.tree.map(
-                lambda x: lax.with_sharding_constraint(x, stage_sharding),
-                params.stages,
-            )
-        )
-
-    def step(state: PipeViTState, images, labels):
-        images = lax.with_sharding_constraint(
-            _preprocess(images, compute_dtype),
-            NamedSharding(mesh, bspec),
-        )
-        B = images.shape[0]
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
-        lbl_mb = labels.reshape(M, B // M)
-        loss_sum, correct, gs, gf, gl = run(
-            state.params.stages, state.params.embed, state.params.head,
-            mbs, lbl_mb,
-        )
-        grads = jax.tree.map(
-            lambda g: (g / B).astype(jnp.float32),
-            PipeViTParams(embed=gf, stages=gs, head=gl),
-        )
-        grads = constrain(grads)
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        params = constrain(optax.apply_updates(state.params, updates))
-        return (
-            PipeViTState(state.step + 1, params, opt_state),
-            StepMetrics(loss=loss_sum / B, accuracy=correct / B),
-        )
-
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return _make_handsched_step(
+        cfg, optimizer, mesh, spmd_pipeline_interleaved, sched,
+        lead=2, compute_dtype=compute_dtype,
+        label_smoothing=label_smoothing, donate=donate,
+    )
 
 
 def _create_state(
@@ -563,15 +598,17 @@ def _create_state(
     mesh: Mesh,
     seed: int,
     init_fn,
-    stage_spec: P,
+    lead: int,
 ) -> PipeViTState:
     params = init_fn(cfg, sample_input, seed=seed)
-    stage_sharding = NamedSharding(mesh, stage_spec)
+    sspecs = _stage_specs(params.stages, mesh, lead=lead)
     rep = NamedSharding(mesh, P())
     params = PipeViTParams(
         embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),
         stages=jax.tree.map(
-            lambda x: jax.device_put(x, stage_sharding), params.stages
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params.stages,
+            sspecs,
         ),
         head=jax.tree.map(lambda x: jax.device_put(x, rep), params.head),
     )
@@ -601,7 +638,7 @@ def create_pipe_vit_state(
     seed: int = 0,
 ) -> PipeViTState:
     return _create_state(
-        cfg, optimizer, sample_input, mesh, seed, init_pipe_vit, P("pipe")
+        cfg, optimizer, sample_input, mesh, seed, init_pipe_vit, 1
     )
 
 
@@ -617,5 +654,5 @@ def create_pipe_vit_state_interleaved(
     round-robin chunk layout resting sharded P(None, pipe)."""
     return _create_state(
         cfg, optimizer, sample_input, mesh, seed,
-        init_pipe_vit_interleaved, P(None, "pipe"),
+        init_pipe_vit_interleaved, 2,
     )

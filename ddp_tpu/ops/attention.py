@@ -80,7 +80,11 @@ def gspmd_flash_attention(mesh, *, causal: bool = False, block_q: int = 512,
     from ddp_tpu.runtime.mesh import data_axes
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    batch_axes = data_axes(mesh)
+    # Same axis set AND same size-1 filter as spmd.batch_spec, so the
+    # island's specs always match the GSPMD step's activation layout.
+    batch_axes = tuple(
+        a for a in data_axes(mesh) if mesh.shape.get(a, 1) > 1
+    )
     tp = mesh.shape.get("model", 1)
 
     def fn(q, k, v):

@@ -135,7 +135,6 @@ class Trainer:
             )
         if self.pipe_mode and (
             config.mesh_model > 1
-            or config.mesh_fsdp > 1
             or config.mesh_expert > 1
             or config.mesh_seq > 1
             or config.zero1
@@ -144,10 +143,11 @@ class Trainer:
             or config.augment not in (None, "none")
         ):
             raise ValueError(
-                "--model pipe_vit composes with the data axis, bf16, "
-                "remat, label smoothing, EMA and LR schedules — not "
-                "tp/fsdp/expert/seq/zero1, accumulation (use "
-                "--num_microbatches), augment, or --fast_epoch"
+                "--model pipe_vit composes with the data axis, fsdp "
+                "(ZeRO-sharded stage params), bf16, remat, label "
+                "smoothing, EMA and LR schedules — not tp/expert/seq/"
+                "zero1, accumulation (use --num_microbatches), "
+                "augment, or --fast_epoch"
             )
         if (self.seq_mode or self.pipe_mode) and (
             config.num_heads < 1

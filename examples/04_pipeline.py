@@ -49,3 +49,30 @@ labels = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
 for i in range(5):
     state, metrics = step(state, images, labels)
     print(f"step {i}: loss {float(metrics.loss):.4f}")
+
+# The same model under the interleaved-1F1B schedule: v=2 chunks per
+# device placed round-robin, bubble (S-1)/(vM+S-1) instead of GPipe's
+# (S-1)/(M+S-1). CLI twin:
+#   python train.py --model pipe_vit --mesh_pipe 4 \
+#       --pipe_schedule interleaved --virtual_stages 2 --num_microbatches 8
+from ddp_tpu.models.pipeline_vit import (
+    create_pipe_vit_state_interleaved,
+    make_pipe_vit_interleaved_train_step,
+)
+from ddp_tpu.parallel.interleaved import schedule_interleaved
+
+cfg_il = cfg._replace(virtual_stages=2, num_microbatches=8)
+sched = schedule_interleaved(4, 8, 2)
+print("interleaved bubble:", round(sched.bubble_fraction(), 3))
+state_il = create_pipe_vit_state_interleaved(
+    cfg_il, tx, jnp.zeros((1, 28, 28, 1), jnp.float32), mesh, seed=0
+)
+step_il = make_pipe_vit_interleaved_train_step(cfg_il, tx, mesh)
+state_il, metrics = step_il(state_il, images, labels)
+print(f"interleaved step: loss {float(metrics.loss):.4f}")
+
+# ZeRO-sharded stage params: swap the data axis for fsdp (or use both)
+# and the stage params + Adam moments REST sharded across the batch
+# replicas, all-gathered transiently inside the step:
+#   mesh = make_mesh(MeshSpec(fsdp=2, pipe=4))
+#   → stage kernel sharding becomes ('pipe', 'fsdp', ...)

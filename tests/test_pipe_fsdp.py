@@ -1,0 +1,176 @@
+"""pipe × fsdp: ZeRO-sharded stage parameters inside the pipeline
+(round-2 verdict weak #4's remaining wall). Stage params and optimizer
+moments REST sharded over the fsdp batch axis (per-device memory
+1/fsdp), are all-gathered transiently inside the island, and gradients
+return reduce-scattered. Pinned equal to the data-axis-only runs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models.pipeline_vit import (
+    PipeViTConfig,
+    create_pipe_vit_state,
+    create_pipe_vit_state_interleaved,
+    make_pipe_vit_1f1b_train_step,
+    make_pipe_vit_interleaved_train_step,
+    make_pipe_vit_train_step,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+CFG = PipeViTConfig(
+    num_classes=10,
+    patch_size=7,
+    embed_dim=64,  # mlp kernels 64x256 = 16384 > _FSDP_MIN_SIZE
+    num_heads=4,
+    num_stages=4,
+    depth_per_stage=1,
+    num_microbatches=8,
+)
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    images = rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(n,)).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+def _fsdp_leaves(tree):
+    return [
+        l
+        for l in jax.tree.leaves(tree)
+        if hasattr(l, "sharding") and "fsdp" in jax.tree.leaves(
+            tuple(l.sharding.spec)
+        )
+    ]
+
+
+class TestGPipeFsdp:
+    def test_params_and_moments_rest_sharded(self, devices):
+        mesh = make_mesh(MeshSpec(fsdp=2, pipe=4), devices=devices)
+        tx = optax.adam(1e-3)
+        st = create_pipe_vit_state(
+            CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+        )
+        sharded = _fsdp_leaves(st.params.stages)
+        assert sharded, "no stage leaf rests fsdp-sharded"
+        for leaf in sharded:
+            # each device materializes 1/(pipe*fsdp) of the global leaf
+            shard = leaf.addressable_shards[0].data
+            assert shard.size * 8 == leaf.size, (shard.shape, leaf.shape)
+        # Adam moments follow their params (ZeRO: optimizer state
+        # sharded too) after one step pins them through the update.
+        step = make_pipe_vit_train_step(CFG, tx, mesh, donate=False)
+        images, labels = _batch(16, seed=1)
+        st2, _ = step(st, images, labels)
+        assert _fsdp_leaves(st2.opt_state), "no Adam moment rests sharded"
+
+    def test_matches_data_axis_run(self, devices):
+        """fsdp=2 and data=2 meshes are the same math: same loss, same
+        params after one step from the same seed."""
+        tx = optax.sgd(0.05)
+        images, labels = _batch(16, seed=2)
+        results = []
+        for spec in (MeshSpec(data=2, pipe=4), MeshSpec(fsdp=2, pipe=4)):
+            mesh = make_mesh(spec, devices=devices)
+            st = create_pipe_vit_state(
+                CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+            )
+            step = make_pipe_vit_train_step(CFG, tx, mesh, donate=False)
+            st, m = step(st, images, labels)
+            results.append((float(m.loss), jax.tree.map(np.asarray, st.params)))
+        (l_a, p_a), (l_b, p_b) = results
+        np.testing.assert_allclose(l_a, l_b, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=3e-5),
+            p_a,
+            p_b,
+        )
+
+
+class TestHandScheduledFsdp:
+    def test_1f1b_matches_gpipe_under_fsdp(self, devices):
+        mesh = make_mesh(MeshSpec(fsdp=2, pipe=4), devices=devices)
+        tx = optax.sgd(0.05)
+        images, labels = _batch(16, seed=3)
+        mk = lambda: create_pipe_vit_state(
+            CFG, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+        )
+        st_a, m_a = make_pipe_vit_train_step(CFG, tx, mesh, donate=False)(
+            mk(), images, labels
+        )
+        st_b, m_b = make_pipe_vit_1f1b_train_step(CFG, tx, mesh, donate=False)(
+            mk(), images, labels
+        )
+        np.testing.assert_allclose(float(m_a.loss), float(m_b.loss), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5
+            ),
+            st_a.params,
+            st_b.params,
+        )
+
+    def test_interleaved_fsdp_matches_data_axis(self, devices):
+        cfg = CFG._replace(virtual_stages=2)
+        tx = optax.sgd(0.05)
+        images, labels = _batch(16, seed=4)
+        results = []
+        for spec in (MeshSpec(data=2, pipe=4), MeshSpec(fsdp=2, pipe=4)):
+            mesh = make_mesh(spec, devices=devices)
+            st = create_pipe_vit_state_interleaved(
+                cfg, tx, jnp.zeros((1, 28, 28, 1)), mesh, seed=0
+            )
+            step = make_pipe_vit_interleaved_train_step(
+                cfg, tx, mesh, donate=False
+            )
+            st, m = step(st, images, labels)
+            results.append((float(m.loss), jax.tree.map(np.asarray, st.params)))
+        (l_a, p_a), (l_b, p_b) = results
+        np.testing.assert_allclose(l_a, l_b, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=3e-5),
+            p_a,
+            p_b,
+        )
+
+
+class TestTrainerPipeFsdp:
+    def test_cli_trains_and_resumes(self, tmp_path, devices):
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        kw = dict(
+            epochs=1,
+            batch_size=8,  # ×2 fsdp shards = global 16, 8 mb of 2
+            model="pipe_vit",
+            mesh_pipe=4,
+            mesh_fsdp=2,
+            num_microbatches=8,
+            pipe_schedule="1f1b",
+            model_dim=64,
+            model_depth=1,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=128,
+            log_interval=4,
+            eval_every=1,
+            optimizer="adam",
+            lr=1e-3,
+        )
+        t = Trainer(TrainConfig(**kw))
+        assert dict(t.mesh.shape)["fsdp"] == 2
+        summary = t.train()
+        sharded = _fsdp_leaves(t.state.params.stages)
+        t.close()
+        assert sharded, "trained stage params do not rest fsdp-sharded"
+        assert summary["epochs_run"] == 1
+        assert np.isfinite(summary["final_accuracy"])
+        t2 = Trainer(TrainConfig(**{**kw, "epochs": 2}))
+        summary = t2.train()
+        t2.close()
+        assert summary["history"][0]["epoch"] == 1
