@@ -176,6 +176,40 @@ def prefill(
     )
 
 
+def filter_logits(logits, *, top_k: int = 0, top_p: float = 1.0):
+    """Mask logits to the top-k and/or nucleus (top-p) candidate set.
+
+    ``top_k > 0`` keeps the k highest logits per row; ``top_p < 1``
+    keeps the smallest prefix of the probability-sorted vocabulary
+    whose cumulative mass reaches p (the highest-probability token
+    always survives, so the set is never empty). Masked entries become
+    a large negative (not −inf: the downstream ``categorical`` is
+    NaN-safe that way even if a row were fully masked). Static shapes
+    throughout — jit/vmap/scan-safe.
+    """
+    logits = logits.astype(jnp.float32)
+    neg = jnp.float32(jnp.finfo(jnp.float32).min / 2)
+    if top_k and top_k < logits.shape[-1]:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep entries whose PRECEDING mass is < p (so the first token
+        # is always kept); the threshold is the smallest kept logit.
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1], bool), cum[..., :-1] < top_p],
+            axis=-1,
+        )
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.float32(jnp.inf)),
+            axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits < thresh, neg, logits)
+    return logits
+
+
 def generate(
     spec: LMSpec,
     params: Any,
@@ -183,20 +217,37 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     seed: int = 0,
 ) -> jax.Array:
     """Sample continuations → [B, P + max_new_tokens] int32.
 
     Greedy when ``temperature == 0``; otherwise categorical over
-    ``logits / temperature`` with a per-step folded key. The whole loop
-    (prefill + decode) is jittable; positions past ``spec.total_len``
-    are rejected up front since the position table ends there.
+    ``filter_logits(logits / temperature, top_k, top_p)`` — the
+    conventional order: temperature first, so the nucleus is computed
+    on the distribution actually being sampled (a hot distribution
+    keeps a wider top-p set). ``top_k=0``/``top_p=1`` disable
+    filtering; combining filters with ``temperature == 0`` is an
+    error (greedy ignores them — refusing beats silently recording
+    settings that had no effect). The whole loop (prefill + decode)
+    is jittable; positions past ``spec.total_len`` are rejected up
+    front since the position table ends there.
     """
     P = prompt.shape[1]
     if P + max_new_tokens > spec.total_len:
         raise ValueError(
             f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"total_len {spec.total_len}"
+        )
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if temperature <= 0.0 and (top_k or top_p < 1.0):
+        raise ValueError(
+            "top_k/top_p only apply when sampling: set --temperature "
+            "> 0 (greedy decoding ignores the filters)"
         )
     logits, cache = prefill(spec, params, prompt)
     key = jax.random.key(seed)
@@ -205,9 +256,13 @@ def generate(
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         k = jax.random.fold_in(key, step_idx)
-        return jax.random.categorical(
-            k, logits.astype(jnp.float32) / temperature, axis=-1
-        ).astype(jnp.int32)
+        filtered = filter_logits(
+            logits.astype(jnp.float32) / temperature,
+            top_k=top_k, top_p=top_p,
+        )
+        return jax.random.categorical(k, filtered, axis=-1).astype(
+            jnp.int32
+        )
 
     def step(carry, step_idx):
         logits, cache = carry

@@ -54,6 +54,14 @@ def main() -> None:
     )
     p.add_argument("--max_new_tokens", type=int, default=64)
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument(
+        "--top_k", type=int, default=0,
+        help="sample only among the k highest-probability tokens (0 = off)",
+    )
+    p.add_argument(
+        "--top_p", type=float, default=1.0,
+        help="nucleus sampling: smallest token set with mass >= p (1 = off)",
+    )
     p.add_argument("--gen_seed", type=int, default=0)
     # Architecture is derived from the checkpoint's param shapes; only
     # the head count (invisible in shapes) is a flag.
@@ -242,6 +250,8 @@ def _generate_lm(args) -> None:
             prompt,
             max_new_tokens=args.max_new_tokens,
             temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
             seed=args.gen_seed,
         )
     )[0]
@@ -251,6 +261,8 @@ def _generate_lm(args) -> None:
         "prompt_tokens": toks,
         "tokens": new.tolist(),
         "temperature": args.temperature,
+        "top_k": args.top_k,
+        "top_p": args.top_p,
     }
     if tokenizer is not None:
         record["text"] = tokenizer.decode(new)

@@ -85,6 +85,86 @@ def test_temperature_sampling_seeded(params):
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+class TestFilterLogits:
+    """top-k / nucleus filtering: exact candidate sets on hand-built
+    distributions, and the generate() plumbing."""
+
+    def test_top_k_keeps_exactly_k(self):
+        from ddp_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+        out = filter_logits(logits, top_k=2)
+        kept = np.asarray(out[0] > -1e30)
+        assert kept.tolist() == [False, True, False, False, True]
+
+    def test_top_p_smallest_prefix(self):
+        from ddp_tpu.models.generate import filter_logits
+
+        # probs ≈ [0.643, 0.236, 0.087, 0.032, 0.002]
+        logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]]))
+        out = filter_logits(logits, top_p=0.8)
+        kept = np.asarray(out[0] > -1e30)
+        # 0.643 < 0.8 so the second token is still needed; 0.879 >= 0.8
+        # stops the set there.
+        assert kept.tolist() == [True, True, False, False, False]
+
+    def test_top_p_always_keeps_argmax(self):
+        from ddp_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[0.0, 10.0, 0.0]])
+        out = filter_logits(logits, top_p=1e-6)
+        kept = np.asarray(out[0] > -1e30)
+        assert kept.tolist() == [False, True, False]
+
+    def test_combined_and_noop(self):
+        from ddp_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+        np.testing.assert_allclose(
+            np.asarray(filter_logits(logits)), np.asarray(logits)
+        )
+        out = filter_logits(logits, top_k=3, top_p=0.5)
+        kept = np.asarray(out[0] > -1e30)
+        assert kept[1] and kept.sum() <= 3
+
+    def test_generate_with_topk_topp(self, params):
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out = generate(
+            SPEC, params, prompt, max_new_tokens=5, temperature=0.8,
+            top_k=4, top_p=0.9, seed=3,
+        )
+        assert out.shape == (1, 8)
+        assert (np.asarray(out) >= 0).all()
+        assert (np.asarray(out) < SPEC.vocab_size).all()
+        # seeded: same call → same tokens
+        out2 = generate(
+            SPEC, params, prompt, max_new_tokens=5, temperature=0.8,
+            top_k=4, top_p=0.9, seed=3,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+        with pytest.raises(ValueError, match="top_p"):
+            generate(SPEC, params, prompt, max_new_tokens=2, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(SPEC, params, prompt, max_new_tokens=2, top_k=-1)
+        # Filters + greedy is refused, not silently ignored.
+        with pytest.raises(ValueError, match="temperature"):
+            generate(SPEC, params, prompt, max_new_tokens=2, top_k=5)
+
+    def test_hot_distribution_widens_nucleus(self):
+        """Temperature is applied BEFORE top_p (the conventional
+        order): the same logits at high temperature keep a wider
+        nucleus than at T=1."""
+        from ddp_tpu.models.generate import filter_logits
+
+        logits = jnp.asarray([[4.0, 2.0, 0.0, -2.0]])
+        cold = np.asarray(filter_logits(logits, top_p=0.95)[0] > -1e30)
+        hot = np.asarray(
+            filter_logits(logits / 3.0, top_p=0.95)[0] > -1e30
+        )
+        assert cold.sum() < hot.sum()
+        assert hot.all()  # T=3 distribution needs all 4 for 0.95 mass
+
+
 def test_generate_rejects_overlong(params):
     prompt = jnp.zeros((1, 20), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
