@@ -73,6 +73,17 @@ def main() -> None:
                 "--model causal_lm needs exactly one of "
                 "--prompt / --prompt_tokens"
             )
+        # Mirror generate()'s validation as clean CLI errors instead
+        # of ValueError tracebacks.
+        if not 0.0 < args.top_p <= 1.0:
+            p.error(f"--top_p must be in (0, 1], got {args.top_p}")
+        if args.top_k < 0:
+            p.error(f"--top_k must be >= 0, got {args.top_k}")
+        if args.temperature <= 0.0 and (args.top_k or args.top_p < 1.0):
+            p.error(
+                "--top_k/--top_p only apply when sampling: set "
+                "--temperature > 0 (greedy decoding ignores them)"
+            )
         _generate_lm(args)
         return
     if (args.dataset is None) == (args.images is None):
