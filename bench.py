@@ -403,8 +403,6 @@ def run_decode_bench(
     [B, 1, d]-thin, HBM-bandwidth dominated), the complement of the
     training benches' throughput regime.
     """
-    import time
-
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -439,16 +437,9 @@ def run_decode_bench(
         return toks_out[-1, 0]
 
     logits, cache = do_prefill(params, prompt)
-    # Sync via a host transfer of the returned scalar —
-    # block_until_ready alone does not flush the axon tunnel
-    # (measured: it returns ~1000× early; same reason
-    # _timed_device_loop syncs with float()).
-    int(do_decode(params, logits, cache))
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        int(do_decode(params, logits, cache))
-        best = min(best, time.perf_counter() - t0)
+    _, best = _timed_device_loop(
+        lambda s, _seed: do_decode(*s), (params, logits, cache)
+    )
     toks = batch * new_tokens
     return {
         "metric": "kv_cache_decode_throughput",

@@ -276,6 +276,82 @@ def generate(
     return jnp.concatenate([prompt, new_tokens.T], axis=1)
 
 
+def beam_search(
+    spec: LMSpec,
+    params: Any,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int,
+    beam_width: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Deterministic beam decode → (tokens [B, W, P+N], scores [B, W]).
+
+    Standard length-synchronous beam search over the KV cache: every
+    step scores all W·V continuations per sequence, keeps the top W,
+    and reorders the cache rows and token history to follow their
+    parent beams (one ``take`` along the cache's batch dim — the
+    [depth, B·W, L, H, Dh] layout makes beam bookkeeping a gather,
+    not a copy loop). Beams are returned best-first with their total
+    log-probabilities; ``beam_width=1`` IS greedy decoding (pinned by
+    tests). All beams decode the full ``max_new_tokens`` (the LM has
+    no reserved EOS token), so no length normalization is applied —
+    scores are directly comparable sums.
+    """
+    B, P = prompt.shape
+    W = beam_width
+    if W < 1:
+        raise ValueError(f"beam_width must be >= 1, got {W}")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"beam search decodes at least one token, got "
+            f"max_new_tokens={max_new_tokens}"
+        )
+    if P + max_new_tokens > spec.total_len:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"total_len {spec.total_len}"
+        )
+    V = spec.vocab_size
+    if W > V:
+        raise ValueError(f"beam_width {W} exceeds vocab_size {V}")
+    logits, cache = prefill(spec, params, prompt)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    scores, tok0 = lax.top_k(logp, W)  # [B, W] first expansion
+
+    def tile(x):  # [depth, B, ...] → [depth, B·W, ...], b-major
+        return jnp.repeat(x, W, axis=1)
+
+    cache = DecodeCache(tile(cache.k), tile(cache.v), cache.pos)
+    seqs = jnp.zeros((B, W, max_new_tokens), jnp.int32)
+    seqs = seqs.at[:, :, 0].set(tok0)
+
+    def step(carry, i):
+        scores, toks, cache, seqs = carry
+        logits, cache = decode_step(spec, params, cache, toks)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = scores[..., None] + logp.reshape(B, W, V)
+        scores, idx = lax.top_k(total.reshape(B, W * V), W)
+        parent = idx // V  # [B, W] surviving beams' ancestors
+        tok = (idx % V).astype(jnp.int32)
+        flat = (jnp.arange(B)[:, None] * W + parent).reshape(-1)
+        cache = DecodeCache(
+            k=jnp.take(cache.k, flat, axis=1),
+            v=jnp.take(cache.v, flat, axis=1),
+            pos=cache.pos,
+        )
+        seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+        seqs = seqs.at[:, :, i].set(tok)
+        return (scores, tok.reshape(B * W), cache, seqs), None
+
+    (scores, _, _, seqs), _ = lax.scan(
+        step,
+        (scores, tok0.reshape(B * W), cache, seqs),
+        jnp.arange(1, max_new_tokens),
+    )
+    tiled_prompt = jnp.broadcast_to(prompt[:, None, :], (B, W, P))
+    return jnp.concatenate([tiled_prompt, seqs], axis=2), scores
+
+
 def cached_logits(
     spec: LMSpec, params: Any, tokens: jax.Array
 ) -> jax.Array:

@@ -62,6 +62,11 @@ def main() -> None:
         "--top_p", type=float, default=1.0,
         help="nucleus sampling: smallest token set with mass >= p (1 = off)",
     )
+    p.add_argument(
+        "--beam_width", type=int, default=1,
+        help="beam-search decode width (>1 implies deterministic "
+        "search; mutually exclusive with sampling flags)",
+    )
     p.add_argument("--gen_seed", type=int, default=0)
     # Architecture is derived from the checkpoint's param shapes; only
     # the head count (invisible in shapes) is a flag.
@@ -83,6 +88,15 @@ def main() -> None:
             p.error(
                 "--top_k/--top_p only apply when sampling: set "
                 "--temperature > 0 (greedy decoding ignores them)"
+            )
+        if args.beam_width < 1:
+            p.error(f"--beam_width must be >= 1, got {args.beam_width}")
+        if args.beam_width > 1 and (
+            args.temperature > 0.0 or args.top_k or args.top_p < 1.0
+        ):
+            p.error(
+                "--beam_width > 1 is a deterministic search; drop "
+                "--temperature/--top_k/--top_p"
             )
         _generate_lm(args)
         return
@@ -254,26 +268,45 @@ def _generate_lm(args) -> None:
                 f"{spec.vocab_size}; use --prompt_tokens"
             )
     prompt = jnp.asarray([toks], jnp.int32)
-    out = np.asarray(
-        generate(
+    if args.beam_width > 1:
+        from ddp_tpu.models.generate import beam_search
+
+        beams, scores = beam_search(
             spec,
             params,
             prompt,
             max_new_tokens=args.max_new_tokens,
-            temperature=args.temperature,
-            top_k=args.top_k,
-            top_p=args.top_p,
-            seed=args.gen_seed,
+            beam_width=args.beam_width,
         )
-    )[0]
+        out = np.asarray(beams)[0, 0]  # best beam
+        extra = {
+            "beam_width": args.beam_width,
+            "beam_scores": [round(float(s), 4) for s in np.asarray(scores)[0]],
+        }
+    else:
+        out = np.asarray(
+            generate(
+                spec,
+                params,
+                prompt,
+                max_new_tokens=args.max_new_tokens,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+                seed=args.gen_seed,
+            )
+        )[0]
+        extra = {
+            "temperature": args.temperature,
+            "top_k": args.top_k,
+            "top_p": args.top_p,
+        }
     new = out[len(toks):]
     record = {
         "epoch": epoch,
         "prompt_tokens": toks,
         "tokens": new.tolist(),
-        "temperature": args.temperature,
-        "top_k": args.top_k,
-        "top_p": args.top_p,
+        **extra,
     }
     if tokenizer is not None:
         record["text"] = tokenizer.decode(new)
