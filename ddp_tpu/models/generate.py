@@ -34,10 +34,12 @@ from ddp_tpu.ops.attention import best_attention
 class DecodeCache(NamedTuple):
     """Static-shape per-layer K/V cache.
 
-    ``k``/``v``: [depth, B, total_len, H, Dh]; ``pos``: next write
+    ``k``/``v``: [depth, B, total_len, H_kv, Dh]; ``pos``: next write
     position (scalar int32). One stacked array per side keeps the scan
     carry flat and lets the per-layer update be a ``dynamic_update_slice``
-    on a leading index.
+    on a leading index. Under GQA (spec.num_kv_heads < num_heads) the
+    cache stores the COMPACT kv heads — the whole point: per-step
+    decode HBM reads shrink by the group factor.
     """
 
     k: jax.Array
@@ -45,9 +47,13 @@ class DecodeCache(NamedTuple):
     pos: jax.Array
 
 
+def _kv_heads(spec: LMSpec) -> int:
+    return spec.num_kv_heads or spec.num_heads
+
+
 def init_cache(spec: LMSpec, batch: int, dtype=jnp.float32) -> DecodeCache:
     head_dim = spec.d_model // spec.num_heads
-    shape = (spec.depth, batch, spec.total_len, spec.num_heads, head_dim)
+    shape = (spec.depth, batch, spec.total_len, _kv_heads(spec), head_dim)
     return DecodeCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
@@ -68,15 +74,25 @@ def _dense(x, p):
     return x @ p["kernel"] + p["bias"]
 
 
-def _block_qkv(p, x, H, Dh):
-    """ln1 → qkv projection → ([B,T,H,Dh] q, k, v). Shared by the
-    incremental decode (T=1) and the parallel prefill (T=P) so the two
-    paths cannot drift numerically."""
+def _block_qkv(p, x, H, Dh, H_kv=None):
+    """ln1 → qkv projection → (q [B,T,H,Dh], k/v [B,T,H_kv,Dh]).
+    Shared by the incremental decode (T=1) and the parallel prefill
+    (T=P) so the two paths cannot drift numerically."""
+    H_kv = H_kv or H
     h = _layer_norm(x, p["ln1"]).astype(x.dtype)
+    qkv = _dense(h, p["attn"]["qkv"])
+    if H_kv != H:
+        # GQA block layout [q·H | k·H_kv | v·H_kv], mirroring
+        # models/vit.py MultiHeadAttention's GQA path.
+        qd, kd = H * Dh, H_kv * Dh
+        q = qkv[..., :qd].reshape(*x.shape[:2], H, Dh)
+        k = qkv[..., qd:qd + kd].reshape(*x.shape[:2], H_kv, Dh)
+        v = qkv[..., qd + kd:].reshape(*x.shape[:2], H_kv, Dh)
+        return q, k, v
     # HEAD-MAJOR fused layout, mirroring models/vit.py
     # MultiHeadAttention: columns ordered [head, (q|k|v), head_dim] so
     # TP shards of the kernel are whole heads.
-    qkv = _dense(h, p["attn"]["qkv"]).reshape(*x.shape[:2], H, 3, Dh)
+    qkv = qkv.reshape(*x.shape[:2], H, 3, Dh)
     return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
 
@@ -102,30 +118,35 @@ def decode_step(
     B = token.shape[0]
     H = spec.num_heads
     Dh = spec.d_model // H
+    H_kv = _kv_heads(spec)
+    G = H // H_kv  # 1 for MHA; the grouped einsums reduce to plain MHA
     pos = cache.pos
     x = embed[token][:, None, :]  # [B, 1, d]
     x = x + lax.dynamic_slice_in_dim(
         params["pos_embed"].astype(x.dtype), pos, 1, axis=1
     )
     # Keys at positions > pos are cache zeros — mask them out.
-    live = (jnp.arange(spec.total_len) <= pos)[None, None, :]  # [1,1,L]
+    live = (jnp.arange(spec.total_len) <= pos)[None, None, None, :]
     ck, cv = cache.k, cache.v
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
-        q, k, v = _block_qkv(p, x, H, Dh)
+        q, k, v = _block_qkv(p, x, H, Dh, H_kv)
         ck = lax.dynamic_update_slice(ck, k[None], (i, 0, pos, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[None], (i, 0, pos, 0, 0))
+        # q head h attends through kv head h // G (h = k·G + g, the
+        # same grouping jnp.repeat gives the training path).
+        qg = q[:, 0].reshape(B, H_kv, G, Dh)
         logits = (
             jnp.einsum(
-                "bhd,blhd->bhl",
-                q[:, 0].astype(jnp.float32),
+                "bkgd,blkd->bkgl",
+                qg.astype(jnp.float32),
                 ck[i].astype(jnp.float32),
             )
             * Dh**-0.5
-        )  # [B, H, L]
+        )  # [B, H_kv, G, L]
         logits = jnp.where(live, logits, -jnp.inf)
         w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhl,blhd->bhd", w, cv[i].astype(jnp.float32))
+        attn = jnp.einsum("bkgl,blkd->bkgd", w, cv[i].astype(jnp.float32))
         attn = attn.reshape(B, 1, spec.d_model).astype(x.dtype)
         x = _block_finish(p, x, attn)
     x = _layer_norm(x, params["ln_final"])
@@ -149,6 +170,8 @@ def prefill(
     B, P = prompt.shape
     H = spec.num_heads
     Dh = spec.d_model // H
+    H_kv = _kv_heads(spec)
+    G = H // H_kv
     cache = init_cache(spec, B)
     embed = params["embed"]
     x = embed[prompt]  # [B, P, d]
@@ -160,12 +183,15 @@ def prefill(
     attn_fn = best_attention(causal=True)
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
-        q, k, v = _block_qkv(p, x, H, Dh)
+        q, k, v = _block_qkv(p, x, H, Dh, H_kv)
         ck = lax.dynamic_update_slice(ck, k[None], (i, 0, 0, 0, 0))
         cv = lax.dynamic_update_slice(cv, v[None], (i, 0, 0, 0, 0))
+        # The cache keeps kv compact; compute expands to full heads
+        # (same jnp.repeat grouping as the training path).
         attn = attn_fn(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32),
+            q.astype(jnp.float32),
+            jnp.repeat(k, G, axis=2).astype(jnp.float32),
+            jnp.repeat(v, G, axis=2).astype(jnp.float32),
         )
         attn = attn.reshape(B, P, spec.d_model).astype(x.dtype)
         x = _block_finish(p, x, attn)
@@ -290,7 +316,7 @@ def beam_search(
     step scores all W·V continuations per sequence, keeps the top W,
     and reorders the cache rows and token history to follow their
     parent beams (one ``take`` along the cache's batch dim — the
-    [depth, B·W, L, H, Dh] layout makes beam bookkeeping a gather,
+    [depth, B·W, L, H_kv, Dh] layout makes beam bookkeeping a gather,
     not a copy loop). Beams are returned best-first with their total
     log-probabilities; ``beam_width=1`` IS greedy decoding (pinned by
     tests). All beams decode the full ``max_new_tokens`` (the LM has

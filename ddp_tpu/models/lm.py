@@ -67,11 +67,15 @@ class CausalLM(nn.Module):
     # to their expert's owner and back (models/moe.py MoEMLP).
     ep_axis: Optional[str] = None
     ep_size: int = 1
+    num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
         assert not (self.num_experts and self.tp_size > 1), (
             "TP shards dense blocks; shard experts with --mesh_expert"
+        )
+        assert not (self.num_experts and self.num_kv_heads), (
+            "GQA covers the dense blocks; drop one of the flags"
         )
         embed = self.param(
             "embed",
@@ -112,6 +116,7 @@ class CausalLM(nn.Module):
                     attention_fn=attn_fn,
                     tp_axis=self.tp_axis,
                     tp_size=self.tp_size,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"block{i + 1}",
                 )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
@@ -130,6 +135,10 @@ class LMSpec(NamedTuple):
     num_experts: int = 0  # >0: MoE MLPs every moe_every-th block
     moe_every: int = 2
     aux_loss_weight: float = 0.01  # GShard load-balance loss weight
+    # Grouped-query attention: 0 → num_heads (MHA). The generation
+    # cache stores the COMPACT num_kv_heads (models/generate.py), so
+    # decode HBM reads shrink by num_heads/num_kv_heads.
+    num_kv_heads: int = 0
 
 
 def _dense_lm(spec: LMSpec) -> CausalLM:
@@ -142,6 +151,7 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
         num_experts=spec.num_experts,
         moe_every=spec.moe_every,
         remat=spec.remat,
+        num_kv_heads=spec.num_kv_heads,
     )
 
 
@@ -167,6 +177,7 @@ def _sharded_lm(
         tp_size=tp_size,
         ep_axis="expert" if ep_size > 1 else None,
         ep_size=ep_size,
+        num_kv_heads=spec.num_kv_heads,
     )
 
 

@@ -76,6 +76,15 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    # Grouped-query attention: 0 → num_heads (plain MHA). Fewer KV
+    # heads shrink the qkv projection and — the real win — the
+    # generation KV cache and its per-step HBM reads
+    # (models/generate.py stores the COMPACT kv). KV is expanded to
+    # the full head count before ``attention_fn``, so flash / ring /
+    # Ulysses compose unchanged. GQA is a parameter-shape change:
+    # tp_size > 1 keeps the MHA head-major layout contract and is
+    # guarded off at the trainer.
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -85,17 +94,36 @@ class MultiHeadAttention(nn.Module):
             self.num_heads, self.tp_size,
         )
         head_dim = C // self.num_heads
-        heads_local = self.num_heads // self.tp_size
-        # HEAD-MAJOR qkv layout: the fused kernel's output columns are
-        # ordered [head, (q|k|v), head_dim], so a contiguous shard of
-        # the output dim — what P(..., "model") hands each TP member —
-        # is a whole number of heads with their complete q, k, AND v.
-        # (A (q|k|v)-major layout would hand member 0 "all of Q plus
-        # half of K" under TP.) generate.py mirrors this layout.
-        qkv = nn.Dense(3 * C // self.tp_size, name="qkv")(x)
-        qkv = qkv.reshape(B, T, heads_local, 3, head_dim)
-        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         fn = self.attention_fn or best_attention()
+        H_kv = self.num_kv_heads or self.num_heads
+        if H_kv != self.num_heads:
+            assert self.tp_size == 1, "GQA does not compose with TP"
+            assert self.num_heads % H_kv == 0, (self.num_heads, H_kv)
+            # Block layout [q·H | k·H_kv | v·H_kv] (head-major within
+            # each block); generate.py mirrors it.
+            qkv = nn.Dense(
+                (self.num_heads + 2 * H_kv) * head_dim, name="qkv"
+            )(x)
+            qd = self.num_heads * head_dim
+            kd = H_kv * head_dim
+            q = qkv[..., :qd].reshape(B, T, self.num_heads, head_dim)
+            k = qkv[..., qd:qd + kd].reshape(B, T, H_kv, head_dim)
+            v = qkv[..., qd + kd:].reshape(B, T, H_kv, head_dim)
+            g = self.num_heads // H_kv
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
+        else:
+            heads_local = self.num_heads // self.tp_size
+            # HEAD-MAJOR qkv layout: the fused kernel's output columns
+            # are ordered [head, (q|k|v), head_dim], so a contiguous
+            # shard of the output dim — what P(..., "model") hands each
+            # TP member — is a whole number of heads with their
+            # complete q, k, AND v. (A (q|k|v)-major layout would hand
+            # member 0 "all of Q plus half of K" under TP.) generate.py
+            # mirrors this layout.
+            qkv = nn.Dense(3 * C // self.tp_size, name="qkv")(x)
+            qkv = qkv.reshape(B, T, heads_local, 3, head_dim)
+            q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         out = fn(q, k, v)  # [B, T, H_local, D]
         out = out.reshape(B, T, C // self.tp_size)
         if self.tp_size > 1:
@@ -121,6 +149,7 @@ class EncoderBlock(nn.Module):
     deterministic: bool = True
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    num_kv_heads: int = 0  # GQA — see MultiHeadAttention
 
     @nn.compact
     def __call__(self, x):
@@ -131,6 +160,7 @@ class EncoderBlock(nn.Module):
             attention_fn=self.attention_fn,
             tp_axis=self.tp_axis,
             tp_size=self.tp_size,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)

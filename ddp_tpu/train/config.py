@@ -42,6 +42,10 @@ class TrainConfig:
     # registry models fix theirs. head_dim = model_dim / num_heads —
     # 128-wide heads measurably fill the MXU better (bench.py).
     num_heads: int = 4
+    # Grouped-query attention for the causal LM: kv heads < num_heads
+    # shrink the generation KV cache (and its decode bandwidth) by
+    # the group factor. 0 = plain MHA.
+    num_kv_heads: int = 0
     augment: str | None = None  # data/augment.py: "crop_flip" | "flip"
     # "auto" resolves per model family: mnist normally, synthetic_seq
     # for --model long_context. An explicit image dataset with the
@@ -159,6 +163,9 @@ class TrainConfig:
         p.add_argument("--model_depth", type=int, default=None)
         p.add_argument("--model_dim", type=int, default=None)
         p.add_argument("--num_heads", type=int, default=cls.num_heads)
+        p.add_argument(
+            "--num_kv_heads", type=int, default=cls.num_kv_heads
+        )
         p.add_argument(
             "--augment", default=None, choices=("none", "crop_flip", "flip")
         )

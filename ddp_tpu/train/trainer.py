@@ -159,6 +159,32 @@ class Trainer:
                 f"--num_heads {config.num_heads} must be >= 1 and "
                 f"divide --model_dim {config.model_dim or 64}"
             )
+        if config.num_kv_heads:
+            if not (self.seq_mode and config.model == "causal_lm"):
+                raise ValueError(
+                    "--num_kv_heads (grouped-query attention) shrinks "
+                    "the causal LM's generation KV cache: use --model "
+                    "causal_lm (or drop the flag)"
+                )
+            if (
+                config.num_kv_heads < 1
+                or config.num_heads % config.num_kv_heads
+            ):
+                raise ValueError(
+                    f"--num_kv_heads {config.num_kv_heads} must be >= 1 "
+                    f"and divide --num_heads {config.num_heads}"
+                )
+            if config.mesh_model > 1:
+                raise ValueError(
+                    "--num_kv_heads keeps the GQA qkv layout, which "
+                    "the Megatron head-major TP sharding does not "
+                    "cover: drop --mesh_model or the flag"
+                )
+            if config.moe_experts:
+                raise ValueError(
+                    "--num_kv_heads covers the dense blocks; it does "
+                    "not compose with --moe_experts"
+                )
         if self.pipe_mode and config.num_microbatches < 1:
             raise ValueError(
                 f"--num_microbatches must be >= 1, got "
@@ -293,6 +319,7 @@ class Trainer:
                     strategy=config.seq_strategy,
                     remat=config.remat,
                     num_experts=config.moe_experts,
+                    num_kv_heads=config.num_kv_heads,
                 )
             else:
                 from ddp_tpu.models.seq_transformer import (

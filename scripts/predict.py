@@ -242,11 +242,23 @@ def _generate_lm(args) -> None:
             f"--num_heads {num_heads} does not divide the checkpoint's "
             f"d_model {d_model}"
         )
+    # GQA is recoverable from shapes once num_heads is known: the qkv
+    # kernel has (H + 2·H_kv)·Dh output columns (vs 3·d for MHA).
+    head_dim = d_model // num_heads
+    qkv_cols = int(params["block1"]["attn"]["qkv"]["kernel"].shape[1])
+    num_kv_heads = (qkv_cols // head_dim - num_heads) // 2
+    if (num_kv_heads * 2 + num_heads) * head_dim != qkv_cols:
+        raise SystemExit(
+            f"checkpoint qkv kernel has {qkv_cols} columns, which no "
+            f"kv-head count explains at --num_heads {num_heads} — "
+            "wrong head count?"
+        )
     spec = LMSpec(
         vocab_size=int(vocab_size),
         total_len=int(total_len),
         d_model=int(d_model),
         depth=int(depth),
+        num_kv_heads=0 if num_kv_heads == num_heads else num_kv_heads,
         num_heads=num_heads,
     )
 
