@@ -28,3 +28,18 @@ python train.py --model causal_lm \
 python scripts/predict.py --model causal_lm \
     --checkpoint_dir "$WORK/checkpoints" \
     --prompt "the five boxing " --max_new_tokens 16
+
+# Nucleus sampling and beam search over the same checkpoint:
+python scripts/predict.py --model causal_lm \
+    --checkpoint_dir "$WORK/checkpoints" \
+    --prompt "the five boxing " --max_new_tokens 16 \
+    --temperature 0.8 --top_k 40 --top_p 0.95
+
+python scripts/predict.py --model causal_lm \
+    --checkpoint_dir "$WORK/checkpoints" \
+    --prompt "the five boxing " --max_new_tokens 16 \
+    --beam_width 4
+
+# Grouped-query attention variant: train with --num_kv_heads 2 (vs 4
+# query heads) and the decode KV cache shrinks 2x; predict.py
+# recognizes the GQA layout from the checkpoint's qkv kernel shapes.
