@@ -393,7 +393,8 @@ def run_lm_long_bench(*, batch: int = 2, seq_len: int = 8192) -> dict:
 
 
 def run_decode_bench(
-    *, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256
+    *, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256,
+    num_kv_heads: int = 0,
 ) -> dict:
     """Generation (serving-path) throughput: KV-cache greedy decode.
 
@@ -401,7 +402,9 @@ def run_decode_bench(
     one jitted ``lax.scan`` of decode steps (models/generate.py) on
     the bench LM config — the latency-bound regime (matmuls are
     [B, 1, d]-thin, HBM-bandwidth dominated), the complement of the
-    training benches' throughput regime.
+    training benches' throughput regime. ``num_kv_heads`` benches the
+    GQA variant: the compact cache cuts per-step KV reads by the
+    group factor (the ``decode_gqa`` entry records the effect).
     """
     import jax
     import jax.numpy as jnp
@@ -414,7 +417,7 @@ def run_decode_bench(
     vocab, d, depth, heads = 8192, 1024, 8, 8
     spec = LMSpec(
         vocab_size=vocab, total_len=prompt_len + new_tokens, d_model=d,
-        depth=depth, num_heads=heads,
+        depth=depth, num_heads=heads, num_kv_heads=num_kv_heads,
     )
     params = init_lm(spec, seed=0)
     prompt = jnp.zeros((batch, prompt_len), jnp.int32)
@@ -450,6 +453,8 @@ def run_decode_bench(
         "new_tokens": new_tokens,
         "d_model": d,
         "depth": depth,
+        "num_heads": heads,
+        "num_kv_heads": num_kv_heads or heads,
         "per_token_ms": round(best / new_tokens * 1000, 3),
         "device_kind": getattr(device, "device_kind", "unknown"),
     }
@@ -550,6 +555,7 @@ def _run_extra_benches() -> None:
         ("lm", run_lm_bench),
         ("lm_long", run_lm_long_bench),
         ("decode", run_decode_bench),
+        ("decode_gqa", lambda: run_decode_bench(num_kv_heads=2)),
         ("loader", run_loader_bench),
     ]:
         try:
