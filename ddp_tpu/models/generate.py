@@ -205,7 +205,11 @@ def prefill(
 def filter_logits(logits, *, top_k: int = 0, top_p: float = 1.0):
     """Mask logits to the top-k and/or nucleus (top-p) candidate set.
 
-    ``top_k > 0`` keeps the k highest logits per row; ``top_p < 1``
+    ``top_k > 0`` keeps the k highest logits per row — tie-inclusive:
+    every logit equal to the kth value survives, so exact ties can
+    leave more than k candidates (the standard shape-static choice;
+    masking ``logits < kth`` keeps strictly-less out only).
+    ``top_p < 1``
     keeps the smallest prefix of the probability-sorted vocabulary
     whose cumulative mass reaches p (the highest-probability token
     always survives, so the set is never empty). Masked entries become

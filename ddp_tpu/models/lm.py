@@ -71,12 +71,18 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
-        assert not (self.num_experts and self.tp_size > 1), (
-            "TP shards dense blocks; shard experts with --mesh_expert"
-        )
-        assert not (self.num_experts and self.num_kv_heads), (
-            "GQA covers the dense blocks; drop one of the flags"
-        )
+        # ValueError (not assert): library users bypass the trainer
+        # guards, and asserts vanish under ``python -O``.
+        if self.num_experts and self.tp_size > 1:
+            raise ValueError(
+                "MoE does not compose with TP here: TP shards dense "
+                "blocks; shard experts with --mesh_expert instead"
+            )
+        if self.num_experts and self.num_kv_heads:
+            raise ValueError(
+                "GQA covers the dense blocks only; drop --num_kv_heads "
+                "or --num_experts"
+            )
         embed = self.param(
             "embed",
             nn.initializers.normal(stddev=0.02),

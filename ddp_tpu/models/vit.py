@@ -97,8 +97,20 @@ class MultiHeadAttention(nn.Module):
         fn = self.attention_fn or best_attention()
         H_kv = self.num_kv_heads or self.num_heads
         if H_kv != self.num_heads:
-            assert self.tp_size == 1, "GQA does not compose with TP"
-            assert self.num_heads % H_kv == 0, (self.num_heads, H_kv)
+            # ValueError (not assert): library users bypass the trainer
+            # guards, and asserts vanish under ``python -O``.
+            if self.num_heads % H_kv != 0:
+                raise ValueError(
+                    f"num_heads={self.num_heads} must be a multiple of "
+                    f"num_kv_heads={H_kv}"
+                )
+            if self.tp_size > 1:
+                raise ValueError(
+                    "GQA does not compose with TP: the head-major fused "
+                    "qkv TP layout assumes equal q/k/v head counts "
+                    f"(got num_heads={self.num_heads}, num_kv_heads="
+                    f"{H_kv}, tp_size={self.tp_size})"
+                )
             # Block layout [q·H | k·H_kv | v·H_kv] (head-major within
             # each block); generate.py mirrors it.
             qkv = nn.Dense(

@@ -206,14 +206,15 @@ def _simulate(S: int, M: int, V: int, Z: int, ring: int) -> InterleavedSchedule:
         mbs.append(row_mb)
         cks.append(row_ck)
         t += 1
-    return InterleavedSchedule(
-        np.asarray(ops, np.int32),
-        np.asarray(mbs, np.int32),
-        np.asarray(cks, np.int32),
-        Z,
-        ring,
-        V,
-    )
+    op_a = np.asarray(ops, np.int32)
+    mb_a = np.asarray(mbs, np.int32)
+    ck_a = np.asarray(cks, np.int32)
+    # The lru_cached schedule is shared across callers (startup log,
+    # step factory, tests): freeze the arrays so a stray in-place edit
+    # raises instead of corrupting every later same-key schedule.
+    for a in (op_a, mb_a, ck_a):
+        a.setflags(write=False)
+    return InterleavedSchedule(op_a, mb_a, ck_a, Z, ring, V)
 
 
 @functools.lru_cache(maxsize=64)
