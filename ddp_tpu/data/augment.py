@@ -20,6 +20,25 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def random_shift(rng, images, *, pad: int = 2):
+    """Random translation by up to ``pad`` pixels (zero fill), no flip.
+
+    The digit-recognition recipe: small translations are
+    label-preserving for handwritten digits while horizontal flip is
+    not (6↔9, 2↔5). Implemented as zero-pad + per-image random crop
+    (``vmap``'d dynamic_slice) — also the crop half of
+    ``random_crop_flip``.
+    """
+    B, H, W, C = images.shape
+    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    offsets = jax.random.randint(rng, (B, 2), 0, 2 * pad + 1)
+
+    def crop(img, off):
+        return lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
+
+    return jax.vmap(crop)(padded, offsets)
+
+
 def random_crop_flip(rng, images, *, pad: int = 4):
     """Zero-pad by ``pad``, random-crop back, random horizontal flip.
 
@@ -27,16 +46,9 @@ def random_crop_flip(rng, images, *, pad: int = 4):
     recipe (zero padding, like its default), vectorized: per-image
     offsets via ``vmap``'d dynamic_slice.
     """
-    B, H, W, C = images.shape
     r_off, r_flip = jax.random.split(rng)
-    padded = jnp.pad(images, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
-    offsets = jax.random.randint(r_off, (B, 2), 0, 2 * pad + 1)
-
-    def crop(img, off):
-        return lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
-
-    images = jax.vmap(crop)(padded, offsets)
-    flip = jax.random.bernoulli(r_flip, 0.5, (B,))
+    images = random_shift(r_off, images, pad=pad)
+    flip = jax.random.bernoulli(r_flip, 0.5, (images.shape[0],))
     return jnp.where(flip[:, None, None, None], images[:, :, ::-1, :], images)
 
 
@@ -49,6 +61,7 @@ def random_flip(rng, images):
 AUGMENTATIONS = {
     "crop_flip": random_crop_flip,
     "flip": random_flip,
+    "shift": random_shift,
 }
 
 

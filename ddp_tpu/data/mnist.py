@@ -45,6 +45,11 @@ _VARIANT_MIRRORS = {
     "kmnist": (
         "http://codh.rois.ac.jp/kmnist/dataset/kmnist/",
     ),
+    # Vendored-only (no mirrors): real UCI handwritten-digit scans
+    # re-packaged into the MNIST IDX container by
+    # scripts/vendor_uci_digits.py and committed under data/uci_digits/
+    # — the real-data convergence proof for zero-egress environments.
+    "uci_digits": (),
 }
 _FILES = {
     "train_images": "train-images-idx3-ubyte.gz",
@@ -97,6 +102,12 @@ def _fetch(root: str, fname: str, variant: str = "mnist") -> str:
     path = os.path.join(base, fname)
     if os.path.exists(path):
         return path
+    if not _VARIANT_MIRRORS[variant]:
+        raise RuntimeError(
+            f"{variant!r} is vendored-only ({fname} not found under "
+            f"{base}); run scripts/vendor_uci_digits.py or point "
+            "--data_root at a checkout that committed data/uci_digits/"
+        )
     os.makedirs(base, exist_ok=True)
     last_err: Exception | None = None
     for mirror in _VARIANT_MIRRORS[variant]:

@@ -1,0 +1,86 @@
+"""The vendored real-data (UCI digits) path: bytes, loader, split.
+
+The round-3 verdict's top ask: the north-star convergence claim must
+rest on real data. scripts/vendor_uci_digits.py re-packages sklearn's
+real digit scans into MNIST's IDX container under data/uci_digits/
+(committed); ddp_tpu.data.mnist loads them as the ``uci_digits``
+variant. These tests pin the committed bytes and the vendored-only
+loading contract.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddp_tpu.data import mnist
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+DATA_ROOT = os.path.abspath(os.path.join(REPO, "data"))
+
+
+def _have_vendored() -> bool:
+    return os.path.exists(
+        os.path.join(DATA_ROOT, "uci_digits", "train-images-idx3-ubyte.gz")
+    )
+
+
+pytestmark = pytest.mark.skipif(
+    not _have_vendored(), reason="data/uci_digits not vendored"
+)
+
+
+def test_loads_with_mnist_shapes_and_balanced_test_split():
+    train = mnist.load(DATA_ROOT, "train", variant="uci_digits")
+    test = mnist.load(DATA_ROOT, "test", variant="uci_digits")
+    assert train.images.shape == (1437, 28, 28, 1)
+    assert train.images.dtype == np.uint8
+    assert test.images.shape == (360, 28, 28, 1)
+    assert test.labels.dtype == np.int32
+    # Stratified: every class equally represented in the test split.
+    assert np.bincount(test.labels).tolist() == [36] * 10
+    # Real scans, not blank padding: ink in every image.
+    assert (train.images.reshape(1437, -1).max(axis=1) > 0).all()
+
+
+def test_vendoring_is_deterministic(tmp_path):
+    """Re-running the vendor script bit-reproduces the committed files.
+
+    Snapshot the committed bytes FIRST (the script writes in place),
+    compare byte-for-byte after, and restore on mismatch so a
+    regression fails loudly without leaving the repo dirty.
+    """
+    script = os.path.join(REPO, "scripts", "vendor_uci_digits.py")
+    committed = os.path.join(DATA_ROOT, "uci_digits")
+    snapshot = {}
+    for fname in sorted(os.listdir(committed)):
+        with open(os.path.join(committed, fname), "rb") as f:
+            snapshot[fname] = f.read()
+    proc = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        cwd=str(tmp_path),  # OUT_DIR is script-relative; cwd must not matter
+    )
+    assert proc.returncode == 0, proc.stderr
+    mismatched = []
+    for fname, want in snapshot.items():
+        with open(os.path.join(committed, fname), "rb") as f:
+            if f.read() != want:
+                mismatched.append(fname)
+    if mismatched:  # restore the committed bytes before failing
+        for fname, want in snapshot.items():
+            with open(os.path.join(committed, fname), "wb") as f:
+                f.write(want)
+        pytest.fail(
+            f"vendor script no longer bit-reproduces: {mismatched} "
+            "(committed bytes restored)"
+        )
+
+
+def test_vendored_only_variant_never_downloads(tmp_path):
+    """Missing files → actionable error, no network attempt."""
+    with pytest.raises(RuntimeError, match="vendored-only"):
+        mnist.load(str(tmp_path), "train", variant="uci_digits")
