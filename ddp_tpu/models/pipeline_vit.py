@@ -29,6 +29,16 @@ from ddp_tpu.parallel.ddp import StepMetrics
 from ddp_tpu.parallel.common import _preprocess, xent
 from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 
+# Stage-sharding machinery shared with the pipelined LM — see
+# parallel/pipe_common.py (FSDP_MIN_SIZE and friends live there). The
+# private aliases keep this module's call sites stable.
+from ddp_tpu.parallel.pipe_common import (
+    gather_stages as _gather_stages,
+    pipe_batch_axes as _pipe_batch_axes,
+    scatter_stage_grads as _scatter_stage_grads,
+    stage_specs as _stage_specs,
+)
+
 
 class PipeViTConfig(NamedTuple):
     num_classes: int = 10
@@ -72,13 +82,19 @@ class PatchEmbed(nn.Module):
 
 
 class StageBlocks(nn.Module):
-    """One pipeline stage: ``depth`` encoder blocks, shape-preserving."""
+    """One pipeline stage: ``depth`` encoder blocks, shape-preserving.
+
+    ``tp_axis``/``tp_size``: Megatron TP inside each block (PP×TP —
+    used by the pipelined LM; see models/vit.py EncoderBlock)."""
 
     depth: int
     num_heads: int
     mlp_dim: int
     attention_fn: Optional[AttentionFn] = None
     remat: bool = False  # jax.checkpoint each block (see models/vit.py)
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
+    tp_inner_vjp: bool = False  # Megatron f/g — see models/vit.py
 
     @nn.compact
     def __call__(self, x):
@@ -88,6 +104,9 @@ class StageBlocks(nn.Module):
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 attention_fn=self.attention_fn,
+                tp_axis=self.tp_axis,
+                tp_size=self.tp_size,
+                tp_inner_vjp=self.tp_inner_vjp,
                 name=f"block{i + 1}",
             )(x)
         return x
@@ -114,75 +133,6 @@ class PipeViTState(NamedTuple):
     step: jax.Array
     params: PipeViTParams
     opt_state: Any
-
-
-def _pipe_batch_axes(mesh) -> tuple:
-    """Axes the pipe family shards its batch over (``expert``/``seq``
-    never compose with pipe)."""
-    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
-
-
-_FSDP_MIN_SIZE = 2**12  # leaves smaller than this stay replicated
-
-
-def _stage_specs(stages, mesh, *, lead: int):
-    """Per-leaf PartitionSpec for the stacked stage tree.
-
-    ``lead`` leading dims carry the stage placement (1 for the plain
-    [S, …] layout on ``pipe``; 2 for the interleaved [v, S, …] layout
-    as P(None, pipe)). With an ``fsdp`` mesh axis, each big-enough
-    leaf additionally shards its first evenly-dividing trailing dim —
-    ZeRO-style: params and optimizer state REST sharded across the
-    batch replicas, and the step all-gathers them transiently
-    (``_gather_stages``)."""
-    fsdp = mesh.shape.get("fsdp", 1)
-    lead_axes = ("pipe",) if lead == 1 else (None, "pipe")
-
-    def spec_for(p):
-        if fsdp <= 1 or p.size < _FSDP_MIN_SIZE:
-            return P(*lead_axes)
-        spec = list(lead_axes) + [None] * (p.ndim - lead)
-        for i in range(lead, p.ndim):
-            if p.shape[i] % fsdp == 0:
-                spec[i] = "fsdp"
-                break
-        return P(*spec)
-
-    return jax.tree.map(spec_for, stages)
-
-
-def _gather_stages(sp, specs):
-    """all_gather the fsdp-sharded stage leaves INSIDE the island.
-
-    Under AD (the GPipe path) the transpose of this all_gather is a
-    psum_scatter over ``fsdp`` — ZeRO's gradient reduce-scatter falls
-    out of the schedule for free; the hand-scheduled paths apply the
-    matching ``_scatter_stage_grads`` explicitly."""
-
-    def g(p, s):
-        for i, ax in enumerate(s):
-            if ax == "fsdp":
-                return lax.all_gather(p, "fsdp", axis=i, tiled=True)
-        return p
-
-    return jax.tree.map(g, sp, specs)
-
-
-def _scatter_stage_grads(gs, specs):
-    """Reduce stage grads over ``fsdp``: sum + re-shard for leaves
-    that rest sharded (psum_scatter), plain psum for the rest —
-    exactly the transpose of ``_gather_stages`` plus the batch-axis
-    reduction every grad needs (fsdp members see different data)."""
-
-    def s(g, spec):
-        for i, ax in enumerate(spec):
-            if ax == "fsdp":
-                return lax.psum_scatter(
-                    g, "fsdp", scatter_dimension=i, tiled=True
-                )
-        return lax.psum(g, "fsdp")
-
-    return jax.tree.map(s, gs, specs)
 
 
 def _modules(cfg: PipeViTConfig):

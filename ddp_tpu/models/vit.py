@@ -42,6 +42,11 @@ class RowParallelDense(nn.Module):
 
     features: int
     axis_name: str
+    # True → combine with Megatron's ``g`` (psum forward, identity
+    # backward) instead of a bare psum: required when the block's
+    # gradient comes from an explicit jax.vjp INSIDE the shard_map
+    # body (hand-scheduled pipeline schedules) — see parallel/tp.py.
+    inner_vjp: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -51,7 +56,13 @@ class RowParallelDense(nn.Module):
             (x.shape[-1], self.features),
         )
         bias = self.param("bias", nn.initializers.zeros, (self.features,))
-        y = lax.psum(x @ kernel.astype(x.dtype), self.axis_name)
+        partial_y = x @ kernel.astype(x.dtype)
+        if self.inner_vjp:
+            from ddp_tpu.parallel.tp import megatron_g
+
+            y = megatron_g(partial_y, self.axis_name)
+        else:
+            y = lax.psum(partial_y, self.axis_name)
         return y + bias.astype(y.dtype)
 
 
@@ -76,6 +87,10 @@ class MultiHeadAttention(nn.Module):
     attention_fn: Optional[AttentionFn] = None
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    # True → Megatron f/g custom-VJP plumbing for contexts that take
+    # the gradient with an explicit jax.vjp inside the shard_map body
+    # (hand-scheduled pipeline schedules). See parallel/tp.py.
+    tp_inner_vjp: bool = False
     # Grouped-query attention: 0 → num_heads (plain MHA). Fewer KV
     # heads shrink the qkv projection and — the real win — the
     # generation KV cache and its per-step HBM reads
@@ -126,6 +141,10 @@ class MultiHeadAttention(nn.Module):
             v = jnp.repeat(v, g, axis=2)
         else:
             heads_local = self.num_heads // self.tp_size
+            if self.tp_size > 1 and self.tp_inner_vjp:
+                from ddp_tpu.parallel.tp import megatron_f
+
+                x = megatron_f(x, self.tp_axis)
             # HEAD-MAJOR qkv layout: the fused kernel's output columns
             # are ordered [head, (q|k|v), head_dim], so a contiguous
             # shard of the output dim — what P(..., "model") hands each
@@ -139,7 +158,9 @@ class MultiHeadAttention(nn.Module):
         out = fn(q, k, v)  # [B, T, H_local, D]
         out = out.reshape(B, T, C // self.tp_size)
         if self.tp_size > 1:
-            return RowParallelDense(C, self.tp_axis, name="proj")(out)
+            return RowParallelDense(
+                C, self.tp_axis, inner_vjp=self.tp_inner_vjp, name="proj"
+            )(out)
         return nn.Dense(C, name="proj")(out)
 
 
@@ -161,6 +182,7 @@ class EncoderBlock(nn.Module):
     deterministic: bool = True
     tp_axis: Optional[str] = None
     tp_size: int = 1
+    tp_inner_vjp: bool = False  # Megatron f/g — see MultiHeadAttention
     num_kv_heads: int = 0  # GQA — see MultiHeadAttention
 
     @nn.compact
@@ -172,16 +194,24 @@ class EncoderBlock(nn.Module):
             attention_fn=self.attention_fn,
             tp_axis=self.tp_axis,
             tp_size=self.tp_size,
+            tp_inner_vjp=self.tp_inner_vjp,
             num_kv_heads=self.num_kv_heads,
             name="attn",
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         x = x + y
         y = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(x.dtype)
+        if self.tp_size > 1 and self.tp_inner_vjp:
+            from ddp_tpu.parallel.tp import megatron_f
+
+            y = megatron_f(y, self.tp_axis)
         y = nn.Dense(self.mlp_dim // self.tp_size, name="mlp1")(y)
         y = nn.gelu(y)
         if self.tp_size > 1:
-            y = RowParallelDense(x.shape[-1], self.tp_axis, name="mlp2")(y)
+            y = RowParallelDense(
+                x.shape[-1], self.tp_axis, inner_vjp=self.tp_inner_vjp,
+                name="mlp2",
+            )(y)
         else:
             y = nn.Dense(x.shape[-1], name="mlp2")(y)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)

@@ -1,0 +1,84 @@
+"""Shared stage-parameter sharding for the pipeline model families.
+
+Every pipelined model (ViT — models/pipeline_vit.py; causal LM —
+models/pipeline_lm.py) stacks its uniform stage bodies on a leading
+stage dim sharded over ``pipe`` and optionally ZeRO-shards each stage's
+leaves over ``fsdp``. The spec computation and the gather/scatter pair
+that moves params between their resting layout and the stage program
+are family-independent and live here so a fix in one family cannot
+miss the other.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+FSDP_MIN_SIZE = 2**12  # leaves smaller than this stay replicated
+
+
+def pipe_batch_axes(mesh) -> tuple:
+    """Axes the pipe family shards its batch over (``expert``/``seq``
+    never compose with pipe)."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+
+
+def stage_specs(stages, mesh, *, lead: int):
+    """Per-leaf PartitionSpec for the stacked stage tree.
+
+    ``lead`` leading dims carry the stage placement (1 for the plain
+    [S, …] layout on ``pipe``; 2 for the interleaved [v, S, …] layout
+    as P(None, pipe)). With an ``fsdp`` mesh axis, each big-enough
+    leaf additionally shards its first evenly-dividing trailing dim —
+    ZeRO-style: params and optimizer state REST sharded across the
+    batch replicas, and the step all-gathers them transiently
+    (``gather_stages``)."""
+    fsdp = mesh.shape.get("fsdp", 1)
+    lead_axes = ("pipe",) if lead == 1 else (None, "pipe")
+
+    def spec_for(p):
+        if fsdp <= 1 or p.size < FSDP_MIN_SIZE:
+            return P(*lead_axes)
+        spec = list(lead_axes) + [None] * (p.ndim - lead)
+        for i in range(lead, p.ndim):
+            if p.shape[i] % fsdp == 0:
+                spec[i] = "fsdp"
+                break
+        return P(*spec)
+
+    return jax.tree.map(spec_for, stages)
+
+
+def gather_stages(sp, specs):
+    """all_gather the fsdp-sharded stage leaves INSIDE the island.
+
+    Under AD (the GPipe path) the transpose of this all_gather is a
+    psum_scatter over ``fsdp`` — ZeRO's gradient reduce-scatter falls
+    out of the schedule for free; the hand-scheduled paths apply the
+    matching ``scatter_stage_grads`` explicitly."""
+
+    def g(p, s):
+        for i, ax in enumerate(s):
+            if ax == "fsdp":
+                return lax.all_gather(p, "fsdp", axis=i, tiled=True)
+        return p
+
+    return jax.tree.map(g, sp, specs)
+
+
+def scatter_stage_grads(gs, specs):
+    """Reduce stage grads over ``fsdp``: sum + re-shard for leaves
+    that rest sharded (psum_scatter), plain psum for the rest —
+    exactly the transpose of ``gather_stages`` plus the batch-axis
+    reduction every grad needs (fsdp members see different data)."""
+
+    def s(g, spec):
+        for i, ax in enumerate(spec):
+            if ax == "fsdp":
+                return lax.psum_scatter(
+                    g, "fsdp", scatter_dimension=i, tiled=True
+                )
+        return lax.psum(g, "fsdp")
+
+    return jax.tree.map(s, gs, specs)

@@ -44,6 +44,7 @@ the point of TP — and AD transposes each gather into a psum_scatter
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -56,6 +57,55 @@ from ddp_tpu.parallel.seq_fsdp import fsdp_size
 
 def tp_size(mesh: Mesh) -> int:
     return int(mesh.shape.get("model", 1))
+
+
+# Megatron's f/g custom-VJP pair — needed ONLY where a Megatron block's
+# gradient is taken by an EXPLICIT ``jax.vjp`` *inside* the shard_map
+# body (the hand-scheduled pipeline kernels, parallel/one_f1b.py /
+# interleaved.py). There the shard_map transpose never runs, so the
+# cross-member summation it would insert for replicated-input
+# cotangents must live in the ops themselves: ``f`` (identity forward,
+# psum backward) makes the column matmuls' input cotangents sum across
+# members; ``g`` (psum forward, identity backward) is the row matmul's
+# combine whose backward must NOT psum again. The seq family's
+# annotation-free blocks remain correct under the shard_map transpose
+# and would DOUBLE-COUNT with f/g added (verified numerically,
+# tests/test_tp.py) — hence the explicit opt-in flag
+# (``tp_inner_vjp``) on the block modules instead of always-on ops.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def megatron_f(x, axis_name: str):
+    """Identity forward; backward psums the cotangent over ``axis_name``."""
+    return x
+
+
+def _megatron_f_fwd(x, axis_name):
+    return x, None
+
+
+def _megatron_f_bwd(axis_name, _res, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+megatron_f.defvjp(_megatron_f_fwd, _megatron_f_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def megatron_g(x, axis_name: str):
+    """psum forward; backward passes the cotangent through unchanged."""
+    return lax.psum(x, axis_name)
+
+
+def _megatron_g_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _megatron_g_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+megatron_g.defvjp(_megatron_g_fwd, _megatron_g_bwd)
 
 
 def ep_size(mesh: Mesh) -> int:

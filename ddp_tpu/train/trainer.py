@@ -68,6 +68,25 @@ def _ctor_accepts(model_name: str, kwarg: str) -> bool:
     )
 
 
+def _check_tp_dims(config: TrainConfig) -> None:
+    """Megatron TP divisibility rules, shared by the seq and pipe-LM
+    families (one definition — the two must not drift): attention
+    heads and the 4×d_model MLP hidden dim split over ``model``."""
+    d_model = config.model_dim or 64
+    if config.num_heads % config.mesh_model:
+        raise ValueError(
+            f"tensor parallelism splits attention heads: "
+            f"--num_heads {config.num_heads} not divisible by "
+            f"--mesh_model {config.mesh_model}"
+        )
+    if (d_model * 4) % config.mesh_model:
+        raise ValueError(
+            f"tensor parallelism splits the MLP hidden dim: "
+            f"{d_model * 4} (4 × --model_dim) not divisible "
+            f"by --mesh_model {config.mesh_model}"
+        )
+
+
 @dataclasses.dataclass
 class EpochStats:
     epoch: int
@@ -120,21 +139,25 @@ class Trainer:
                 "--mesh_seq shards tokens, which only the sequence "
                 "models have: use --model long_context or causal_lm"
             )
-        # Pipeline family: the whole ViT rides the pipe axis
-        # (models/pipeline_vit.py) under GPipe or 1F1B.
-        self.pipe_mode = config.model == "pipe_vit"
+        # Pipeline family: the whole model rides the pipe axis under
+        # GPipe / 1F1B / interleaved — the ViT (models/pipeline_vit.py)
+        # and, since round 4, the causal LM (models/pipeline_lm.py,
+        # which additionally composes with Megatron TP over ``model``:
+        # the PP×TP layout).
+        self.pipe_lm_mode = config.model == "pipe_lm"
+        self.pipe_mode = config.model == "pipe_vit" or self.pipe_lm_mode
         if config.mesh_pipe > 1 and not self.pipe_mode:
             raise ValueError(
                 "--mesh_pipe cuts a model into stages, which only the "
-                "pipeline family has: use --model pipe_vit"
+                "pipeline family has: use --model pipe_vit or pipe_lm"
             )
         if self.pipe_mode and config.mesh_pipe < 2:
             raise ValueError(
-                "--model pipe_vit needs --mesh_pipe >= 2 (a 1-stage "
-                "pipeline is the plain step — drop the flag)"
+                f"--model {config.model} needs --mesh_pipe >= 2 (a "
+                "1-stage pipeline is the plain step — drop the flag)"
             )
         if self.pipe_mode and (
-            config.mesh_model > 1
+            (config.mesh_model > 1 and not self.pipe_lm_mode)
             or config.mesh_expert > 1
             or config.mesh_seq > 1
             or config.zero1
@@ -143,12 +166,17 @@ class Trainer:
             or config.augment not in (None, "none")
         ):
             raise ValueError(
-                "--model pipe_vit composes with the data axis, fsdp "
-                "(ZeRO-sharded stage params), bf16, remat, label "
-                "smoothing, EMA and LR schedules — not tp/expert/seq/"
-                "zero1, accumulation (use --num_microbatches), "
-                "augment, or --fast_epoch"
+                f"--model {config.model} composes with the data axis, "
+                "fsdp (ZeRO-sharded stage params)"
+                + (", tp (--mesh_model, PP×TP)" if self.pipe_lm_mode else "")
+                + ", bf16, remat, label smoothing, EMA and LR schedules "
+                "— not "
+                + ("" if self.pipe_lm_mode else "tp/")
+                + "expert/seq/zero1, accumulation (use "
+                "--num_microbatches), augment, or --fast_epoch"
             )
+        if self.pipe_lm_mode and config.mesh_model > 1:
+            _check_tp_dims(config)
         if (self.seq_mode or self.pipe_mode) and (
             config.num_heads < 1
             or (config.model_dim or 64) % config.num_heads
@@ -197,8 +225,8 @@ class Trainer:
         if config.virtual_stages > 1 and not self.pipe_mode:
             raise ValueError(
                 "--virtual_stages cuts a pipelined model into chunks: "
-                "use --model pipe_vit (with --mesh_pipe and "
-                "--pipe_schedule interleaved)"
+                "use --model pipe_vit or pipe_lm (with --mesh_pipe "
+                "and --pipe_schedule interleaved)"
             )
         if config.virtual_stages > 1 and config.pipe_schedule != "interleaved":
             raise ValueError(
@@ -225,7 +253,11 @@ class Trainer:
 
         self.dataset = config.dataset
         if self.dataset == "auto":
-            self.dataset = "synthetic_seq" if self.seq_mode else "mnist"
+            self.dataset = (
+                "synthetic_seq"
+                if self.seq_mode or self.pipe_lm_mode
+                else "mnist"
+            )
         # Round 1 walled the sequence family off from everything but
         # data+seq (VERDICT.md weak #4); round 2 lifted fsdp
         # (parallel/seq_fsdp.py), accumulation, and label smoothing;
@@ -265,19 +297,7 @@ class Trainer:
                     "(Megatron TP); MoE expert weights shard over "
                     "--mesh_expert instead — drop one of the flags"
                 )
-            d_model = config.model_dim or 64
-            if config.num_heads % config.mesh_model:
-                raise ValueError(
-                    f"tensor parallelism splits attention heads: "
-                    f"--num_heads {config.num_heads} not divisible by "
-                    f"--mesh_model {config.mesh_model}"
-                )
-            if (d_model * 4) % config.mesh_model:
-                raise ValueError(
-                    f"tensor parallelism splits the MLP hidden dim: "
-                    f"{d_model * 4} (4 × --model_dim) not divisible "
-                    f"by --mesh_model {config.mesh_model}"
-                )
+            _check_tp_dims(config)
         self.mesh = make_mesh(
             MeshSpec(
                 data=-1,
@@ -424,16 +444,17 @@ class Trainer:
             },
         )
 
-        if self.seq_mode:
+        token_mode = self.lm_mode or self.pipe_lm_mode
+        if self.seq_mode or self.pipe_lm_mode:
             if self.dataset == "text":
                 # Real data for the LM: a corpus file — raw bytes at
                 # --vocab_size <= 256, BPE subwords above (the trained
                 # tokenizer persists next to the checkpoints: it is
                 # part of the model, and generation needs it to decode).
-                if not self.lm_mode:
+                if not token_mode:
                     raise ValueError(
                         "--dataset text is causal-LM data (bytes, no "
-                        "class labels): use --model causal_lm"
+                        "class labels): use --model causal_lm or pipe_lm"
                     )
                 if not config.text_file:
                     raise ValueError("--dataset text needs --text_file PATH")
@@ -459,7 +480,7 @@ class Trainer:
                 n = config.synthetic_size or 2048
 
                 def seq_split(count, seed):
-                    if self.lm_mode:
+                    if token_mode:
                         toks = sequences.synthetic_tokens(
                             count, total_len=config.seq_len,
                             vocab_size=config.vocab_size, seed=seed,
@@ -492,7 +513,7 @@ class Trainer:
             # feeds float sequences the byte-pipeline can't serve —
             # don't spin up (or warn about) a pool that can't be used.
             num_workers=0
-            if (config.fast_epoch or self.seq_mode)
+            if (config.fast_epoch or self.seq_mode or self.pipe_lm_mode)
             else config.num_workers,
         )
 
@@ -564,6 +585,87 @@ class Trainer:
                 or config.mesh_expert > 1
                 else replicate_state(st_tr, self.mesh)
             )
+        elif self.pipe_lm_mode:
+            from ddp_tpu.models.pipeline_lm import (
+                PipeLMConfig,
+                PipeLMState,
+                create_pipe_lm_state,
+                make_pipe_lm_1f1b_train_step,
+                make_pipe_lm_eval_step,
+                make_pipe_lm_interleaved_train_step,
+                make_pipe_lm_train_step,
+            )
+            from ddp_tpu.parallel.ddp import TrainState
+            from ddp_tpu.parallel.pipeline import bubble_fraction
+
+            self._check_pipe_batch(config)
+            interleaved = config.pipe_schedule == "interleaved"
+            self.pipe_cfg = PipeLMConfig(
+                vocab_size=config.vocab_size,
+                seq_len=config.seq_len,
+                d_model=config.model_dim or 64,
+                num_heads=config.num_heads,
+                num_stages=config.mesh_pipe,
+                depth_per_stage=config.model_depth or 1,
+                num_microbatches=config.num_microbatches,
+                remat=config.remat,
+                virtual_stages=config.virtual_stages,
+                label_smoothing=config.label_smoothing,
+                tp_size=config.mesh_model,
+            )
+            logger.info(
+                "Pipeline LM: %d stages × %d virtual × %d blocks, %d "
+                "microbatches, %s schedule, tp=%d, bubble fraction %.3f",
+                self.pipe_cfg.num_stages,
+                self.pipe_cfg.virtual_stages,
+                self.pipe_cfg.depth_per_stage,
+                self.pipe_cfg.num_microbatches,
+                config.pipe_schedule,
+                self.pipe_cfg.tp_size,
+                bubble_fraction(
+                    self.pipe_cfg.num_stages,
+                    self.pipe_cfg.num_microbatches
+                    * self.pipe_cfg.virtual_stages,
+                ),
+            )
+            make_step = {
+                "1f1b": make_pipe_lm_1f1b_train_step,
+                "interleaved": make_pipe_lm_interleaved_train_step,
+            }.get(config.pipe_schedule, make_pipe_lm_train_step)
+            pipe_step = make_step(
+                self.pipe_cfg, self.optimizer, self.mesh,
+                compute_dtype=compute_dtype,
+            )
+
+            def step(ts, tokens, labels):
+                del labels  # targets are the shifted tokens
+                ps, metrics = pipe_step(
+                    PipeLMState(ts.step, ts.params, ts.opt_state), tokens
+                )
+                return (
+                    ts._replace(
+                        step=ps.step, params=ps.params,
+                        opt_state=ps.opt_state,
+                    ),
+                    metrics,
+                )
+
+            self.train_step = step
+            self.eval_step = make_pipe_lm_eval_step(
+                self.pipe_cfg, self.mesh, compute_dtype=compute_dtype
+            )
+            st = create_pipe_lm_state(
+                self.pipe_cfg, self.optimizer, self.mesh,
+                seed=config.seed, interleaved=interleaved,
+            )
+            # Stage params rest sharded over pipe (and model/fsdp when
+            # composed) — those placements are the contract.
+            self.state = TrainState(
+                step=st.step,
+                params=st.params,
+                opt_state=st.opt_state,
+                model_state={},
+            )
         elif self.pipe_mode:
             from ddp_tpu.models.pipeline_vit import (
                 PipeViTConfig,
@@ -582,21 +684,7 @@ class Trainer:
             from ddp_tpu.parallel.ddp import TrainState
             from ddp_tpu.parallel.pipeline import bubble_fraction
 
-            if self.global_batch_size % config.num_microbatches:
-                raise ValueError(
-                    f"global batch {self.global_batch_size} (batch_size "
-                    f"× data shards) not divisible by "
-                    f"--num_microbatches {config.num_microbatches}"
-                )
-            mb_size = self.global_batch_size // config.num_microbatches
-            if mb_size % self.data_shards:
-                raise ValueError(
-                    f"microbatch size {mb_size} (global batch "
-                    f"{self.global_batch_size} / {config.num_microbatches} "
-                    f"microbatches) not divisible by {self.data_shards} "
-                    "data shards — each microbatch shards over the data "
-                    "axis"
-                )
+            self._check_pipe_batch(config)
             H = int(train_split.images.shape[1])
             pipe_heads = config.num_heads  # validated in __init__ above
             interleaved = config.pipe_schedule == "interleaved"
@@ -812,6 +900,24 @@ class Trainer:
         self.history: list[EpochStats] = []
 
     # ---- the reference's epoch/batch loop (train_ddp.py:192-209) ----
+
+    def _check_pipe_batch(self, config: TrainConfig) -> None:
+        """Microbatch divisibility guards shared by both pipe families."""
+        if self.global_batch_size % config.num_microbatches:
+            raise ValueError(
+                f"global batch {self.global_batch_size} (batch_size "
+                f"× data shards) not divisible by "
+                f"--num_microbatches {config.num_microbatches}"
+            )
+        mb_size = self.global_batch_size // config.num_microbatches
+        if mb_size % self.data_shards:
+            raise ValueError(
+                f"microbatch size {mb_size} (global batch "
+                f"{self.global_batch_size} / {config.num_microbatches} "
+                f"microbatches) not divisible by {self.data_shards} "
+                "data shards — each microbatch shards over the data "
+                "axis"
+            )
 
     def _install_preemption_handler(self):
         """SIGTERM → finish the in-flight step, checkpoint, exit clean.
@@ -1153,7 +1259,7 @@ class Trainer:
             # mean next-token cross-entropy, so this is exp(loss).
             **(
                 {"perplexity": round(float(np.exp(final_loss)), 4)}
-                if self.lm_mode
+                if (self.lm_mode or self.pipe_lm_mode)
                 and np.isfinite(final_loss)
                 and np.isfinite(np.exp(final_loss))
                 else {}

@@ -23,7 +23,7 @@ from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
 CFG = PipeViTConfig(
     num_classes=10,
     patch_size=7,
-    embed_dim=64,  # mlp kernels 64x256 = 16384 > _FSDP_MIN_SIZE
+    embed_dim=64,  # mlp kernels 64x256 = 16384 > pipe_common.FSDP_MIN_SIZE
     num_heads=4,
     num_stages=4,
     depth_per_stage=1,

@@ -58,22 +58,26 @@ def test_vendoring_is_deterministic(tmp_path):
     for fname in sorted(os.listdir(committed)):
         with open(os.path.join(committed, fname), "rb") as f:
             snapshot[fname] = f.read()
-    proc = subprocess.run(
-        [sys.executable, script],
-        capture_output=True,
-        text=True,
-        cwd=str(tmp_path),  # OUT_DIR is script-relative; cwd must not matter
-    )
-    assert proc.returncode == 0, proc.stderr
     mismatched = []
-    for fname, want in snapshot.items():
-        with open(os.path.join(committed, fname), "rb") as f:
-            if f.read() != want:
-                mismatched.append(fname)
-    if mismatched:  # restore the committed bytes before failing
+    try:
+        proc = subprocess.run(
+            [sys.executable, script],
+            capture_output=True,
+            text=True,
+            cwd=str(tmp_path),  # OUT_DIR script-relative; cwd must not matter
+        )
+        assert proc.returncode == 0, proc.stderr
+        for fname, want in snapshot.items():
+            with open(os.path.join(committed, fname), "rb") as f:
+                if f.read() != want:
+                    mismatched.append(fname)
+    finally:
+        # ALWAYS restore the committed bytes — a partial write from a
+        # crashed script (or a mismatch) must not leave the repo dirty.
         for fname, want in snapshot.items():
             with open(os.path.join(committed, fname), "wb") as f:
                 f.write(want)
+    if mismatched:
         pytest.fail(
             f"vendor script no longer bit-reproduces: {mismatched} "
             "(committed bytes restored)"
