@@ -82,13 +82,14 @@ def _block_qkv(p, x, H, Dh, H_kv=None):
     h = _layer_norm(x, p["ln1"]).astype(x.dtype)
     qkv = _dense(h, p["attn"]["qkv"])
     if H_kv != H:
-        # GQA block layout [q·H | k·H_kv | v·H_kv], mirroring
-        # models/vit.py MultiHeadAttention's GQA path.
-        qd, kd = H * Dh, H_kv * Dh
-        q = qkv[..., :qd].reshape(*x.shape[:2], H, Dh)
-        k = qkv[..., qd:qd + kd].reshape(*x.shape[:2], H_kv, Dh)
-        v = qkv[..., qd + kd:].reshape(*x.shape[:2], H_kv, Dh)
-        return q, k, v
+        # GQA GROUP-MAJOR fused layout [kv-group: q·G | k | v] × H_kv,
+        # mirroring models/vit.py MultiHeadAttention's GQA path (whole
+        # kv groups per TP column shard). q head j = g·G + i comes out
+        # in natural 0..H-1 order, matching the grouped decode einsums.
+        G = H // H_kv
+        qkv = qkv.reshape(*x.shape[:2], H_kv, G + 2, Dh)
+        q = qkv[..., :G, :].reshape(*x.shape[:2], H, Dh)
+        return q, qkv[..., G, :], qkv[..., G + 1, :]
     # HEAD-MAJOR fused layout, mirroring models/vit.py
     # MultiHeadAttention: columns ordered [head, (q|k|v), head_dim] so
     # TP shards of the kernel are whole heads.

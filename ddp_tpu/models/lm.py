@@ -358,8 +358,13 @@ def make_lm_train_step(
     compute_dtype=jnp.float32,
     grad_accum_steps: int = 1,
     label_smoothing: float = 0.0,
+    jit: bool = True,
 ):
     """dp×sp[×fsdp] causal-LM step: ``step(state, tokens)``.
+
+    ``jit=False`` returns the raw (untraced) step for callers that
+    embed it in a larger program — the compiled-epoch runner
+    (train/fast.py make_lm_epoch_runner) scans it.
 
     ``tokens``: [B, T_global] int32. The label shift and loss masking
     happen on GLOBAL arrays before/after the sharded forward, so shard
@@ -435,4 +440,6 @@ def make_lm_train_step(
             ),
         )
 
+    if not jit:
+        return step
     return jax.jit(step, donate_argnums=(0,) if donate else ())

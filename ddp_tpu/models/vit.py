@@ -96,9 +96,13 @@ class MultiHeadAttention(nn.Module):
     # generation KV cache and its per-step HBM reads
     # (models/generate.py stores the COMPACT kv). KV is expanded to
     # the full head count before ``attention_fn``, so flash / ring /
-    # Ulysses compose unchanged. GQA is a parameter-shape change:
-    # tp_size > 1 keeps the MHA head-major layout contract and is
-    # guarded off at the trainer.
+    # Ulysses compose unchanged. Composes with TP when tp_size
+    # divides num_kv_heads (whole kv groups per member — see the
+    # group-major layout note in __call__). BREAKING vs the round-3
+    # layout: the fused qkv columns moved from the [q·H | k·H_kv |
+    # v·H_kv] block order to group-major (same shapes — a round-3 GQA
+    # checkpoint restores shape-clean but mispermuted; retrain or
+    # re-export).
     num_kv_heads: int = 0
 
     @nn.compact
@@ -119,24 +123,32 @@ class MultiHeadAttention(nn.Module):
                     f"num_heads={self.num_heads} must be a multiple of "
                     f"num_kv_heads={H_kv}"
                 )
-            if self.tp_size > 1:
+            if H_kv % self.tp_size != 0:
                 raise ValueError(
-                    "GQA does not compose with TP: the head-major fused "
-                    "qkv TP layout assumes equal q/k/v head counts "
-                    f"(got num_heads={self.num_heads}, num_kv_heads="
-                    f"{H_kv}, tp_size={self.tp_size})"
+                    f"GQA under TP shards whole kv groups: num_kv_heads="
+                    f"{H_kv} not divisible by tp_size={self.tp_size}"
                 )
-            # Block layout [q·H | k·H_kv | v·H_kv] (head-major within
-            # each block); generate.py mirrors it.
-            qkv = nn.Dense(
-                (self.num_heads + 2 * H_kv) * head_dim, name="qkv"
-            )(x)
-            qd = self.num_heads * head_dim
-            kd = H_kv * head_dim
-            q = qkv[..., :qd].reshape(B, T, self.num_heads, head_dim)
-            k = qkv[..., qd:qd + kd].reshape(B, T, H_kv, head_dim)
-            v = qkv[..., qd + kd:].reshape(B, T, H_kv, head_dim)
+            # GROUP-MAJOR fused layout: columns ordered [kv-group g:
+            # q_{g,0..G-1} | k_g | v_g] × H_kv groups. A contiguous
+            # shard of the output dim — what P(..., "model") hands each
+            # TP member — is a whole number of kv GROUPS, each with its
+            # G query heads and its complete k AND v (the GQA analogue
+            # of the MHA head-major contract above). generate.py
+            # mirrors this layout.
+            if self.tp_size > 1 and self.tp_inner_vjp:
+                from ddp_tpu.parallel.tp import megatron_f
+
+                x = megatron_f(x, self.tp_axis)
             g = self.num_heads // H_kv
+            kv_local = H_kv // self.tp_size
+            qkv = nn.Dense(
+                (self.num_heads + 2 * H_kv) * head_dim // self.tp_size,
+                name="qkv",
+            )(x)
+            qkv = qkv.reshape(B, T, kv_local, g + 2, head_dim)
+            q = qkv[..., :g, :].reshape(B, T, kv_local * g, head_dim)
+            k = qkv[..., g, :]  # [B, T, kv_local, head_dim]
+            v = qkv[..., g + 1, :]
             k = jnp.repeat(k, g, axis=2)
             v = jnp.repeat(v, g, axis=2)
         else:

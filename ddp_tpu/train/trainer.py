@@ -202,11 +202,14 @@ class Trainer:
                     f"--num_kv_heads {config.num_kv_heads} must be >= 1 "
                     f"and divide --num_heads {config.num_heads}"
                 )
-            if config.mesh_model > 1:
+            if (
+                config.mesh_model > 1
+                and config.num_kv_heads % config.mesh_model
+            ):
                 raise ValueError(
-                    "--num_kv_heads keeps the GQA qkv layout, which "
-                    "the Megatron head-major TP sharding does not "
-                    "cover: drop --mesh_model or the flag"
+                    "GQA under TP shards whole kv groups: "
+                    f"--num_kv_heads {config.num_kv_heads} not "
+                    f"divisible by --mesh_model {config.mesh_model}"
                 )
             if config.moe_experts:
                 raise ValueError(
@@ -264,20 +267,26 @@ class Trainer:
         # round 3 lifts tensor parallelism (parallel/tp.py — Megatron
         # column/row inside the shard_map step, composing with seq and
         # fsdp) and expert parallelism for the MoE-LM (models/moe.py
-        # MoEMLP all-to-all dispatch over the ``expert`` axis). What
-        # remains out: zero1 (subsumed by fsdp, which shards moments
-        # too), the image-only augment pipeline, and the
-        # device-resident fast-epoch path.
+        # MoEMLP all-to-all dispatch over the ``expert`` axis); round 4
+        # lifts --fast_epoch for the causal LM (train/fast.py
+        # make_lm_epoch_runner — the compiled-epoch dispatch over the
+        # same raw step). What remains out: zero1 (subsumed by fsdp,
+        # which shards moments too), the image-only augment pipeline,
+        # and fast_epoch for the long-context classifier.
         if self.seq_mode and (
             config.zero1
-            or config.fast_epoch
+            or (config.fast_epoch and not self.lm_mode)
             or get_augmentation(config.augment) is not None
         ):
             raise ValueError(
                 f"--model {config.model} composes with data/seq/fsdp/"
                 "model/expert mesh axes, accumulation, label smoothing "
-                "and bf16 — but not zero1 (use --mesh_fsdp), augment, "
-                "or --fast_epoch"
+                "and bf16 — but not zero1 (use --mesh_fsdp), augment"
+                + (
+                    ""
+                    if self.lm_mode
+                    else ", or --fast_epoch (causal_lm only)"
+                )
             )
         if self.seq_mode and config.mesh_expert > 1:
             if not config.moe_experts:
@@ -837,10 +846,12 @@ class Trainer:
             self.state = replicate_state(state, self.mesh)
         self.fast_runner = None
         if config.fast_epoch:
-            if self.use_spmd or config.grad_accum_steps > 1:
+            if not self.lm_mode and (
+                self.use_spmd or config.grad_accum_steps > 1
+            ):
                 raise ValueError(
                     "--fast_epoch supports the pure-DDP step without "
-                    "gradient accumulation"
+                    "gradient accumulation (or the causal LM family)"
                 )
             if not config.shuffle:
                 raise ValueError(
@@ -856,24 +867,38 @@ class Trainer:
                 )
             from ddp_tpu.train.fast import (
                 device_put_dataset,
+                device_put_replicated,
                 make_epoch_runner,
+                make_lm_epoch_runner,
             )
 
-            # Full arrays on device: the runner permutes all n images
-            # per epoch and drops a DIFFERENT tail of the permutation
-            # each time (make_epoch_runner), matching the step path's
-            # coverage — a static [:usable] truncation would exclude
-            # the same images every epoch.
-            dev_images, dev_labels = device_put_dataset(
-                train_split.images, train_split.labels, self.mesh
-            )
-            self.fast_runner = make_epoch_runner(
-                self.model, self.optimizer, self.mesh,
-                dev_images, dev_labels, self.global_batch_size,
-                compute_dtype=compute_dtype, seed=config.seed,
-                augment_fn=augment_fn,
-                label_smoothing=config.label_smoothing,
-            )
+            if self.lm_mode:
+                dev_tokens = device_put_replicated(
+                    train_split.images, self.mesh  # tokens ride .images
+                )
+                self.fast_runner = make_lm_epoch_runner(
+                    self.seq_spec, self.optimizer, self.mesh,
+                    dev_tokens, self.global_batch_size,
+                    compute_dtype=compute_dtype, seed=config.seed,
+                    grad_accum_steps=config.grad_accum_steps,
+                    label_smoothing=config.label_smoothing,
+                )
+            else:
+                # Full arrays on device: the runner permutes all n
+                # images per epoch and drops a DIFFERENT tail of the
+                # permutation each time (make_epoch_runner), matching
+                # the step path's coverage — a static [:usable]
+                # truncation would exclude the same images every epoch.
+                dev_images, dev_labels = device_put_dataset(
+                    train_split.images, train_split.labels, self.mesh
+                )
+                self.fast_runner = make_epoch_runner(
+                    self.model, self.optimizer, self.mesh,
+                    dev_images, dev_labels, self.global_batch_size,
+                    compute_dtype=compute_dtype, seed=config.seed,
+                    augment_fn=augment_fn,
+                    label_smoothing=config.label_smoothing,
+                )
         if config.keep_best and config.eval_every != 1:
             raise ValueError(
                 "--keep_best ranks checkpoints by eval accuracy, so "

@@ -182,7 +182,59 @@ class TestGQATraining:
             Trainer(
                 TrainConfig(**{**kw, "model": "simple_cnn", "mesh_seq": 1})
             )
-        with pytest.raises(ValueError, match="mesh_model|TP"):
-            Trainer(TrainConfig(**{**kw, "mesh_model": 2, "mesh_seq": 1}))
+        # GQA×TP (round-4): allowed when tp divides the kv heads —
+        # whole kv groups per TP member (group-major qkv layout) —
+        # rejected with the divisibility rule otherwise.
+        with pytest.raises(ValueError, match="not\\s+divisible"):
+            Trainer(
+                TrainConfig(
+                    **{
+                        **kw,
+                        "num_heads": 4,
+                        "num_kv_heads": 1,
+                        "mesh_model": 2,
+                        "mesh_seq": 1,
+                    }
+                )
+            )
         with pytest.raises(ValueError, match="moe"):
             Trainer(TrainConfig(**{**kw, "moe_experts": 4}))
+
+    def test_gqa_tp_trains_with_parity(self, tmp_path, devices):
+        """--num_kv_heads 2 --mesh_model 2 trains; loss parity vs the
+        same config without TP (round-3 verdict weak #4)."""
+        from ddp_tpu.train.config import TrainConfig
+        from ddp_tpu.train.trainer import Trainer
+
+        kw = dict(
+            epochs=1,
+            batch_size=4,
+            model="causal_lm",
+            seq_len=32,
+            vocab_size=64,
+            model_dim=32,
+            model_depth=2,
+            num_heads=4,
+            num_kv_heads=2,
+            synthetic_data=True,
+            synthetic_size=32,
+            eval_every=1,
+            optimizer="sgd",
+            lr=0.1,
+            shuffle=False,
+        )
+        losses = {}
+        for tp in (1, 2):
+            t = Trainer(
+                TrainConfig(
+                    **kw,
+                    mesh_model=tp,
+                    num_devices=2 * tp,
+                    checkpoint_dir=str(tmp_path / f"ck{tp}"),
+                    data_root=str(tmp_path / "data"),
+                )
+            )
+            summary = t.train()
+            t.close()
+            losses[tp] = summary["final_loss"]
+        assert losses[1] == pytest.approx(losses[2], abs=1e-4)
