@@ -57,7 +57,7 @@ def run_bench(
         # hour. The record's ``platform`` field marks it.
         global_batch_size = min(global_batch_size, 256)
         warmup_epochs = min(warmup_epochs, 1)
-        timed_epochs = min(timed_epochs, 1)
+        timed_epochs = min(timed_epochs, 2)
     mesh = make_mesh(MeshSpec(data=len(devices)), devices=devices)
 
     train = mnist.load("./data", "train", allow_synthetic=True)
@@ -76,16 +76,43 @@ def run_bench(
     state = replicate_state(
         create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0), mesh
     )
-    runner = make_epoch_runner(
-        model,
-        tx,
-        mesh,
-        images,
-        labels,
-        global_batch_size,
-        compute_dtype=compute_dtype,
-        seed=0,
-    )
+    if platform == "tpu":
+        runner = make_epoch_runner(
+            model,
+            tx,
+            mesh,
+            images,
+            labels,
+            global_batch_size,
+            compute_dtype=compute_dtype,
+            seed=0,
+        )
+    else:
+        # XLA:CPU compiles the conv step ~200× slower INSIDE lax.scan
+        # than the identical step standalone (measured round 4:
+        # 3.4 s/step scanned vs 15 ms/step at B=32 — the r03 fallback's
+        # absurd 8.7 img/s was this artifact, not the framework). The
+        # fallback record therefore measures the per-step path; the
+        # scanned fast path stays the TPU measurement.
+        from ddp_tpu.parallel.ddp import make_train_step
+
+        step_fn = make_train_step(
+            model, tx, mesh, donate=False, compute_dtype=compute_dtype,
+            seed=0,
+        )
+        n_imgs = images.shape[0]
+        steps = n_imgs // global_batch_size
+
+        def runner(state, e):
+            perm = jax.random.permutation(jax.random.key(e), n_imgs)
+            metrics = None
+            for b in range(steps):
+                sel = perm[b * global_batch_size:(b + 1) * global_batch_size]
+                state, metrics = step_fn(state, images[sel], labels[sel])
+            # Match the epoch runner's stacked-loss contract ([-1]).
+            return state, metrics._replace(loss=metrics.loss[None])
+
+        runner.steps_per_epoch = steps
     images_per_epoch = runner.steps_per_epoch * global_batch_size
 
     for e in range(warmup_epochs):  # compile + stabilize clocks
@@ -300,7 +327,7 @@ def run_vit_bench(
     # in line with the LM bench at MXU-friendly shapes (d=1024).
     split = _profile_op_split(run, (params, opt_state))
     note = (
-        "tiling-limited at T=65/d=192: see op_time_split — matmuls "
+        f"tiling-limited at T={T}/d=192: see op_time_split — matmuls "
         "('convolution fusion') vs layout copies ('data formatting', "
         "'copy-done'); est_mfu / matmul_share ≈ MXU-busy efficiency"
     ) if split is not None else None

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# The canonical 3-D large-model layout on the pipelined causal LM:
+# stages over `pipe` (PP), Megatron column/row inside each stage over
+# `model` (TP), batch over `data` (DP) — models/pipeline_lm.py.
+#
+# Runs offline on a CPU dev box via an 8-device emulated mesh; on real
+# chips drop --emulate_devices. Stage 0 embeds tokens; stage S-1 runs
+# final-LN + the TIED embedding-transpose head + the next-token loss
+# INSIDE the schedule, so logits never leave the last stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CK=$(mktemp -d)
+
+# PP x TP x DP under the hand-scheduled 1F1B schedule (O(S) stash).
+python train.py --model pipe_lm \
+    --mesh_pipe 2 --mesh_model 2 \
+    --pipe_schedule 1f1b --num_microbatches 4 \
+    --epochs 2 --batch_size 4 \
+    --seq_len 64 --vocab_size 128 --model_dim 64 --num_heads 4 \
+    --model_depth 2 \
+    --emulate_devices 8 \
+    --synthetic_data --synthetic_size 256 \
+    --checkpoint_dir "$CK/pp_tp" --data_root "$CK/data"
+
+# Interleaved-1F1B: 2 virtual chunks per device cut the bubble from
+# (S-1)/(M+S-1) to (S-1)/(vM+S-1); composes with fsdp (ZeRO-sharded
+# stage params) instead of tp here.
+python train.py --model pipe_lm \
+    --mesh_pipe 2 --mesh_fsdp 2 \
+    --pipe_schedule interleaved --virtual_stages 2 --num_microbatches 4 \
+    --epochs 1 --batch_size 4 \
+    --seq_len 64 --vocab_size 128 --model_dim 64 --num_heads 4 \
+    --emulate_devices 8 \
+    --synthetic_data --synthetic_size 256 \
+    --checkpoint_dir "$CK/pp_fsdp" --data_root "$CK/data"
+
+echo "pipeline-LM 3-D layouts trained; checkpoints under $CK"

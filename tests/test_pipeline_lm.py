@@ -149,6 +149,26 @@ def test_pp_tp_matches_pp_only(devices, toks, schedule):
     assert _max_diff(s_tp.params, s_1.params) < 1e-5
 
 
+def test_pp_tp_interleaved_matches_pp_only(devices, toks):
+    """PP×TP under the interleaved schedule (v chunks per device) —
+    the deepest composition: virtual stages × Megatron f/g exactness."""
+    tx = optax.sgd(0.1)
+    cfg_tp = CFG._replace(tp_size=2, virtual_stages=2)
+    cfg_1 = CFG._replace(virtual_stages=2)
+    mesh_tp = _mesh(devices, data=2, pipe=2, model=2)
+    mesh_1 = _mesh(devices[:4], data=2, pipe=2)
+    s_tp, m_tp = make_pipe_lm_interleaved_train_step(
+        cfg_tp, tx, mesh_tp, donate=False
+    )(create_pipe_lm_state(cfg_tp, tx, mesh_tp, seed=0, interleaved=True),
+      toks)
+    s_1, m_1 = make_pipe_lm_interleaved_train_step(
+        cfg_1, tx, mesh_1, donate=False
+    )(create_pipe_lm_state(cfg_1, tx, mesh_1, seed=0, interleaved=True),
+      toks)
+    assert abs(float(m_tp.loss) - float(m_1.loss)) < 1e-5
+    assert _max_diff(s_tp.params, s_1.params) < 1e-5
+
+
 def test_tied_embedding_gradient_sums_both_ends(devices, toks):
     """d loss/d embed = lookup(stage 0) + head(stage S−1) pieces —
     pinned against the sequential forward's AD, which ties naturally."""
