@@ -286,6 +286,17 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
     return apply_fn
 
 
+def _maybe_augment(augment_fn, seed, step_no, x):
+    """Train-time augmentation before the pipeline (data/augment.py):
+    per-step rng keyed on the step counter, applied to the GLOBAL
+    batch before microbatching — same placement contract as the DDP
+    step families (inside jit, after the uint8→float conversion)."""
+    if augment_fn is None:
+        return x
+    rng = jax.random.fold_in(jax.random.key(seed), step_no)
+    return augment_fn(rng, x).astype(x.dtype)
+
+
 def make_pipe_vit_train_step(
     cfg: PipeViTConfig,
     optimizer: optax.GradientTransformation,
@@ -294,6 +305,8 @@ def make_pipe_vit_train_step(
     compute_dtype=jnp.float32,
     label_smoothing: float = 0.0,
     donate: bool = True,
+    augment_fn=None,
+    seed: int = 0,
 ):
     """``step(state, images, labels) -> (state, metrics)`` over dp×pp.
 
@@ -321,7 +334,13 @@ def make_pipe_vit_train_step(
 
     def step(state: PipeViTState, images, labels):
         def loss_fn(params):
-            logits = apply_fn(params, _preprocess(images, compute_dtype))
+            logits = apply_fn(
+                params,
+                _maybe_augment(
+                    augment_fn, seed, state.step,
+                    _preprocess(images, compute_dtype),
+                ),
+            )
             loss = xent(
                 logits.astype(jnp.float32), labels, label_smoothing
             ).mean()
@@ -352,6 +371,8 @@ def make_pipe_vit_1f1b_train_step(
     compute_dtype=jnp.float32,
     label_smoothing: float = 0.0,
     donate: bool = True,
+    augment_fn=None,
+    seed: int = 0,
 ):
     """``step(state, images, labels)`` under the 1F1B schedule.
 
@@ -373,6 +394,7 @@ def make_pipe_vit_1f1b_train_step(
         cfg, optimizer, mesh, spmd_pipeline_1f1b, schedule_1f1b(S, M),
         lead=1, compute_dtype=compute_dtype,
         label_smoothing=label_smoothing, donate=donate,
+        augment_fn=augment_fn, seed=seed,
     )
 
 
@@ -387,6 +409,8 @@ def _make_handsched_step(
     compute_dtype,
     label_smoothing: float,
     donate: bool,
+    augment_fn=None,
+    seed: int = 0,
 ):
     """Shared machinery of the hand-scheduled (no-jax.grad) pipe steps.
 
@@ -470,7 +494,10 @@ def _make_handsched_step(
 
     def step(state: PipeViTState, images, labels):
         images = lax.with_sharding_constraint(
-            _preprocess(images, compute_dtype),
+            _maybe_augment(
+                augment_fn, seed, state.step,
+                _preprocess(images, compute_dtype),
+            ),
             NamedSharding(mesh, bspec),
         )
         B = images.shape[0]
@@ -510,6 +537,8 @@ def make_pipe_vit_interleaved_train_step(
     compute_dtype=jnp.float32,
     label_smoothing: float = 0.0,
     donate: bool = True,
+    augment_fn=None,
+    seed: int = 0,
 ):
     """``step(state, images, labels)`` under the interleaved-1F1B
     schedule (v = cfg.virtual_stages model chunks per device).
@@ -538,6 +567,7 @@ def make_pipe_vit_interleaved_train_step(
         cfg, optimizer, mesh, spmd_pipeline_interleaved, sched,
         lead=2, compute_dtype=compute_dtype,
         label_smoothing=label_smoothing, donate=donate,
+        augment_fn=augment_fn, seed=seed,
     )
 
 

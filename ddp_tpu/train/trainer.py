@@ -163,17 +163,30 @@ class Trainer:
             or config.zero1
             or config.grad_accum_steps > 1
             or config.fast_epoch
-            or config.augment not in (None, "none")
+            # augment is image-family: the pipelined ViT takes it
+            # (applied to the global batch before microbatching);
+            # token data has nothing to crop.
+            or (
+                self.pipe_lm_mode
+                and config.augment not in (None, "none")
+            )
         ):
             raise ValueError(
                 f"--model {config.model} composes with the data axis, "
                 "fsdp (ZeRO-sharded stage params)"
-                + (", tp (--mesh_model, PP×TP)" if self.pipe_lm_mode else "")
+                + (
+                    ", tp (--mesh_model, PP×TP)"
+                    if self.pipe_lm_mode
+                    else ", augment"
+                )
                 + ", bf16, remat, label smoothing, EMA and LR schedules "
                 "— not "
                 + ("" if self.pipe_lm_mode else "tp/")
                 + "expert/seq/zero1, accumulation (use "
-                "--num_microbatches), augment, or --fast_epoch"
+                "--num_microbatches), "
+                + ("--fast_epoch, or augment"
+                   if self.pipe_lm_mode
+                   else "or --fast_epoch")
             )
         if self.pipe_lm_mode and config.mesh_model > 1:
             _check_tp_dims(config)
@@ -750,6 +763,7 @@ class Trainer:
                 self.pipe_cfg, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype,
                 label_smoothing=config.label_smoothing,
+                augment_fn=augment_fn, seed=config.seed,
             )
 
             def step(ts, images, labels):

@@ -86,3 +86,34 @@ def test_pipe_rejects_bad_combos(tmp_path, devices):
         )
     with pytest.raises(ValueError, match="pipeline family"):
         Trainer(make_config(tmp_path, model="simple_cnn"))
+
+
+def test_pipe_trainer_augment_trains(tmp_path, devices):
+    """Round-4 wall lift: --augment runs through the pipe family
+    (applied to the global batch before microbatching, per-step rng
+    keyed on the step counter)."""
+    t = Trainer(
+        make_config(
+            tmp_path, pipe_schedule="1f1b", augment="crop_flip"
+        )
+    )
+    summary = t.train()
+    t.close()
+    assert np.isfinite(summary["history"][0]["mean_loss"])
+
+
+def test_pipe_lm_still_rejects_augment(tmp_path, devices):
+    """Token data has nothing to crop — the LM pipe keeps the wall."""
+    with pytest.raises(ValueError, match="augment"):
+        Trainer(
+            make_config(
+                tmp_path,
+                model="pipe_lm",
+                mesh_pipe=2,
+                seq_len=16,
+                vocab_size=64,
+                model_dim=32,
+                num_heads=2,
+                augment="crop_flip",
+            )
+        )
