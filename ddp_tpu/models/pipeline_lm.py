@@ -74,6 +74,10 @@ class PipeLMConfig(NamedTuple):
     # blocks (PP×TP): attention heads + MLP hidden shard, everything
     # else replicates across ``model``.
     tp_size: int = 1
+    # Grouped-query attention: 0 → num_heads (MHA). Same group-major
+    # fused-qkv layout as the seq family (models/vit.py), so GQA
+    # composes with the stage TP when tp_size divides num_kv_heads.
+    num_kv_heads: int = 0
 
 
 class PipeLMParams(NamedTuple):
@@ -114,6 +118,7 @@ def _stage_module(
         tp_axis="model" if tp else None,
         tp_size=cfg.tp_size if tp else 1,
         tp_inner_vjp=inner_vjp,
+        num_kv_heads=cfg.num_kv_heads,
     )
 
 

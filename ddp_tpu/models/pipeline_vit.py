@@ -95,6 +95,7 @@ class StageBlocks(nn.Module):
     tp_axis: Optional[str] = None
     tp_size: int = 1
     tp_inner_vjp: bool = False  # Megatron f/g — see models/vit.py
+    num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
 
     @nn.compact
     def __call__(self, x):
@@ -107,6 +108,7 @@ class StageBlocks(nn.Module):
                 tp_axis=self.tp_axis,
                 tp_size=self.tp_size,
                 tp_inner_vjp=self.tp_inner_vjp,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block{i + 1}",
             )(x)
         return x

@@ -201,11 +201,14 @@ class Trainer:
                 f"divide --model_dim {config.model_dim or 64}"
             )
         if config.num_kv_heads:
-            if not (self.seq_mode and config.model == "causal_lm"):
+            if not (
+                (self.seq_mode and config.model == "causal_lm")
+                or self.pipe_lm_mode
+            ):
                 raise ValueError(
                     "--num_kv_heads (grouped-query attention) shrinks "
                     "the causal LM's generation KV cache: use --model "
-                    "causal_lm (or drop the flag)"
+                    "causal_lm or pipe_lm (or drop the flag)"
                 )
             if (
                 config.num_kv_heads < 1
@@ -634,6 +637,7 @@ class Trainer:
                 virtual_stages=config.virtual_stages,
                 label_smoothing=config.label_smoothing,
                 tp_size=config.mesh_model,
+                num_kv_heads=config.num_kv_heads,
             )
             logger.info(
                 "Pipeline LM: %d stages × %d virtual × %d blocks, %d "

@@ -169,6 +169,30 @@ def test_pp_tp_interleaved_matches_pp_only(devices, toks):
     assert _max_diff(s_tp.params, s_1.params) < 1e-5
 
 
+def test_gqa_pipe_matches_sequential_and_tp_invisible(devices, toks):
+    """GQA through the pipeline (round-4): loss parity vs the
+    sequential reference, and GQA×PP×TP numerically invisible
+    (group-major qkv shards whole kv groups per TP member)."""
+    tx = optax.sgd(0.1)
+    cfg = CFG._replace(num_heads=4, num_kv_heads=2)
+    mesh = _mesh(devices[:4], data=2, pipe=2)
+    s, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
+        create_pipe_lm_state(cfg, tx, mesh, seed=0), toks
+    )
+    ref = next_token_loss(
+        sequential_apply(cfg, init_pipe_lm(cfg, seed=0), toks), toks
+    )
+    assert abs(float(m.loss) - float(ref)) < 1e-5
+
+    cfg_tp = cfg._replace(tp_size=2)
+    mesh_tp = _mesh(devices, data=2, pipe=2, model=2)
+    s_tp, m_tp = make_pipe_lm_1f1b_train_step(
+        cfg_tp, tx, mesh_tp, donate=False
+    )(create_pipe_lm_state(cfg_tp, tx, mesh_tp, seed=0), toks)
+    assert abs(float(m_tp.loss) - float(m.loss)) < 1e-5
+    assert _max_diff(s.params, s_tp.params) < 1e-5
+
+
 def test_tied_embedding_gradient_sums_both_ends(devices, toks):
     """d loss/d embed = lookup(stage 0) + head(stage S−1) pieces —
     pinned against the sequential forward's AD, which ties naturally."""

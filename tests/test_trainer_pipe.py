@@ -88,15 +88,16 @@ def test_pipe_rejects_bad_combos(tmp_path, devices):
         Trainer(make_config(tmp_path, model="simple_cnn"))
 
 
-def test_pipe_trainer_augment_trains(tmp_path, devices):
-    """Round-4 wall lift: --augment runs through the pipe family
-    (applied to the global batch before microbatching, per-step rng
-    keyed on the step counter)."""
-    t = Trainer(
-        make_config(
-            tmp_path, pipe_schedule="1f1b", augment="crop_flip"
-        )
-    )
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved"])
+def test_pipe_trainer_augment_trains(tmp_path, devices, schedule):
+    """Round-4 wall lift: --augment runs through ALL three pipe
+    schedules (the GPipe path inserts it inside the differentiated
+    loss_fn; the hand-scheduled paths before microbatching — both on
+    the global batch with per-step rng keyed on the step counter)."""
+    kw = dict(pipe_schedule=schedule, augment="crop_flip")
+    if schedule == "interleaved":
+        kw.update(virtual_stages=2, mesh_pipe=2)
+    t = Trainer(make_config(tmp_path, **kw))
     summary = t.train()
     t.close()
     assert np.isfinite(summary["history"][0]["mean_loss"])
