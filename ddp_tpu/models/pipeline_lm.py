@@ -50,7 +50,7 @@ from ddp_tpu.parallel.pipe_common import (
     gather_stages,
     pipe_batch_axes,
     scatter_stage_grads,
-    stage_specs,
+    stage_specs_megatron,
 )
 from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 
@@ -247,19 +247,6 @@ def _specs(mesh: Mesh):
     return baxes, bspec, mbspec, lblspec
 
 
-def _constrain(params: PipeLMParams, mesh: Mesh, lead: int) -> PipeLMParams:
-    sspecs = stage_specs(params.stages, mesh, lead=lead)
-    return params._replace(
-        stages=jax.tree.map(
-            lambda x, s: lax.with_sharding_constraint(
-                x, NamedSharding(mesh, s)
-            ),
-            params.stages,
-            sspecs,
-        )
-    )
-
-
 def _tp_stage_fn(cfg: PipeLMConfig, mesh: Mesh, *, inner_vjp: bool = False):
     """stage_fn for the pipeline kernels, TP-aware.
 
@@ -320,49 +307,12 @@ def make_pipe_lm_apply(cfg: PipeLMConfig, mesh: Mesh):
 
 
 def _param_specs(cfg: PipeLMConfig, stages, mesh: Mesh, *, lead: int):
-    """Stage-tree specs; TP leaves take their Megatron dim on ``model``.
-
-    Without TP this is exactly ``pipe_common.stage_specs``. With TP the
-    block kernels/biases follow parallel/tp.py's suffix rules shifted
-    by the ``lead`` stacked dims — column kernels shard their output
-    dim, row kernels their input dim, column biases their only dim —
-    and ``fsdp``, when present, rides the kernels' *other* dim where
-    it divides (same composition seq_param_specs builds). Leaves the
-    rules don't name (LNs) keep the base pipe/fsdp spec.
-    """
-    base = stage_specs(stages, mesh, lead=lead)
-    if cfg.tp_size <= 1:
-        return base
-
-    from ddp_tpu.parallel.seq_fsdp import fsdp_size
-    from ddp_tpu.parallel.tp import (
-        _COLUMN_BIASES,
-        _COLUMN_KERNELS,
-        _ROW_KERNELS,
-        _check_divides,
-        _path_str,
+    """Stage-tree specs; TP leaves take their Megatron dim on ``model``
+    (parallel/pipe_common.py ``stage_specs_megatron`` — shared with
+    the pipelined ViT)."""
+    return stage_specs_megatron(
+        stages, mesh, lead=lead, tp_size=cfg.tp_size
     )
-
-    n = fsdp_size(mesh)
-    lead_axes = ("pipe",) if lead == 1 else (None, "pipe")
-
-    def with_model(path, p, s):
-        suffix = _path_str(path)
-        shape = p.shape[lead:]  # per-stage (global, pre-TP) shape
-        if suffix.endswith(_COLUMN_KERNELS):
-            _check_divides(suffix, shape[1], cfg.tp_size)
-            d0 = "fsdp" if n > 1 and shape[0] % n == 0 else None
-            return P(*lead_axes, d0, "model")
-        if suffix.endswith(_COLUMN_BIASES):
-            _check_divides(suffix, shape[0], cfg.tp_size)
-            return P(*lead_axes, "model")
-        if suffix.endswith(_ROW_KERNELS):
-            _check_divides(suffix, shape[0], cfg.tp_size)
-            d1 = "fsdp" if n > 1 and shape[1] % n == 0 else None
-            return P(*lead_axes, "model", d1)
-        return s
-
-    return jax.tree_util.tree_map_with_path(with_model, stages, base)
 
 
 def make_pipe_lm_train_step(

@@ -36,7 +36,7 @@ from ddp_tpu.parallel.pipe_common import (
     gather_stages as _gather_stages,
     pipe_batch_axes as _pipe_batch_axes,
     scatter_stage_grads as _scatter_stage_grads,
-    stage_specs as _stage_specs,
+    stage_specs_megatron as _stage_specs_megatron,
 )
 
 
@@ -55,6 +55,9 @@ class PipeViTConfig(NamedTuple):
     # depth num_stages × virtual_stages × depth_per_stage blocks),
     # placed round-robin — parallel/interleaved.py.
     virtual_stages: int = 1
+    # Megatron TP over the ``model`` mesh axis inside each stage's
+    # blocks (PP×TP) — same machinery as the pipelined LM.
+    tp_size: int = 1
 
 
 class PatchEmbed(nn.Module):
@@ -137,7 +140,12 @@ class PipeViTState(NamedTuple):
     opt_state: Any
 
 
-def _modules(cfg: PipeViTConfig):
+def _modules(cfg: PipeViTConfig, *, tp: bool = False, inner_vjp: bool = False):
+    """``tp=False`` builds the GLOBAL-shape stage (init, sequential
+    forward); ``tp=True`` the Megatron variant whose local param
+    shapes match each ``model`` member's shard; ``inner_vjp=True``
+    adds the f/g plumbing the hand-scheduled kernels' in-body vjp
+    needs (models/pipeline_lm.py has the full story)."""
     embed = PatchEmbed(embed_dim=cfg.embed_dim, patch_size=cfg.patch_size)
     stage = StageBlocks(
         depth=cfg.depth_per_stage,
@@ -145,9 +153,18 @@ def _modules(cfg: PipeViTConfig):
         mlp_dim=cfg.embed_dim * cfg.mlp_ratio,
         attention_fn=cfg.attention_fn,
         remat=cfg.remat,
+        tp_axis="model" if tp else None,
+        tp_size=cfg.tp_size if tp else 1,
+        tp_inner_vjp=inner_vjp,
     )
     head = PipeHead(num_classes=cfg.num_classes)
     return embed, stage, head
+
+
+def _vit_stage_specs(cfg: PipeViTConfig, stages, mesh, *, lead: int):
+    return _stage_specs_megatron(
+        stages, mesh, lead=lead, tp_size=cfg.tp_size
+    )
 
 
 def init_pipe_vit(
@@ -239,7 +256,9 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
     Batch additionally shards over the mesh's ``data`` axis.
     Differentiable end to end. GPipe bubble: ``bubble_fraction(S, M)``.
     """
-    embed, stage, head = _modules(cfg)
+    # AD path: TP blocks WITHOUT the f/g ops (the shard_map transpose
+    # owns the cross-member sums here — see models/pipeline_lm.py).
+    embed, stage, head = _modules(cfg, tp=cfg.tp_size > 1)
     baxes = _pipe_batch_axes(mesh)
     bspec = P(baxes) if baxes else P()
     mbspec = P(None, "pipe", baxes) if baxes else P(None, "pipe")
@@ -269,7 +288,7 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
                 "(the sharded stream rests microbatch m on device m mod S)"
             )
         mb = images.reshape(M // S, S, B // M, *images.shape[1:])
-        sspecs = _stage_specs(params.stages, mesh, lead=1)
+        sspecs = _vit_stage_specs(cfg, params.stages, mesh, lead=1)
 
         pipelined = jax.shard_map(
             lambda sp, ep, hp, m: spmd_pipeline(
@@ -323,7 +342,7 @@ def make_pipe_vit_train_step(
     apply_fn = make_pipe_vit_apply(cfg, mesh)
 
     def constrain(params: PipeViTParams) -> PipeViTParams:
-        sspecs = _stage_specs(params.stages, mesh, lead=1)
+        sspecs = _vit_stage_specs(cfg, params.stages, mesh, lead=1)
         return params._replace(
             stages=jax.tree.map(
                 lambda x, s: lax.with_sharding_constraint(
@@ -428,7 +447,11 @@ def _make_handsched_step(
         raise ValueError(
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
         )
-    embed, stage, head = _modules(cfg)
+    # Hand-scheduled paths vjp INSIDE the island: the TP blocks need
+    # Megatron's f/g custom-VJP pair (models/pipeline_lm.py rationale).
+    embed, stage, head = _modules(
+        cfg, tp=cfg.tp_size > 1, inner_vjp=cfg.tp_size > 1
+    )
     S = mesh.shape["pipe"]
     M = cfg.num_microbatches
     baxes = _pipe_batch_axes(mesh)
@@ -483,7 +506,7 @@ def _make_handsched_step(
         )
 
     def constrain(params: PipeViTParams) -> PipeViTParams:
-        sspecs = _stage_specs(params.stages, mesh, lead=lead)
+        sspecs = _vit_stage_specs(cfg, params.stages, mesh, lead=lead)
         return params._replace(
             stages=jax.tree.map(
                 lambda x, s: lax.with_sharding_constraint(
@@ -507,7 +530,7 @@ def _make_handsched_step(
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
         mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
         lbl_mb = labels.reshape(M, B // M)
-        run = make_run(_stage_specs(state.params.stages, mesh, lead=lead))
+        run = make_run(_vit_stage_specs(cfg, state.params.stages, mesh, lead=lead))
         loss_sum, correct, gs, gf, gl = run(
             state.params.stages, state.params.embed, state.params.head,
             mbs, lbl_mb,
@@ -583,7 +606,9 @@ def _create_state(
     lead: int,
 ) -> PipeViTState:
     params = init_fn(cfg, sample_input, seed=seed)
-    sspecs = _stage_specs(params.stages, mesh, lead=lead)
+    # TP-aware: Megatron kernels REST sharded over ``model`` (the
+    # placements are the checkpoint contract, like pipe/fsdp).
+    sspecs = _vit_stage_specs(cfg, params.stages, mesh, lead=lead)
     rep = NamedSharding(mesh, P())
     params = PipeViTParams(
         embed=jax.tree.map(lambda x: jax.device_put(x, rep), params.embed),

@@ -50,6 +50,52 @@ def stage_specs(stages, mesh, *, lead: int):
     return jax.tree.map(spec_for, stages)
 
 
+def stage_specs_megatron(stages, mesh, *, lead: int, tp_size: int):
+    """``stage_specs`` plus Megatron TP dims over ``model``.
+
+    With ``tp_size <= 1`` this IS ``stage_specs``. Otherwise the block
+    kernels/biases follow parallel/tp.py's suffix rules shifted by the
+    ``lead`` stacked dims — column kernels shard their output dim, row
+    kernels their input dim, column biases their only dim — and
+    ``fsdp``, when present, rides the kernels' *other* dim where it
+    divides (the composition seq_param_specs builds). Leaves the rules
+    don't name (LayerNorms) keep the base pipe/fsdp spec.
+    """
+    base = stage_specs(stages, mesh, lead=lead)
+    if tp_size <= 1:
+        return base
+
+    from ddp_tpu.parallel.seq_fsdp import fsdp_size
+    from ddp_tpu.parallel.tp import (
+        _COLUMN_BIASES,
+        _COLUMN_KERNELS,
+        _ROW_KERNELS,
+        _check_divides,
+        _path_str,
+    )
+
+    n = fsdp_size(mesh)
+    lead_axes = ("pipe",) if lead == 1 else (None, "pipe")
+
+    def with_model(path, p, s):
+        suffix = _path_str(path)
+        shape = p.shape[lead:]  # per-stage (global, pre-TP) shape
+        if suffix.endswith(_COLUMN_KERNELS):
+            _check_divides(suffix, shape[1], tp_size)
+            d0 = "fsdp" if n > 1 and shape[0] % n == 0 else None
+            return P(*lead_axes, d0, "model")
+        if suffix.endswith(_COLUMN_BIASES):
+            _check_divides(suffix, shape[0], tp_size)
+            return P(*lead_axes, "model")
+        if suffix.endswith(_ROW_KERNELS):
+            _check_divides(suffix, shape[0], tp_size)
+            d1 = "fsdp" if n > 1 and shape[1] % n == 0 else None
+            return P(*lead_axes, "model", d1)
+        return s
+
+    return jax.tree_util.tree_map_with_path(with_model, stages, base)
+
+
 def gather_stages(sp, specs):
     """all_gather the fsdp-sharded stage leaves INSIDE the island.
 

@@ -69,9 +69,12 @@ def _ctor_accepts(model_name: str, kwarg: str) -> bool:
 
 
 def _check_tp_dims(config: TrainConfig) -> None:
-    """Megatron TP divisibility rules, shared by the seq and pipe-LM
-    families (one definition — the two must not drift): attention
-    heads and the 4×d_model MLP hidden dim split over ``model``."""
+    """Megatron TP divisibility rules, shared by the seq family and
+    the whole pipe family (LM and ViT — one definition, none may
+    drift): attention heads and the 4×d_model MLP hidden dim split
+    over ``model``. (The ViT's mlp_dim is embed_dim × mlp_ratio,
+    which coincides with 4×d_model because the trainer pins
+    mlp_ratio=4; a configurable ratio must update this rule.)"""
     d_model = config.model_dim or 64
     if config.num_heads % config.mesh_model:
         raise ValueError(
@@ -157,8 +160,7 @@ class Trainer:
                 "1-stage pipeline is the plain step — drop the flag)"
             )
         if self.pipe_mode and (
-            (config.mesh_model > 1 and not self.pipe_lm_mode)
-            or config.mesh_expert > 1
+            config.mesh_expert > 1
             or config.mesh_seq > 1
             or config.zero1
             or config.grad_accum_steps > 1
@@ -173,22 +175,17 @@ class Trainer:
         ):
             raise ValueError(
                 f"--model {config.model} composes with the data axis, "
-                "fsdp (ZeRO-sharded stage params)"
-                + (
-                    ", tp (--mesh_model, PP×TP)"
-                    if self.pipe_lm_mode
-                    else ", augment"
-                )
+                "fsdp (ZeRO-sharded stage params), tp (--mesh_model, "
+                "PP×TP)"
+                + ("" if self.pipe_lm_mode else ", augment")
                 + ", bf16, remat, label smoothing, EMA and LR schedules "
-                "— not "
-                + ("" if self.pipe_lm_mode else "tp/")
-                + "expert/seq/zero1, accumulation (use "
+                "— not expert/seq/zero1, accumulation (use "
                 "--num_microbatches), "
                 + ("--fast_epoch, or augment"
                    if self.pipe_lm_mode
                    else "or --fast_epoch")
             )
-        if self.pipe_lm_mode and config.mesh_model > 1:
+        if self.pipe_mode and config.mesh_model > 1:
             _check_tp_dims(config)
         if (self.seq_mode or self.pipe_mode) and (
             config.num_heads < 1
@@ -725,6 +722,7 @@ class Trainer:
                 num_microbatches=config.num_microbatches,
                 remat=config.remat,
                 virtual_stages=config.virtual_stages,
+                tp_size=config.mesh_model,
             )
             if interleaved:
                 from ddp_tpu.parallel.interleaved import schedule_interleaved
