@@ -558,6 +558,45 @@ def create_pipe_lm_state(
     )
 
 
+def to_dense_lm(cfg: PipeLMConfig, params: PipeLMParams):
+    """Pipe-layout params → the dense CausalLM tree + its LMSpec.
+
+    Train pipelined, serve dense: the returned tree is exactly what
+    ``models/lm.py`` CausalLM builds (embed / pos_embed / blockN /
+    ln_final), so the whole serving stack — ``dense_lm_apply``,
+    models/generate.py prefill + KV-cache decode, scripts/predict.py —
+    consumes a pipelined run's weights unchanged. Chunk c's block j
+    becomes dense block c·depth_per_stage + j + 1 ([v, S] layouts
+    flatten chunk-major, matching ``sequential_apply``).
+    """
+    from ddp_tpu.models.lm import LMSpec
+
+    stages = params.stages
+    if min(p.ndim for p in jax.tree.leaves(stages)) == 3:
+        stages = jax.tree.map(lambda p: p.reshape(-1, *p.shape[2:]), stages)
+    C = jax.tree.leaves(stages)[0].shape[0]
+    dense = {
+        "embed": params.front["embed"],
+        "pos_embed": params.front["pos_embed"],
+        "ln_final": params.back["ln"],
+    }
+    for c in range(C):
+        chunk = jax.tree.map(lambda p: p[c], stages)
+        for j in range(cfg.depth_per_stage):
+            dense[f"block{c * cfg.depth_per_stage + j + 1}"] = chunk[
+                f"block{j + 1}"
+            ]
+    spec = LMSpec(
+        vocab_size=cfg.vocab_size,
+        total_len=cfg.seq_len,
+        d_model=cfg.d_model,
+        depth=C * cfg.depth_per_stage,
+        num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads,
+    )
+    return spec, dense
+
+
 def make_pipe_lm_eval_step(
     cfg: PipeLMConfig, mesh: Mesh, *, compute_dtype=jnp.float32
 ):

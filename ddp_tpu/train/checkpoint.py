@@ -43,13 +43,18 @@ from ddp_tpu.parallel.ddp import TrainState
 logger = logging.getLogger("ddp_tpu")
 
 # Checkpoint format version, saved as a ``fmt`` scalar alongside the
-# state. 2 = HEAD-MAJOR fused qkv layout (models/vit.py
-# MultiHeadAttention: kernel columns ordered [head, q|k|v, head_dim] so
-# contiguous TP shards are whole heads). Format-1 checkpoints (no
-# ``fmt`` key; q/k/v-major columns) have IDENTICAL shapes, so a silent
-# restore would scramble attention — restore refuses attention-bearing
-# format-1 trees and points at scripts/convert_qkv_layout.py instead.
-CHECKPOINT_FORMAT = 2
+# state. The qkv-layout ladder (models/vit.py MultiHeadAttention):
+#   1 — (no ``fmt`` key) q/k/v-major fused columns;
+#   2 — HEAD-MAJOR MHA columns ([head, q|k|v, head_dim], round 3: TP
+#       shards are whole heads) and BLOCK-layout GQA columns
+#       ([q·H | k·H_kv | v·H_kv]);
+#   3 — GROUP-MAJOR GQA columns ([kv-group: q·G | k | v] × H_kv,
+#       round 4: GQA×TP shards are whole kv groups). MHA trees are
+#       bit-identical between 2 and 3 and restore freely.
+# Each step has IDENTICAL shapes to the last, so a silent restore
+# would scramble attention — restore refuses stale attention-bearing
+# trees and points at scripts/convert_qkv_layout.py instead.
+CHECKPOINT_FORMAT = 3
 
 
 def _has_fused_qkv(tree: Any) -> bool:
@@ -66,15 +71,49 @@ def _has_fused_qkv(tree: Any) -> bool:
     return found
 
 
+def _has_gqa_qkv(tree: Any) -> bool:
+    """Any ``attn/qkv`` KERNEL with out-dim ≠ 3×in-dim (the GQA
+    signature: (H + 2·H_kv)·Dh < 3·d_model when H_kv < H). Rank-
+    agnostic on the LEADING dims: pipelined-LM checkpoints stack
+    stage params ([S, …] / [v, S, …]), so kernels are 3-D/4-D there —
+    only the trailing (in, out) pair is the layout signature."""
+    found = False
+
+    def visit(path, leaf):
+        nonlocal found
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if (
+            "qkv" in keys
+            and keys[-1] == "kernel"
+            and getattr(leaf, "ndim", 0) >= 2
+            and leaf.shape[-1] != 3 * leaf.shape[-2]
+        ):
+            found = True
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return found
+
+
 def _check_qkv_format(fmt: int | None, tree: Any, source: str) -> None:
-    if (fmt or 1) < 2 and _has_fused_qkv(tree):
+    f = fmt or 1
+    if f < 2 and _has_fused_qkv(tree):
         raise RuntimeError(
             f"{source} predates the head-major fused-qkv layout "
-            f"(format {fmt or 1} < {CHECKPOINT_FORMAT}) and contains "
+            f"(format {f} < {CHECKPOINT_FORMAT}) and contains "
             "attention weights — restoring it here would silently "
             "scramble q/k/v across heads (same shapes, different "
             "column order). Convert it once with "
             "scripts/convert_qkv_layout.py --num_heads <H>."
+        )
+    if f == 2 and _has_gqa_qkv(tree):
+        raise RuntimeError(
+            f"{source} holds grouped-query attention weights in the "
+            "format-2 BLOCK layout ([q·H | k·H_kv | v·H_kv]); round 4 "
+            "moved GQA to group-major columns so TP shards are whole "
+            "kv groups — same shapes, different order, a silent "
+            "restore would scramble attention. Convert it once with "
+            "scripts/convert_qkv_layout.py --num_heads <H> "
+            "--num_kv_heads <K>."
         )
 
 

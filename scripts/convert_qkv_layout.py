@@ -1,19 +1,21 @@
 #!/usr/bin/env python
-"""Convert format-1 checkpoints to the head-major fused-qkv layout.
+"""Convert stale-qkv-layout checkpoints to the current format.
 
-Round 3 reordered the fused qkv projection's output columns from
-[q|k|v, head, head_dim] to [head, q|k|v, head_dim] (models/vit.py
-MultiHeadAttention) so contiguous tensor-parallel shards of the kernel
-are whole heads. Shapes are identical, so old checkpoints would restore
-without error and silently scramble attention — the restore path
-refuses them (train/checkpoint.py ``_check_qkv_format``) and points
-here.
+The layout ladder (train/checkpoint.py CHECKPOINT_FORMAT): round 3
+reordered the fused qkv projection's output columns from [q|k|v, head,
+head_dim] to [head, q|k|v, head_dim] (format 2 — TP shards are whole
+heads); round 4 moved GROUPED-QUERY checkpoints to group-major columns
+([kv-group: q·G | k | v] × H_kv, format 3 — GQA×TP shards are whole kv
+groups; MHA trees are identical in 2 and 3). Shapes never change, so
+stale checkpoints would restore without error and silently scramble
+attention — the restore path refuses them (train/checkpoint.py
+``_check_qkv_format``) and points here.
 
     python scripts/convert_qkv_layout.py --checkpoint_dir ./checkpoints \
-        --num_heads 4 [--epoch N] [--out_dir ./checkpoints_fmt2]
+        --num_heads 4 [--num_kv_heads 2] [--epoch N] [--out_dir DIR]
 
 NON-DESTRUCTIVE: converted epochs are written to ``--out_dir``
-(default ``<checkpoint_dir>_fmt2``); the source directory is never
+(default ``<checkpoint_dir>_converted``); the source directory is never
 touched, so a crash mid-conversion cannot destroy the only copy of an
 irreplaceable checkpoint. Point the trainer at the new directory when
 done. Every ``attn/qkv`` kernel (last dim) and bias is permuted in
@@ -56,21 +58,67 @@ def permute_qkv_columns(tree, num_heads: int):
     return jax.tree_util.tree_map_with_path(fix, tree)
 
 
+def permute_gqa_columns(tree, num_heads: int, num_kv_heads: int):
+    """Format-2 GQA block layout → format-3 group-major (round 4).
+
+    Old trailing-axis order: [q_0..q_{H−1} | k_0..k_{K−1} |
+    v_0..v_{K−1}], each head_dim wide. New: for each kv group g,
+    [q_{gG}..q_{gG+G−1}, k_g, v_g]. A GQA checkpoint's qkv leaves are
+    uniformly (H + 2K)·Dh wide in this framework (every dense block
+    shares ``num_kv_heads``; MoE+GQA is rejected at construction), so
+    every divisible qkv leaf is permuted; pass the H and K the
+    checkpoint was trained with.
+    """
+    import jax
+
+    H, K = num_heads, num_kv_heads
+    G = H // K
+    n_cols = H + 2 * K  # head-sized column blocks
+
+    def fix(path, leaf):
+        keys = [str(getattr(k, "key", k)) for k in path]
+        if "qkv" not in keys:
+            return leaf
+        arr = np.asarray(leaf)
+        if arr.ndim == 0 or arr.shape[-1] % n_cols:
+            return leaf
+        dh = arr.shape[-1] // n_cols
+        head_order = []
+        for g in range(K):
+            head_order.extend(range(g * G, (g + 1) * G))  # q heads
+            head_order.append(H + g)  # k_g
+            head_order.append(H + K + g)  # v_g
+        perm = np.concatenate(
+            [np.arange(h * dh, (h + 1) * dh) for h in head_order]
+        )
+        return arr[..., perm]
+
+    return jax.tree_util.tree_map_with_path(fix, tree)
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--checkpoint_dir", default="./checkpoints")
     p.add_argument(
         "--out_dir", default=None,
-        help="destination (default: <checkpoint_dir>_fmt2); the source "
-        "is left untouched",
+        help="destination (default: <checkpoint_dir>_converted); the "
+        "source is left untouched",
     )
     p.add_argument("--num_heads", type=int, required=True)
+    p.add_argument(
+        "--num_kv_heads", type=int, default=0,
+        help="grouped-query checkpoints only: the K the run used — "
+        "converts the format-2 GQA block layout to the format-3 "
+        "group-major layout (round 4)",
+    )
     p.add_argument(
         "--epoch", type=int, default=None,
         help="convert one epoch (default: every epoch in the dir)",
     )
     args = p.parse_args()
-    out_dir = args.out_dir or args.checkpoint_dir.rstrip("/\\") + "_fmt2"
+    out_dir = args.out_dir or (
+        args.checkpoint_dir.rstrip("/\\") + "_converted"
+    )
     if os.path.abspath(out_dir) == os.path.abspath(args.checkpoint_dir):
         print("--out_dir must differ from --checkpoint_dir", file=sys.stderr)
         return 2
@@ -79,6 +127,7 @@ def main() -> int:
     from ddp_tpu.train.checkpoint import (
         CHECKPOINT_FORMAT,
         CheckpointManager,
+        _has_gqa_qkv,
     )
 
     src = CheckpointManager(args.checkpoint_dir, async_save=False)
@@ -98,9 +147,42 @@ def main() -> int:
         if fmt >= CHECKPOINT_FORMAT:
             print(f"epoch {epoch}: already format {fmt}, skipping")
             continue
+        gqa = bool(
+            args.num_kv_heads
+            and args.num_kv_heads != args.num_heads
+        )
+        if fmt == 2 and not gqa and _has_gqa_qkv(tree.get("params", {})):
+            # Without the permutation the output would be stamped
+            # format 3 with the OLD column order inside — laundering a
+            # scrambled checkpoint past the restore guard forever.
+            print(
+                f"epoch {epoch}: holds GQA attention weights (qkv "
+                "out-dim ≠ 3×in-dim) — pass --num_kv_heads <K> so the "
+                "2→3 group-major permutation actually runs",
+                file=sys.stderr,
+            )
+            return 2
+        if fmt < 2 and gqa:
+            # Format 1 predates GQA: every qkv leaf is MHA-shaped and
+            # the GQA permutation would corrupt it.
+            print(
+                f"epoch {epoch}: format-1 checkpoints predate GQA — "
+                "drop --num_kv_heads",
+                file=sys.stderr,
+            )
+            return 2
         for key in ("params", "opt_state"):
-            if key in tree:
+            if key not in tree:
+                continue
+            if fmt < 2:
+                # 1 → 2: q/k/v-major → head-major (predates GQA, so
+                # every format-1 qkv leaf is MHA-shaped).
                 tree[key] = permute_qkv_columns(tree[key], args.num_heads)
+            if gqa and fmt >= 2:
+                # 2 → 3: GQA block layout → group-major.
+                tree[key] = permute_gqa_columns(
+                    tree[key], args.num_heads, args.num_kv_heads
+                )
         state = TrainState(
             step=tree["step"],
             params=tree["params"],

@@ -286,7 +286,7 @@ class TestQkvFormat:
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
 
-        dst = CheckpointManager(src_dir + "_fmt2", async_save=False)
+        dst = CheckpointManager(src_dir + "_converted", async_save=False)
         restored, epoch = dst.restore(state)  # format gate passes
         assert epoch == 0
         assert dst.last_restored_spe == 7
@@ -345,3 +345,157 @@ class TestQkvFormat:
             mod.permute_qkv_columns(tree2, num_heads=H)["mlp1"]["kernel"],
             old,
         )
+
+
+class TestGqaQkvFormat:
+    """Round-4 group-major GQA layout: format-2 GQA checkpoints are
+    refused (same shapes, block column order) and the converter's 2→3
+    permutation recovers the exact current layout."""
+
+    def _gqa_state(self, mesh8):
+        from ddp_tpu.models.lm import LMSpec, create_lm_train_state
+
+        spec = LMSpec(vocab_size=32, total_len=16, d_model=16, depth=1,
+                      num_heads=4, num_kv_heads=2)
+        return spec, create_lm_train_state(
+            spec, optax.adam(1e-3), mesh8, seed=0
+        )
+
+    @staticmethod
+    def _to_block_layout(tree, H, K):
+        """Inverse of the round-4 permutation: group-major → the old
+        [q·H | k·K | v·K] block order (builds a format-2 fixture)."""
+        G = H // K
+
+        def fix(path, leaf):
+            keys = [str(getattr(k, "key", k)) for k in path]
+            arr = np.asarray(leaf)
+            if "qkv" not in keys or arr.ndim == 0 or arr.shape[-1] % (
+                H + 2 * K
+            ):
+                return leaf
+            dh = arr.shape[-1] // (H + 2 * K)
+            # Position of head-block h (old order) inside the NEW
+            # group-major axis: q head g·G+i sits at g·(G+2)+i; k_g at
+            # g·(G+2)+G; v_g at g·(G+2)+G+1.
+            new_pos = []
+            for g in range(K):
+                for i in range(G):
+                    new_pos.append(g * (G + 2) + i)
+            for g in range(K):
+                new_pos.append(g * (G + 2) + G)
+            for g in range(K):
+                new_pos.append(g * (G + 2) + G + 1)
+            perm = np.concatenate(
+                [np.arange(p * dh, (p + 1) * dh) for p in new_pos]
+            )
+            return arr[..., perm]
+
+        return jax.tree_util.tree_map_with_path(fix, tree)
+
+    def test_format2_gqa_checkpoint_refused(
+        self, mesh8, tmp_ckpt_dir, monkeypatch
+    ):
+        import ddp_tpu.train.checkpoint as ckpt_mod
+        from ddp_tpu.parallel.ddp import TrainState
+
+        _, st = self._gqa_state(mesh8)
+        state = TrainState(step=st.step, params=st.params,
+                           opt_state=st.opt_state, model_state={})
+        monkeypatch.setattr(ckpt_mod, "CHECKPOINT_FORMAT", 2)
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        with pytest.raises(RuntimeError, match="group-major"):
+            mgr.restore(state)
+        mgr.close()
+
+    def test_format2_mha_checkpoint_still_restores(
+        self, mesh8, tmp_ckpt_dir, monkeypatch
+    ):
+        """MHA trees are bit-identical between formats 2 and 3."""
+        import ddp_tpu.train.checkpoint as ckpt_mod
+        from ddp_tpu.models.lm import LMSpec, create_lm_train_state
+        from ddp_tpu.parallel.ddp import TrainState
+
+        spec = LMSpec(vocab_size=32, total_len=16, d_model=16, depth=1,
+                      num_heads=2)
+        st = create_lm_train_state(spec, optax.sgd(0.01), mesh8, seed=0)
+        state = TrainState(step=st.step, params=st.params,
+                           opt_state=st.opt_state, model_state={})
+        monkeypatch.setattr(ckpt_mod, "CHECKPOINT_FORMAT", 2)
+        mgr = CheckpointManager(tmp_ckpt_dir, async_save=False)
+        mgr.save(0, state)
+        monkeypatch.setattr(ckpt_mod, "CHECKPOINT_FORMAT", 3)
+        restored, _ = mgr.restore(state)  # no error
+        mgr.close()
+
+    def test_gqa_convert_script_end_to_end(self, mesh8, tmp_path):
+        """A format-2 GQA checkpoint converts to a restorable format-3
+        copy whose qkv columns equal the current group-major layout."""
+        import subprocess
+        import sys
+
+        import ddp_tpu.train.checkpoint as ckpt_mod
+        from ddp_tpu.parallel.ddp import TrainState
+
+        spec, st = self._gqa_state(mesh8)
+        H, K = 4, 2
+        block_params = self._to_block_layout(st.params, H, K)
+        block_opt = self._to_block_layout(st.opt_state, H, K)
+        state = TrainState(step=st.step, params=block_params,
+                           opt_state=block_opt, model_state={})
+        src_dir = str(tmp_path / "src")
+        out_dir = str(tmp_path / "out")
+        orig_fmt = ckpt_mod.CHECKPOINT_FORMAT
+        ckpt_mod.CHECKPOINT_FORMAT = 2
+        try:
+            mgr = CheckpointManager(src_dir, async_save=False)
+            mgr.save(0, state)
+            mgr.close()
+        finally:
+            ckpt_mod.CHECKPOINT_FORMAT = orig_fmt
+
+        script = os.path.join(
+            os.path.dirname(__file__), os.pardir, "scripts",
+            "convert_qkv_layout.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, script, "--checkpoint_dir", src_dir,
+             "--out_dir", out_dir, "--num_heads", str(H),
+             "--num_kv_heads", str(K)],
+            capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+        dst = CheckpointManager(out_dir, async_save=False)
+        from ddp_tpu.parallel.ddp import TrainState as TS
+
+        template = TS(step=st.step, params=st.params,
+                      opt_state=st.opt_state, model_state={})
+        restored, _ = dst.restore(template)  # format check passes
+        np.testing.assert_allclose(
+            np.asarray(restored.params["block1"]["attn"]["qkv"]["kernel"]),
+            np.asarray(st.params["block1"]["attn"]["qkv"]["kernel"]),
+        )
+        dst.close()
+
+    def test_gqa_detector_sees_stacked_pipeline_kernels(self):
+        """Pipelined-LM checkpoints stack stage params ([S, …] /
+        [v, S, …] → 3-D/4-D qkv kernels); the format guard must flag
+        those too, not just the seq family's 2-D kernels."""
+        from ddp_tpu.models.pipeline_lm import PipeLMConfig, init_pipe_lm
+        from ddp_tpu.train.checkpoint import _has_gqa_qkv
+
+        cfg = PipeLMConfig(
+            vocab_size=32, seq_len=16, d_model=16, num_heads=4,
+            num_stages=2, num_kv_heads=2,
+        )
+        assert _has_gqa_qkv(init_pipe_lm(cfg, seed=0).stages)
+        assert _has_gqa_qkv(
+            init_pipe_lm(
+                cfg._replace(virtual_stages=2), seed=0, interleaved=True
+            ).stages
+        )
+        mha = cfg._replace(num_kv_heads=0)
+        assert not _has_gqa_qkv(init_pipe_lm(mha, seed=0).stages)

@@ -266,3 +266,28 @@ def test_trainer_cli_pipe_lm_e2e(tmp_path, devices):
     t2.close()
     # Resumed from the epoch-0 checkpoint → only epoch 1 ran.
     assert out2["epochs_run"] == 1
+
+
+def test_to_dense_lm_serves_through_generation(devices, toks):
+    """Train pipelined, serve dense: the exported tree matches the
+    CausalLM forward exactly and decodes through the KV cache."""
+    from ddp_tpu.models.generate import generate, prefill
+    from ddp_tpu.models.lm import dense_lm_apply
+    from ddp_tpu.models.pipeline_lm import to_dense_lm
+
+    cfg = CFG._replace(virtual_stages=2, num_kv_heads=2, num_heads=4)
+    params = init_pipe_lm(cfg, seed=0, interleaved=True)
+    spec, dense = to_dense_lm(cfg, params)
+    assert spec.depth == cfg.num_stages * cfg.virtual_stages
+
+    want = sequential_apply(cfg, params, toks)
+    got = dense_lm_apply(spec, dense, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
+
+    # End to end through the serving stack: KV-cache greedy decode.
+    out = generate(
+        spec, dense, toks[:2, :4], max_new_tokens=3
+    )
+    assert out.shape == (2, 7)
