@@ -97,7 +97,7 @@ class CausalLM(nn.Module):
         x = x + lax.dynamic_slice_in_dim(
             pos.astype(x.dtype), pos_offset, x.shape[1], axis=1
         )
-        from ddp_tpu.models.moe import MoEEncoderBlock
+        from ddp_tpu.models.moe import MoEEncoderBlock, is_moe_block
 
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         moe_cls = (
@@ -105,7 +105,7 @@ class CausalLM(nn.Module):
         )
         attn_fn = self.attention_fn or best_attention(causal=True)
         for i in range(self.depth):
-            if self.num_experts and (i + 1) % self.moe_every == 0:
+            if is_moe_block(i, self.num_experts, self.moe_every):
                 x = moe_cls(
                     num_heads=self.num_heads,
                     mlp_dim=self.d_model * self.mlp_ratio,

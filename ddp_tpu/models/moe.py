@@ -147,6 +147,14 @@ class MoEMLP(nn.Module):
         return out.reshape(B, T, d)
 
 
+def is_moe_block(i: int, num_experts: int, moe_every: int) -> bool:
+    """THE block-interleave rule, shared by every MoE family (CausalLM,
+    MoEViT, the pipelined StageBlocks): block index ``i`` (0-based)
+    hosts a routed MLP iff experts are on and ``(i+1) % moe_every``
+    lands. One definition so the three families cannot drift."""
+    return bool(num_experts) and (i + 1) % moe_every == 0
+
+
 class MoEEncoderBlock(nn.Module):
     """Pre-LN transformer block whose MLP is a routed expert layer."""
 
@@ -225,7 +233,7 @@ class MoEViT(nn.Module):
         moe_cls = nn.remat(MoEEncoderBlock) if self.remat else MoEEncoderBlock
         dense_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         for i in range(self.depth):
-            if (i + 1) % self.moe_every == 0:
+            if is_moe_block(i, self.num_experts, self.moe_every):
                 x = moe_cls(
                     num_heads=self.num_heads,
                     mlp_dim=mlp_dim,

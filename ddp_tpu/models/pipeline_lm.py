@@ -78,6 +78,23 @@ class PipeLMConfig(NamedTuple):
     # fused-qkv layout as the seq family (models/vit.py), so GQA
     # composes with the stage TP when tp_size divides num_kv_heads.
     num_kv_heads: int = 0
+    # MoE: every moe_every-th block's MLP is GShard top-k routed
+    # (models/moe.py), experts replicated (no expert axis in the pipe
+    # family). depth_per_stage % moe_every == 0 keeps the per-stage
+    # pattern equal to the seq-family CausalLM's global pattern. The
+    # load-balance aux loss is NOT collected on the pipe path (the
+    # kernels apply stages purely); routing + capacity dropping still
+    # train. NOTE on routing semantics: GShard capacity/slot
+    # competition is computed over whatever batch the layer sees —
+    # per MICROBATCH in the pipelined step, per full batch in
+    # ``sequential_apply``/eval — so the two forwards agree exactly
+    # only while no token overflows capacity (always true for
+    # near-uniform routers at capacity_factor 2.0; a skewed router
+    # drops different tokens in the two views, like any
+    # batch-size-dependent GShard eval). Does not compose with tp/GQA
+    # (same walls as CausalLM).
+    num_experts: int = 0
+    moe_every: int = 2
 
 
 class PipeLMParams(NamedTuple):
@@ -109,6 +126,18 @@ def _stage_module(
     ``inner_vjp=True`` adds the f/g custom-VJP plumbing the
     hand-scheduled kernels need (they vjp INSIDE the shard_map body,
     where the transpose's cross-member sums never run)."""
+    if cfg.num_experts:
+        if cfg.tp_size > 1 or cfg.num_kv_heads:
+            raise ValueError(
+                "the pipelined MoE-LM composes with data/fsdp/pipe — "
+                "not tp or GQA (the same walls as CausalLM)"
+            )
+        if cfg.depth_per_stage % cfg.moe_every:
+            raise ValueError(
+                f"depth_per_stage {cfg.depth_per_stage} must be a "
+                f"multiple of moe_every {cfg.moe_every} (stages must "
+                "be structure-uniform for parameter stacking)"
+            )
     return StageBlocks(
         depth=cfg.depth_per_stage,
         num_heads=cfg.num_heads,
@@ -119,6 +148,8 @@ def _stage_module(
         tp_size=cfg.tp_size if tp else 1,
         tp_inner_vjp=inner_vjp,
         num_kv_heads=cfg.num_kv_heads,
+        num_experts=cfg.num_experts,
+        moe_every=cfg.moe_every,
     )
 
 
@@ -593,6 +624,8 @@ def to_dense_lm(cfg: PipeLMConfig, params: PipeLMParams):
         depth=C * cfg.depth_per_stage,
         num_heads=cfg.num_heads,
         num_kv_heads=cfg.num_kv_heads,
+        num_experts=cfg.num_experts,
+        moe_every=cfg.moe_every,
     )
     return spec, dense
 

@@ -88,7 +88,17 @@ class StageBlocks(nn.Module):
     """One pipeline stage: ``depth`` encoder blocks, shape-preserving.
 
     ``tp_axis``/``tp_size``: Megatron TP inside each block (PP×TP —
-    used by the pipelined LM; see models/vit.py EncoderBlock)."""
+    used by the pipelined LM; see models/vit.py EncoderBlock).
+
+    ``num_experts > 0``: every ``moe_every``-th block WITHIN the stage
+    is a routed MoE block (models/moe.py MoEEncoderBlock). Stages must
+    stay structure-uniform for parameter stacking, so the pattern is
+    per-stage; with ``depth % moe_every == 0`` it equals the global
+    every-Nth pattern the seq-family CausalLM uses. The GShard
+    load-balance aux loss is ``is_mutable_collection``-guarded and the
+    pipeline kernels apply stages purely, so routing works but the
+    balance loss is NOT collected on the pipe path (callers document
+    this)."""
 
     depth: int
     num_heads: int
@@ -99,21 +109,47 @@ class StageBlocks(nn.Module):
     tp_size: int = 1
     tp_inner_vjp: bool = False  # Megatron f/g — see models/vit.py
     num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
+    num_experts: int = 0  # MoE MLPs — see models/moe.py
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, x):
+        from ddp_tpu.models.moe import MoEEncoderBlock, is_moe_block
+
+        # In-module guard (the CausalLM pattern, models/lm.py): MoE
+        # blocks take none of the tp/GQA wiring, so a caller combining
+        # them must hear it HERE, not get silently-unsharded experts
+        # under stage_specs_megatron's tp specs.
+        if self.num_experts and (self.tp_size > 1 or self.num_kv_heads):
+            raise ValueError(
+                "StageBlocks: MoE blocks do not compose with tp or "
+                "GQA (tp_size="
+                f"{self.tp_size}, num_kv_heads={self.num_kv_heads})"
+            )
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
+        moe_cls = (
+            nn.remat(MoEEncoderBlock) if self.remat else MoEEncoderBlock
+        )
         for i in range(self.depth):
-            x = block_cls(
-                num_heads=self.num_heads,
-                mlp_dim=self.mlp_dim,
-                attention_fn=self.attention_fn,
-                tp_axis=self.tp_axis,
-                tp_size=self.tp_size,
-                tp_inner_vjp=self.tp_inner_vjp,
-                num_kv_heads=self.num_kv_heads,
-                name=f"block{i + 1}",
-            )(x)
+            if is_moe_block(i, self.num_experts, self.moe_every):
+                x = moe_cls(
+                    num_heads=self.num_heads,
+                    mlp_dim=self.mlp_dim,
+                    num_experts=self.num_experts,
+                    attention_fn=self.attention_fn,
+                    name=f"block{i + 1}",
+                )(x)
+            else:
+                x = block_cls(
+                    num_heads=self.num_heads,
+                    mlp_dim=self.mlp_dim,
+                    attention_fn=self.attention_fn,
+                    tp_axis=self.tp_axis,
+                    tp_size=self.tp_size,
+                    tp_inner_vjp=self.tp_inner_vjp,
+                    num_kv_heads=self.num_kv_heads,
+                    name=f"block{i + 1}",
+                )(x)
         return x
 
 

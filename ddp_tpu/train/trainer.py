@@ -131,10 +131,24 @@ class Trainer:
         # (ring/Ulysses attention) with their own step/eval builders —
         # the long-context classifier and the causal LM.
         self.lm_mode = config.model == "causal_lm"
-        if config.moe_experts and not self.lm_mode:
+        if config.moe_experts and not (
+            self.lm_mode or config.model == "pipe_lm"
+        ):
             raise ValueError(
                 "--moe_experts routes the causal LM's MLPs: use "
-                "--model causal_lm (images have --model vit_moe_tiny)"
+                "--model causal_lm or pipe_lm (images have "
+                "--model vit_moe_tiny)"
+            )
+        if (
+            config.moe_experts
+            and config.model == "pipe_lm"
+            and (config.model_depth or 1) % 2
+        ):
+            raise ValueError(
+                "the pipelined MoE-LM interleaves a routed block every "
+                "2nd layer and stages must be structure-uniform: set "
+                f"--model_depth to a multiple of 2 (got "
+                f"{config.model_depth or 1})"
             )
         self.seq_mode = config.model == "long_context" or self.lm_mode
         if config.mesh_seq > 1 and not self.seq_mode:
@@ -635,7 +649,17 @@ class Trainer:
                 label_smoothing=config.label_smoothing,
                 tp_size=config.mesh_model,
                 num_kv_heads=config.num_kv_heads,
+                num_experts=config.moe_experts,
             )
+            if config.moe_experts:
+                logger.info(
+                    "Pipelined MoE: %d experts every 2nd block; the "
+                    "GShard load-balance aux loss is not collected on "
+                    "the pipe path (routing + capacity dropping still "
+                    "apply) — use --model causal_lm for the full aux "
+                    "objective",
+                    config.moe_experts,
+                )
             logger.info(
                 "Pipeline LM: %d stages × %d virtual × %d blocks, %d "
                 "microbatches, %s schedule, tp=%d, bubble fraction %.3f",
