@@ -102,6 +102,12 @@ def run_bench(
         )
         n_imgs = images.shape[0]
         steps = n_imgs // global_batch_size
+        if steps == 0:
+            raise ValueError(
+                f"train split ({n_imgs} images) smaller than "
+                f"global_batch_size ({global_batch_size}) — zero steps "
+                "per epoch (make_lm_epoch_runner guards the same case)"
+            )
 
         def runner(state, e):
             perm = jax.random.permutation(jax.random.key(e), n_imgs)

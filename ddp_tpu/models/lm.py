@@ -145,6 +145,7 @@ class LMSpec(NamedTuple):
     # cache stores the COMPACT num_kv_heads (models/generate.py), so
     # decode HBM reads shrink by num_heads/num_kv_heads.
     num_kv_heads: int = 0
+    mlp_ratio: int = 4
 
 
 def _dense_lm(spec: LMSpec) -> CausalLM:
@@ -158,6 +159,7 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
         moe_every=spec.moe_every,
         remat=spec.remat,
         num_kv_heads=spec.num_kv_heads,
+        mlp_ratio=spec.mlp_ratio,
     )
 
 
@@ -184,6 +186,7 @@ def _sharded_lm(
         ep_axis="expert" if ep_size > 1 else None,
         ep_size=ep_size,
         num_kv_heads=spec.num_kv_heads,
+        mlp_ratio=spec.mlp_ratio,
     )
 
 

@@ -626,6 +626,7 @@ def to_dense_lm(cfg: PipeLMConfig, params: PipeLMParams):
         num_kv_heads=cfg.num_kv_heads,
         num_experts=cfg.num_experts,
         moe_every=cfg.moe_every,
+        mlp_ratio=cfg.mlp_ratio,
     )
     return spec, dense
 

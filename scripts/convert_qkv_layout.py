@@ -40,13 +40,18 @@ import ddp_tpu  # noqa: F401,E402  (applies the JAX_PLATFORMS env pin)
 import numpy as np  # noqa: E402
 
 
+def _path_keys(path):
+    """Stringified tree-path keys — the one place the converter and
+    its verifier decide what counts as a qkv leaf."""
+    return [str(getattr(k, "key", k)) for k in path]
+
+
 def permute_qkv_columns(tree, num_heads: int):
     """[..., 3, H, Dh]-ordered trailing axis → [..., H, 3, Dh]."""
     import jax
 
     def fix(path, leaf):
-        keys = [str(getattr(k, "key", k)) for k in path]
-        if "qkv" not in keys:
+        if "qkv" not in _path_keys(path):
             return leaf
         arr = np.asarray(leaf)
         if arr.ndim == 0 or arr.shape[-1] % (3 * num_heads):
@@ -76,8 +81,7 @@ def permute_gqa_columns(tree, num_heads: int, num_kv_heads: int):
     n_cols = H + 2 * K  # head-sized column blocks
 
     def fix(path, leaf):
-        keys = [str(getattr(k, "key", k)) for k in path]
-        if "qkv" not in keys:
+        if "qkv" not in _path_keys(path):
             return leaf
         arr = np.asarray(leaf)
         if arr.ndim == 0 or arr.shape[-1] % n_cols:
@@ -94,6 +98,50 @@ def permute_gqa_columns(tree, num_heads: int, num_kv_heads: int):
         return arr[..., perm]
 
     return jax.tree_util.tree_map_with_path(fix, tree)
+
+
+def verify_gqa_qkv(tree, num_heads: int, num_kv_heads: int):
+    """Return the qkv kernel leaves that do NOT match the GQA layout.
+
+    ``permute_gqa_columns`` silently skips any leaf whose trailing dim
+    is not divisible by H + 2K — so a wrong ``--num_kv_heads`` either
+    leaves every GQA leaf untouched, or (when the divisibility
+    accidentally holds) mis-groups columns; both would then be stamped
+    format 3, laundering a scrambled checkpoint past the restore guard.
+    The shape invariant below catches every such case EXCEPT a
+    ratio-preserving wrong pair (H/m, K/m) — e.g. true (4, 2) given as
+    (2, 1) — where the expected out-dim (H+2K)·(in//H) depends only on
+    the K/H ratio; nothing in a template-free checkpoint encodes H
+    itself (d_model = H·Dh for every plausible split), so that case is
+    undetectable here and the docs/error text must not overclaim.
+    The invariant: every qkv KERNEL in this
+    framework is (…, C, (H + 2K)·Dh) with Dh = C // H (models/vit.py
+    Block.__call__ builds the fused projection from d_model = H·Dh;
+    pipelined checkpoints stack stages in leading dims). Keyed on the
+    ``kernel`` leaf name so a stacked bias ([S, out]) is never misread
+    as a kernel. Biases carry no in-dim to derive Dh from; the kernels
+    they ride with share the layout code, so kernel verification
+    covers them.
+    """
+    import jax
+
+    H, K = num_heads, num_kv_heads
+    bad = []
+
+    def chk(path, leaf):
+        keys = _path_keys(path)
+        if "qkv" not in keys or keys[-1] != "kernel":
+            return leaf
+        arr = np.asarray(leaf)
+        if arr.ndim < 2:
+            return leaf
+        in_dim, out_dim = arr.shape[-2], arr.shape[-1]
+        if in_dim % H or out_dim != (H + 2 * K) * (in_dim // H):
+            bad.append(("/".join(keys), (in_dim, out_dim)))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(chk, tree)
+    return bad
 
 
 def main() -> int:
@@ -183,6 +231,30 @@ def main() -> int:
                 tree[key] = permute_gqa_columns(
                     tree[key], args.num_heads, args.num_kv_heads
                 )
+        # A wrong H (or K) makes the permutes silently skip (or
+        # mis-group) leaves; refuse to stamp the new format unless
+        # every qkv kernel has the expected out-dim. MHA conversions
+        # verify with K = H (out = 3H·Dh) — the 1→2 path has the same
+        # silent-skip laundering mode as 2→3.
+        k_eff = args.num_kv_heads if gqa else args.num_heads
+        bad = verify_gqa_qkv(
+            {k: tree[k] for k in ("params", "opt_state") if k in tree},
+            args.num_heads, k_eff,
+        )
+        if bad:
+            print(
+                f"epoch {epoch}: {len(bad)} qkv kernel(s) do not "
+                f"match --num_heads {args.num_heads} "
+                f"--num_kv_heads {k_eff} (expect out = "
+                "(H+2K)*(in//H)); first: "
+                f"{bad[0][0]} shape {bad[0][1]} — wrong H/K would "
+                "stamp an unconverted or scrambled checkpoint as "
+                f"format {CHECKPOINT_FORMAT}, refusing (note: a "
+                "ratio-preserving wrong pair like H/2, K/2 cannot be "
+                "detected — double-check against the training config)",
+                file=sys.stderr,
+            )
+            return 2
         state = TrainState(
             step=tree["step"],
             params=tree["params"],

@@ -36,7 +36,13 @@ import struct
 
 import numpy as np
 
-OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "data", "uci_digits")
+# Overridable so tests can vendor into a scratch dir and compare,
+# never rewriting the committed bytes in place (a hard kill mid-write
+# would otherwise leave the repo dirty).
+OUT_DIR = os.environ.get(
+    "UCI_DIGITS_OUT_DIR",
+    os.path.join(os.path.dirname(__file__), "..", "data", "uci_digits"),
+)
 TEST_PER_CLASS = 36  # 360 test total → 1,437 train (MNIST's 6:1 ratio)
 
 

@@ -480,6 +480,54 @@ class TestGqaQkvFormat:
         )
         dst.close()
 
+        # A WRONG --num_kv_heads must refuse instead of stamping
+        # format 3 over unconverted columns (advisor r4, medium): K=1
+        # makes every qkv leaf indivisible by H+2K, so the permutation
+        # silently skips them — the post-conversion shape check
+        # catches it.
+        proc = subprocess.run(
+            [sys.executable, script, "--checkpoint_dir", src_dir,
+             "--out_dir", str(tmp_path / "bad"), "--num_heads", str(H),
+             "--num_kv_heads", "1"],
+            capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode == 2
+        assert "refusing" in proc.stderr
+
+    def test_verify_gqa_qkv_flags_wrong_k_and_reads_stacked_kernels(self):
+        """Unit coverage of the converter's post-conversion guard."""
+        import importlib.util
+
+        spec_ = importlib.util.spec_from_file_location(
+            "convert_qkv_layout",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "scripts", "convert_qkv_layout.py"),
+        )
+        mod = importlib.util.module_from_spec(spec_)
+        spec_.loader.exec_module(mod)
+
+        H, K, dh = 4, 2, 4
+        d = H * dh
+        good = {
+            "block1": {"attn": {"qkv": {
+                "kernel": np.zeros((d, (H + 2 * K) * dh)),
+                "bias": np.zeros(((H + 2 * K) * dh,)),
+            }}},
+            "mlp": {"kernel": np.zeros((d, 7))},  # non-qkv: ignored
+        }
+        assert mod.verify_gqa_qkv(good, H, K) == []
+        # Wrong K: out-dim no longer (H+2K)·Dh.
+        assert mod.verify_gqa_qkv(good, H, 1) != []
+        # Stacked pipeline kernel [S, d, out] verifies via trailing
+        # dims; a stacked bias [S, out] must NOT be misread as a
+        # kernel (it is named bias).
+        stacked = {"stages": {"qkv": {
+            "kernel": np.zeros((3, d, (H + 2 * K) * dh)),
+            "bias": np.zeros((3, (H + 2 * K) * dh)),
+        }}}
+        assert mod.verify_gqa_qkv(stacked, H, K) == []
+        assert mod.verify_gqa_qkv(stacked, H, 1) != []
+
     def test_gqa_detector_sees_stacked_pipeline_kernels(self):
         """Pipelined-LM checkpoints stack stage params ([S, …] /
         [v, S, …] → 3-D/4-D qkv kernels); the format guard must flag

@@ -275,10 +275,15 @@ def test_to_dense_lm_serves_through_generation(devices, toks):
     from ddp_tpu.models.lm import dense_lm_apply
     from ddp_tpu.models.pipeline_lm import to_dense_lm
 
-    cfg = CFG._replace(virtual_stages=2, num_kv_heads=2, num_heads=4)
+    cfg = CFG._replace(
+        virtual_stages=2, num_kv_heads=2, num_heads=4, mlp_ratio=2
+    )
     params = init_pipe_lm(cfg, seed=0, interleaved=True)
     spec, dense = to_dense_lm(cfg, params)
     assert spec.depth == cfg.num_stages * cfg.virtual_stages
+    # mlp_ratio threads through (advisor r4: a ratio≠4 export used to
+    # build 4·d_model dense MLPs and die at serve time).
+    assert spec.mlp_ratio == 2
 
     want = sequential_apply(cfg, params, toks)
     got = dense_lm_apply(spec, dense, toks)

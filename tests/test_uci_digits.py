@@ -48,40 +48,48 @@ def test_loads_with_mnist_shapes_and_balanced_test_split():
 def test_vendoring_is_deterministic(tmp_path):
     """Re-running the vendor script bit-reproduces the committed files.
 
-    Snapshot the committed bytes FIRST (the script writes in place),
-    compare byte-for-byte after, and restore on mismatch so a
-    regression fails loudly without leaving the repo dirty.
+    The script vendors into a scratch dir (UCI_DIGITS_OUT_DIR) and the
+    test compares byte-for-byte against the committed files — the
+    committed bytes are never touched, so even a SIGKILL mid-run
+    cannot leave the repo dirty.
     """
     script = os.path.join(REPO, "scripts", "vendor_uci_digits.py")
     committed = os.path.join(DATA_ROOT, "uci_digits")
-    snapshot = {}
+    # The test always overrides UCI_DIGITS_OUT_DIR, so separately pin
+    # the script's DEFAULT to the committed dir — a regression there
+    # would make a real re-vendoring write to the wrong place while
+    # this test stays green.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("vendor_uci", script)
+    mod = importlib.util.module_from_spec(spec)
+    env_out = os.environ.pop("UCI_DIGITS_OUT_DIR", None)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        if env_out is not None:
+            os.environ["UCI_DIGITS_OUT_DIR"] = env_out
+    assert os.path.normpath(os.path.abspath(mod.OUT_DIR)) == os.path.normpath(
+        os.path.abspath(committed)
+    )
+    out = tmp_path / "uci_digits"
+    proc = subprocess.run(
+        [sys.executable, script],
+        capture_output=True,
+        text=True,
+        cwd=str(tmp_path),  # cwd must not matter
+        env={**os.environ, "UCI_DIGITS_OUT_DIR": str(out)},
+    )
+    assert proc.returncode == 0, proc.stderr
+    mismatched = []
     for fname in sorted(os.listdir(committed)):
         with open(os.path.join(committed, fname), "rb") as f:
-            snapshot[fname] = f.read()
-    mismatched = []
-    try:
-        proc = subprocess.run(
-            [sys.executable, script],
-            capture_output=True,
-            text=True,
-            cwd=str(tmp_path),  # OUT_DIR script-relative; cwd must not matter
-        )
-        assert proc.returncode == 0, proc.stderr
-        for fname, want in snapshot.items():
-            with open(os.path.join(committed, fname), "rb") as f:
-                if f.read() != want:
-                    mismatched.append(fname)
-    finally:
-        # ALWAYS restore the committed bytes — a partial write from a
-        # crashed script (or a mismatch) must not leave the repo dirty.
-        for fname, want in snapshot.items():
-            with open(os.path.join(committed, fname), "wb") as f:
-                f.write(want)
+            want = f.read()
+        regen = out / fname
+        if not regen.exists() or regen.read_bytes() != want:
+            mismatched.append(fname)
     if mismatched:
-        pytest.fail(
-            f"vendor script no longer bit-reproduces: {mismatched} "
-            "(committed bytes restored)"
-        )
+        pytest.fail(f"vendor script no longer bit-reproduces: {mismatched}")
 
 
 def test_vendored_only_variant_never_downloads(tmp_path):
