@@ -48,6 +48,7 @@ from ddp_tpu.ops.attention import best_attention
 from ddp_tpu.parallel.ddp import StepMetrics
 from ddp_tpu.parallel.pipe_common import (
     gather_stages,
+    merge_microbatch_stream,
     pipe_batch_axes,
     scatter_stage_grads,
     stage_specs_megatron,
@@ -79,9 +80,9 @@ class PipeLMConfig(NamedTuple):
     # composes with the stage TP when tp_size divides num_kv_heads.
     num_kv_heads: int = 0
     # MoE: every moe_every-th block's MLP is GShard top-k routed
-    # (models/moe.py), experts replicated (no expert axis in the pipe
-    # family). depth_per_stage % moe_every == 0 keeps the per-stage
-    # pattern equal to the seq-family CausalLM's global pattern. The
+    # (models/moe.py). depth_per_stage % moe_every == 0 keeps the
+    # per-stage pattern equal to the seq-family CausalLM's global
+    # pattern. The
     # load-balance aux loss is NOT collected on the pipe path (the
     # kernels apply stages purely); routing + capacity dropping still
     # train. NOTE on routing semantics: GShard capacity/slot
@@ -95,6 +96,13 @@ class PipeLMConfig(NamedTuple):
     # (same walls as CausalLM).
     num_experts: int = 0
     moe_every: int = 2
+    # Expert parallelism over the ``expert`` mesh axis (PP×EP, round
+    # 5): expert weights rest sharded 1/ep per member INSIDE each
+    # stage, ``expert`` joins the batch axes (pipe_common.py
+    # pipe_batch_axes), and MoEMLP's explicit lax.all_to_all pair runs
+    # within the stage's pipeline island — the flat EP family's
+    # exchange (models/moe.py, tests/test_ep_lm.py) riding per stage.
+    ep_size: int = 1
 
 
 class PipeLMParams(NamedTuple):
@@ -117,20 +125,23 @@ def _attn(cfg: PipeLMConfig):
 
 
 def _stage_module(
-    cfg: PipeLMConfig, *, tp: bool = False, inner_vjp: bool = False
+    cfg: PipeLMConfig, *, tp: bool = False, inner_vjp: bool = False,
+    ep: bool = False
 ):
-    """The stage body. ``tp=False`` builds the GLOBAL-shape module
-    (init, sequential/eval forward); ``tp=True`` the Megatron module
-    whose local param shapes match each ``model`` member's shard of
-    the global arrays (the seq-family convention, parallel/tp.py).
+    """The stage body. ``tp=False``/``ep=False`` builds the
+    GLOBAL-shape module (init, sequential/eval forward); ``tp=True``
+    the Megatron module whose local param shapes match each ``model``
+    member's shard of the global arrays, ``ep=True`` the
+    expert-parallel module whose expert weights are each ``expert``
+    member's 1/ep slice (the seq-family convention, parallel/tp.py).
     ``inner_vjp=True`` adds the f/g custom-VJP plumbing the
     hand-scheduled kernels need (they vjp INSIDE the shard_map body,
     where the transpose's cross-member sums never run)."""
     if cfg.num_experts:
         if cfg.tp_size > 1 or cfg.num_kv_heads:
             raise ValueError(
-                "the pipelined MoE-LM composes with data/fsdp/pipe — "
-                "not tp or GQA (the same walls as CausalLM)"
+                "the pipelined MoE-LM composes with data/fsdp/pipe/"
+                "expert — not tp or GQA (the same walls as CausalLM)"
             )
         if cfg.depth_per_stage % cfg.moe_every:
             raise ValueError(
@@ -138,6 +149,13 @@ def _stage_module(
                 f"multiple of moe_every {cfg.moe_every} (stages must "
                 "be structure-uniform for parameter stacking)"
             )
+        if cfg.num_experts % cfg.ep_size:
+            raise ValueError(
+                f"num_experts {cfg.num_experts} not divisible by "
+                f"ep_size {cfg.ep_size}"
+            )
+    elif cfg.ep_size > 1:
+        raise ValueError("ep_size > 1 needs num_experts > 0")
     return StageBlocks(
         depth=cfg.depth_per_stage,
         num_heads=cfg.num_heads,
@@ -150,6 +168,8 @@ def _stage_module(
         num_kv_heads=cfg.num_kv_heads,
         num_experts=cfg.num_experts,
         moe_every=cfg.moe_every,
+        ep_axis="expert" if ep else None,
+        ep_size=cfg.ep_size if ep else 1,
     )
 
 
@@ -254,19 +274,16 @@ def _loss_fn_factory(cfg: PipeLMConfig):
 
 
 def _split_microbatches(cfg: PipeLMConfig, mesh: Mesh, tokens):
-    """[B, T] int32 → ([M//S, S, mb, T] stream layout, [M, mb, T])."""
+    """[B, T] int32 → ([M//S, S, mb, T] stream layout, [M, mb, T]),
+    STRIDED (rows m::M) — parallel/pipe_common.py has the why."""
+    from ddp_tpu.parallel.pipe_common import (
+        split_microbatch_labels,
+        split_microbatch_stream,
+    )
+
     S = mesh.shape["pipe"]
-    M = cfg.num_microbatches
-    B = tokens.shape[0]
-    if B % M:
-        raise ValueError(f"batch {B} not divisible by {M} microbatches")
-    if M % S:
-        raise ValueError(
-            f"{M} microbatches not divisible by {S} pipeline stages "
-            "(the sharded stream rests microbatch m on device m mod S)"
-        )
-    mbs = tokens.reshape(M // S, S, B // M, tokens.shape[1])
-    lbl_mb = tokens.reshape(M, B // M, tokens.shape[1])
+    mbs = split_microbatch_stream(tokens, cfg.num_microbatches, S)
+    lbl_mb = split_microbatch_labels(tokens, cfg.num_microbatches)
     return mbs, lbl_mb
 
 
@@ -296,7 +313,8 @@ def _tp_stage_fn(cfg: PipeLMConfig, mesh: Mesh, *, inner_vjp: bool = False):
     """
     del mesh
     stage = _stage_module(
-        cfg, tp=cfg.tp_size > 1, inner_vjp=cfg.tp_size > 1 and inner_vjp
+        cfg, tp=cfg.tp_size > 1, inner_vjp=cfg.tp_size > 1 and inner_vjp,
+        ep=cfg.ep_size > 1,
     )
 
     def stage_fn(p, x):
@@ -315,7 +333,6 @@ def make_pipe_lm_apply(cfg: PipeLMConfig, mesh: Mesh):
         tokens = lax.with_sharding_constraint(
             tokens, NamedSharding(mesh, bspec)
         )
-        B = tokens.shape[0]
         mbs, _ = _split_microbatches(cfg, mesh, tokens)
         sspecs = _param_specs(cfg, params.stages, mesh, lead=1)
 
@@ -332,7 +349,7 @@ def make_pipe_lm_apply(cfg: PipeLMConfig, mesh: Mesh):
         )
         lp = {"ln": params.back["ln"], "embed": params.front["embed"]}
         out = pipelined(params.stages, params.front, lp, mbs)
-        return out.reshape(B, *out.shape[3:])
+        return merge_microbatch_stream(out)
 
     return apply_fn
 
@@ -342,7 +359,7 @@ def _param_specs(cfg: PipeLMConfig, stages, mesh: Mesh, *, lead: int):
     (parallel/pipe_common.py ``stage_specs_megatron`` — shared with
     the pipelined ViT)."""
     return stage_specs_megatron(
-        stages, mesh, lead=lead, tp_size=cfg.tp_size
+        stages, mesh, lead=lead, tp_size=cfg.tp_size, ep_size=cfg.ep_size
     )
 
 
@@ -459,6 +476,19 @@ def _make_handsched_lm_step(
                 gl = jax.tree.map(lambda g: lax.psum(g, baxes), gl)
             if "data" in baxes:
                 gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
+            if "expert" in baxes:
+                # Expert-sharded leaves (wi/bi/wo/bo) need NO expert
+                # reduction: the all_to_all pair already routed every
+                # member's slots through the owning expert, so each
+                # member's backward computes the complete grad for its
+                # own experts. Replicated-over-expert leaves (attn,
+                # dense MLPs, router, LNs) saw different tokens per
+                # member → sum like any batch axis.
+                gs = jax.tree.map(
+                    lambda g, s: g if "expert" in s
+                    else lax.psum(g, "expert"),
+                    gs, sspecs,
+                )
             if has_fsdp:
                 gs = scatter_stage_grads(gs, sspecs)
             # TP needs no extra reduction here: each ``model`` member

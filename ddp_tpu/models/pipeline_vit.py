@@ -34,10 +34,28 @@ from ddp_tpu.parallel.pipeline import spmd_pipeline, stack_stage_params
 # private aliases keep this module's call sites stable.
 from ddp_tpu.parallel.pipe_common import (
     gather_stages as _gather_stages,
+    merge_microbatch_stream as _merge_microbatch_stream,
     pipe_batch_axes as _pipe_batch_axes,
     scatter_stage_grads as _scatter_stage_grads,
+    split_microbatch_labels as _split_microbatch_labels,
+    split_microbatch_stream as _split_microbatch_stream,
     stage_specs_megatron as _stage_specs_megatron,
 )
+
+
+def _reject_expert_mesh(mesh):
+    """The pipelined ViT has no MoE, but ``pipe_batch_axes`` would
+    still shard its batch over an ``expert`` axis — and the
+    hand-scheduled steps only reduce stage grads over ``data``, so an
+    expert axis would silently diverge params across expert groups.
+    PP×EP is the pipelined LM's (models/pipeline_lm.py); refuse here
+    at build time."""
+    if mesh.shape.get("expert", 1) > 1:
+        raise ValueError(
+            "the pipelined ViT takes no expert mesh axis (it has no "
+            "MoE blocks); PP×EP is the pipelined MoE-LM's — "
+            "models/pipeline_lm.py"
+        )
 
 
 class PipeViTConfig(NamedTuple):
@@ -98,7 +116,15 @@ class StageBlocks(nn.Module):
     load-balance aux loss is ``is_mutable_collection``-guarded and the
     pipeline kernels apply stages purely, so routing works but the
     balance loss is NOT collected on the pipe path (callers document
-    this)."""
+    this).
+
+    ``ep_axis``/``ep_size`` (PP×EP, round 5): expert weights shard
+    their leading dim over the ``expert`` mesh axis INSIDE the stage's
+    pipeline island — each member holds ``num_experts/ep_size``
+    experts and a different token shard, and MoEMLP's explicit
+    ``lax.all_to_all`` pair carries dispatched slots to each expert's
+    owner and back, exactly the flat EP family's exchange riding
+    within each pipeline stage."""
 
     depth: int
     num_heads: int
@@ -111,6 +137,8 @@ class StageBlocks(nn.Module):
     num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
     num_experts: int = 0  # MoE MLPs — see models/moe.py
     moe_every: int = 2
+    ep_axis: Optional[str] = None  # expert parallelism (see MoEMLP)
+    ep_size: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -137,6 +165,8 @@ class StageBlocks(nn.Module):
                     mlp_dim=self.mlp_dim,
                     num_experts=self.num_experts,
                     attention_fn=self.attention_fn,
+                    ep_axis=self.ep_axis,
+                    ep_size=self.ep_size,
                     name=f"block{i + 1}",
                 )(x)
             else:
@@ -295,6 +325,7 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
     # AD path: TP blocks WITHOUT the f/g ops (the shard_map transpose
     # owns the cross-member sums here — see models/pipeline_lm.py).
     embed, stage, head = _modules(cfg, tp=cfg.tp_size > 1)
+    _reject_expert_mesh(mesh)
     baxes = _pipe_batch_axes(mesh)
     bspec = P(baxes) if baxes else P()
     mbspec = P(None, "pipe", baxes) if baxes else P(None, "pipe")
@@ -314,16 +345,7 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
         images = lax.with_sharding_constraint(
             images, NamedSharding(mesh, bspec)
         )
-        B = images.shape[0]
-        M = cfg.num_microbatches
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        if M % S:
-            raise ValueError(
-                f"{M} microbatches not divisible by {S} pipeline stages "
-                "(the sharded stream rests microbatch m on device m mod S)"
-            )
-        mb = images.reshape(M // S, S, B // M, *images.shape[1:])
+        mb = _split_microbatch_stream(images, cfg.num_microbatches, S)
         sspecs = _vit_stage_specs(cfg, params.stages, mesh, lead=1)
 
         pipelined = jax.shard_map(
@@ -338,7 +360,7 @@ def make_pipe_vit_apply(cfg: PipeViTConfig, mesh: Mesh):
             check_vma=False,
         )
         out = pipelined(params.stages, params.embed, params.head, mb)
-        return out.reshape(B, *out.shape[3:])
+        return _merge_microbatch_stream(out)
 
     return apply_fn
 
@@ -490,6 +512,7 @@ def _make_handsched_step(
     )
     S = mesh.shape["pipe"]
     M = cfg.num_microbatches
+    _reject_expert_mesh(mesh)
     baxes = _pipe_batch_axes(mesh)
     has_fsdp = mesh.shape.get("fsdp", 1) > 1
     bspec = P(baxes) if baxes else P()
@@ -562,10 +585,8 @@ def _make_handsched_step(
             NamedSharding(mesh, bspec),
         )
         B = images.shape[0]
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        mbs = images.reshape(M // S, S, B // M, *images.shape[1:])
-        lbl_mb = labels.reshape(M, B // M)
+        mbs = _split_microbatch_stream(images, M, S)
+        lbl_mb = _split_microbatch_labels(labels, M)
         run = make_run(_vit_stage_specs(cfg, state.params.stages, mesh, lead=lead))
         loss_sum, correct, gs, gf, gl = run(
             state.params.stages, state.params.embed, state.params.head,

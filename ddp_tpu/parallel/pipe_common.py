@@ -19,9 +19,64 @@ FSDP_MIN_SIZE = 2**12  # leaves smaller than this stay replicated
 
 
 def pipe_batch_axes(mesh) -> tuple:
-    """Axes the pipe family shards its batch over (``expert``/``seq``
-    never compose with pipe)."""
-    return tuple(a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1)
+    """Axes the pipe family shards its batch over. ``expert`` is a
+    batch axis exactly as in the flat EP family (runtime/mesh.py
+    ``data_axes``): each expert-group member routes its own token
+    shard and the all-to-all carries dispatched slots to the expert's
+    owner (PP×EP, round 5). ``seq`` still never composes with pipe."""
+    return tuple(
+        a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
+    )
+
+
+def split_microbatch_stream(x, num_microbatches: int, num_stages: int):
+    """[B, …] → the [M//S, S, mb, …] pipeline stream, STRIDED.
+
+    Microbatch m takes rows ``m::M`` (the grad-accum idiom,
+    models/lm.py): ``stream[r, s, i] = x[i·M + r·S + s]``. The loader
+    delivers batches sharded on dim 0 over the batch axes, and the
+    trainer's microbatch guard (``mb % data_shards == 0``) makes each
+    member's contiguous row block whole i-groups — so the reshape
+    below is sharding-LOCAL and the transpose a free tiling
+    permutation; the shard_map boundary then only SLICES the
+    replicated stream dim onto ``pipe``. A contiguous split would
+    demand a dim0-batch → (None, pipe, batch) resharding that XLA's
+    SPMD partitioner can only express by involuntary full
+    rematerialization (the %reshape warning in MULTICHIP_r04; fixed
+    round 5). One definition for both pipe families so the stream,
+    label, and output orderings cannot drift."""
+    import jax.numpy as jnp
+
+    B, M, S = x.shape[0], num_microbatches, num_stages
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if M % S:
+        raise ValueError(
+            f"{M} microbatches not divisible by {S} pipeline stages "
+            "(the sharded stream rests microbatch m on device m mod S)"
+        )
+    x = x.reshape(B // M, M // S, S, *x.shape[1:])
+    return jnp.transpose(x, (1, 2, 0) + tuple(range(3, x.ndim)))
+
+
+def split_microbatch_labels(y, num_microbatches: int):
+    """[B, …] → [M, mb, …] with the SAME strided row assignment as
+    ``split_microbatch_stream``: ``labels[m, i] = y[i·M + m]``."""
+    import jax.numpy as jnp
+
+    B, M = y.shape[0], num_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    return jnp.moveaxis(y.reshape(B // M, M, *y.shape[1:]), 1, 0)
+
+
+def merge_microbatch_stream(out):
+    """Invert ``split_microbatch_stream``: [R, S, mb, …] → [B, …]
+    (out[r, s, i] is row i·M + r·S + s)."""
+    import jax.numpy as jnp
+
+    B = out.shape[0] * out.shape[1] * out.shape[2]
+    return jnp.moveaxis(out, 2, 0).reshape(B, *out.shape[3:])
 
 
 def stage_specs(stages, mesh, *, lead: int):
@@ -50,25 +105,34 @@ def stage_specs(stages, mesh, *, lead: int):
     return jax.tree.map(spec_for, stages)
 
 
-def stage_specs_megatron(stages, mesh, *, lead: int, tp_size: int):
-    """``stage_specs`` plus Megatron TP dims over ``model``.
+def stage_specs_megatron(
+    stages, mesh, *, lead: int, tp_size: int, ep_size: int = 1
+):
+    """``stage_specs`` plus Megatron TP dims over ``model`` and MoE
+    expert dims over ``expert``.
 
-    With ``tp_size <= 1`` this IS ``stage_specs``. Otherwise the block
-    kernels/biases follow parallel/tp.py's suffix rules shifted by the
-    ``lead`` stacked dims — column kernels shard their output dim, row
-    kernels their input dim, column biases their only dim — and
-    ``fsdp``, when present, rides the kernels' *other* dim where it
-    divides (the composition seq_param_specs builds). Leaves the rules
-    don't name (LayerNorms) keep the base pipe/fsdp spec.
+    With ``tp_size <= 1`` and ``ep_size <= 1`` this IS ``stage_specs``.
+    With TP, the block kernels/biases follow parallel/tp.py's suffix
+    rules shifted by the ``lead`` stacked dims — column kernels shard
+    their output dim, row kernels their input dim, column biases their
+    only dim — and ``fsdp``, when present, rides the kernels' *other*
+    dim where it divides (the composition seq_param_specs builds).
+    With EP (PP×EP, round 5), MoE expert weights take their leading
+    per-stage dim (the expert index) on ``expert`` — the same rule as
+    seq_param_specs' ``_EXPERT_LEAVES``, shifted by ``lead`` — with
+    ``fsdp`` on the next dim where it divides; the router stays with
+    the base rule (identical routing on every member). Leaves no rule
+    names (LayerNorms) keep the base pipe/fsdp spec.
     """
     base = stage_specs(stages, mesh, lead=lead)
-    if tp_size <= 1:
+    if tp_size <= 1 and ep_size <= 1:
         return base
 
     from ddp_tpu.parallel.seq_fsdp import fsdp_size
     from ddp_tpu.parallel.tp import (
         _COLUMN_BIASES,
         _COLUMN_KERNELS,
+        _EXPERT_LEAVES,
         _ROW_KERNELS,
         _check_divides,
         _path_str,
@@ -79,18 +143,29 @@ def stage_specs_megatron(stages, mesh, *, lead: int, tp_size: int):
 
     def with_model(path, p, s):
         suffix = _path_str(path)
-        shape = p.shape[lead:]  # per-stage (global, pre-TP) shape
-        if suffix.endswith(_COLUMN_KERNELS):
-            _check_divides(suffix, shape[1], tp_size)
-            d0 = "fsdp" if n > 1 and shape[0] % n == 0 else None
-            return P(*lead_axes, d0, "model")
-        if suffix.endswith(_COLUMN_BIASES):
-            _check_divides(suffix, shape[0], tp_size)
-            return P(*lead_axes, "model")
-        if suffix.endswith(_ROW_KERNELS):
-            _check_divides(suffix, shape[0], tp_size)
-            d1 = "fsdp" if n > 1 and shape[1] % n == 0 else None
-            return P(*lead_axes, "model", d1)
+        shape = p.shape[lead:]  # per-stage (global, pre-TP/EP) shape
+        if ep_size > 1 and suffix.endswith(_EXPERT_LEAVES):
+            _check_divides(suffix, shape[0], ep_size)
+            # wi [E, d, mlp] / wo [E, mlp, d]: fsdp rides dim 1 where
+            # it divides; biases [E, 1, f] shard the expert dim only.
+            if (
+                n > 1 and len(shape) > 1 and shape[1] > 1
+                and shape[1] % n == 0
+            ):
+                return P(*lead_axes, "expert", "fsdp")
+            return P(*lead_axes, "expert")
+        if tp_size > 1:
+            if suffix.endswith(_COLUMN_KERNELS):
+                _check_divides(suffix, shape[1], tp_size)
+                d0 = "fsdp" if n > 1 and shape[0] % n == 0 else None
+                return P(*lead_axes, d0, "model")
+            if suffix.endswith(_COLUMN_BIASES):
+                _check_divides(suffix, shape[0], tp_size)
+                return P(*lead_axes, "model")
+            if suffix.endswith(_ROW_KERNELS):
+                _check_divides(suffix, shape[0], tp_size)
+                d1 = "fsdp" if n > 1 and shape[1] % n == 0 else None
+                return P(*lead_axes, "model", d1)
         return s
 
     return jax.tree_util.tree_map_with_path(with_model, stages, base)

@@ -174,7 +174,7 @@ class Trainer:
                 "1-stage pipeline is the plain step — drop the flag)"
             )
         if self.pipe_mode and (
-            config.mesh_expert > 1
+            (config.mesh_expert > 1 and not self.pipe_lm_mode)
             or config.mesh_seq > 1
             or config.zero1
             or config.grad_accum_steps > 1
@@ -191,9 +191,12 @@ class Trainer:
                 f"--model {config.model} composes with the data axis, "
                 "fsdp (ZeRO-sharded stage params), tp (--mesh_model, "
                 "PP×TP)"
-                + ("" if self.pipe_lm_mode else ", augment")
+                + (", expert (--mesh_expert, PP×EP)"
+                   if self.pipe_lm_mode else ", augment")
                 + ", bf16, remat, label smoothing, EMA and LR schedules "
-                "— not expert/seq/zero1, accumulation (use "
+                "— not "
+                + ("" if self.pipe_lm_mode else "expert/")
+                + "seq/zero1, accumulation (use "
                 "--num_microbatches), "
                 + ("--fast_epoch, or augment"
                    if self.pipe_lm_mode
@@ -315,7 +318,7 @@ class Trainer:
                     else ", or --fast_epoch (causal_lm only)"
                 )
             )
-        if self.seq_mode and config.mesh_expert > 1:
+        if (self.seq_mode or self.pipe_lm_mode) and config.mesh_expert > 1:
             if not config.moe_experts:
                 raise ValueError(
                     "--mesh_expert shards MoE expert weights: give the "
@@ -650,6 +653,7 @@ class Trainer:
                 tp_size=config.mesh_model,
                 num_kv_heads=config.num_kv_heads,
                 num_experts=config.moe_experts,
+                ep_size=config.mesh_expert,
             )
             if config.moe_experts:
                 logger.info(

@@ -331,3 +331,143 @@ def test_moe_pipe_matches_sequential(devices, toks):
         init_pipe_lm(cfg._replace(tp_size=2), seed=0)
     with pytest.raises(ValueError, match="structure-uniform"):
         init_pipe_lm(cfg._replace(depth_per_stage=1), seed=0)
+
+# ----------------------- PP×EP (round 5) -----------------------
+#
+# Expert parallelism INSIDE the pipeline stages: expert weights rest
+# sharded over the ``expert`` mesh axis within each stage's shard_map
+# island, ``expert`` joins the batch axes, and MoEMLP's explicit
+# lax.all_to_all dispatch runs per stage (models/moe.py). Contract
+# mirrors tests/test_ep_lm.py: EXACT parity with the replicated-
+# experts step under the same batch split — (pipe=2, expert=2) routes
+# identically to (pipe=2, data=2) — and per-device expert memory
+# drops by the axis size.
+
+
+@pytest.mark.parametrize(
+    "make_step,interleaved",
+    [
+        (make_pipe_lm_train_step, False),
+        (make_pipe_lm_1f1b_train_step, False),
+        (make_pipe_lm_interleaved_train_step, True),
+    ],
+    ids=["gpipe", "1f1b", "interleaved"],
+)
+def test_pp_ep_exact_parity_with_dp(devices, toks, make_step, interleaved):
+    tx = optax.adam(1e-3)
+    cfg = CFG._replace(
+        depth_per_stage=2,
+        num_experts=4,
+        virtual_stages=2 if interleaved else 1,
+    )
+
+    def run(mesh, cfg):
+        st = create_pipe_lm_state(
+            cfg, tx, mesh, seed=0, interleaved=interleaved
+        )
+        step = make_step(cfg, tx, mesh, donate=False)
+        losses = []
+        for _ in range(3):
+            st, m = step(st, toks)
+            losses.append(float(m.loss))
+        return np.array(losses), st
+
+    ref, _ = run(_mesh(devices[:4], data=2, pipe=2), cfg)
+    ep, st = run(
+        _mesh(devices[:4], pipe=2, expert=2), cfg._replace(ep_size=2)
+    )
+    np.testing.assert_array_equal(ep, ref)
+    # Expert weights rest 1/pipe × 1/ep per device (both layouts:
+    # [S, E, …] and the interleaved [v, S, E, …]).
+    wi = st.params.stages["block2"]["moe"]["wi"]
+    assert (
+        wi.addressable_shards[0].data.size == wi.size // 4
+    ), (wi.addressable_shards[0].data.shape, wi.shape)
+
+
+def test_pp_ep_fsdp_composition(devices):
+    """PP×EP×FSDP: exact parity vs PP×DP×FSDP on the same 8 devices;
+    wi rests (1/pipe, 1/ep, dim-2/fsdp); moments inherit placement."""
+    tx = optax.adam(1e-3)
+    cfg = CFG._replace(depth_per_stage=2, num_experts=4)
+    toks16 = _tokens(16, seed=3)
+
+    def run(mesh, cfg):
+        st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+        step = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)
+        losses = []
+        for _ in range(2):
+            st, m = step(st, toks16)
+            losses.append(float(m.loss))
+        return np.array(losses), st
+
+    ref, _ = run(_mesh(devices, pipe=2, fsdp=2, data=2), cfg)
+    ep, st = run(
+        _mesh(devices, pipe=2, fsdp=2, expert=2), cfg._replace(ep_size=2)
+    )
+    np.testing.assert_array_equal(ep, ref)
+    wi = st.params.stages["block2"]["moe"]["wi"]
+    assert wi.shape == (2, 4, 32, 128)
+    assert wi.addressable_shards[0].data.shape == (1, 2, 16, 128)
+    mu_wi = st.opt_state[0].mu.stages["block2"]["moe"]["wi"]
+    assert mu_wi.addressable_shards[0].data.shape == (1, 2, 16, 128)
+    # Router replicates over expert: identical routing on every member.
+    router = st.params.stages["block2"]["moe"]["router"]["kernel"]
+    assert "expert" not in jax.tree_util.tree_leaves([router.sharding.spec])
+
+
+def test_pp_ep_validation_and_trainer_e2e(tmp_path, devices):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="not divisible"):
+        init_pipe_lm(
+            CFG._replace(depth_per_stage=2, num_experts=3, ep_size=2),
+            seed=0,
+        )
+    with pytest.raises(ValueError, match="needs num_experts"):
+        init_pipe_lm(CFG._replace(ep_size=2), seed=0)
+    # The pipelined ViT rejects expert meshes at build time (it has no
+    # MoE; its hand-scheduled steps reduce stage grads over data only).
+    from ddp_tpu.models.pipeline_vit import (
+        PipeViTConfig,
+        make_pipe_vit_1f1b_train_step,
+    )
+
+    with pytest.raises(ValueError, match="no expert mesh axis"):
+        make_pipe_vit_1f1b_train_step(
+            PipeViTConfig(num_stages=2), optax.sgd(0.1),
+            _mesh(devices[:4], pipe=2, expert=2),
+        )
+
+    kw = dict(
+        model="pipe_lm",
+        epochs=1,
+        batch_size=4,
+        mesh_pipe=2,
+        num_microbatches=4,
+        seq_len=16,
+        vocab_size=64,
+        model_dim=32,
+        num_heads=2,
+        model_depth=2,
+        synthetic_data=True,
+        synthetic_size=64,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        num_devices=4,
+    )
+    # --mesh_expert without experts / indivisible experts: refused.
+    with pytest.raises(ValueError, match="--moe_experts"):
+        Trainer(TrainConfig(**{**kw, "mesh_expert": 2}))
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(
+            TrainConfig(**{**kw, "mesh_expert": 2, "moe_experts": 3})
+        )
+    # PP×EP end to end: pipe=2 × expert=2 on 4 devices.
+    t = Trainer(
+        TrainConfig(**{**kw, "mesh_expert": 2, "moe_experts": 4})
+    )
+    out = t.train()
+    t.close()
+    assert np.isfinite(out["final_loss"])

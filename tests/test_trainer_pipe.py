@@ -76,6 +76,9 @@ def test_pipe_rejects_bad_combos(tmp_path, devices):
         Trainer(make_config(tmp_path, num_microbatches=6))
     with pytest.raises(ValueError, match="composes with"):
         Trainer(make_config(tmp_path, grad_accum_steps=2))
+    with pytest.raises(ValueError, match="composes with"):
+        # PP×EP is the pipelined LM's (round 5); the ViT has no MoE.
+        Trainer(make_config(tmp_path, mesh_expert=2))
     with pytest.raises(ValueError, match="data shards"):
         # mesh_pipe=2 → data=4; global batch 12, 6 microbatches of 2:
         # a microbatch can't shard over 4 data shards.
