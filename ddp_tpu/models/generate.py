@@ -97,10 +97,56 @@ def _block_qkv(p, x, H, Dh, H_kv=None):
     return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
 
+def _moe_mlp(p, x, *, top_k: int = 2):
+    """Routed expert MLP for serving (round 5 — MoE-LM decode).
+
+    models/moe.py MoEMLP numerics WITHOUT the capacity mechanism:
+    each token's top-k experts are selected by the same iterative
+    argmax, gates normalized the same way, and the combine runs as a
+    dense weighting over all E expert FFNs — so the output equals the
+    training forward EXACTLY while no token overflows capacity (the
+    no-drop regime; capacity competition depends on the batch a layer
+    sees, so a skewed router drops differently at train vs serve —
+    the same caveat as any batch-size-dependent GShard eval). Dense
+    E-way compute is the right serving shape here: decode batches are
+    small and the capacity/dispatch einsums exist for training-scale
+    token counts. Defaults mirror MoEMLP (top_k=2, normalized gates —
+    the only configuration the LM families construct)."""
+    B, T, d = x.shape
+    toks = x.reshape(B * T, d)
+    gates = jax.nn.softmax(
+        toks.astype(jnp.float32) @ p["router"]["kernel"]
+        + p["router"]["bias"],
+        axis=-1,
+    )  # [n, E] fp32 — the router runs fp32 in training too
+    E = gates.shape[-1]
+    remaining = gates
+    comb = jnp.zeros_like(gates)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        comb = comb + remaining * mask
+        remaining = remaining * (1.0 - mask)
+    comb = comb / jnp.maximum(comb.sum(-1, keepdims=True), 1e-9)
+    wi, wo = p["wi"].astype(x.dtype), p["wo"].astype(x.dtype)
+    h = jax.nn.gelu(
+        jnp.einsum("nd,edf->enf", toks, wi) + p["bi"].astype(x.dtype)
+    )
+    y = jnp.einsum("enf,efd->end", h, wo) + p["bo"].astype(x.dtype)
+    out = jnp.einsum("ne,end->nd", comb.astype(x.dtype), y)
+    return out.reshape(B, T, d)
+
+
 def _block_finish(p, x, attn_vec):
-    """Output projection residual + MLP residual (the block's back half)."""
+    """Output projection residual + MLP residual (the block's back
+    half). Routed blocks (``moe`` in the tree) take the expert path —
+    every decode surface (decode_step, prefill, beam_search,
+    cached_logits) flows through here, so the MoE-LM serves through
+    the whole stack."""
     x = x + _dense(attn_vec, p["attn"]["proj"])
     h = _layer_norm(x, p["ln2"]).astype(x.dtype)
+    if "moe" in p:
+        return x + _moe_mlp(p["moe"], h)
     h = _dense(h, p["mlp1"])
     h = jax.nn.gelu(h)  # tanh approximation — Flax's default
     return x + _dense(h, p["mlp2"])

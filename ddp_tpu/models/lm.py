@@ -78,11 +78,6 @@ class CausalLM(nn.Module):
                 "MoE does not compose with TP here: TP shards dense "
                 "blocks; shard experts with --mesh_expert instead"
             )
-        if self.num_experts and self.num_kv_heads:
-            raise ValueError(
-                "GQA covers the dense blocks only; drop --num_kv_heads "
-                "or --num_experts"
-            )
         embed = self.param(
             "embed",
             nn.initializers.normal(stddev=0.02),
@@ -113,6 +108,7 @@ class CausalLM(nn.Module):
                     attention_fn=attn_fn,
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"block{i + 1}",
                 )(x)
             else:

@@ -156,7 +156,12 @@ def is_moe_block(i: int, num_experts: int, moe_every: int) -> bool:
 
 
 class MoEEncoderBlock(nn.Module):
-    """Pre-LN transformer block whose MLP is a routed expert layer."""
+    """Pre-LN transformer block whose MLP is a routed expert layer.
+
+    ``num_kv_heads`` (round 5): grouped-query attention in routed
+    blocks too — GQA lives in the attention, routing in the MLP;
+    orthogonal subsystems (the Mixtral-class composition). Same
+    group-major fused-qkv layout as the dense EncoderBlock."""
 
     num_heads: int
     mlp_dim: int
@@ -168,12 +173,14 @@ class MoEEncoderBlock(nn.Module):
     deterministic: bool = True  # attribute, not call kwarg — remat-safe
     ep_axis: Optional[str] = None  # expert parallelism (see MoEMLP)
     ep_size: int = 1
+    num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
         y = MultiHeadAttention(
-            self.num_heads, attention_fn=self.attention_fn, name="attn"
+            self.num_heads, attention_fn=self.attention_fn,
+            num_kv_heads=self.num_kv_heads, name="attn"
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         x = x + y

@@ -92,8 +92,9 @@ class PipeLMConfig(NamedTuple):
     # only while no token overflows capacity (always true for
     # near-uniform routers at capacity_factor 2.0; a skewed router
     # drops different tokens in the two views, like any
-    # batch-size-dependent GShard eval). Does not compose with tp/GQA
-    # (same walls as CausalLM).
+    # batch-size-dependent GShard eval). Composes with GQA (round 5 —
+    # attention and routing are orthogonal) but not tp (same wall as
+    # CausalLM).
     num_experts: int = 0
     moe_every: int = 2
     # Expert parallelism over the ``expert`` mesh axis (PP×EP, round
@@ -138,10 +139,10 @@ def _stage_module(
     hand-scheduled kernels need (they vjp INSIDE the shard_map body,
     where the transpose's cross-member sums never run)."""
     if cfg.num_experts:
-        if cfg.tp_size > 1 or cfg.num_kv_heads:
+        if cfg.tp_size > 1:
             raise ValueError(
                 "the pipelined MoE-LM composes with data/fsdp/pipe/"
-                "expert — not tp or GQA (the same walls as CausalLM)"
+                "expert/GQA — not tp (the same wall as CausalLM)"
             )
         if cfg.depth_per_stage % cfg.moe_every:
             raise ValueError(

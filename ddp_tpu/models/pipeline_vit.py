@@ -145,14 +145,13 @@ class StageBlocks(nn.Module):
         from ddp_tpu.models.moe import MoEEncoderBlock, is_moe_block
 
         # In-module guard (the CausalLM pattern, models/lm.py): MoE
-        # blocks take none of the tp/GQA wiring, so a caller combining
-        # them must hear it HERE, not get silently-unsharded experts
-        # under stage_specs_megatron's tp specs.
-        if self.num_experts and (self.tp_size > 1 or self.num_kv_heads):
+        # blocks take no tp wiring, so a caller combining them must
+        # hear it HERE, not get silently-unsharded experts under
+        # stage_specs_megatron's tp specs. (GQA composes — round 5.)
+        if self.num_experts and self.tp_size > 1:
             raise ValueError(
-                "StageBlocks: MoE blocks do not compose with tp or "
-                "GQA (tp_size="
-                f"{self.tp_size}, num_kv_heads={self.num_kv_heads})"
+                "StageBlocks: MoE blocks do not compose with tp "
+                f"(tp_size={self.tp_size})"
             )
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         moe_cls = (
@@ -167,6 +166,7 @@ class StageBlocks(nn.Module):
                     attention_fn=self.attention_fn,
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"block{i + 1}",
                 )(x)
             else:

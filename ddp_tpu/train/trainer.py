@@ -241,11 +241,6 @@ class Trainer:
                     f"--num_kv_heads {config.num_kv_heads} not "
                     f"divisible by --mesh_model {config.mesh_model}"
                 )
-            if config.moe_experts:
-                raise ValueError(
-                    "--num_kv_heads covers the dense blocks; it does "
-                    "not compose with --moe_experts"
-                )
         if self.pipe_mode and config.num_microbatches < 1:
             raise ValueError(
                 f"--num_microbatches must be >= 1, got "

@@ -226,16 +226,9 @@ def _generate_lm(args) -> None:
             f"checkpoint in {args.checkpoint_dir} is not a causal_lm "
             f"checkpoint (missing {e})"
         )
-    if any(
-        "moe" in params.get(k, {})
-        for k in params
-        if str(k).startswith("block")
-    ):
-        raise SystemExit(
-            "this checkpoint has MoE blocks; KV-cache generation "
-            "supports dense causal LMs only (train without "
-            "--moe_experts to decode)"
-        )
+    # MoE checkpoints decode too (round 5): generate.py routes each
+    # block by the presence of "moe" in its param tree, so no expert
+    # config needs recovering here.
     num_heads = args.num_heads
     if d_model % num_heads:
         raise SystemExit(

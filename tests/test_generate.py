@@ -266,9 +266,13 @@ def test_generate_is_jittable(params):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_predict_cli_generates_from_trained_checkpoint(tmp_path):
+@pytest.mark.parametrize("moe", [False, True], ids=["dense", "moe"])
+def test_predict_cli_generates_from_trained_checkpoint(tmp_path, moe):
     """Train a tiny causal LM via the Trainer, then decode with the
-    predict.py CLI (the VERDICT #5 'predict.py generates' contract)."""
+    predict.py CLI (the VERDICT #5 'predict.py generates' contract).
+    ``moe=True``: an MoE checkpoint decodes through the same CLI
+    (round 5 — generate.py routes blocks by their param tree; the
+    predict.py MoE rejection is gone)."""
     import json
     import os
     import subprocess
@@ -283,7 +287,8 @@ def test_predict_cli_generates_from_trained_checkpoint(tmp_path):
         model="causal_lm",
         vocab_size=32,
         seq_len=16,
-        model_depth=1,
+        model_depth=2 if moe else 1,
+        moe_experts=4 if moe else 0,
         checkpoint_dir=str(tmp_path / "ck"),
         data_root=str(tmp_path / "data"),
         synthetic_data=True,

@@ -127,3 +127,25 @@ def test_moe_rejected_outside_lm(tmp_path):
                 data_root=str(tmp_path / "data"),
             )
         )
+
+
+def test_moe_lm_decodes_through_kv_cache():
+    """Round 5: the MoE-LM serves — cached incremental decode equals
+    the dense full-sequence forward to fp32 tolerance (the no-drop
+    regime: generate.py _moe_mlp routes top-k per token without the
+    capacity mechanism, which matches training exactly while no token
+    overflows; fresh near-uniform routers at capacity_factor 2.0
+    never do)."""
+    from ddp_tpu.models.generate import cached_logits, generate
+    from ddp_tpu.models.lm import dense_lm_apply, init_lm
+
+    spec = SPEC._replace(total_len=24)
+    params = init_lm(spec, seed=0)
+    toks = _tokens(2, seed=5)[:, :12]
+    want = dense_lm_apply(spec, params, toks)
+    got = cached_logits(spec, params, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
+    out = generate(spec, params, toks[:, :4], max_new_tokens=3)
+    assert out.shape == (2, 7)
