@@ -80,9 +80,14 @@ class PipeLMConfig(NamedTuple):
     # composes with the stage TP when tp_size divides num_kv_heads.
     num_kv_heads: int = 0
     # MoE: every moe_every-th block's MLP is GShard top-k routed
-    # (models/moe.py). depth_per_stage % moe_every == 0 keeps the
-    # per-stage pattern equal to the seq-family CausalLM's global
-    # pattern. The
+    # (models/moe.py). Any moe_every dividing depth_per_stage works
+    # (1 = fully-routed; odd depths with k | D included): the global
+    # every-k pattern is then chunk-periodic, which stacked SPMD
+    # stages REQUIRE — one shard_map trace consumes one stacked param
+    # tree, so every chunk must share its routed-block positions; a
+    # flat model whose k does not divide D (per-chunk heterogeneous
+    # structure) is inexpressible here and belongs to the seq-family
+    # CausalLM. The
     # load-balance aux loss is NOT collected on the pipe path (the
     # kernels apply stages purely); routing + capacity dropping still
     # train. NOTE on routing semantics: GShard capacity/slot
@@ -371,6 +376,7 @@ def make_pipe_lm_train_step(
     *,
     compute_dtype=jnp.float32,
     donate: bool = True,
+    jit: bool = True,
 ):
     """GPipe (AD-derived backward) train step over dp×pp[×fsdp×tp].
 
@@ -399,6 +405,8 @@ def make_pipe_lm_train_step(
             tokens.shape, lead=1,
         )
 
+    if not jit:
+        return step  # raw: the compiled-epoch runner scans it
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -453,6 +461,7 @@ def _make_handsched_lm_step(
     lead: int,
     compute_dtype,
     donate: bool,
+    jit: bool = True,
 ):
     """Shared 1F1B/interleaved step: hand-scheduled backward, loss
     inside the last stage, tied-embed grads summed across both ends."""
@@ -535,6 +544,8 @@ def _make_handsched_lm_step(
             tokens.shape, lead=lead,
         )
 
+    if not jit:
+        return step  # raw: the compiled-epoch runner scans it
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -545,6 +556,7 @@ def make_pipe_lm_1f1b_train_step(
     *,
     compute_dtype=jnp.float32,
     donate: bool = True,
+    jit: bool = True,
 ):
     """1F1B: O(S) activation stash, loss inside stage S−1."""
     from ddp_tpu.parallel.one_f1b import schedule_1f1b, spmd_pipeline_1f1b
@@ -553,7 +565,7 @@ def make_pipe_lm_1f1b_train_step(
     return _make_handsched_lm_step(
         cfg, optimizer, mesh, spmd_pipeline_1f1b,
         schedule_1f1b(S, cfg.num_microbatches),
-        lead=1, compute_dtype=compute_dtype, donate=donate,
+        lead=1, compute_dtype=compute_dtype, donate=donate, jit=jit,
     )
 
 
@@ -564,6 +576,7 @@ def make_pipe_lm_interleaved_train_step(
     *,
     compute_dtype=jnp.float32,
     donate: bool = True,
+    jit: bool = True,
 ):
     """Interleaved-1F1B: v chunks per device, bubble (S−1)/(vM+S−1)."""
     from ddp_tpu.parallel.interleaved import (
@@ -581,7 +594,7 @@ def make_pipe_lm_interleaved_train_step(
     )
     return _make_handsched_lm_step(
         cfg, optimizer, mesh, spmd_pipeline_interleaved, sched,
-        lead=2, compute_dtype=compute_dtype, donate=donate,
+        lead=2, compute_dtype=compute_dtype, donate=donate, jit=jit,
     )
 
 

@@ -386,6 +386,7 @@ def make_pipe_vit_train_step(
     donate: bool = True,
     augment_fn=None,
     seed: int = 0,
+    jit: bool = True,
 ):
     """``step(state, images, labels) -> (state, metrics)`` over dp×pp.
 
@@ -439,6 +440,8 @@ def make_pipe_vit_train_step(
             StepMetrics(loss=loss, accuracy=correct),
         )
 
+    if not jit:
+        return step  # raw: the compiled-epoch runner scans it
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -452,6 +455,7 @@ def make_pipe_vit_1f1b_train_step(
     donate: bool = True,
     augment_fn=None,
     seed: int = 0,
+    jit: bool = True,
 ):
     """``step(state, images, labels)`` under the 1F1B schedule.
 
@@ -473,7 +477,7 @@ def make_pipe_vit_1f1b_train_step(
         cfg, optimizer, mesh, spmd_pipeline_1f1b, schedule_1f1b(S, M),
         lead=1, compute_dtype=compute_dtype,
         label_smoothing=label_smoothing, donate=donate,
-        augment_fn=augment_fn, seed=seed,
+        augment_fn=augment_fn, seed=seed, jit=jit,
     )
 
 
@@ -490,6 +494,7 @@ def _make_handsched_step(
     donate: bool,
     augment_fn=None,
     seed: int = 0,
+    jit: bool = True,
 ):
     """Shared machinery of the hand-scheduled (no-jax.grad) pipe steps.
 
@@ -608,6 +613,8 @@ def _make_handsched_step(
             StepMetrics(loss=loss_sum / B, accuracy=correct / B),
         )
 
+    if not jit:
+        return step  # raw: the compiled-epoch runner scans it
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
@@ -621,6 +628,7 @@ def make_pipe_vit_interleaved_train_step(
     donate: bool = True,
     augment_fn=None,
     seed: int = 0,
+    jit: bool = True,
 ):
     """``step(state, images, labels)`` under the interleaved-1F1B
     schedule (v = cfg.virtual_stages model chunks per device).
@@ -649,7 +657,7 @@ def make_pipe_vit_interleaved_train_step(
         cfg, optimizer, mesh, spmd_pipeline_interleaved, sched,
         lead=2, compute_dtype=compute_dtype,
         label_smoothing=label_smoothing, donate=donate,
-        augment_fn=augment_fn, seed=seed,
+        augment_fn=augment_fn, seed=seed, jit=jit,
     )
 
 

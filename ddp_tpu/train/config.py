@@ -93,9 +93,14 @@ class TrainConfig:
     seq_dim: int = 16  # input feature channels per token
     seq_strategy: str = "ring"  # ring | ulysses
     vocab_size: int = 256  # causal_lm token vocabulary
-    # >0: causal_lm routes every 2nd block's MLP through this many
-    # experts (GShard top-k, replicated experts, per-shard routing).
+    # >0: causal_lm/pipe_lm route every --moe_every-th block's MLP
+    # through this many experts (GShard top-k routing).
     moe_experts: int = 0
+    # Which blocks route: block i (1-based) hosts experts iff
+    # i % moe_every == 0. 1 = every block (fully-routed). The pipe
+    # family needs moe_every to divide --model_depth (stages must be
+    # structure-uniform for parameter stacking — models/pipeline_lm.py).
+    moe_every: int = 2
     # Real LM data: a file read as raw bytes (--dataset text),
     # chunked into seq_len sequences (data/text.py). No tokenizer dep.
     text_file: str | None = None
@@ -214,6 +219,10 @@ class TrainConfig:
         )
         p.add_argument("--vocab_size", type=int, default=cls.vocab_size)
         p.add_argument("--moe_experts", type=int, default=cls.moe_experts)
+        p.add_argument(
+            "--moe_every", type=int, default=cls.moe_every,
+            help="route every k-th block's MLP (1 = all blocks)",
+        )
         p.add_argument(
             "--text_file", default=cls.text_file,
             help="byte-level corpus for --dataset text (causal_lm)",

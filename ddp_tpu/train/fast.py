@@ -140,6 +140,50 @@ def make_epoch_runner(
     return run
 
 
+def _global_scan_runner(
+    raw_step, arrays, global_batch_size: int, *, seed: int, donate: bool,
+    what: str = "examples",
+):
+    """The permute-slice-scan epoch skeleton shared by every
+    GLOBAL-level runner (LM, pipe-LM, pipe-ViT — the steps own their
+    sharding internally, so the scan wraps them on global arrays; the
+    image-DDP runner scans per-device inside its own shard_map and
+    stays separate). One definition so the sampler keying
+    (seed+epoch), tail-drop, and donation semantics cannot drift
+    between the fast/step parity guarantees of different families."""
+    n = arrays[0].shape[0]
+    steps = n // global_batch_size
+    if steps == 0:
+        raise ValueError(
+            f"dataset of {n} {what} yields zero batches of "
+            f"{global_batch_size}"
+        )
+
+    def epoch_fn(state, epoch, *arrs):
+        perm = jax.random.permutation(jax.random.key(seed + epoch), n)
+
+        def body(state, t):
+            idx = lax.dynamic_slice(
+                perm, (t * global_batch_size,), (global_batch_size,)
+            )
+            return raw_step(
+                state, *(jnp.take(a, idx, axis=0) for a in arrs)
+            )
+
+        return lax.scan(body, state, jnp.arange(steps))
+
+    jitted = jax.jit(
+        lambda state, epoch: epoch_fn(state, epoch, *arrays),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def run(state, epoch):
+        return jitted(state, jnp.asarray(epoch, jnp.int32))
+
+    run.steps_per_epoch = steps  # type: ignore[attr-defined]
+    return run
+
+
 def make_lm_epoch_runner(
     spec,
     optimizer,
@@ -170,40 +214,102 @@ def make_lm_epoch_runner(
     """
     from ddp_tpu.models.lm import make_lm_train_step
 
-    n = tokens.shape[0]
-    steps = n // global_batch_size
-    if steps == 0:
-        raise ValueError(
-            f"dataset of {n} sequences yields zero batches of "
-            f"{global_batch_size}"
-        )
     raw_step = make_lm_train_step(
         spec, optimizer, mesh, donate=False, compute_dtype=compute_dtype,
         grad_accum_steps=grad_accum_steps, label_smoothing=label_smoothing,
         jit=False,
     )
-
-    def epoch_fn(state, epoch, toks):
-        perm = jax.random.permutation(jax.random.key(seed + epoch), n)
-
-        def body(state, t):
-            idx = lax.dynamic_slice(
-                perm, (t * global_batch_size,), (global_batch_size,)
-            )
-            return raw_step(state, jnp.take(toks, idx, axis=0))
-
-        return lax.scan(body, state, jnp.arange(steps))
-
-    jitted = jax.jit(
-        lambda state, epoch: epoch_fn(state, epoch, tokens),
-        donate_argnums=(0,) if donate else (),
+    return _global_scan_runner(
+        raw_step, (tokens,), global_batch_size, seed=seed, donate=donate,
+        what="sequences",
     )
 
-    def run(state, epoch):
-        return jitted(state, jnp.asarray(epoch, jnp.int32))
 
-    run.steps_per_epoch = steps  # type: ignore[attr-defined]
-    return run
+def make_pipe_lm_epoch_runner(
+    cfg,
+    optimizer,
+    mesh: Mesh,
+    tokens: jax.Array,
+    global_batch_size: int,
+    *,
+    schedule: str = "gpipe",
+    compute_dtype=jnp.float32,
+    seed: int = 0,
+    donate: bool = True,
+):
+    """Compiled-epoch fast path for the pipelined LM (round-5 ask #5).
+
+    Identical shape to ``make_lm_epoch_runner``: token dataset
+    device-resident, seed+epoch-keyed permutation on device, one
+    ``lax.scan`` over the raw (unjitted) pipe step — GPipe, 1F1B, or
+    interleaved per ``schedule``. The pipe step owns its sharding
+    story (shard_map over pipe/data/fsdp/model/expert inside), so the
+    scan wraps it at the global level. Runs on ``PipeLMState``; the
+    trainer converts at the boundary like its per-step wrapper does.
+    Loss-identical to the step loop (tests/test_trainer_fast.py).
+    """
+    from ddp_tpu.models.pipeline_lm import (
+        make_pipe_lm_1f1b_train_step,
+        make_pipe_lm_interleaved_train_step,
+        make_pipe_lm_train_step,
+    )
+
+    make_step = {
+        "1f1b": make_pipe_lm_1f1b_train_step,
+        "interleaved": make_pipe_lm_interleaved_train_step,
+    }.get(schedule, make_pipe_lm_train_step)
+    raw_step = make_step(
+        cfg, optimizer, mesh, donate=False, compute_dtype=compute_dtype,
+        jit=False,
+    )
+    return _global_scan_runner(
+        raw_step, (tokens,), global_batch_size, seed=seed, donate=donate,
+        what="sequences",
+    )
+
+
+def make_pipe_vit_epoch_runner(
+    cfg,
+    optimizer,
+    mesh: Mesh,
+    images: jax.Array,
+    labels: jax.Array,
+    global_batch_size: int,
+    *,
+    schedule: str = "gpipe",
+    compute_dtype=jnp.float32,
+    seed: int = 0,
+    donate: bool = True,
+    augment_fn=None,
+    label_smoothing: float = 0.0,
+):
+    """Compiled-epoch fast path for the pipelined ViT — the image
+    sibling of ``make_pipe_lm_epoch_runner`` (same global-level scan;
+    augment/label smoothing ride inside the pipe step, which already
+    applies them to the global batch before microbatching). NOTE for
+    CPU runs: the patch-embed conv inside a ``lax.scan`` hits the
+    XLA:CPU scan-conv pathology (~200× slower than the standalone
+    step, measured round 4) — this path is for TPU benches; tests pin
+    correctness on tiny step counts only."""
+    from ddp_tpu.models.pipeline_vit import (
+        make_pipe_vit_1f1b_train_step,
+        make_pipe_vit_interleaved_train_step,
+        make_pipe_vit_train_step,
+    )
+
+    make_step = {
+        "1f1b": make_pipe_vit_1f1b_train_step,
+        "interleaved": make_pipe_vit_interleaved_train_step,
+    }.get(schedule, make_pipe_vit_train_step)
+    raw_step = make_step(
+        cfg, optimizer, mesh, donate=False, compute_dtype=compute_dtype,
+        label_smoothing=label_smoothing, augment_fn=augment_fn,
+        seed=seed, jit=False,
+    )
+    return _global_scan_runner(
+        raw_step, (images, labels), global_batch_size, seed=seed,
+        donate=donate,
+    )
 
 
 def _linear_shard_index(axes) -> jax.Array:

@@ -139,16 +139,30 @@ class Trainer:
                 "--model causal_lm or pipe_lm (images have "
                 "--model vit_moe_tiny)"
             )
+        if config.moe_experts and config.moe_every < 1:
+            raise ValueError(
+                f"--moe_every must be >= 1, got {config.moe_every}"
+            )
         if (
             config.moe_experts
             and config.model == "pipe_lm"
-            and (config.model_depth or 1) % 2
+            and (config.model_depth or 1) % config.moe_every
         ):
+            # One stacked stage tree feeds one shard_map trace, so
+            # every chunk must have the SAME routed-block positions;
+            # the global every-k pattern is chunk-periodic iff k
+            # divides the per-stage depth. Flat models with k not
+            # dividing D (e.g. depth 6 = 2 stages x 3, moe_every 2)
+            # need per-chunk param-tree structures, which stacked
+            # SPMD stages cannot express — use --model causal_lm for
+            # those, or pick k | model_depth (any k, including odd
+            # depths: --model_depth 3 --moe_every 3, or 1).
             raise ValueError(
-                "the pipelined MoE-LM interleaves a routed block every "
-                "2nd layer and stages must be structure-uniform: set "
-                f"--model_depth to a multiple of 2 (got "
-                f"{config.model_depth or 1})"
+                "the pipelined MoE-LM needs --moe_every "
+                f"({config.moe_every}) to divide --model_depth "
+                f"({config.model_depth or 1}): stages must be "
+                "structure-uniform for parameter stacking (the flat "
+                "--model causal_lm expresses any pattern)"
             )
         self.seq_mode = config.model == "long_context" or self.lm_mode
         if config.mesh_seq > 1 and not self.seq_mode:
@@ -178,7 +192,6 @@ class Trainer:
             or config.mesh_seq > 1
             or config.zero1
             or config.grad_accum_steps > 1
-            or config.fast_epoch
             # augment is image-family: the pipelined ViT takes it
             # (applied to the global batch before microbatching);
             # token data has nothing to crop.
@@ -193,14 +206,11 @@ class Trainer:
                 "PP×TP)"
                 + (", expert (--mesh_expert, PP×EP)"
                    if self.pipe_lm_mode else ", augment")
-                + ", bf16, remat, label smoothing, EMA and LR schedules "
-                "— not "
+                + ", --fast_epoch, bf16, remat, label smoothing, EMA "
+                "and LR schedules — not "
                 + ("" if self.pipe_lm_mode else "expert/")
-                + "seq/zero1, accumulation (use "
-                "--num_microbatches), "
-                + ("--fast_epoch, or augment"
-                   if self.pipe_lm_mode
-                   else "or --fast_epoch")
+                + "seq/zero1, accumulation (use --num_microbatches)"
+                + (", or augment" if self.pipe_lm_mode else "")
             )
         if self.pipe_mode and config.mesh_model > 1:
             _check_tp_dims(config)
@@ -373,6 +383,7 @@ class Trainer:
                     strategy=config.seq_strategy,
                     remat=config.remat,
                     num_experts=config.moe_experts,
+                    moe_every=config.moe_every,
                     num_kv_heads=config.num_kv_heads,
                 )
             else:
@@ -648,16 +659,18 @@ class Trainer:
                 tp_size=config.mesh_model,
                 num_kv_heads=config.num_kv_heads,
                 num_experts=config.moe_experts,
+                moe_every=config.moe_every,
                 ep_size=config.mesh_expert,
             )
             if config.moe_experts:
                 logger.info(
-                    "Pipelined MoE: %d experts every 2nd block; the "
+                    "Pipelined MoE: %d experts every %d-th block; the "
                     "GShard load-balance aux loss is not collected on "
                     "the pipe path (routing + capacity dropping still "
                     "apply) — use --model causal_lm for the full aux "
                     "objective",
                     config.moe_experts,
+                    config.moe_every,
                 )
             logger.info(
                 "Pipeline LM: %d stages × %d virtual × %d blocks, %d "
@@ -885,12 +898,13 @@ class Trainer:
             self.state = replicate_state(state, self.mesh)
         self.fast_runner = None
         if config.fast_epoch:
-            if not self.lm_mode and (
+            if not (self.lm_mode or self.pipe_mode) and (
                 self.use_spmd or config.grad_accum_steps > 1
             ):
                 raise ValueError(
                     "--fast_epoch supports the pure-DDP step without "
-                    "gradient accumulation (or the causal LM family)"
+                    "gradient accumulation (or the causal LM / "
+                    "pipeline families)"
                 )
             if not config.shuffle:
                 raise ValueError(
@@ -909,9 +923,46 @@ class Trainer:
                 device_put_replicated,
                 make_epoch_runner,
                 make_lm_epoch_runner,
+                make_pipe_lm_epoch_runner,
+                make_pipe_vit_epoch_runner,
             )
 
-            if self.lm_mode:
+            if self.pipe_lm_mode:
+                # Round-5 wall lift: the pipelined LM rides the
+                # compiled-epoch dispatch like the flat LM — the raw
+                # pipe step (any schedule) scanned on device.
+                from ddp_tpu.models.pipeline_lm import PipeLMState
+
+                dev_tokens = device_put_replicated(
+                    train_split.images, self.mesh  # tokens ride .images
+                )
+                runner = make_pipe_lm_epoch_runner(
+                    self.pipe_cfg, self.optimizer, self.mesh,
+                    dev_tokens, self.global_batch_size,
+                    schedule=config.pipe_schedule,
+                    compute_dtype=compute_dtype, seed=config.seed,
+                )
+                self.fast_runner = self._wrap_pipe_runner(
+                    runner, PipeLMState
+                )
+            elif self.pipe_mode:
+                from ddp_tpu.models.pipeline_vit import PipeViTState
+
+                dev_images, dev_labels = device_put_dataset(
+                    train_split.images, train_split.labels, self.mesh
+                )
+                runner = make_pipe_vit_epoch_runner(
+                    self.pipe_cfg, self.optimizer, self.mesh,
+                    dev_images, dev_labels, self.global_batch_size,
+                    schedule=config.pipe_schedule,
+                    compute_dtype=compute_dtype, seed=config.seed,
+                    augment_fn=augment_fn,
+                    label_smoothing=config.label_smoothing,
+                )
+                self.fast_runner = self._wrap_pipe_runner(
+                    runner, PipeViTState
+                )
+            elif self.lm_mode:
                 dev_tokens = device_put_replicated(
                     train_split.images, self.mesh  # tokens ride .images
                 )
@@ -964,6 +1015,27 @@ class Trainer:
         self.history: list[EpochStats] = []
 
     # ---- the reference's epoch/batch loop (train_ddp.py:192-209) ----
+
+    @staticmethod
+    def _wrap_pipe_runner(runner, state_cls):
+        """Adapt a pipe-family epoch runner (PipeLMState/PipeViTState)
+        to the trainer's TrainState — the same conversion the per-step
+        wrappers do; NamedTuple construction shares buffers, so
+        donation still applies."""
+
+        def wrapped(ts, epoch):
+            ps, metrics = runner(
+                state_cls(ts.step, ts.params, ts.opt_state), epoch
+            )
+            return (
+                ts._replace(
+                    step=ps.step, params=ps.params, opt_state=ps.opt_state
+                ),
+                metrics,
+            )
+
+        wrapped.steps_per_epoch = runner.steps_per_epoch
+        return wrapped
 
     def _check_pipe_batch(self, config: TrainConfig) -> None:
         """Microbatch divisibility guards shared by both pipe families."""

@@ -117,3 +117,91 @@ def test_lm_fast_epoch_composes_with_fsdp(tmp_path):
     summary = t.train()
     t.close()
     assert np.isfinite(summary["final_loss"])
+
+
+def _pipe_config(tmp_path, tag, **kw):
+    defaults = dict(
+        epochs=2,
+        batch_size=4,
+        model="pipe_lm",
+        mesh_pipe=2,
+        num_microbatches=4,
+        num_devices=4,
+        seq_len=16,
+        vocab_size=64,
+        model_dim=32,
+        num_heads=2,
+        optimizer="adam",
+        lr=1e-3,
+        checkpoint_dir=str(tmp_path / f"ck_{tag}"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        eval_every=1,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipe_lm_fast_epoch_loss_identical_to_step_loop(
+    tmp_path, schedule
+):
+    """Round-5 ask #5: --model pipe_lm --fast_epoch pinned
+    loss-identical to the per-step loop across schedules (same sampler
+    keying, same raw pipe step scanned on device —
+    train/fast.py make_pipe_lm_epoch_runner)."""
+    results = {}
+    for tag, fast in (("fast", True), ("step", False)):
+        t = Trainer(
+            _pipe_config(
+                tmp_path, f"{schedule}_{tag}", fast_epoch=fast,
+                pipe_schedule=schedule,
+            )
+        )
+        if fast:
+            assert t.fast_runner is not None
+            assert t.fast_runner.steps_per_epoch == 64 // (4 * 2)
+        summary = t.train()
+        t.close()
+        results[tag] = summary
+    assert results["fast"]["final_loss"] == pytest.approx(
+        results["step"]["final_loss"], abs=1e-6
+    )
+    for h_fast, h_step in zip(
+        results["fast"]["history"], results["step"]["history"]
+    ):
+        assert h_fast["mean_loss"] == pytest.approx(
+            h_step["mean_loss"], abs=1e-6
+        )
+
+
+def test_pipe_vit_fast_epoch_trains(tmp_path):
+    """The pipelined ViT rides the compiled epoch too (tiny step count
+    — the scanned conv is an XLA:CPU tarpit, so correctness only; the
+    fast path's win is a TPU measurement)."""
+    t = Trainer(
+        _pipe_config(
+            tmp_path, "vit", model="pipe_vit", model_dim=32,
+            num_heads=4, epochs=1, fast_epoch=True,
+        )
+    )
+    assert t.fast_runner is not None
+    summary = t.train()
+    t.close()
+    assert np.isfinite(summary["final_loss"])
+
+
+def test_pipe_fast_epoch_composes_with_fsdp_and_ep(tmp_path):
+    """PP×FSDP×EP under the scanned epoch: the full round-5 sharding
+    story rides the compiled-epoch dispatch."""
+    t = Trainer(
+        _pipe_config(
+            tmp_path, "ppep", mesh_fsdp=2, mesh_expert=2,
+            num_devices=8, moe_experts=4, model_depth=2, epochs=1,
+            fast_epoch=True,
+        )
+    )
+    summary = t.train()
+    t.close()
+    assert np.isfinite(summary["final_loss"])
