@@ -109,6 +109,18 @@ class PipeLMConfig(NamedTuple):
     # within the stage's pipeline island — the flat EP family's
     # exchange (models/moe.py, tests/test_ep_lm.py) riding per stage.
     ep_size: int = 1
+    # Sequence/context parallelism over the ``seq`` mesh axis (PP×SP,
+    # round 5): each microbatch's tokens shard over ``seq`` (the
+    # stream spec gains a trailing seq dim), the stage blocks run
+    # ring/Ulysses attention (parallel/ring.py — the lax.ppermute hops
+    # ride INSIDE the schedule kernels exactly like the TP psums and
+    # EP all-to-alls), stage 0 offsets its position embedding by the
+    # shard index, and stage S−1 computes the next-token loss on its
+    # LOCAL logits against the full (seq-replicated) token stream —
+    # the shift crosses shard boundaries by slicing, never by
+    # collective. ``seq`` reduces param grads like a batch axis.
+    sp_size: int = 1
+    sp_strategy: str = "ring"  # ring | ulysses
 
 
 class PipeLMParams(NamedTuple):
@@ -126,13 +138,34 @@ class PipeLMState(NamedTuple):
 _LN = nn.LayerNorm(dtype=jnp.float32)  # the final LN (root module: no name)
 
 
-def _attn(cfg: PipeLMConfig):
+def _attn(cfg: PipeLMConfig, *, sp: bool = False):
+    """``sp=True`` (inside the pipeline island only): token-sharded
+    attention over ``seq`` — ring or Ulysses per cfg.sp_strategy. The
+    GLOBAL modules (init, sequential/eval) always take the dense
+    path; shapes are identical either way."""
+    if sp:
+        if cfg.attention_fn is not None:
+            raise ValueError(
+                "attention_fn is not supported with sp_size > 1: the "
+                "pipeline island must run the token-sharded "
+                "ring/Ulysses exchange (a custom fn would silently "
+                "diverge from the dense init/eval forward)"
+            )
+        from ddp_tpu.parallel.ring import sequence_sharded_attention
+
+        def attn(q, k, v):
+            return sequence_sharded_attention(
+                q, k, v, axis_name="seq", strategy=cfg.sp_strategy,
+                causal=True,
+            )
+
+        return attn
     return cfg.attention_fn or best_attention(causal=True)
 
 
 def _stage_module(
     cfg: PipeLMConfig, *, tp: bool = False, inner_vjp: bool = False,
-    ep: bool = False
+    ep: bool = False, sp: bool = False
 ):
     """The stage body. ``tp=False``/``ep=False`` builds the
     GLOBAL-shape module (init, sequential/eval forward); ``tp=True``
@@ -162,11 +195,16 @@ def _stage_module(
             )
     elif cfg.ep_size > 1:
         raise ValueError("ep_size > 1 needs num_experts > 0")
+    if cfg.sp_size > 1 and cfg.seq_len % cfg.sp_size:
+        raise ValueError(
+            f"seq_len {cfg.seq_len} not divisible by sp_size "
+            f"{cfg.sp_size}"
+        )
     return StageBlocks(
         depth=cfg.depth_per_stage,
         num_heads=cfg.num_heads,
         mlp_dim=cfg.d_model * cfg.mlp_ratio,
-        attention_fn=_attn(cfg),
+        attention_fn=_attn(cfg, sp=sp),
         remat=cfg.remat,
         tp_axis="model" if tp else None,
         tp_size=cfg.tp_size if tp else 1,
@@ -183,6 +221,24 @@ def _first_fn(fp, tokens):
     """Token + position embedding — runs inside stage 0."""
     x = fp["embed"][tokens]  # [mb, T, d]
     return x + fp["pos_embed"][:, : x.shape[1]].astype(x.dtype)
+
+
+def _make_first_fn(cfg: PipeLMConfig):
+    """Pipeline-island first_fn: under SP each member embeds its
+    LOCAL token shard and slices the position table at its offset."""
+    if cfg.sp_size <= 1:
+        return _first_fn
+
+    def first_fn(fp, tokens):
+        x = fp["embed"][tokens]  # [mb, T_local, d]
+        t_local = x.shape[1]
+        off = lax.axis_index("seq") * t_local
+        pos = lax.dynamic_slice_in_dim(
+            fp["pos_embed"].astype(x.dtype), off, t_local, axis=1
+        )
+        return x + pos
+
+    return first_fn
 
 
 def _make_last_fn(cfg: PipeLMConfig):
@@ -255,28 +311,58 @@ def sequential_apply(cfg: PipeLMConfig, params: PipeLMParams, tokens):
 
 def _loss_fn_factory(cfg: PipeLMConfig):
     """Per-microbatch next-token loss SUM + correct count, computed
-    inside the last stage (hand-scheduled paths)."""
+    inside the last stage (hand-scheduled paths).
 
-    def loss_fn(logits, tok_mb):
-        logits32 = logits[:, :-1].astype(jnp.float32)
-        targets = tok_mb[:, 1:]
+    Under SP (cfg.sp_size > 1) the logits are this member's token
+    shard while ``tok_mb`` is the full sequence, so the label shift
+    crosses shard boundaries by SLICING tok_mb at the shard's offset;
+    the final global position (no target) is masked out — summing the
+    masked local losses over ``seq`` equals the dense ``[:, :-1]``
+    reduction exactly."""
+
+    def _per_tok(logits32, targets):
         if cfg.label_smoothing:
             eps = cfg.label_smoothing
             logp = jax.nn.log_softmax(logits32, axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-            per_tok = (1.0 - eps) * nll - (
-                eps / logits.shape[-1]
+            return (1.0 - eps) * nll - (
+                eps / logits32.shape[-1]
             ) * logp.sum(-1)
-        else:
-            per_tok = optax.softmax_cross_entropy_with_integer_labels(
-                logits32, targets
-            )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits32, targets
+        )
+
+    def loss_fn(logits, tok_mb):
+        logits32 = logits[:, :-1].astype(jnp.float32)
+        targets = tok_mb[:, 1:]
+        per_tok = _per_tok(logits32, targets)
         correct = (
             (jnp.argmax(logits32, -1) == targets).sum().astype(jnp.float32)
         )
         return per_tok.sum(), correct
 
-    return loss_fn
+    if cfg.sp_size <= 1:
+        return loss_fn
+
+    def sp_loss_fn(logits, tok_mb):
+        t_local = logits.shape[1]
+        T = tok_mb.shape[1]
+        off = lax.axis_index("seq") * t_local
+        logits32 = logits.astype(jnp.float32)
+        # Target for local position p is token off+p+1; pad one dummy
+        # column so the slice stays in bounds on the last shard.
+        padded = jnp.pad(tok_mb, ((0, 0), (0, 1)))
+        targets = lax.dynamic_slice_in_dim(padded, off + 1, t_local, 1)
+        valid = ((off + jnp.arange(t_local)) < T - 1).astype(jnp.float32)
+        per_tok = _per_tok(logits32, targets) * valid
+        correct = (
+            ((jnp.argmax(logits32, -1) == targets) * valid)
+            .sum()
+            .astype(jnp.float32)
+        )
+        return per_tok.sum(), correct
+
+    return sp_loss_fn
 
 
 def _split_microbatches(cfg: PipeLMConfig, mesh: Mesh, tokens):
@@ -293,11 +379,32 @@ def _split_microbatches(cfg: PipeLMConfig, mesh: Mesh, tokens):
     return mbs, lbl_mb
 
 
+def _check_sp_mesh(cfg: PipeLMConfig, mesh: Mesh):
+    """cfg.sp_size and the mesh ``seq`` axis must agree: _specs and
+    the grad reductions key off the MESH while attention/first_fn/loss
+    key off the CFG — a mismatch (e.g. seq=2 mesh with sp_size=1)
+    would shard tokens under dense per-shard attention and train
+    silently wrong under GPipe."""
+    mesh_sp = int(mesh.shape.get("seq", 1))
+    if cfg.sp_size != mesh_sp and not (cfg.sp_size <= 1 and mesh_sp <= 1):
+        raise ValueError(
+            f"cfg.sp_size {cfg.sp_size} != mesh seq axis {mesh_sp} — "
+            "set PipeLMConfig.sp_size to the mesh's seq size"
+        )
+
+
 def _specs(mesh: Mesh):
     baxes = pipe_batch_axes(mesh)
-    bspec = P(baxes) if baxes else P()
-    mbspec = P(None, "pipe", baxes) if baxes else P(None, "pipe")
-    lblspec = P(None, baxes) if baxes else P()
+    ba = baxes if baxes else None
+    sp = "seq" if mesh.shape.get("seq", 1) > 1 else None
+    # Tokens [B, T]: batch over the batch axes, tokens over ``seq``.
+    bspec = P(ba, sp)
+    # Stream [R, S, mb, T]: microbatch rows over the batch axes,
+    # tokens over ``seq``. Label stream [M, mb, T] keeps FULL
+    # sequences per member (the in-stage loss slices its shard's
+    # shifted targets out of it — pipe loss_fn).
+    mbspec = P(None, "pipe", ba, sp)
+    lblspec = P(None, ba)
     return baxes, bspec, mbspec, lblspec
 
 
@@ -320,7 +427,7 @@ def _tp_stage_fn(cfg: PipeLMConfig, mesh: Mesh, *, inner_vjp: bool = False):
     del mesh
     stage = _stage_module(
         cfg, tp=cfg.tp_size > 1, inner_vjp=cfg.tp_size > 1 and inner_vjp,
-        ep=cfg.ep_size > 1,
+        ep=cfg.ep_size > 1, sp=cfg.sp_size > 1,
     )
 
     def stage_fn(p, x):
@@ -331,7 +438,9 @@ def _tp_stage_fn(cfg: PipeLMConfig, mesh: Mesh, *, inner_vjp: bool = False):
 
 def make_pipe_lm_apply(cfg: PipeLMConfig, mesh: Mesh):
     """Jitted pipelined ``apply(params, tokens) -> logits`` (GPipe)."""
+    _check_sp_mesh(cfg, mesh)
     stage_fn = _tp_stage_fn(cfg, mesh)
+    first_fn = _make_first_fn(cfg)
     last_fn = _make_last_fn(cfg)
     baxes, bspec, mbspec, _ = _specs(mesh)
 
@@ -345,7 +454,7 @@ def make_pipe_lm_apply(cfg: PipeLMConfig, mesh: Mesh):
         pipelined = jax.shard_map(
             lambda sp, fp, lp, m: spmd_pipeline(
                 stage_fn, gather_stages(sp, sspecs), m, axis_name="pipe",
-                first_fn=_first_fn, first_params=fp,
+                first_fn=first_fn, first_params=fp,
                 last_fn=last_fn, last_params=lp,
             ),
             mesh=mesh,
@@ -465,18 +574,42 @@ def _make_handsched_lm_step(
 ):
     """Shared 1F1B/interleaved step: hand-scheduled backward, loss
     inside the last stage, tied-embed grads summed across both ends."""
+    if cfg.sp_size > 1 and cfg.sp_strategy == "ring":
+        # CONCRETE blocker, not a scope cut: lax.ppermute lowers to a
+        # group-LESS CollectivePermute naming every device in the
+        # assignment, and the hand-scheduled kernels run the stage
+        # body inside lax.switch branches that DIVERGE across pipe
+        # members (stage s does fwd while s' does bwd at the same
+        # tick) — so a ring hop issued inside a branch can never
+        # assemble its full participant set and the step deadlocks
+        # (reproduced: XLA CPU rendezvous timeout, two members at the
+        # fwd ring's CollectivePermute, two at the bwd's). AllReduce /
+        # AllToAll carry replica GROUPS that stay within one stage,
+        # which is why the TP psums, EP all-to-alls, and Ulysses
+        # compose with these schedules while ring cannot.
+        raise ValueError(
+            "ring attention does not compose with the hand-scheduled "
+            "pipeline schedules (1f1b/interleaved): its ppermute hops "
+            "have no replica groups, and the schedules' fwd/bwd "
+            "branches diverge across pipe stages — use "
+            "sp_strategy='ulysses' here, or the GPipe schedule "
+            "(unconditional stage body) for ring"
+        )
+    _check_sp_mesh(cfg, mesh)
     stage_fn = _tp_stage_fn(cfg, mesh, inner_vjp=True)
+    first_fn = _make_first_fn(cfg)
     last_fn = _make_last_fn(cfg)
     loss_fn = _loss_fn_factory(cfg)
     baxes, bspec, mbspec, lblspec = _specs(mesh)
     has_fsdp = mesh.shape.get("fsdp", 1) > 1
+    has_sp = mesh.shape.get("seq", 1) > 1
 
     def make_run(sspecs):
         def inner(sp, fp, lp, m, l):
             loss, correct, gs, gf, gl = pipeline_fn(
                 stage_fn, gather_stages(sp, sspecs), m, l, loss_fn,
                 sched, axis_name="pipe",
-                first_fn=_first_fn, first_params=fp,
+                first_fn=first_fn, first_params=fp,
                 last_fn=last_fn, last_params=lp,
             )
             if baxes:
@@ -484,6 +617,15 @@ def _make_handsched_lm_step(
                 correct = lax.psum(correct, baxes)
                 gf = jax.tree.map(lambda g: lax.psum(g, baxes), gf)
                 gl = jax.tree.map(lambda g: lax.psum(g, baxes), gl)
+            if has_sp:
+                # ``seq`` shards tokens, not params: every param grad
+                # sums over it like a batch axis (the ring collectives
+                # already routed the ACTIVATION grads between shards).
+                loss = lax.psum(loss, "seq")
+                correct = lax.psum(correct, "seq")
+                gf = jax.tree.map(lambda g: lax.psum(g, "seq"), gf)
+                gl = jax.tree.map(lambda g: lax.psum(g, "seq"), gl)
+                gs = jax.tree.map(lambda g: lax.psum(g, "seq"), gs)
             if "data" in baxes:
                 gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)
             if "expert" in baxes:

@@ -23,7 +23,11 @@ def pipe_batch_axes(mesh) -> tuple:
     batch axis exactly as in the flat EP family (runtime/mesh.py
     ``data_axes``): each expert-group member routes its own token
     shard and the all-to-all carries dispatched slots to the expert's
-    owner (PP×EP, round 5). ``seq`` still never composes with pipe."""
+    owner (PP×EP, round 5). ``seq`` composes with pipe too (PP×SP,
+    round 5) but shards TOKENS, not batch rows, so it is deliberately
+    not a batch axis here — models/pipeline_lm.py puts it on the
+    stream spec's trailing token dim and reduces param grads over it
+    explicitly."""
     return tuple(
         a for a in ("data", "fsdp", "expert") if mesh.shape.get(a, 1) > 1
     )

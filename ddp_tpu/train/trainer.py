@@ -165,10 +165,13 @@ class Trainer:
                 "--model causal_lm expresses any pattern)"
             )
         self.seq_mode = config.model == "long_context" or self.lm_mode
-        if config.mesh_seq > 1 and not self.seq_mode:
+        if config.mesh_seq > 1 and not (
+            self.seq_mode or config.model == "pipe_lm"
+        ):
             raise ValueError(
                 "--mesh_seq shards tokens, which only the sequence "
-                "models have: use --model long_context or causal_lm"
+                "models have: use --model long_context, causal_lm, or "
+                "pipe_lm (PP×SP)"
             )
         # Pipeline family: the whole model rides the pipe axis under
         # GPipe / 1F1B / interleaved — the ViT (models/pipeline_vit.py)
@@ -189,7 +192,7 @@ class Trainer:
             )
         if self.pipe_mode and (
             (config.mesh_expert > 1 and not self.pipe_lm_mode)
-            or config.mesh_seq > 1
+            or (config.mesh_seq > 1 and not self.pipe_lm_mode)
             or config.zero1
             or config.grad_accum_steps > 1
             # augment is image-family: the pipelined ViT takes it
@@ -204,12 +207,14 @@ class Trainer:
                 f"--model {config.model} composes with the data axis, "
                 "fsdp (ZeRO-sharded stage params), tp (--mesh_model, "
                 "PP×TP)"
-                + (", expert (--mesh_expert, PP×EP)"
+                + (", expert (--mesh_expert, PP×EP), seq "
+                   "(--mesh_seq, PP×SP — ulysses under 1f1b/"
+                   "interleaved, ring under gpipe)"
                    if self.pipe_lm_mode else ", augment")
                 + ", --fast_epoch, bf16, remat, label smoothing, EMA "
                 "and LR schedules — not "
-                + ("" if self.pipe_lm_mode else "expert/")
-                + "seq/zero1, accumulation (use --num_microbatches)"
+                + ("" if self.pipe_lm_mode else "expert/seq/")
+                + "zero1, accumulation (use --num_microbatches)"
                 + (", or augment" if self.pipe_lm_mode else "")
             )
         if self.pipe_mode and config.mesh_model > 1:
@@ -645,6 +650,34 @@ class Trainer:
 
             self._check_pipe_batch(config)
             interleaved = config.pipe_schedule == "interleaved"
+            if config.mesh_seq > 1:
+                if config.seq_len % config.mesh_seq:
+                    raise ValueError(
+                        f"--seq_len {config.seq_len} not divisible by "
+                        f"--mesh_seq {config.mesh_seq}"
+                    )
+                if (
+                    config.pipe_schedule != "gpipe"
+                    and config.seq_strategy == "ring"
+                ):
+                    raise ValueError(
+                        "PP×SP under the hand-scheduled schedules "
+                        "(1f1b/interleaved) needs --seq_strategy "
+                        "ulysses: ring's ppermute hops have no replica "
+                        "groups and the schedules' fwd/bwd branches "
+                        "diverge across pipe stages "
+                        "(models/pipeline_lm.py has the full story); "
+                        "ring works under --pipe_schedule gpipe"
+                    )
+                if (
+                    config.seq_strategy == "ulysses"
+                    and config.num_heads % config.mesh_seq
+                ):
+                    raise ValueError(
+                        "ulysses shards attention heads during the "
+                        f"exchange: --num_heads {config.num_heads} not "
+                        f"divisible by --mesh_seq {config.mesh_seq}"
+                    )
             self.pipe_cfg = PipeLMConfig(
                 vocab_size=config.vocab_size,
                 seq_len=config.seq_len,
@@ -661,6 +694,8 @@ class Trainer:
                 num_experts=config.moe_experts,
                 moe_every=config.moe_every,
                 ep_size=config.mesh_expert,
+                sp_size=config.mesh_seq,
+                sp_strategy=config.seq_strategy,
             )
             if config.moe_experts:
                 logger.info(

@@ -536,3 +536,113 @@ def test_trainer_moe_every_surface(tmp_path, devices):
         Trainer(
             TrainConfig(**{**kw, "moe_experts": 4, "moe_every": 0})
         )
+
+
+# ----------------------- PP×SP (round 5) -----------------------
+#
+# Long-context pipelined LM: each microbatch's tokens shard over the
+# ``seq`` mesh axis, the stage blocks run ring/Ulysses attention
+# inside the pipeline island, stage 0 offsets its position table per
+# shard, and stage S−1 computes the loss on LOCAL logits against the
+# seq-replicated token stream. Ring composes with GPipe only (its
+# ppermute hops have no replica groups and the hand-scheduled fwd/bwd
+# branches diverge across pipe stages — concrete blocker documented
+# in models/pipeline_lm.py); Ulysses (all_to_all: grouped) rides all
+# three schedules.
+
+
+@pytest.mark.parametrize(
+    "make_step,strategy,interleaved",
+    [
+        (make_pipe_lm_train_step, "ring", False),
+        (make_pipe_lm_train_step, "ulysses", False),
+        (make_pipe_lm_1f1b_train_step, "ulysses", False),
+        (make_pipe_lm_interleaved_train_step, "ulysses", True),
+    ],
+    ids=["gpipe-ring", "gpipe-ulysses", "1f1b-ulysses", "il-ulysses"],
+)
+def test_pp_sp_matches_pipe_only(devices, make_step, strategy, interleaved):
+    cfg0 = CFG._replace(
+        num_heads=4, virtual_stages=2 if interleaved else 1
+    )
+    toks = _tokens(8, seed=11)
+    tx = optax.sgd(0.1)
+
+    def run(mesh, cfg):
+        st = create_pipe_lm_state(
+            cfg, tx, mesh, seed=0, interleaved=interleaved
+        )
+        step = make_step(cfg, tx, mesh, donate=False)
+        losses = []
+        for _ in range(2):
+            st, m = step(st, toks)
+            losses.append(float(m.loss))
+        return np.array(losses)
+
+    ref = run(_mesh(devices[:2], pipe=2), cfg0)
+    got = run(
+        _mesh(devices[:4], pipe=2, seq=2),
+        cfg0._replace(sp_size=2, sp_strategy=strategy),
+    )
+    np.testing.assert_allclose(got, ref, atol=2e-6)
+
+
+def test_pp_sp_composes_with_dp_gqa(devices):
+    """PP×SP×DP with grouped-query attention — losses match the
+    pipe×dp run exactly (Ulysses exchange is numerically invisible)."""
+    cfg = CFG._replace(
+        num_heads=4, num_kv_heads=2, sp_size=2, sp_strategy="ulysses"
+    )
+    toks = _tokens(8, seed=13)
+    tx = optax.sgd(0.1)
+    st_ref = create_pipe_lm_state(
+        cfg._replace(sp_size=1), tx, _mesh(devices[:4], pipe=2, data=2),
+        seed=0,
+    )
+    _, m_ref = make_pipe_lm_1f1b_train_step(
+        cfg._replace(sp_size=1), tx, _mesh(devices[:4], pipe=2, data=2),
+        donate=False,
+    )(st_ref, toks)
+    mesh = _mesh(devices, pipe=2, seq=2, data=2)
+    st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+    _, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
+        st, toks
+    )
+    assert abs(float(m.loss) - float(m_ref.loss)) < 2e-6
+
+
+def test_pp_sp_ring_rejected_on_handsched_and_trainer_guards(
+    tmp_path, devices
+):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="replica groups"):
+        make_pipe_lm_1f1b_train_step(
+            CFG._replace(num_heads=4, sp_size=2, sp_strategy="ring"),
+            optax.sgd(0.1),
+            _mesh(devices[:4], pipe=2, seq=2),
+            donate=False,
+        )
+    kw = dict(
+        model="pipe_lm", epochs=1, batch_size=4, mesh_pipe=2,
+        mesh_seq=2, num_microbatches=4, seq_len=16, vocab_size=64,
+        model_dim=32, num_heads=4, synthetic_data=True,
+        synthetic_size=64, checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"), num_devices=4,
+    )
+    with pytest.raises(ValueError, match="ulysses"):
+        Trainer(
+            TrainConfig(
+                **{**kw, "pipe_schedule": "1f1b", "seq_strategy": "ring"}
+            )
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(TrainConfig(**{**kw, "seq_len": 15}))
+    with pytest.raises(ValueError, match="heads"):
+        Trainer(
+            TrainConfig(
+                **{**kw, "num_heads": 3, "model_dim": 33,
+                   "seq_strategy": "ulysses"}
+            )
+        )
