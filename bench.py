@@ -449,7 +449,7 @@ def run_lm_long_bench(*, batch: int = 2, seq_len: int = 8192) -> dict:
 
 def run_decode_bench(
     *, batch: int = 8, prompt_len: int = 128, new_tokens: int = 256,
-    num_kv_heads: int = 0,
+    num_kv_heads: int = 0, num_experts: int = 0,
 ) -> dict:
     """Generation (serving-path) throughput: KV-cache greedy decode.
 
@@ -460,6 +460,9 @@ def run_decode_bench(
     training benches' throughput regime. ``num_kv_heads`` benches the
     GQA variant: the compact cache cuts per-step KV reads by the
     group factor (the ``decode_gqa`` entry records the effect).
+    ``num_experts`` benches the round-5 MoE serving path (generate.py
+    ``_moe_mlp``: dense E-way expert compute, top-k combine) — the
+    ``decode_moe`` entry records routed-decode cost vs dense.
     """
     import jax
     import jax.numpy as jnp
@@ -473,6 +476,7 @@ def run_decode_bench(
     spec = LMSpec(
         vocab_size=vocab, total_len=prompt_len + new_tokens, d_model=d,
         depth=depth, num_heads=heads, num_kv_heads=num_kv_heads,
+        num_experts=num_experts,
     )
     params = init_lm(spec, seed=0)
     prompt = jnp.zeros((batch, prompt_len), jnp.int32)
@@ -510,6 +514,7 @@ def run_decode_bench(
         "depth": depth,
         "num_heads": heads,
         "num_kv_heads": num_kv_heads or heads,
+        "num_experts": num_experts,
         "per_token_ms": round(best / new_tokens * 1000, 3),
         "device_kind": getattr(device, "device_kind", "unknown"),
     }
@@ -850,6 +855,10 @@ def _run_extra_benches() -> None:
         ("lm_long", run_lm_long_bench),
         ("decode", run_decode_bench),
         ("decode_gqa", lambda: run_decode_bench(num_kv_heads=2)),
+        # Round-5 MoE serving path: routed blocks through the same
+        # KV-cache decode scan (GQA×MoE — the Mixtral-class config).
+        ("decode_moe", lambda: run_decode_bench(
+            num_kv_heads=2, num_experts=8)),
         ("loader", run_loader_bench),
     ]:
         try:

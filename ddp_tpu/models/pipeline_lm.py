@@ -612,19 +612,17 @@ def _make_handsched_lm_step(
                 first_fn=first_fn, first_params=fp,
                 last_fn=last_fn, last_params=lp,
             )
-            if baxes:
-                loss = lax.psum(loss, baxes)
-                correct = lax.psum(correct, baxes)
-                gf = jax.tree.map(lambda g: lax.psum(g, baxes), gf)
-                gl = jax.tree.map(lambda g: lax.psum(g, baxes), gl)
+            # ``seq`` shards tokens, not params: every param grad
+            # sums over it like a batch axis (the in-stage collectives
+            # already routed the ACTIVATION grads between shards) —
+            # folded into ONE reduction with the batch axes.
+            raxes = tuple(baxes) + (("seq",) if has_sp else ())
+            if raxes:
+                loss = lax.psum(loss, raxes)
+                correct = lax.psum(correct, raxes)
+                gf = jax.tree.map(lambda g: lax.psum(g, raxes), gf)
+                gl = jax.tree.map(lambda g: lax.psum(g, raxes), gl)
             if has_sp:
-                # ``seq`` shards tokens, not params: every param grad
-                # sums over it like a batch axis (the ring collectives
-                # already routed the ACTIVATION grads between shards).
-                loss = lax.psum(loss, "seq")
-                correct = lax.psum(correct, "seq")
-                gf = jax.tree.map(lambda g: lax.psum(g, "seq"), gf)
-                gl = jax.tree.map(lambda g: lax.psum(g, "seq"), gl)
                 gs = jax.tree.map(lambda g: lax.psum(g, "seq"), gs)
             if "data" in baxes:
                 gs = jax.tree.map(lambda g: lax.psum(g, "data"), gs)

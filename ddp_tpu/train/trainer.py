@@ -669,15 +669,27 @@ class Trainer:
                         "(models/pipeline_lm.py has the full story); "
                         "ring works under --pipe_schedule gpipe"
                     )
-                if (
-                    config.seq_strategy == "ulysses"
-                    and config.num_heads % config.mesh_seq
-                ):
-                    raise ValueError(
-                        "ulysses shards attention heads during the "
-                        f"exchange: --num_heads {config.num_heads} not "
-                        f"divisible by --mesh_seq {config.mesh_seq}"
+                if config.seq_strategy == "ulysses":
+                    # Under PP×TP each model member holds
+                    # num_heads/mesh_model LOCAL heads — ulysses
+                    # shards those during its exchange (the
+                    # seq-family guard checks the same way).
+                    local_heads = config.num_heads // max(
+                        1, config.mesh_model
                     )
+                    if local_heads % config.mesh_seq:
+                        raise ValueError(
+                            "ulysses shards attention heads during "
+                            f"the exchange: {local_heads} local heads "
+                            f"(--num_heads {config.num_heads}"
+                            + (
+                                f" / --mesh_model {config.mesh_model}"
+                                if config.mesh_model > 1
+                                else ""
+                            )
+                            + f") not divisible by --mesh_seq "
+                            f"{config.mesh_seq}"
+                        )
             self.pipe_cfg = PipeLMConfig(
                 vocab_size=config.vocab_size,
                 seq_len=config.seq_len,

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Round-5 pipeline compositions on the pipelined causal LM
+# (models/pipeline_lm.py):
+#
+#   PP x EP — expert weights shard 1/ep INSIDE each stage's island;
+#   one lax.all_to_all per routed layer carries dispatched token slots
+#   to the expert's owner and back (the flat EP family's exchange,
+#   models/moe.py, riding per stage). Exact parity vs replicated
+#   experts under the same batch split.
+#
+#   PP x SP — each microbatch's tokens shard over `seq` inside the
+#   stages (long-context pipelined LM). Ulysses (all_to_all: grouped
+#   collectives) composes with all three schedules; ring attention is
+#   GPipe-only — its ppermute hops have no replica groups and the
+#   hand-scheduled fwd/bwd switch branches diverge across pipe stages.
+#
+# Runs offline on a CPU dev box via an 8-device emulated mesh; on real
+# chips drop --emulate_devices.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CK=$(mktemp -d)
+
+# PP x EP x DP: 2 stages x 2 expert shards x 2 data replicas, MoE MLPs
+# every 2nd block, GQA in the attention (the Mixtral-class config).
+python train.py --model pipe_lm \
+    --mesh_pipe 2 --mesh_expert 2 \
+    --moe_experts 4 --moe_every 2 --model_depth 2 \
+    --num_kv_heads 2 --num_heads 4 \
+    --pipe_schedule 1f1b --num_microbatches 4 \
+    --epochs 1 --batch_size 4 \
+    --seq_len 64 --vocab_size 128 --model_dim 64 \
+    --emulate_devices 8 \
+    --synthetic_data --synthetic_size 256 \
+    --checkpoint_dir "$CK/pp_ep" --data_root "$CK/data"
+
+# PP x SP x DP: tokens shard over seq inside each stage; Ulysses under
+# the hand-scheduled 1F1B schedule.
+python train.py --model pipe_lm \
+    --mesh_pipe 2 --mesh_seq 2 \
+    --seq_strategy ulysses --num_heads 4 \
+    --pipe_schedule 1f1b --num_microbatches 4 \
+    --epochs 1 --batch_size 4 \
+    --seq_len 64 --vocab_size 128 --model_dim 64 \
+    --emulate_devices 8 \
+    --synthetic_data --synthetic_size 256 \
+    --checkpoint_dir "$CK/pp_sp" --data_root "$CK/data"
+
+echo "PP x EP and PP x SP trained; checkpoints under $CK"

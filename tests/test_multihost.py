@@ -523,3 +523,76 @@ def test_spawn_fsdp_across_processes(tmp_path):
     assert results[0] == results[1]
     assert abs(results[0]["loss0"] - results[0]["dense"]) < 5e-5
     assert results[0]["loss1"] < results[0]["loss0"]
+
+
+# ------------------------------------------- cross-process pipeline (PP)
+
+
+def _pipe_lm_worker(rank, world, out_dir):
+    """The pipe axis spans PROCESSES: each rank hosts one stage, so
+    the microbatch-stream ppermute hops and the tied-embed/loss psums
+    cross a real process boundary (round-5 ask #8 — until now the
+    pipe family only ever ran on the in-process 8-device emulation).
+    Loss must equal the local sequential (non-pipelined) forward."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddp_tpu.models.lm import next_token_loss
+    from ddp_tpu.models.pipeline_lm import (
+        PipeLMConfig,
+        create_pipe_lm_state,
+        init_pipe_lm,
+        make_pipe_lm_1f1b_train_step,
+        sequential_apply,
+    )
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    assert jax.process_count() == world
+    mesh = make_mesh(MeshSpec(pipe=world))
+    cfg = PipeLMConfig(
+        vocab_size=32, seq_len=16, d_model=32, num_heads=4,
+        num_stages=world, depth_per_stage=1, num_microbatches=world,
+    )
+    tx = optax.sgd(0.1)
+    state = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+
+    toks_np = np.random.default_rng(7).integers(0, 32, (4, 16)).astype(
+        np.int32
+    )  # same seed on every rank → identically staged global batch
+    toks = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P()), toks_np
+    )
+    # Local dense reference (no pipeline, no collectives).
+    ref = float(
+        next_token_loss(
+            sequential_apply(
+                cfg, init_pipe_lm(cfg, seed=0), jnp.asarray(toks_np)
+            ),
+            jnp.asarray(toks_np),
+        )
+    )
+    step = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)
+    state, m0 = step(state, toks)
+    state, m1 = step(state, toks)
+    jax.block_until_ready(m1.loss)
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "loss0": float(m0.loss),
+                "loss1": float(m1.loss),
+                "ref": ref,
+            },
+            f,
+        )
+
+
+def test_spawn_pipeline_across_processes(tmp_path):
+    """2 spawned processes drive a 2-stage 1F1B pipelined LM one step;
+    loss parity vs the sequential forward and across ranks."""
+    spawn(_pipe_lm_worker, 2, (str(tmp_path),), timeout=420)
+    results = _read(tmp_path, 2)
+    assert results[0] == results[1]
+    assert abs(results[0]["loss0"] - results[0]["ref"]) < 5e-5
+    assert results[0]["loss1"] < results[0]["loss0"]
