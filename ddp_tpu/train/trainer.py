@@ -68,6 +68,20 @@ def _ctor_accepts(model_name: str, kwarg: str) -> bool:
     )
 
 
+
+def _check_ulysses_heads(num_heads: int, mesh_model: int, mesh_seq: int):
+    """Ulysses re-shards each model member's LOCAL heads
+    (num_heads/mesh_model) over ``seq`` — one definition for the seq
+    AND pipe families so the rule cannot drift; fail at construction,
+    not at first trace (parallel/ring.py)."""
+    local_heads = num_heads // max(1, mesh_model)
+    if local_heads % max(1, mesh_seq):
+        raise ValueError(
+            f"ulysses shards attention heads: {local_heads} heads per "
+            f"model shard ({num_heads} total / --mesh_model "
+            f"{mesh_model}) not divisible by --mesh_seq {mesh_seq}"
+        )
+
 def _check_tp_dims(config: TrainConfig) -> None:
     """Megatron TP divisibility rules, shared by the seq family and
     the whole pipe family (LM and ViT — one definition, none may
@@ -407,21 +421,10 @@ class Trainer:
                     remat=config.remat,
                 )
             if config.seq_strategy == "ulysses":
-                # Ulysses re-shards heads over seq — fail at
-                # construction, not at first trace (parallel/ring.py).
-                # Under TP each model member holds num_heads/mesh_model
-                # LOCAL heads, and it is those that Ulysses re-shards.
-                local_heads = self.seq_spec.num_heads // max(
-                    1, config.mesh_model
+                _check_ulysses_heads(
+                    self.seq_spec.num_heads, config.mesh_model,
+                    config.mesh_seq,
                 )
-                if local_heads % max(1, config.mesh_seq):
-                    raise ValueError(
-                        f"ulysses shards attention heads: "
-                        f"{local_heads} heads per model shard "
-                        f"({self.seq_spec.num_heads} total / "
-                        f"--mesh_model {config.mesh_model}) not "
-                        f"divisible by --mesh_seq {config.mesh_seq}"
-                    )
             self.model = None  # spec-driven; no registry module
         elif self.pipe_mode:
             # Spec built after the data split is known (patch size
@@ -670,26 +673,10 @@ class Trainer:
                         "ring works under --pipe_schedule gpipe"
                     )
                 if config.seq_strategy == "ulysses":
-                    # Under PP×TP each model member holds
-                    # num_heads/mesh_model LOCAL heads — ulysses
-                    # shards those during its exchange (the
-                    # seq-family guard checks the same way).
-                    local_heads = config.num_heads // max(
-                        1, config.mesh_model
+                    _check_ulysses_heads(
+                        config.num_heads, config.mesh_model,
+                        config.mesh_seq,
                     )
-                    if local_heads % config.mesh_seq:
-                        raise ValueError(
-                            "ulysses shards attention heads during "
-                            f"the exchange: {local_heads} local heads "
-                            f"(--num_heads {config.num_heads}"
-                            + (
-                                f" / --mesh_model {config.mesh_model}"
-                                if config.mesh_model > 1
-                                else ""
-                            )
-                            + f") not divisible by --mesh_seq "
-                            f"{config.mesh_seq}"
-                        )
             self.pipe_cfg = PipeLMConfig(
                 vocab_size=config.vocab_size,
                 seq_len=config.seq_len,

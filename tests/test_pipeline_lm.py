@@ -464,13 +464,18 @@ def test_pp_ep_validation_and_trainer_e2e(tmp_path, devices):
         Trainer(
             TrainConfig(**{**kw, "mesh_expert": 2, "moe_experts": 3})
         )
-    # PP×EP end to end: pipe=2 × expert=2 on 4 devices.
-    t = Trainer(
-        TrainConfig(**{**kw, "mesh_expert": 2, "moe_experts": 4})
-    )
+    # PP×EP end to end: pipe=2 × expert=2 on 4 devices — train, then
+    # RESUME (expert-sharded stage params + moments restore onto the
+    # same sharded layout).
+    ep_kw = {**kw, "mesh_expert": 2, "moe_experts": 4}
+    t = Trainer(TrainConfig(**ep_kw))
     out = t.train()
     t.close()
     assert np.isfinite(out["final_loss"])
+    t2 = Trainer(TrainConfig(**{**ep_kw, "epochs": 2}))
+    out2 = t2.train()
+    t2.close()
+    assert out2["epochs_run"] == 1  # resumed from the epoch-0 save
 
 
 def test_moe_every_generalized_including_odd_depth(devices, toks):
@@ -646,3 +651,54 @@ def test_pp_sp_ring_rejected_on_handsched_and_trainer_guards(
                    "seq_strategy": "ulysses"}
             )
         )
+
+
+def test_pp_ep_sp_triple_composition_exact(devices):
+    """PP×EP×SP all at once (experts 1/ep per stage, tokens over seq,
+    Ulysses exchange) == pipe×data with replicated experts — exact in
+    the no-drop regime."""
+    cfg = CFG._replace(
+        num_heads=4, depth_per_stage=2, num_experts=4, moe_every=2,
+        ep_size=2, sp_size=2, sp_strategy="ulysses",
+    )
+    toks = _tokens(8, seed=17)
+    tx = optax.sgd(0.1)
+    ref_cfg = cfg._replace(ep_size=1, sp_size=1)
+    mesh_r = _mesh(devices[:4], pipe=2, data=2)
+    st = create_pipe_lm_state(ref_cfg, tx, mesh_r, seed=0)
+    _, mr = make_pipe_lm_1f1b_train_step(ref_cfg, tx, mesh_r, donate=False)(
+        st, toks
+    )
+    mesh = _mesh(devices, pipe=2, expert=2, seq=2)
+    st2 = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+    _, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
+        st2, toks
+    )
+    assert float(m.loss) == float(mr.loss)
+
+
+def test_to_dense_lm_serves_moe_gqa(devices, toks):
+    """Round 5 closes the loop: a pipelined GQA×MoE run exports to the
+    dense tree and serves through the KV-cache decode (generate.py
+    routes blocks by their param tree; parity in the no-drop regime)."""
+    from ddp_tpu.models.generate import cached_logits
+    from ddp_tpu.models.lm import dense_lm_apply
+    from ddp_tpu.models.pipeline_lm import to_dense_lm
+
+    cfg = CFG._replace(
+        num_heads=4, num_kv_heads=2, depth_per_stage=2, num_experts=4,
+        moe_every=2,
+    )
+    params = init_pipe_lm(cfg, seed=0)
+    spec, dense = to_dense_lm(cfg, params)
+    assert spec.num_experts == 4 and spec.moe_every == 2
+
+    want = sequential_apply(cfg, params, toks)
+    got = dense_lm_apply(spec, dense, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
+    cached = cached_logits(spec, dense, toks[:2, :8])
+    np.testing.assert_allclose(
+        np.asarray(cached), np.asarray(want[:2, :8]), atol=1e-5
+    )
