@@ -280,10 +280,12 @@ class TestGQAxMoE:
 
         combo = run(self.COMBO)
         gqa_only = run(self.COMBO._replace(num_experts=0))
-        moe_only = run(self.COMBO._replace(num_kv_heads=0))
         assert all(np.isfinite(combo)) and combo[-1] < combo[0]
-        for other in (gqa_only, moe_only):
-            assert abs(combo[0] - other[0]) < 0.25  # same init family
+        # Same init family as GQA-only. (The MoE-only comparator was
+        # dropped for suite wall-time — round-5 ask #9; this one
+        # catches a combined-model init regression, which is the
+        # failure mode this smoke exists for.)
+        assert abs(combo[0] - gqa_only[0]) < 0.25
 
     def test_decode_matches_dense_forward(self):
         """GQA compact-KV cache + MoE routed blocks through the same
@@ -301,11 +303,8 @@ class TestGQAxMoE:
             np.asarray(got), np.asarray(want), atol=1e-5
         )
 
-    def test_pipe_gqa_moe_matches_sequential_and_ep_invisible(
-        self, devices
-    ):
-        """GQA×MoE through the pipeline: 1F1B == sequential forward;
-        adding EP (pipe×expert) == pipe×data exactly."""
+    def test_pipe_gqa_moe_matches_sequential(self, devices):
+        """GQA×MoE through the pipeline: 1F1B == sequential forward."""
         import optax
 
         from ddp_tpu.models.lm import next_token_loss
@@ -334,12 +333,6 @@ class TestGQAxMoE:
             sequential_apply(cfg, init_pipe_lm(cfg, seed=0), toks), toks
         )
         assert abs(float(m.loss) - float(ref)) < 1e-5
-
-        cfg_ep = cfg._replace(ep_size=2)
-        mesh_ep = make_mesh(
-            MeshSpec(pipe=2, expert=2), devices=devices[:4]
-        )
-        _, m_ep = make_pipe_lm_1f1b_train_step(
-            cfg_ep, tx, mesh_ep, donate=False
-        )(create_pipe_lm_state(cfg_ep, tx, mesh_ep, seed=0), toks)
-        assert float(m_ep.loss) == float(m.loss)
+        # EP-invisibility for this combined config is pinned by
+        # test_pipeline_lm.py::test_pp_ep_exact_parity_with_dp (GQA is
+        # folded into its cfg).

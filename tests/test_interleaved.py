@@ -195,7 +195,7 @@ class TestKernel:
 
 
 class TestTrainer:
-    def test_cli_trains_and_resumes(self, tmp_path, devices):
+    def test_cli_trains(self, tmp_path, devices):
         from ddp_tpu.train.config import TrainConfig
         from ddp_tpu.train.trainer import Trainer
 
@@ -223,10 +223,10 @@ class TestTrainer:
         assert summary["epochs_run"] == 1
         assert np.isfinite(summary["history"][0]["mean_loss"])
         assert np.isfinite(summary["final_accuracy"])
-        t2 = Trainer(TrainConfig(**{**kw, "epochs": 2}))
-        summary = t2.train()
-        t2.close()
-        assert summary["history"][0]["epoch"] == 1
+        # Resume-from-checkpoint for the pipe family is pinned by
+        # test_pipe_fsdp / test_pipeline_lm e2e's (the resume path is
+        # schedule-independent) — no second trainer run here (suite
+        # wall-time, round-5 ask #9).
 
     def test_guards(self, tmp_path, devices):
         from ddp_tpu.train.config import TrainConfig

@@ -345,26 +345,23 @@ def test_moe_pipe_matches_sequential(devices, toks):
 
 
 @pytest.mark.parametrize(
-    "make_step,interleaved",
-    [
-        (make_pipe_lm_train_step, False),
-        (make_pipe_lm_1f1b_train_step, False),
-        (make_pipe_lm_interleaved_train_step, True),
-    ],
-    ids=["gpipe", "1f1b", "interleaved"],
+    "make_step",
+    [make_pipe_lm_train_step, make_pipe_lm_1f1b_train_step],
+    ids=["gpipe", "1f1b"],
 )
-def test_pp_ep_exact_parity_with_dp(devices, toks, make_step, interleaved):
+def test_pp_ep_exact_parity_with_dp(devices, toks, make_step):
+    """One schedule per backward mechanism (GPipe = shard_map AD
+    transpose, 1F1B = explicit in-island psums; interleaved shares the
+    latter's machinery and its [v,S,E,…] specs ride the same
+    stage_specs rule). GQA is folded into the config so every run
+    covers the GQA×MoE×EP (Mixtral-class) composition."""
     tx = optax.adam(1e-3)
     cfg = CFG._replace(
-        depth_per_stage=2,
-        num_experts=4,
-        virtual_stages=2 if interleaved else 1,
+        depth_per_stage=2, num_experts=4, num_heads=4, num_kv_heads=2
     )
 
     def run(mesh, cfg):
-        st = create_pipe_lm_state(
-            cfg, tx, mesh, seed=0, interleaved=interleaved
-        )
+        st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
         step = make_step(cfg, tx, mesh, donate=False)
         losses = []
         for _ in range(3):
@@ -376,13 +373,36 @@ def test_pp_ep_exact_parity_with_dp(devices, toks, make_step, interleaved):
     ep, st = run(
         _mesh(devices[:4], pipe=2, expert=2), cfg._replace(ep_size=2)
     )
-    np.testing.assert_array_equal(ep, ref)
-    # Expert weights rest 1/pipe × 1/ep per device (both layouts:
-    # [S, E, …] and the interleaved [v, S, E, …]).
+    # Near-exact: the MHA-only variant is bitwise equal (pinned by
+    # test_pp_ep_sp_triple_composition_exact); with GQA in the mix the
+    # expert-vs-data psum reduction order shows at 1 ulp by step 3.
+    np.testing.assert_allclose(ep, ref, atol=2e-6)
+    # Expert weights rest 1/pipe × 1/ep per device.
     wi = st.params.stages["block2"]["moe"]["wi"]
     assert (
         wi.addressable_shards[0].data.size == wi.size // 4
     ), (wi.addressable_shards[0].data.shape, wi.shape)
+    # Interleaved [v, S, E, …] layout: pin the lead=2 expert spec rule
+    # and the resting shards (no schedule compile needed — the
+    # schedule kernels consume whatever stage_specs hands them).
+    from jax.sharding import PartitionSpec as P
+
+    from ddp_tpu.parallel.pipe_common import stage_specs_megatron
+
+    il_cfg = cfg._replace(ep_size=2, virtual_stages=2)
+    st_il = create_pipe_lm_state(
+        il_cfg, tx, _mesh(devices[:4], pipe=2, expert=2), seed=0,
+        interleaved=True,
+    )
+    wi_il = st_il.params.stages["block2"]["moe"]["wi"]
+    assert wi_il.sharding.spec == P(None, "pipe", "expert"), (
+        wi_il.sharding.spec
+    )
+    specs_il = stage_specs_megatron(
+        st_il.params.stages, _mesh(devices[:4], pipe=2, expert=2),
+        lead=2, tp_size=1, ep_size=2,
+    )
+    assert specs_il["block2"]["moe"]["wi"] == P(None, "pipe", "expert")
 
 
 def test_pp_ep_fsdp_composition(devices):
@@ -486,18 +506,23 @@ def test_moe_every_generalized_including_odd_depth(devices, toks):
     stays refused (stacked SPMD stages must be structure-uniform)."""
     tx = optax.sgd(0.1)
     mesh = _mesh(devices[:4], data=2, pipe=2)
-    for k, D in [(3, 3), (1, 1)]:
-        cfg = CFG._replace(
-            depth_per_stage=D, num_experts=4, moe_every=k, num_heads=4
-        )
-        st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
-        _, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
-            st, toks
-        )
-        ref = next_token_loss(
-            sequential_apply(cfg, init_pipe_lm(cfg, seed=0), toks), toks
-        )
-        assert abs(float(m.loss) - float(ref)) < 1e-5
+    cfg = CFG._replace(
+        depth_per_stage=3, num_experts=4, moe_every=3, num_heads=4
+    )
+    st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+    _, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
+        st, toks
+    )
+    ref = next_token_loss(
+        sequential_apply(cfg, init_pipe_lm(cfg, seed=0), toks), toks
+    )
+    assert abs(float(m.loss) - float(ref)) < 1e-5
+    # k=1 (fully-routed, odd depth 1) is structurally expressible too.
+    p1 = init_pipe_lm(
+        CFG._replace(depth_per_stage=1, num_experts=4, moe_every=1),
+        seed=0,
+    )
+    assert "moe" in p1.stages["block1"]
     # D=3, k=3: blocks 1-2 dense, block 3 routed — per chunk.
     p = init_pipe_lm(
         CFG._replace(depth_per_stage=3, num_experts=4, moe_every=3),
@@ -560,15 +585,19 @@ def test_trainer_moe_every_surface(tmp_path, devices):
     "make_step,strategy,interleaved",
     [
         (make_pipe_lm_train_step, "ring", False),
-        (make_pipe_lm_train_step, "ulysses", False),
         (make_pipe_lm_1f1b_train_step, "ulysses", False),
-        (make_pipe_lm_interleaved_train_step, "ulysses", True),
     ],
-    ids=["gpipe-ring", "gpipe-ulysses", "1f1b-ulysses", "il-ulysses"],
+    ids=["gpipe-ring", "1f1b-ulysses"],
 )
 def test_pp_sp_matches_pipe_only(devices, make_step, strategy, interleaved):
+    """One param per collective-mechanism class: ring (group-less
+    ppermute — GPipe-only) and Ulysses under a hand-scheduled kernel
+    (grouped all_to_all inside switch branches; interleaved shares
+    that machinery). GQA folded into the config so both runs cover
+    GQA×SP through the pipe."""
     cfg0 = CFG._replace(
-        num_heads=4, virtual_stages=2 if interleaved else 1
+        num_heads=4, num_kv_heads=2,
+        virtual_stages=2 if interleaved else 1,
     )
     toks = _tokens(8, seed=11)
     tx = optax.sgd(0.1)
@@ -585,35 +614,19 @@ def test_pp_sp_matches_pipe_only(devices, make_step, strategy, interleaved):
         return np.array(losses)
 
     ref = run(_mesh(devices[:2], pipe=2), cfg0)
+    # The hand-scheduled param also carries a data axis (PP×SP×DP):
+    # DP grad reduction must not disturb the seq replica groups.
+    sp_axes = (
+        dict(pipe=2, seq=2)
+        if make_step is make_pipe_lm_train_step
+        else dict(pipe=2, seq=2, data=2)
+    )
+    n_dev = 4 if make_step is make_pipe_lm_train_step else 8
     got = run(
-        _mesh(devices[:4], pipe=2, seq=2),
+        _mesh(devices[:n_dev], **sp_axes),
         cfg0._replace(sp_size=2, sp_strategy=strategy),
     )
     np.testing.assert_allclose(got, ref, atol=2e-6)
-
-
-def test_pp_sp_composes_with_dp_gqa(devices):
-    """PP×SP×DP with grouped-query attention — losses match the
-    pipe×dp run exactly (Ulysses exchange is numerically invisible)."""
-    cfg = CFG._replace(
-        num_heads=4, num_kv_heads=2, sp_size=2, sp_strategy="ulysses"
-    )
-    toks = _tokens(8, seed=13)
-    tx = optax.sgd(0.1)
-    st_ref = create_pipe_lm_state(
-        cfg._replace(sp_size=1), tx, _mesh(devices[:4], pipe=2, data=2),
-        seed=0,
-    )
-    _, m_ref = make_pipe_lm_1f1b_train_step(
-        cfg._replace(sp_size=1), tx, _mesh(devices[:4], pipe=2, data=2),
-        donate=False,
-    )(st_ref, toks)
-    mesh = _mesh(devices, pipe=2, seq=2, data=2)
-    st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
-    _, m = make_pipe_lm_1f1b_train_step(cfg, tx, mesh, donate=False)(
-        st, toks
-    )
-    assert abs(float(m.loss) - float(m_ref.loss)) < 2e-6
 
 
 def test_pp_sp_ring_rejected_on_handsched_and_trainer_guards(

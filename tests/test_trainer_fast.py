@@ -148,9 +148,10 @@ def test_pipe_lm_fast_epoch_loss_identical_to_step_loop(
     tmp_path, schedule
 ):
     """Round-5 ask #5: --model pipe_lm --fast_epoch pinned
-    loss-identical to the per-step loop across schedules (same sampler
-    keying, same raw pipe step scanned on device —
-    train/fast.py make_pipe_lm_epoch_runner)."""
+    loss-identical to the per-step loop for BOTH jit=False plumbings
+    (the GPipe builder and the hand-scheduled one) — finiteness alone
+    would miss a sampler-keying or state-threading bug that produces
+    finite-but-wrong losses."""
     results = {}
     for tag, fast in (("fast", True), ("step", False)):
         t = Trainer(
