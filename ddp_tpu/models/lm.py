@@ -58,8 +58,9 @@ class CausalLM(nn.Module):
     remat: bool = False
     # Megatron TP over the ``model`` mesh axis (shard_map-only):
     # attention heads + MLP hidden shard, embeddings/LNs/tied head
-    # replicate (parallel/tp.py). Dense blocks only — expert
-    # parallelism owns the MoE sharding story.
+    # replicate (parallel/tp.py). Routed blocks shard their ATTENTION
+    # over ``model`` too (round 5 — Megatron-MoE); their expert MLPs
+    # replicate across ``model`` and shard over ``expert`` instead.
     tp_axis: Optional[str] = None
     tp_size: int = 1
     # Expert parallelism over the ``expert`` mesh axis (shard_map-only):
@@ -71,13 +72,6 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
-        # ValueError (not assert): library users bypass the trainer
-        # guards, and asserts vanish under ``python -O``.
-        if self.num_experts and self.tp_size > 1:
-            raise ValueError(
-                "MoE does not compose with TP here: TP shards dense "
-                "blocks; shard experts with --mesh_expert instead"
-            )
         embed = self.param(
             "embed",
             nn.initializers.normal(stddev=0.02),
@@ -109,6 +103,8 @@ class CausalLM(nn.Module):
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,
                     num_kv_heads=self.num_kv_heads,
+                    tp_axis=self.tp_axis,
+                    tp_size=self.tp_size,
                     name=f"block{i + 1}",
                 )(x)
             else:

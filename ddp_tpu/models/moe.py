@@ -174,13 +174,26 @@ class MoEEncoderBlock(nn.Module):
     ep_axis: Optional[str] = None  # expert parallelism (see MoEMLP)
     ep_size: int = 1
     num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
+    # Megatron TP for the ATTENTION half only (round 5 — the
+    # Megatron-MoE layout): heads shard over ``model`` exactly as in
+    # the dense EncoderBlock; the routed MLP stays replicated across
+    # ``model`` members (experts shard over ``expert`` instead — EP
+    # owns the MoE sharding story), so every member routes the same
+    # replicated residual stream and computes identical expert
+    # updates, which the shard_map AD transpose accounts for like any
+    # replicated leaf (LNs, embeddings). Deliberately NO tp_inner_vjp:
+    # the Megatron f/g path (hand-scheduled pipeline kernels) does not
+    # extend into routed blocks — StageBlocks refuses MoE×TP.
+    tp_axis: Optional[str] = None
+    tp_size: int = 1
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(x.dtype)
         y = MultiHeadAttention(
             self.num_heads, attention_fn=self.attention_fn,
-            num_kv_heads=self.num_kv_heads, name="attn"
+            num_kv_heads=self.num_kv_heads,
+            tp_axis=self.tp_axis, tp_size=self.tp_size, name="attn"
         )(y, deterministic=self.deterministic)
         y = nn.Dropout(self.dropout_rate, deterministic=self.deterministic)(y)
         x = x + y

@@ -180,7 +180,10 @@ def _stage_module(
         if cfg.tp_size > 1:
             raise ValueError(
                 "the pipelined MoE-LM composes with data/fsdp/pipe/"
-                "expert/GQA — not tp (the same wall as CausalLM)"
+                "expert/seq/GQA — not tp: the hand-scheduled in-island "
+                "vjp's Megatron f/g plumbing does not extend into "
+                "routed blocks (the flat --model causal_lm composes "
+                "TP×MoE)"
             )
         if cfg.depth_per_stage % cfg.moe_every:
             raise ValueError(

@@ -144,14 +144,17 @@ class StageBlocks(nn.Module):
     def __call__(self, x):
         from ddp_tpu.models.moe import MoEEncoderBlock, is_moe_block
 
-        # In-module guard (the CausalLM pattern, models/lm.py): MoE
-        # blocks take no tp wiring, so a caller combining them must
-        # hear it HERE, not get silently-unsharded experts under
-        # stage_specs_megatron's tp specs. (GQA composes — round 5.)
+        # In-module guard: the pipe family's hand-scheduled in-island
+        # vjp needs Megatron f/g plumbing that does not extend into
+        # routed blocks, so a caller combining them must hear it HERE,
+        # not get silently-wrong gradients. (GQA composes — round 5;
+        # the flat CausalLM composes TP×MoE via the shard_map AD
+        # transpose, which the pipe kernels bypass.)
         if self.num_experts and self.tp_size > 1:
             raise ValueError(
                 "StageBlocks: MoE blocks do not compose with tp "
-                f"(tp_size={self.tp_size})"
+                f"(tp_size={self.tp_size}) — use the flat causal_lm "
+                "for TP×MoE"
             )
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         moe_cls = (

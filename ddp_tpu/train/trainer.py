@@ -354,12 +354,11 @@ class Trainer:
                     f"by --mesh_expert {config.mesh_expert}"
                 )
         if self.seq_mode and config.mesh_model > 1:
-            if config.moe_experts:
-                raise ValueError(
-                    "--mesh_model shards dense transformer blocks "
-                    "(Megatron TP); MoE expert weights shard over "
-                    "--mesh_expert instead — drop one of the flags"
-                )
+            # TP×MoE composes since round 5 (the Megatron-MoE layout):
+            # attention heads shard over ``model`` in routed blocks
+            # too, the expert MLPs stay replicated across ``model``
+            # (experts shard over --mesh_expert — EP owns the MoE
+            # sharding story).
             _check_tp_dims(config)
         self.mesh = make_mesh(
             MeshSpec(
