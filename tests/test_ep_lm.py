@@ -140,41 +140,43 @@ def test_trainer_ep_guards():
     Trainer(TrainConfig(mesh_model=2, moe_experts=4, **base)).close()
 
 
-def test_tp_moe_matches_replicated():
-    """TP×MoE (round 5 — the Megatron-MoE layout): attention heads
-    shard over ``model`` inside routed blocks too; losses match the
-    TP-less run to float tolerance, and the MoE-block qkv rests
-    column-sharded. SGD on purpose: adam's m/√v update is nearly
-    invariant to uniform gradient scaling, so it could not catch a
-    tp×-double-counted gradient on the replicated expert/router
-    leaves — the exact failure mode this composition risks."""
+def test_tp_moe_replicated_experts_matches_dp():
+    """TP×MoE with FULLY REPLICATED experts (no expert axis, data>1)
+    — a distinct MoEMLP path from the full-stack test below (no
+    all-to-all; expert/router leaves replicate across BOTH data and
+    model, the layout where a transpose double-count would bite).
+    SGD for scaling sensitivity (see the full-stack docstring)."""
     tx = optax.sgd(0.1)
+    toks = jax.random.randint(jax.random.key(7), (4, 32), 0, 64)
 
     def run(mesh):
         state = create_lm_train_state(SPEC, tx, mesh, seed=0)
         step = make_lm_train_step(SPEC, tx, mesh, donate=False)
         out = []
-        for _ in range(3):
-            state, m = step(state, jax.random.randint(
-                jax.random.key(7), (4, 32), 0, 64))
+        for _ in range(2):
+            state, m = step(state, toks)
             out.append(float(m.loss))
-        return np.array(out), state
+        return np.array(out)
 
-    ref, _ = run(_mesh(2, data=2))
-    tp, state = run(_mesh(4, data=2, model=2))
+    ref = run(_mesh(2, data=2))
+    tp = run(_mesh(4, data=2, model=2))
     np.testing.assert_allclose(tp, ref, atol=2e-6)
-    qkv = state.params["block2"]["attn"]["qkv"]["kernel"]
-    assert qkv.sharding.spec == P(None, "model")
 
 
 def test_full_stack_gqa_moe_tp_ep_sp():
-    """Every LM axis at once — GQA attention, routed MLPs, Megatron
-    TP over ``model``, expert parallelism over ``expert``, Ulysses-free
-    ring over ``seq`` — equals the dp×sp run with the same batch/token
-    split (GShard groups match) to float tolerance."""
+    """TP×MoE (round 5 — the Megatron-MoE layout) at full stack:
+    every LM axis at once — GQA attention, routed MLPs, Megatron TP
+    over ``model`` (attention heads shard inside routed blocks too),
+    expert parallelism over ``expert``, ring attention over ``seq`` —
+    equals the dp×sp run with the same batch/token split (GShard
+    groups match) to float tolerance. SGD on purpose: adam's m/√v
+    update is nearly invariant to uniform gradient scaling, so it
+    could not catch a tp×-double-counted gradient on the replicated
+    expert/router leaves — the exact failure mode this composition
+    risks."""
     spec = SPEC._replace(num_kv_heads=2, total_len=32)
     toks = jax.random.randint(jax.random.key(9), (4, 32), 0, 64)
-    tx = optax.sgd(0.1)  # scaling-sensitive — see test_tp_moe above
+    tx = optax.sgd(0.1)  # scaling-sensitive — see the docstring
 
     def run(mesh):
         state = create_lm_train_state(spec, tx, mesh, seed=0)
@@ -191,3 +193,6 @@ def test_full_stack_gqa_moe_tp_ep_sp():
     wi = state.params["block2"]["moe"]["wi"]
     assert wi.sharding.spec == P("expert")
     assert wi.addressable_shards[0].data.shape[0] == wi.shape[0] // 2
+    # MoE-block attention rests column-sharded over ``model``.
+    qkv = state.params["block2"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, "model")
