@@ -63,16 +63,16 @@ def test_ep_exact_parity_with_replicated():
 
 
 def test_ep4_parity_with_dp4():
-    """4-way splits agree exactly whatever axis provides them."""
+    """4-way splits agree exactly whatever axis provides them (ep>2:
+    the all-to-all exchanges more than a neighbor swap)."""
     ref, _ = _run(_mesh(4, data=4))
     ep, _ = _run(_mesh(4, data=1, expert=4))
     np.testing.assert_array_equal(ep, ref)
 
 
-def test_dp_ep_sp_composition_runs():
-    losses, _ = _run(_mesh(8, data=2, expert=2, seq=2))
-    assert np.all(np.isfinite(losses))
-    assert losses[-1] < losses[0]
+# (The former dp×ep×sp finite-only composition smoke is subsumed by
+# test_full_stack_gqa_moe_tp_ep_sp below, which pins ep×sp — plus tp
+# and GQA — to float-tolerance PARITY, not just finiteness.)
 
 
 def test_ep_expert_memory_shards():
