@@ -183,7 +183,9 @@ class MoEEncoderBlock(nn.Module):
     # updates, which the shard_map AD transpose accounts for like any
     # replicated leaf (LNs, embeddings). Deliberately NO tp_inner_vjp:
     # the Megatron f/g path (hand-scheduled pipeline kernels) does not
-    # extend into routed blocks — StageBlocks refuses MoE×TP.
+    # extend into routed blocks — StageBlocks refuses MoE×TP when
+    # built with tp_inner_vjp (1F1B/interleaved); the AD paths (flat
+    # CausalLM, GPipe) compose MoE×TP via the shard_map transpose.
     tp_axis: Optional[str] = None
     tp_size: int = 1
 

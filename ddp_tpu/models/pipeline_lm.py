@@ -98,8 +98,10 @@ class PipeLMConfig(NamedTuple):
     # near-uniform routers at capacity_factor 2.0; a skewed router
     # drops different tokens in the two views, like any
     # batch-size-dependent GShard eval). Composes with GQA (round 5 —
-    # attention and routing are orthogonal) but not tp (same wall as
-    # CausalLM).
+    # attention and routing are orthogonal) and with tp under the
+    # GPipe schedule (the AD transpose owns the cross-member sums;
+    # the hand-scheduled kernels' in-island vjp refuses MoE×TP — its
+    # f/g plumbing does not extend into routed blocks).
     num_experts: int = 0
     moe_every: int = 2
     # Expert parallelism over the ``expert`` mesh axis (PP×EP, round
@@ -177,13 +179,13 @@ def _stage_module(
     hand-scheduled kernels need (they vjp INSIDE the shard_map body,
     where the transpose's cross-member sums never run)."""
     if cfg.num_experts:
-        if cfg.tp_size > 1:
+        if cfg.tp_size > 1 and inner_vjp:
             raise ValueError(
-                "the pipelined MoE-LM composes with data/fsdp/pipe/"
-                "expert/seq/GQA — not tp: the hand-scheduled in-island "
-                "vjp's Megatron f/g plumbing does not extend into "
-                "routed blocks (the flat --model causal_lm composes "
-                "TP×MoE)"
+                "the pipelined MoE-LM composes with tp under the "
+                "GPipe schedule only: the hand-scheduled kernels' "
+                "in-island vjp needs Megatron f/g plumbing that does "
+                "not extend into routed blocks — use --pipe_schedule "
+                "gpipe (or the flat --model causal_lm)"
             )
         if cfg.depth_per_stage % cfg.moe_every:
             raise ValueError(

@@ -144,17 +144,20 @@ class StageBlocks(nn.Module):
     def __call__(self, x):
         from ddp_tpu.models.moe import MoEEncoderBlock, is_moe_block
 
-        # In-module guard: the pipe family's hand-scheduled in-island
-        # vjp needs Megatron f/g plumbing that does not extend into
-        # routed blocks, so a caller combining them must hear it HERE,
-        # not get silently-wrong gradients. (GQA composes — round 5;
-        # the flat CausalLM composes TP×MoE via the shard_map AD
-        # transpose, which the pipe kernels bypass.)
-        if self.num_experts and self.tp_size > 1:
+        # In-module guard: the HAND-SCHEDULED kernels' in-island vjp
+        # needs Megatron f/g plumbing that does not extend into routed
+        # blocks — a caller combining them must hear it HERE, not get
+        # silently-wrong gradients. The AD path (GPipe — tp_inner_vjp
+        # False) composes MoE×TP exactly like the flat CausalLM: the
+        # shard_map transpose owns the cross-member sums, and the
+        # routed block's attention takes the same column/row wiring.
+        if self.num_experts and self.tp_size > 1 and self.tp_inner_vjp:
             raise ValueError(
-                "StageBlocks: MoE blocks do not compose with tp "
-                f"(tp_size={self.tp_size}) — use the flat causal_lm "
-                "for TP×MoE"
+                "StageBlocks: MoE blocks do not compose with tp under "
+                "the hand-scheduled schedules (their in-island vjp's "
+                "Megatron f/g plumbing does not extend into routed "
+                "blocks) — use the GPipe schedule or the flat "
+                "causal_lm for TP×MoE"
             )
         block_cls = nn.remat(EncoderBlock) if self.remat else EncoderBlock
         moe_cls = (
@@ -170,6 +173,8 @@ class StageBlocks(nn.Module):
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,
                     num_kv_heads=self.num_kv_heads,
+                    tp_axis=self.tp_axis,
+                    tp_size=self.tp_size,
                     name=f"block{i + 1}",
                 )(x)
             else:

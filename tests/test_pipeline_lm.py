@@ -327,8 +327,9 @@ def test_moe_pipe_matches_sequential(devices, toks):
     wi1 = np.asarray(s_g.params.stages["block2"]["moe"]["wi"])
     assert np.abs(wi1 - wi0).max() > 0  # experts actually train
 
-    with pytest.raises(ValueError, match="not tp"):
-        init_pipe_lm(cfg._replace(tp_size=2), seed=0)
+    # MoE×TP is GPipe-only since round 5 — the refusal moved to the
+    # hand-scheduled step builders (pinned by
+    # test_pp_tp_moe_gpipe_exact_and_handsched_refused).
     with pytest.raises(ValueError, match="structure-uniform"):
         init_pipe_lm(cfg._replace(depth_per_stage=1), seed=0)
 
@@ -715,3 +716,56 @@ def test_to_dense_lm_serves_moe_gqa(devices, toks):
     np.testing.assert_allclose(
         np.asarray(cached), np.asarray(want[:2, :8]), atol=1e-5
     )
+
+
+def test_pp_tp_moe_gpipe_exact_and_handsched_refused(devices, toks):
+    """Round 5 (beyond the asks): MoE×TP rides the pipe under GPipe —
+    the AD path's shard_map transpose owns the cross-member sums
+    exactly as in the flat family — bitwise equal to pipe×dp, and the
+    full PP×TP×EP stack shards experts over (pipe, expert) with
+    routed-block attention over (pipe, model). The hand-scheduled
+    schedules refuse with the mechanism (their in-island vjp's f/g
+    plumbing does not extend into routed blocks)."""
+    cfg = CFG._replace(
+        num_heads=4, num_kv_heads=2, depth_per_stage=2, num_experts=4,
+        moe_every=2,
+    )
+    tx = optax.sgd(0.1)
+
+    def run(mesh, cfg):
+        st = create_pipe_lm_state(cfg, tx, mesh, seed=0)
+        step = make_pipe_lm_train_step(cfg, tx, mesh, donate=False)
+        out = []
+        for _ in range(2):
+            st, m = step(st, toks)
+            out.append(float(m.loss))
+        return np.array(out), st
+
+    ref, _ = run(_mesh(devices[:4], pipe=2, data=2), cfg)
+    # Plain PP×TP×MoE (replicated experts — the replicated-over-model
+    # gradient path the old guard forbade) …
+    tp_only, st_tp = run(
+        _mesh(devices, pipe=2, data=2, model=2), cfg._replace(tp_size=2)
+    )
+    np.testing.assert_array_equal(tp_only, ref)
+    from jax.sharding import PartitionSpec as P
+
+    assert st_tp.params.stages["block2"]["moe"]["wi"].sharding.spec == P(
+        "pipe"
+    )
+    # … and the full PP×TP×EP expert layout.
+    full, st = run(
+        _mesh(devices, pipe=2, model=2, expert=2),
+        cfg._replace(tp_size=2, ep_size=2),
+    )
+    np.testing.assert_array_equal(full, ref)
+    wi = st.params.stages["block2"]["moe"]["wi"]
+    assert wi.sharding.spec == P("pipe", "expert")
+    qkv = st.params.stages["block2"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P("pipe", None, "model")
+
+    with pytest.raises(ValueError, match="GPipe schedule"):
+        make_pipe_lm_1f1b_train_step(
+            cfg._replace(tp_size=2), tx,
+            _mesh(devices[:4], pipe=2, model=2), donate=False,
+        )
