@@ -35,6 +35,43 @@ if _os.environ.get("JAX_PLATFORMS"):
 
     _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
 
+
+def _install_jax_compat() -> None:
+    """Make ``jax.shard_map(..., check_vma=)`` work on jax < 0.6.
+
+    The codebase speaks the modern surface (top-level ``jax.shard_map``
+    with the ``check_vma`` kwarg). Older jax (e.g. 0.4.x) ships the
+    same function as ``jax.experimental.shard_map.shard_map`` with the
+    kwarg named ``check_rep``. Alias + translate once at package
+    import, so every ddp_tpu module (and the test suite, which always
+    imports ddp_tpu first) runs on either jax without scattering
+    version branches through the parallel layer.
+    """
+    import jax as _j
+
+    if not hasattr(_j.lax, "axis_size"):
+        # Same era: no lax.axis_size either. The traced psum(1, axis)
+        # is the old idiom — equivalent everywhere this codebase calls
+        # it (inside shard_map bodies, for index arithmetic).
+        _j.lax.axis_size = lambda axis_name: _j.lax.psum(1, axis_name)
+
+    if hasattr(_j, "shard_map"):
+        return
+    from functools import wraps as _wraps
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @_wraps(_shard_map)
+    def _compat_shard_map(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)
+
+    _j.shard_map = _compat_shard_map
+
+
+_install_jax_compat()
+
 from ddp_tpu.runtime.dist import DistContext, setup, cleanup  # noqa: F401
 from ddp_tpu.runtime.mesh import MeshSpec, make_mesh  # noqa: F401
 

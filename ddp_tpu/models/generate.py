@@ -97,7 +97,7 @@ def _block_qkv(p, x, H, Dh, H_kv=None):
     return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
 
 
-def _moe_mlp(p, x, *, top_k: int = 2):
+def _moe_mlp(p, x, *, top_k: int = 2, normalize_gates: bool = True):
     """Routed expert MLP for serving (round 5 — MoE-LM decode).
 
     models/moe.py MoEMLP numerics WITHOUT the capacity mechanism:
@@ -110,8 +110,10 @@ def _moe_mlp(p, x, *, top_k: int = 2):
     the same caveat as any batch-size-dependent GShard eval). Dense
     E-way compute is the right serving shape here: decode batches are
     small and the capacity/dispatch einsums exist for training-scale
-    token counts. Defaults mirror MoEMLP (top_k=2, normalized gates —
-    the only configuration the LM families construct)."""
+    token counts. ``top_k``/``normalize_gates`` come from the LMSpec
+    (round-5 ADVICE fix: decode no longer hardcodes the MoEMLP
+    defaults — a checkpoint trained at top_k=1 or with raw gates now
+    serves with its own routing)."""
     B, T, d = x.shape
     toks = x.reshape(B * T, d)
     gates = jax.nn.softmax(
@@ -127,7 +129,8 @@ def _moe_mlp(p, x, *, top_k: int = 2):
         mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
         comb = comb + remaining * mask
         remaining = remaining * (1.0 - mask)
-    comb = comb / jnp.maximum(comb.sum(-1, keepdims=True), 1e-9)
+    if normalize_gates:
+        comb = comb / jnp.maximum(comb.sum(-1, keepdims=True), 1e-9)
     wi, wo = p["wi"].astype(x.dtype), p["wo"].astype(x.dtype)
     h = jax.nn.gelu(
         jnp.einsum("nd,edf->enf", toks, wi) + p["bi"].astype(x.dtype)
@@ -137,16 +140,21 @@ def _moe_mlp(p, x, *, top_k: int = 2):
     return out.reshape(B, T, d)
 
 
-def _block_finish(p, x, attn_vec):
+def _block_finish(spec: LMSpec, p, x, attn_vec):
     """Output projection residual + MLP residual (the block's back
     half). Routed blocks (``moe`` in the tree) take the expert path —
     every decode surface (decode_step, prefill, beam_search,
     cached_logits) flows through here, so the MoE-LM serves through
-    the whole stack."""
+    the whole stack. Routing config (top_k, gate normalization) comes
+    from the spec, not the MoEMLP defaults."""
     x = x + _dense(attn_vec, p["attn"]["proj"])
     h = _layer_norm(x, p["ln2"]).astype(x.dtype)
     if "moe" in p:
-        return x + _moe_mlp(p["moe"], h)
+        return x + _moe_mlp(
+            p["moe"], h,
+            top_k=spec.moe_top_k,
+            normalize_gates=spec.moe_normalize_gates,
+        )
     h = _dense(h, p["mlp1"])
     h = jax.nn.gelu(h)  # tanh approximation — Flax's default
     return x + _dense(h, p["mlp2"])
@@ -195,7 +203,7 @@ def decode_step(
         w = jax.nn.softmax(logits, axis=-1)
         attn = jnp.einsum("bkgl,blkd->bkgd", w, cv[i].astype(jnp.float32))
         attn = attn.reshape(B, 1, spec.d_model).astype(x.dtype)
-        x = _block_finish(p, x, attn)
+        x = _block_finish(spec, p, x, attn)
     x = _layer_norm(x, params["ln_final"])
     out_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
     return out_logits, DecodeCache(k=ck, v=cv, pos=pos + 1)
@@ -241,7 +249,7 @@ def prefill(
             jnp.repeat(v, G, axis=2).astype(jnp.float32),
         )
         attn = attn.reshape(B, P, spec.d_model).astype(x.dtype)
-        x = _block_finish(p, x, attn)
+        x = _block_finish(spec, p, x, attn)
     x = _layer_norm(x[:, -1:], params["ln_final"])
     last_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
     return last_logits, DecodeCache(
@@ -427,6 +435,189 @@ def beam_search(
     )
     tiled_prompt = jnp.broadcast_to(prompt[:, None, :], (B, W, P))
     return jnp.concatenate([tiled_prompt, seqs], axis=2), scores
+
+
+# --- slot-level primitives (ddp_tpu.serve continuous batching) -------
+#
+# The serving engine (serve/engine.py) keeps ONE static-shape decode
+# batch of S slots alive forever; requests of different ages share it.
+# That needs decode with a PER-SLOT position (DecodeCache.pos is one
+# scalar for the whole batch) plus lane-level refill: prefill one
+# request at a fixed padded width, then splice its K/V into a free
+# lane. All three primitives are shape-static — slot index, lengths
+# and positions are traced scalars/vectors — so a running engine
+# compiles each exactly once regardless of the request mix.
+
+
+class SlotCache(NamedTuple):
+    """Per-slot variant of DecodeCache for continuous batching.
+
+    Same ``k``/``v`` layout ([depth, S, total_len, H_kv, Dh] — each
+    slot is a lane of the batch dim), but ``pos`` is [S] int32: every
+    slot decodes at its own position, so a mixed-age batch (one
+    request 5 tokens in, another 200) advances in one step.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+
+
+def init_slot_cache(
+    spec: LMSpec, slots: int, dtype=jnp.float32
+) -> SlotCache:
+    head_dim = spec.d_model // spec.num_heads
+    shape = (spec.depth, slots, spec.total_len, _kv_heads(spec), head_dim)
+    return SlotCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.zeros((slots,), jnp.int32),
+    )
+
+
+def slot_decode_step(
+    spec: LMSpec, params: Any, cache: SlotCache, tokens: jax.Array
+) -> tuple[jax.Array, SlotCache]:
+    """decode_step with per-slot positions → (logits [S, V], cache).
+
+    ``tokens``: [S] int32, slot s's token written at ``cache.pos[s]``.
+    Numerics per lane are identical to ``decode_step`` (same einsums,
+    same mask rule ``key_pos <= pos``) — only the position bookkeeping
+    is vectorized: the K/V write is a vmapped ``dynamic_update_slice``
+    over the slot dim (a scatter of S rows, not a full-cache rewrite),
+    the position embedding a per-slot gather. Idle slots are decoded
+    too (the batch shape never changes); their outputs are garbage the
+    engine ignores, but never NaN — position 0 is always live, so the
+    softmax normalizes over at least one (zero) logit. ``pos`` is
+    clamped at ``total_len`` so an idle slot can sit in the batch
+    indefinitely without indexing past the cache (writes at the clamp
+    land on the last line, which a refill overwrites).
+    """
+    embed = params["embed"]
+    S = tokens.shape[0]
+    H = spec.num_heads
+    Dh = spec.d_model // H
+    H_kv = _kv_heads(spec)
+    G = H // H_kv
+    pos = cache.pos  # [S]
+    x = embed[tokens][:, None, :]  # [S, 1, d]
+    # Per-slot position embedding: row s reads pos_embed[pos[s]].
+    pe = params["pos_embed"][0]  # [L, d]
+    x = x + pe[jnp.minimum(pos, spec.total_len - 1)][:, None, :].astype(
+        x.dtype
+    )
+    live = (
+        jnp.arange(spec.total_len)[None, :] <= pos[:, None]
+    )[:, None, None, :]  # [S, 1, 1, L]
+    write = jax.vmap(
+        lambda lane, row, p: lax.dynamic_update_slice(
+            lane, row, (p, 0, 0)
+        )
+    )  # ([S, L, H_kv, Dh], [S, 1, H_kv, Dh], [S]) → written lanes
+    ck, cv = cache.k, cache.v
+    for i in range(spec.depth):
+        p = params[f"block{i + 1}"]
+        q, k, v = _block_qkv(p, x, H, Dh, H_kv)
+        ck = ck.at[i].set(write(ck[i], k, pos))
+        cv = cv.at[i].set(write(cv[i], v, pos))
+        qg = q[:, 0].reshape(S, H_kv, G, Dh)
+        logits = (
+            jnp.einsum(
+                "bkgd,blkd->bkgl",
+                qg.astype(jnp.float32),
+                ck[i].astype(jnp.float32),
+            )
+            * Dh**-0.5
+        )  # [S, H_kv, G, L]
+        logits = jnp.where(live, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bkgl,blkd->bkgd", w, cv[i].astype(jnp.float32))
+        attn = attn.reshape(S, 1, spec.d_model).astype(x.dtype)
+        x = _block_finish(spec, p, x, attn)
+    x = _layer_norm(x, params["ln_final"])
+    out_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
+    return out_logits, SlotCache(
+        k=ck, v=cv, pos=jnp.minimum(pos + 1, spec.total_len)
+    )
+
+
+def prefill_slot(
+    spec: LMSpec, params: Any, prompt: jax.Array, length: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One request's prefill at a FIXED padded width → lane K/V.
+
+    ``prompt``: [1, P_pad] int32, the real prompt in positions
+    [0, length) and arbitrary padding after; ``length`` is a traced
+    scalar, so every refill reuses one compiled prefill regardless of
+    the prompt's true length — the static-shape invariant the serving
+    engine is built on. Causal attention makes the padding harmless:
+    position t only attends to keys <= t, so K/V and logits at
+    positions < length never see the pad garbage, and the garbage K/V
+    the pad positions leave in the lane sit above the slot's live mask
+    until the decode loop overwrites them (write-then-attend order in
+    ``slot_decode_step``).
+
+    Returns ``(logits [vocab] at position length-1, k, v)`` with k/v
+    shaped [depth, P_pad, H_kv, Dh] for ``write_slot``.
+    """
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError(f"prefill_slot is per-request: batch {B} != 1")
+    H = spec.num_heads
+    Dh = spec.d_model // H
+    H_kv = _kv_heads(spec)
+    G = H // H_kv
+    embed = params["embed"]
+    x = embed[prompt]  # [1, P, d]
+    x = x + params["pos_embed"].astype(x.dtype)[:, :P]
+    attn_fn = best_attention(causal=True)
+    ks, vs = [], []
+    for i in range(spec.depth):
+        p = params[f"block{i + 1}"]
+        q, k, v = _block_qkv(p, x, H, Dh, H_kv)
+        ks.append(k[0])
+        vs.append(v[0])
+        attn = attn_fn(
+            q.astype(jnp.float32),
+            jnp.repeat(k, G, axis=2).astype(jnp.float32),
+            jnp.repeat(v, G, axis=2).astype(jnp.float32),
+        )
+        attn = attn.reshape(1, P, spec.d_model).astype(x.dtype)
+        x = _block_finish(spec, p, x, attn)
+    # Logits at the last REAL position (length - 1), not the last
+    # padded one — a dynamic slice on a traced index, still one
+    # compiled shape.
+    xt = lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+    xt = _layer_norm(xt, params["ln_final"])
+    logits = (xt[0, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
+    return logits, jnp.stack(ks), jnp.stack(vs)
+
+
+def write_slot(
+    cache: SlotCache,
+    slot: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    length: jax.Array,
+) -> SlotCache:
+    """Splice a prefilled lane into the cache → cache with slot live.
+
+    ``k``/``v``: [depth, P_pad, H_kv, Dh] from ``prefill_slot``;
+    ``slot``/``length`` are traced scalars. The lane's positions past
+    P_pad keep whatever the previous occupant left — they sit above
+    the slot's live mask (pos starts at ``length`` <= P_pad) and the
+    decode loop overwrites each line before it becomes attendable.
+    """
+    new_k = lax.dynamic_update_slice(
+        cache.k, k[:, None].astype(cache.k.dtype), (0, slot, 0, 0, 0)
+    )
+    new_v = lax.dynamic_update_slice(
+        cache.v, v[:, None].astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    )
+    new_pos = lax.dynamic_update_slice(
+        cache.pos, length[None].astype(jnp.int32), (slot,)
+    )
+    return SlotCache(k=new_k, v=new_v, pos=new_pos)
 
 
 def cached_logits(

@@ -55,6 +55,13 @@ class CausalLM(nn.Module):
     attention_fn: Optional[Callable] = None
     num_experts: int = 0  # 0 = dense MLPs everywhere
     moe_every: int = 2
+    # Routing config for the MoE blocks (models/moe.py MoEMLP): how
+    # many experts each token visits, and whether the surviving gates
+    # are renormalized to sum to 1. Threaded from LMSpec so the decode
+    # path (models/generate.py) can reproduce the training routing
+    # instead of assuming the defaults.
+    moe_top_k: int = 2
+    moe_normalize_gates: bool = True
     remat: bool = False
     # Megatron TP over the ``model`` mesh axis (shard_map-only):
     # attention heads + MLP hidden shard, embeddings/LNs/tied head
@@ -99,6 +106,8 @@ class CausalLM(nn.Module):
                     num_heads=self.num_heads,
                     mlp_dim=self.d_model * self.mlp_ratio,
                     num_experts=self.num_experts,
+                    top_k=self.moe_top_k,
+                    normalize_gates=self.moe_normalize_gates,
                     attention_fn=attn_fn,
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,
@@ -133,11 +142,66 @@ class LMSpec(NamedTuple):
     num_experts: int = 0  # >0: MoE MLPs every moe_every-th block
     moe_every: int = 2
     aux_loss_weight: float = 0.01  # GShard load-balance loss weight
+    # MoE routing config (round-5 ADVICE: decode hardcoded top_k=2 and
+    # always-normalized gates — now derived from the spec, and recorded
+    # in the lm_spec.json checkpoint sidecar so serving recovers it).
+    moe_top_k: int = 2
+    moe_normalize_gates: bool = True
     # Grouped-query attention: 0 → num_heads (MHA). The generation
     # cache stores the COMPACT num_kv_heads (models/generate.py), so
     # decode HBM reads shrink by num_heads/num_kv_heads.
     num_kv_heads: int = 0
     mlp_ratio: int = 4
+
+
+def derive_lm_spec(params: Any, *, num_heads: int, **overrides) -> LMSpec:
+    """Recover an LMSpec from a restored parameter tree.
+
+    vocab_size, total_len, d_model, depth and the GQA kv-head count
+    are all visible in the shapes (embed [V, d], pos_embed [1, L, d],
+    blockN count, qkv kernel columns (H + 2·H_kv)·Dh); only the head
+    count is not, so it is an argument. ``overrides`` lets a
+    checkpoint-sidecar config (train/checkpoint.py save_lm_spec) fill
+    the fields shapes cannot carry — MoE routing (moe_top_k,
+    moe_normalize_gates), strategy — and wins over the derivation.
+    Raises ValueError when the tree is not a causal-LM tree or the
+    head count does not explain the shapes.
+    """
+    try:
+        vocab_size, d_model = (int(s) for s in params["embed"].shape)
+        total_len = int(params["pos_embed"].shape[1])
+        depth = sum(1 for k in params if str(k).startswith("block"))
+        qkv_cols = int(params["block1"]["attn"]["qkv"]["kernel"].shape[-1])
+    except (KeyError, TypeError, AttributeError) as e:
+        raise ValueError(f"not a causal-LM parameter tree (missing {e})")
+    if d_model % num_heads:
+        raise ValueError(
+            f"num_heads {num_heads} does not divide d_model {d_model}"
+        )
+    head_dim = d_model // num_heads
+    num_kv_heads = (qkv_cols // head_dim - num_heads) // 2
+    if (num_kv_heads * 2 + num_heads) * head_dim != qkv_cols:
+        raise ValueError(
+            f"qkv kernel has {qkv_cols} columns, which no kv-head "
+            f"count explains at num_heads {num_heads} — wrong head "
+            "count?"
+        )
+    fields = dict(
+        vocab_size=vocab_size,
+        total_len=total_len,
+        d_model=d_model,
+        depth=depth,
+        num_heads=num_heads,
+        num_kv_heads=0 if num_kv_heads == num_heads else num_kv_heads,
+    )
+    # Shape-derived fields win: the checkpoint is ground truth, a
+    # sidecar can only add what shapes cannot see.
+    fields.update(
+        (k, v)
+        for k, v in overrides.items()
+        if k in LMSpec._fields and k not in fields
+    )
+    return LMSpec(**fields)
 
 
 def _dense_lm(spec: LMSpec) -> CausalLM:
@@ -149,6 +213,8 @@ def _dense_lm(spec: LMSpec) -> CausalLM:
         num_heads=spec.num_heads,
         num_experts=spec.num_experts,
         moe_every=spec.moe_every,
+        moe_top_k=spec.moe_top_k,
+        moe_normalize_gates=spec.moe_normalize_gates,
         remat=spec.remat,
         num_kv_heads=spec.num_kv_heads,
         mlp_ratio=spec.mlp_ratio,
@@ -172,6 +238,8 @@ def _sharded_lm(
         attention_fn=attention,
         num_experts=spec.num_experts,
         moe_every=spec.moe_every,
+        moe_top_k=spec.moe_top_k,
+        moe_normalize_gates=spec.moe_normalize_gates,
         remat=spec.remat,
         tp_axis="model" if tp_size > 1 else None,
         tp_size=tp_size,
@@ -214,17 +282,7 @@ def next_token_loss(logits, tokens, *, label_smoothing: float = 0.0):
         axis=1,
     )
     logits32 = logits.astype(jnp.float32)
-    if label_smoothing:
-        eps = label_smoothing
-        logp = jax.nn.log_softmax(logits32, axis=-1)
-        nll_target = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
-        per_tok = (1.0 - eps) * nll_target - (
-            eps / logits.shape[-1]
-        ) * logp.sum(-1)
-    else:
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits32, targets
-        )
+    per_tok = _per_token_nll(logits32, targets, label_smoothing)
     return (per_tok * weights).sum() / weights.sum()
 
 
@@ -315,6 +373,96 @@ def _make_sharded_forward(spec: LMSpec, mesh: Mesh, compute_dtype):
     return forward, xspec
 
 
+def _per_token_nll(logits32, targets, label_smoothing: float):
+    """[B, T] next-token NLL from fp32 logits (shared CE math)."""
+    if label_smoothing:
+        eps = label_smoothing
+        logp = jax.nn.log_softmax(logits32, axis=-1)
+        nll_target = -jnp.take_along_axis(logp, targets[..., None], -1)[..., 0]
+        return (1.0 - eps) * nll_target - (
+            eps / logits32.shape[-1]
+        ) * logp.sum(-1)
+    return optax.softmax_cross_entropy_with_integer_labels(logits32, targets)
+
+
+def _make_sharded_token_metrics(
+    spec: LMSpec, mesh: Mesh, *, label_smoothing: float = 0.0
+):
+    """Next-token (loss, correct-count) computed INSIDE shard_map.
+
+    The train/eval steps used to run the CE + argmax on the GLOBAL
+    [B, T, V] logits the forward shard_map returns, leaving the jit
+    partitioner to reshard them. On jax 0.4.x CPU that miscompiles
+    once the mesh has both ``model`` and ``seq`` axes (values half-
+    wrong or NaN for ops fused downstream of the multi-axis shard_map
+    — measured round 6). Keeping every consumer of the sharded logits
+    inside shard_map sidesteps the partitioner entirely, and is the
+    TPU-native shape anyway: no global reshard of train-scale logits,
+    each shard reduces its own tokens, one psum carries scalars.
+
+    The global label shift becomes a ring exchange: shard s's last
+    local position targets shard s+1's first token (``ppermute``); the
+    very last global position is weight-0, exactly as in
+    ``next_token_loss``. Returns ``(mean loss, correct count)``
+    replicated; weights sum to B·(T−1).
+    """
+    from ddp_tpu.models.seq_transformer import _batch_axes
+
+    baxes = _batch_axes(mesh)
+    xspec = P(baxes, "seq")
+    n_seq = mesh.shape.get("seq", 1)
+    red_axes = tuple(baxes or ()) + (("seq",) if n_seq > 1 else ())
+    # model/expert members hold identical logits copies, so pmean over
+    # them is an identity — but it is what lets the jax-0.4.x shard_map
+    # transpose treat the P() scalar outputs as replicated (same reason
+    # the forward's aux output pmeans over every mesh axis).
+    rep_axes = tuple(a for a in mesh.axis_names if a not in red_axes)
+
+    def body(logits, tok_shard):
+        T_l = tok_shard.shape[1]
+        if n_seq > 1:
+            nxt = lax.ppermute(
+                tok_shard[:, :1],
+                "seq",
+                perm=[(k, (k - 1) % n_seq) for k in range(n_seq)],
+            )
+            on_last_shard = lax.axis_index("seq") == n_seq - 1
+        else:
+            nxt = jnp.zeros_like(tok_shard[:, :1])
+            on_last_shard = jnp.bool_(True)
+        targets = jnp.concatenate([tok_shard[:, 1:], nxt], axis=1)
+        weights = jnp.where(
+            (jnp.arange(T_l) == T_l - 1) & on_last_shard, 0.0, 1.0
+        )[None, :].astype(jnp.float32)  # [1, T_l], broadcasts over B
+        logits32 = logits.astype(jnp.float32)
+        per_tok = _per_token_nll(logits32, targets, label_smoothing)
+        loss_sum = (per_tok * weights).sum()
+        pred = jnp.argmax(logits32, -1)
+        correct = ((pred == targets).astype(jnp.float32) * weights).sum()
+        if red_axes:
+            loss_sum, correct = lax.psum((loss_sum, correct), red_axes)
+        # The weight total is static — B_global·(T_global−1) — so divide
+        # by the Python constant: a TRACED w_sum would become a scalar
+        # residual with a nonzero cotangent, which the jax-0.4.x
+        # shard_map transpose cannot express (rank-0 aval with
+        # all-axes out names → _SpecError).
+        b_global = tok_shard.shape[0]
+        for a in baxes or ():
+            b_global *= mesh.shape[a]
+        loss = loss_sum / (b_global * (T_l * n_seq - 1))
+        if rep_axes:
+            loss, correct = lax.pmean((loss, correct), rep_axes)
+        return loss, correct
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(xspec, xspec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+
 def make_lm_eval_step(
     spec: LMSpec, mesh: Mesh, *, compute_dtype=jnp.float32
 ):
@@ -328,20 +476,71 @@ def make_lm_eval_step(
     ``labels`` is ignored (targets are the shifted tokens themselves).
     """
     sharded_forward, _ = _make_sharded_forward(spec, mesh, compute_dtype)
+    seq_metrics = _make_sharded_seq_metrics(spec, mesh)
 
     def step(params, model_state, tokens, labels, weights):
         del model_state, labels
         logits, _ = sharded_forward(params, tokens, want_aux=False)
-        targets = tokens[:, 1:]
-        per_tok = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1].astype(jnp.float32), targets
-        )  # [B, T-1]
-        seq_loss = per_tok.mean(axis=1)
-        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
-        seq_acc = (pred == targets).mean(axis=1)
-        return (seq_acc * weights).sum(), (seq_loss * weights).sum()
+        return seq_metrics(logits, tokens, weights)
 
     return jax.jit(step)
+
+
+def _make_sharded_seq_metrics(spec: LMSpec, mesh: Mesh):
+    """Eval-side sibling of ``_make_sharded_token_metrics``: weighted
+    Σ per-sequence accuracy and per-sequence mean loss, with the CE /
+    argmax kept inside shard_map for the same jax-0.4.x partitioner
+    reason. Per-sequence sums psum over ``seq``; the weighted batch
+    sums psum over the batch axes."""
+    from ddp_tpu.models.seq_transformer import _batch_axes
+
+    baxes = _batch_axes(mesh)
+    xspec = P(baxes, "seq")
+    n_seq = mesh.shape.get("seq", 1)
+    red_axes = tuple(baxes or ()) + (("seq",) if n_seq > 1 else ())
+    rep_axes = tuple(a for a in mesh.axis_names if a not in red_axes)
+
+    def body(logits, tok_shard, w_shard):
+        T_l = tok_shard.shape[1]
+        if n_seq > 1:
+            nxt = lax.ppermute(
+                tok_shard[:, :1],
+                "seq",
+                perm=[(k, (k - 1) % n_seq) for k in range(n_seq)],
+            )
+            on_last_shard = lax.axis_index("seq") == n_seq - 1
+        else:
+            nxt = jnp.zeros_like(tok_shard[:, :1])
+            on_last_shard = jnp.bool_(True)
+        targets = jnp.concatenate([tok_shard[:, 1:], nxt], axis=1)
+        mask = jnp.where(
+            (jnp.arange(T_l) == T_l - 1) & on_last_shard, 0.0, 1.0
+        )[None, :].astype(jnp.float32)
+        logits32 = logits.astype(jnp.float32)
+        per_tok = _per_token_nll(logits32, targets, 0.0)
+        pred = jnp.argmax(logits32, -1)
+        seq_loss = (per_tok * mask).sum(axis=1)  # [B_l]
+        seq_correct = ((pred == targets).astype(jnp.float32) * mask).sum(1)
+        if n_seq > 1:
+            seq_loss, seq_correct = lax.psum(
+                (seq_loss, seq_correct), "seq"
+            )
+        denom = T_l * n_seq - 1  # targets per sequence
+        acc_sum = (seq_correct / denom * w_shard).sum()
+        loss_sum = (seq_loss / denom * w_shard).sum()
+        if baxes:
+            acc_sum, loss_sum = lax.psum((acc_sum, loss_sum), baxes)
+        if rep_axes:
+            acc_sum, loss_sum = lax.pmean((acc_sum, loss_sum), rep_axes)
+        return acc_sum, loss_sum
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(xspec, xspec, P(baxes)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
 
 
 def make_lm_train_step(
@@ -361,11 +560,12 @@ def make_lm_train_step(
     embed it in a larger program — the compiled-epoch runner
     (train/fast.py make_lm_epoch_runner) scans it.
 
-    ``tokens``: [B, T_global] int32. The label shift and loss masking
-    happen on GLOBAL arrays before/after the sharded forward, so shard
-    boundaries need no special cases; gradients arrive psum'd (and,
-    for fsdp-sharded params, scatter-reduced — parallel/seq_fsdp.py)
-    by the shard_map transpose. ``grad_accum_steps=k`` splits the
+    ``tokens``: [B, T_global] int32. The loss/accuracy math runs
+    INSIDE a second shard_map (``_make_sharded_token_metrics`` — label
+    shift as a ring exchange, CE reduced per shard, one psum), so the
+    jit partitioner never consumes the sharded logits; gradients
+    arrive psum'd (and, for fsdp-sharded params, scatter-reduced —
+    parallel/seq_fsdp.py) by the shard_map transpose. ``grad_accum_steps=k`` splits the
     batch into k STRIDED microbatches (rows i::k — contiguous splits
     would reshard the data-axis layout every step, parallel/spmd.py)
     and accumulates gradients through one ``lax.scan``. Metrics: loss
@@ -373,16 +573,15 @@ def make_lm_train_step(
     top-1.
     """
     sharded_forward, xspec = _make_sharded_forward(spec, mesh, compute_dtype)
+    token_metrics = _make_sharded_token_metrics(
+        spec, mesh, label_smoothing=label_smoothing
+    )
 
     def loss_and_logits(params, tokens):
         logits, aux = sharded_forward(params, tokens)
-        loss = next_token_loss(
-            logits, tokens, label_smoothing=label_smoothing
-        )
+        loss, correct = token_metrics(logits, tokens)
         if spec.num_experts:
             loss = loss + spec.aux_loss_weight * aux
-        pred = jnp.argmax(logits[:, :-1].astype(jnp.float32), -1)
-        correct = (pred == tokens[:, 1:]).sum().astype(jnp.float32)
         return loss, correct
 
     def step(state: LMTrainState, tokens):

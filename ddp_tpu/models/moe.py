@@ -168,6 +168,7 @@ class MoEEncoderBlock(nn.Module):
     num_experts: int
     top_k: int = 2
     capacity_factor: float = 2.0
+    normalize_gates: bool = True
     dropout_rate: float = 0.0
     attention_fn: Optional[AttentionFn] = None
     deterministic: bool = True  # attribute, not call kwarg — remat-safe
@@ -205,6 +206,7 @@ class MoEEncoderBlock(nn.Module):
             mlp_dim=self.mlp_dim,
             top_k=self.top_k,
             capacity_factor=self.capacity_factor,
+            normalize_gates=self.normalize_gates,
             ep_axis=self.ep_axis,
             ep_size=self.ep_size,
             name="moe",

@@ -104,6 +104,11 @@ class PipeLMConfig(NamedTuple):
     # f/g plumbing does not extend into routed blocks).
     num_experts: int = 0
     moe_every: int = 2
+    # Routing config for those MoE blocks (threaded into StageBlocks →
+    # MoEEncoderBlock, same fields as LMSpec — the CLI's --moe_top_k /
+    # --moe_raw_gates must not be silently ignored on this family).
+    moe_top_k: int = 2
+    moe_normalize_gates: bool = True
     # Expert parallelism over the ``expert`` mesh axis (PP×EP, round
     # 5): expert weights rest sharded 1/ep per member INSIDE each
     # stage, ``expert`` joins the batch axes (pipe_common.py
@@ -217,6 +222,8 @@ def _stage_module(
         num_kv_heads=cfg.num_kv_heads,
         num_experts=cfg.num_experts,
         moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
+        moe_normalize_gates=cfg.moe_normalize_gates,
         ep_axis="expert" if ep else None,
         ep_size=cfg.ep_size if ep else 1,
     )
@@ -815,6 +822,8 @@ def to_dense_lm(cfg: PipeLMConfig, params: PipeLMParams):
         num_kv_heads=cfg.num_kv_heads,
         num_experts=cfg.num_experts,
         moe_every=cfg.moe_every,
+        moe_top_k=cfg.moe_top_k,
+        moe_normalize_gates=cfg.moe_normalize_gates,
         mlp_ratio=cfg.mlp_ratio,
     )
     return spec, dense

@@ -137,6 +137,8 @@ class StageBlocks(nn.Module):
     num_kv_heads: int = 0  # GQA — see models/vit.py MultiHeadAttention
     num_experts: int = 0  # MoE MLPs — see models/moe.py
     moe_every: int = 2
+    moe_top_k: int = 2  # routing config — threaded from PipeLMConfig
+    moe_normalize_gates: bool = True
     ep_axis: Optional[str] = None  # expert parallelism (see MoEMLP)
     ep_size: int = 1
 
@@ -169,6 +171,8 @@ class StageBlocks(nn.Module):
                     num_heads=self.num_heads,
                     mlp_dim=self.mlp_dim,
                     num_experts=self.num_experts,
+                    top_k=self.moe_top_k,
+                    normalize_gates=self.moe_normalize_gates,
                     attention_fn=self.attention_fn,
                     ep_axis=self.ep_axis,
                     ep_size=self.ep_size,

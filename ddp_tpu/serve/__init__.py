@@ -1,0 +1,22 @@
+"""ddp_tpu.serve — continuous-batching TPU inference engine.
+
+The framework's serving half (the ROADMAP north star "serves heavy
+traffic"): a fixed-slot, static-shape decode batch over the
+models/generate.py KV cache, fed by a FIFO queue with admission
+control, fronted by a stdlib HTTP server, observable through the same
+JSONL metrics stream the trainer writes. See docs/SERVING.md.
+
+Layer map:
+
+  scheduler.py   admission control, FIFO queue, deadlines (pure host)
+  engine.py      slots, continuous batching, the 3-program compile set
+  server.py      stdlib HTTP frontend + background engine thread
+  scripts/serve.py (repo root)  checkpoint → listening server CLI
+"""
+
+from ddp_tpu.serve.engine import Completion, ServeEngine  # noqa: F401
+from ddp_tpu.serve.scheduler import (  # noqa: F401
+    Admission,
+    Request,
+    Scheduler,
+)

@@ -1,0 +1,392 @@
+"""Continuous-batching decode engine over fixed slots.
+
+The TPU serving problem (PAPERS.md #1/#5 regime): requests arrive at
+arbitrary times with arbitrary prompt/output lengths, but XLA wants
+ONE compiled program per shape. The resolution is the standard
+continuous-batching design (Orca/vLLM lineage) restricted to fully
+static shapes:
+
+- the engine owns S decode **slots** — lanes of one SlotCache
+  (models/generate.py) sized [depth, S, total_len, H_kv, Dh] at
+  startup, never reshaped;
+- every engine step advances ALL S lanes by one token
+  (``slot_decode_step`` — one compiled program, mixed-age batch);
+- a finished/evicted slot is **refilled** in place: the queue head is
+  prefilled at one fixed padded width (``prefill_slot``) and spliced
+  into the freed lane (``write_slot``) while the other lanes keep
+  decoding on the next step;
+- therefore the engine compiles exactly THREE programs (prefill,
+  decode, splice) at warmup, and a varied request mix — staggered
+  arrivals, different lengths, evictions — triggers **zero further
+  compilation** (pinned by tests/test_serve.py via the jit cache
+  counters this class exposes in ``compile_counts``).
+
+Scheduling policy lives in serve/scheduler.py (admission, FIFO,
+deadlines); this module is the data plane plus per-request
+bookkeeping. Observability flows through utils/metrics.MetricsWriter:
+``serve_step`` records (queue depth, slot occupancy, evictions) and
+``serve_request`` records (status, TTFT, decode tokens/s) land in the
+same JSONL stream the trainer writes.
+
+Sampling: greedy (temperature 0, the correctness-pinned path — token-
+identical to models/generate.generate) or host-side temperature
+sampling with a per-request numpy PRNG (deliberately NOT the jitted
+``jax.random`` path: per-request keys would either recompile per mix
+or burn a [S]-wide key tensor for mostly-greedy traffic).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_tpu.models.generate import (
+    init_slot_cache,
+    prefill_slot,
+    slot_decode_step,
+    write_slot,
+)
+from ddp_tpu.models.lm import LMSpec
+from ddp_tpu.serve.scheduler import Admission, Request, Scheduler
+from ddp_tpu.utils.metrics import MetricsWriter, StatSummary
+
+# Completion statuses.
+COMPLETE = "complete"
+TIMEOUT_EVICTED = "timeout_evicted"  # deadline hit while decoding
+TIMEOUT_QUEUE = "timeout_queue"  # deadline hit while queued
+
+
+@dataclass
+class Completion:
+    """One finished request: everything the frontend returns."""
+
+    rid: int
+    status: str
+    prompt: list[int]
+    tokens: list[int]
+    ttft: float  # seconds, submit → first token ready
+    decode_seconds: float  # first token → finish
+    submitted: float
+    finished: float
+
+    @property
+    def decode_tokens_per_s(self) -> float:
+        n = len(self.tokens) - 1  # tokens after the prefill token
+        return n / self.decode_seconds if self.decode_seconds > 0 else 0.0
+
+
+@dataclass
+class _Slot:
+    """Host-side bookkeeping for one lane."""
+
+    request: Optional[Request] = None
+    tokens: list[int] = field(default_factory=list)
+    first_token_at: float = 0.0
+    rng: Optional[np.random.Generator] = None
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+
+class ServeEngine:
+    """Fixed-slot continuous-batching engine for one causal LM.
+
+    ``slots`` and ``prefill_len`` fix the static shapes (prefill_len
+    defaults to half the position table — prompts longer than it are
+    rejected at admission, budget for decode is what remains).
+    ``clock`` is injectable for deterministic tests; MetricsWriter
+    ``metrics`` may be shared with a trainer's stream or omitted.
+    """
+
+    def __init__(
+        self,
+        spec: LMSpec,
+        params: Any,
+        *,
+        slots: int = 4,
+        prefill_len: Optional[int] = None,
+        max_queue: int = 64,
+        metrics: Optional[MetricsWriter] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        prefill_len = prefill_len or max(1, spec.total_len // 2)
+        if not 0 < prefill_len <= spec.total_len - 1:
+            raise ValueError(
+                f"prefill_len {prefill_len} must leave room to decode "
+                f"inside total_len {spec.total_len}"
+            )
+        self.spec = spec
+        self.params = params
+        self.num_slots = slots
+        self.prefill_len = prefill_len
+        self.clock = clock
+        self.metrics = metrics or MetricsWriter(None)
+        self.scheduler = Scheduler(
+            max_queue=max_queue,
+            prefill_len=prefill_len,
+            total_len=spec.total_len,
+            vocab_size=spec.vocab_size,
+            clock=clock,
+        )
+        self._slots = [_Slot() for _ in range(slots)]
+        self._cache = init_slot_cache(spec, slots)
+        self._tokens = np.zeros((slots,), np.int32)
+        self._completed: dict[int, Completion] = {}
+        self._steps = 0
+        self.ttft = StatSummary()
+        self.decode_rate = StatSummary()
+        # The engine's entire compiled surface: three programs, built
+        # once here. Slot index / length / positions are traced, so
+        # no request mix can grow this set after warmup.
+        self._prefill = jax.jit(
+            lambda p, prompt, n: prefill_slot(spec, p, prompt, n)
+        )
+        # The cache argument is DONATED in both cache-threading
+        # programs: the engine always overwrites self._cache with the
+        # result, and without donation XLA must preserve the input, so
+        # every decoded token would re-materialize the full
+        # [depth, S, total_len, H_kv, Dh] KV buffer (2× serving HBM +
+        # a copy per step). Same reason models/generate.py's scan
+        # donates its cache carry.
+        self._decode = jax.jit(
+            lambda p, cache, toks: slot_decode_step(spec, p, cache, toks),
+            donate_argnums=(1,),
+        )
+        # A fresh lambda (like the two above), NOT jax.jit(write_slot):
+        # jit tracing caches are shared per function object, so a bare
+        # write_slot wrapper would count OTHER engines' compilations in
+        # this engine's compile_counts — the static-shape pin must be
+        # per-engine.
+        self._splice = jax.jit(
+            lambda c, s, k, v, n: write_slot(c, s, k, v, n),
+            donate_argnums=(0,),
+        )
+
+    # ---- frontend surface ------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Admission:
+        """Admission-checked enqueue; rejections carry a reason."""
+        adm = self.scheduler.submit(
+            prompt,
+            max_new_tokens,
+            temperature=temperature,
+            seed=seed,
+            timeout=timeout,
+        )
+        if not adm.accepted:
+            self.metrics.write(
+                "serve_reject",
+                reason=adm.reason,
+                queue_depth=self.scheduler.depth,
+            )
+        return adm
+
+    def result(self, rid: int) -> Optional[Completion]:
+        """The finished record for ``rid``, None while pending."""
+        return self._completed.get(rid)
+
+    def pop_result(self, rid: int) -> Optional[Completion]:
+        return self._completed.pop(rid, None)
+
+    @property
+    def active(self) -> int:
+        return sum(1 for s in self._slots if not s.free)
+
+    @property
+    def pending(self) -> bool:
+        return self.active > 0 or self.scheduler.depth > 0
+
+    def compile_counts(self) -> dict[str, int]:
+        """Compiled-program count per engine function (the static-
+        shape pin: after warmup these must never grow)."""
+        return {
+            "prefill": self._prefill._cache_size(),
+            "decode": self._decode._cache_size(),
+            "splice": self._splice._cache_size(),
+        }
+
+    def stats(self) -> dict:
+        """JSON-ready operational snapshot (the /stats endpoint)."""
+        return {
+            "slots": self.num_slots,
+            "active": self.active,
+            "queue_depth": self.scheduler.depth,
+            "steps": self._steps,
+            "completed": len(self._completed),
+            "ttft_s": self.ttft.snapshot(),
+            "decode_tokens_per_s": self.decode_rate.snapshot(),
+            "compile_counts": self.compile_counts(),
+        }
+
+    # ---- engine loop ------------------------------------------------
+
+    def step(self) -> int:
+        """One engine iteration → number of live tokens produced.
+
+        Order: (1) retire finished / evict expired running requests,
+        (2) evict expired queued requests, (3) refill free slots from
+        the queue (prefill produces each request's FIRST token), (4)
+        one batched decode step over all slots. A slot refilled in (3)
+        also decodes in (4) — continuous batching, no drain barrier.
+        """
+        now = self.clock()
+        evictions = 0
+        for slot in self._slots:
+            req = slot.request
+            if req is None:
+                continue
+            if len(slot.tokens) >= req.max_new_tokens:
+                self._finish(slot, COMPLETE)
+            elif req.expired(now):
+                self._finish(slot, TIMEOUT_EVICTED)
+                evictions += 1
+        for req in self.scheduler.evict_expired():
+            now2 = self.clock()
+            self._completed[req.rid] = Completion(
+                rid=req.rid, status=TIMEOUT_QUEUE, prompt=req.prompt,
+                tokens=[], ttft=now2 - req.submitted, decode_seconds=0.0,
+                submitted=req.submitted, finished=now2,
+            )
+            self._record_request(self._completed[req.rid])
+            evictions += 1
+
+        produced = 0
+        for i, slot in enumerate(self._slots):
+            if not slot.free or self.scheduler.depth == 0:
+                continue
+            req = self.scheduler.next_request()
+            if req is None:
+                break
+            self._refill(i, slot, req)
+            produced += 1
+
+        if self.active:
+            logits, self._cache = self._decode(
+                self.params, self._cache, jnp.asarray(self._tokens)
+            )
+            logits = np.asarray(logits)
+            for i, slot in enumerate(self._slots):
+                req = slot.request
+                if req is None or len(slot.tokens) >= req.max_new_tokens:
+                    # Idle lane, or a request whose budget the prefill
+                    # token already filled — it retires next step; the
+                    # lane's decode output is discarded.
+                    continue
+                tok = self._pick(slot, logits[i])
+                slot.tokens.append(tok)
+                self._tokens[i] = tok
+                produced += 1
+
+        self._steps += 1
+        self.metrics.write(
+            "serve_step",
+            step=self._steps,
+            queue_depth=self.scheduler.depth,
+            active_slots=self.active,
+            slot_occupancy=round(self.active / self.num_slots, 4),
+            evictions=evictions,
+            tokens=produced,
+        )
+        return produced
+
+    def run(self, *, max_steps: Optional[int] = None) -> list[Completion]:
+        """Drive ``step()`` until idle (or ``max_steps``) → completions
+        retired during this call, in finish order."""
+        before = set(self._completed)
+        steps = 0
+        # A request whose budget fills on a decode step retires at the
+        # START of the next step, so ``pending`` stays true until the
+        # retire pass has run — no trailing flush needed.
+        while self.pending and (max_steps is None or steps < max_steps):
+            self.step()
+            steps += 1
+        return sorted(
+            (c for r, c in self._completed.items() if r not in before),
+            key=lambda c: c.finished,
+        )
+
+    # ---- internals --------------------------------------------------
+
+    def _refill(self, index: int, slot: _Slot, req: Request) -> None:
+        """Prefill ``req`` into lane ``index``; emits the first token."""
+        pad = self.prefill_len - len(req.prompt)
+        padded = jnp.asarray(
+            [req.prompt + [0] * pad], jnp.int32
+        )
+        logits, k, v = self._prefill(
+            self.params, padded, jnp.int32(len(req.prompt))
+        )
+        self._cache = self._splice(
+            self._cache, jnp.int32(index), k, v, jnp.int32(len(req.prompt))
+        )
+        slot.request = req
+        slot.tokens = []
+        slot.rng = (
+            np.random.default_rng(req.seed)
+            if req.temperature > 0.0
+            else None
+        )
+        tok = self._pick(slot, np.asarray(logits))
+        slot.tokens.append(tok)
+        self._tokens[index] = tok
+        slot.first_token_at = self.clock()
+        self.ttft.add(slot.first_token_at - req.submitted)
+
+    def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
+        """Greedy argmax, or host-side temperature sampling."""
+        req = slot.request
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / req.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(slot.rng.choice(len(p), p=p))
+
+    def _finish(self, slot: _Slot, status: str) -> None:
+        req = slot.request
+        now = self.clock()
+        c = Completion(
+            rid=req.rid,
+            status=status,
+            prompt=req.prompt,
+            tokens=list(slot.tokens),
+            ttft=slot.first_token_at - req.submitted,
+            decode_seconds=now - slot.first_token_at,
+            submitted=req.submitted,
+            finished=now,
+        )
+        self._completed[req.rid] = c
+        if len(c.tokens) > 1:
+            self.decode_rate.add(c.decode_tokens_per_s)
+        self._record_request(c)
+        slot.request = None
+        slot.tokens = []
+        slot.rng = None
+
+    def _record_request(self, c: Completion) -> None:
+        self.metrics.write(
+            "serve_request",
+            rid=c.rid,
+            status=c.status,
+            prompt_len=len(c.prompt),
+            new_tokens=len(c.tokens),
+            ttft_s=round(c.ttft, 4),
+            decode_tokens_per_s=round(c.decode_tokens_per_s, 2),
+        )

@@ -1,0 +1,151 @@
+"""Request queue with admission control — the serving front door.
+
+The engine (serve/engine.py) owns a FIXED number of decode slots; this
+module owns everything that happens before a request reaches one:
+
+- **Admission control**: a request is validated at submit time against
+  the engine's static limits (prompt fits the prefill width, prompt +
+  budget fits the position table, budget positive) and the queue
+  bound. Rejection is an explicit ``Admission`` with a machine-readable
+  reason — the backpressure contract is *reject-with-reason at the
+  door*, never queue-without-bound and OOM later.
+- **FIFO with deadline eviction**: queued requests past their deadline
+  are evicted (status ``timeout_queue``) rather than prefilled after
+  they stopped mattering; the engine applies the same deadline to
+  RUNNING requests (status ``timeout_evicted``), freeing the slot for
+  the queue head.
+
+Pure host-side Python — no JAX here. ``clock`` is injectable so tests
+drive time explicitly.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+
+# Machine-readable rejection reasons (the HTTP layer maps these to 4xx
+# bodies; tests assert on them).
+QUEUE_FULL = "queue_full"
+PROMPT_EMPTY = "prompt_empty"
+PROMPT_TOO_LONG = "prompt_too_long"
+BUDGET_NONPOSITIVE = "max_new_tokens_nonpositive"
+BUDGET_EXCEEDS_CONTEXT = "budget_exceeds_context"
+TOKEN_OUT_OF_RANGE = "token_out_of_range"
+
+
+@dataclass
+class Request:
+    """One admitted generate request."""
+
+    rid: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    seed: int = 0
+    deadline: Optional[float] = None  # absolute, in clock() time
+    submitted: float = 0.0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+@dataclass
+class Admission:
+    """Submit outcome: ``request`` on accept, ``reason`` on reject."""
+
+    accepted: bool
+    reason: Optional[str] = None
+    request: Optional[Request] = None
+
+
+@dataclass
+class Scheduler:
+    """Bounded FIFO queue + admission control for the serve engine.
+
+    ``prefill_len``/``total_len`` mirror the engine's static shapes:
+    a prompt longer than the prefill width can never be prefilled
+    (one compiled prefill shape is the whole point), and prompt +
+    max_new_tokens beyond the position table would decode garbage —
+    both are admission errors, not runtime surprises.
+    """
+
+    max_queue: int
+    prefill_len: int
+    total_len: int
+    vocab_size: int = 0  # 0 = skip the token-range check
+    clock: Callable[[], float] = time.monotonic
+    _queue: deque = field(default_factory=deque)
+    _ids: "itertools.count" = field(default_factory=itertools.count)
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        seed: int = 0,
+        timeout: Optional[float] = None,
+    ) -> Admission:
+        """Validate + enqueue → Admission (never raises on bad input)."""
+        try:
+            prompt = [int(t) for t in prompt]
+        except (TypeError, ValueError):
+            # Non-numeric tokens: same front-door contract as a
+            # numeric token outside the vocab — reject, don't raise.
+            return Admission(False, TOKEN_OUT_OF_RANGE)
+        if not prompt:
+            return Admission(False, PROMPT_EMPTY)
+        if len(prompt) > self.prefill_len:
+            return Admission(False, PROMPT_TOO_LONG)
+        if max_new_tokens < 1:
+            return Admission(False, BUDGET_NONPOSITIVE)
+        if len(prompt) + max_new_tokens > self.total_len:
+            return Admission(False, BUDGET_EXCEEDS_CONTEXT)
+        if self.vocab_size and not all(
+            0 <= t < self.vocab_size for t in prompt
+        ):
+            return Admission(False, TOKEN_OUT_OF_RANGE)
+        if len(self._queue) >= self.max_queue:
+            return Admission(False, QUEUE_FULL)
+        now = self.clock()
+        req = Request(
+            rid=next(self._ids),
+            prompt=prompt,
+            max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature),
+            seed=int(seed),
+            deadline=None if timeout is None else now + float(timeout),
+            submitted=now,
+        )
+        self._queue.append(req)
+        return Admission(True, request=req)
+
+    def evict_expired(self) -> list[Request]:
+        """Drop queued requests past their deadline → the evicted."""
+        now = self.clock()
+        expired = [r for r in self._queue if r.expired(now)]
+        if expired:
+            dead = {r.rid for r in expired}
+            self._queue = deque(
+                r for r in self._queue if r.rid not in dead
+            )
+        return expired
+
+    def next_request(self) -> Optional[Request]:
+        """Pop the FIFO head, None when empty.
+
+        Callers run ``evict_expired()`` first (the engine does, every
+        step) — this only pops; an expired head that slipped between
+        the two calls is still caught by the engine's running-request
+        deadline check on its first decode step.
+        """
+        return self._queue.popleft() if self._queue else None
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)

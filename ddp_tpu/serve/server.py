@@ -1,0 +1,229 @@
+"""Stdlib-HTTP frontend for the serve engine.
+
+One background thread drives ``ServeEngine.step()`` whenever work is
+pending; HTTP handler threads only touch the engine under the same
+lock (the engine is deliberately single-threaded — slots and cache are
+one device program's state). No web framework: ``http.server`` is in
+every container this repo targets, and the API is three routes:
+
+  POST /generate   {"prompt_tokens": [...], "max_new_tokens": N,
+                    "temperature"?, "seed"?, "timeout"?}
+                   → 200 {"rid", "status", "tokens", "ttft_s", ...}
+                   → 429 {"error": "queue_full"} on backpressure
+                   → 400 {"error": "prompt_too_long" | ...} on
+                     permanently-invalid requests
+                   → 400 on malformed bodies
+  GET  /healthz    → 200 {"ok": true, "slots": S, ...} (liveness)
+  GET  /stats      → 200 engine.stats() (TTFT/throughput summaries,
+                    compile counts — the static-shape invariant is an
+                    OBSERVABLE, not a comment)
+
+The handler blocks until its request completes (simple request/
+response serving); queue position and slot availability decide
+latency. Backpressure is visible: an admission rejection returns
+immediately with the scheduler's reason.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ddp_tpu.serve.engine import ServeEngine
+from ddp_tpu.serve.scheduler import QUEUE_FULL
+
+# Engine-loop idle poll; the loop burns no CPU when no work is queued.
+_IDLE_SLEEP_S = 0.002
+
+
+class LMServer:
+    """Engine + driver thread + ThreadingHTTPServer, lifecycle-managed.
+
+    ``port=0`` binds an ephemeral port (tests); ``server.port`` is the
+    bound one. Use as a context manager or call ``start()``/``stop()``.
+    """
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._engine_error: Optional[str] = None
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._threads: list[threading.Thread] = []
+
+    # ---- lifecycle --------------------------------------------------
+
+    def start(self) -> "LMServer":
+        for name, target in (
+            ("serve-engine", self._engine_loop),
+            ("serve-http", self._httpd.serve_forever),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def __enter__(self) -> "LMServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ---- engine driving ---------------------------------------------
+
+    def _engine_loop(self) -> None:
+        # An exception escaping step() (device OOM, runtime error) must
+        # not kill this daemon thread SILENTLY: waiters would poll
+        # forever and /healthz would keep answering ok. Record it, flip
+        # health, and fail in-flight requests fast instead.
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    busy = self.engine.pending
+                    if busy:
+                        self.engine.step()
+                if not busy:
+                    time.sleep(_IDLE_SLEEP_S)
+        except Exception as e:  # noqa: BLE001 — terminal, reported
+            self._engine_error = f"{type(e).__name__}: {e}"
+
+    def submit_and_wait(
+        self, body: dict, *, poll: float = 0.002
+    ) -> tuple[int, dict]:
+        """The POST /generate implementation → (http_status, payload).
+
+        Also the in-process frontend: callers embedding the engine
+        (tests, bench) can use it without a socket.
+        """
+        try:
+            prompt = list(body["prompt_tokens"])
+            max_new = int(body["max_new_tokens"])
+            temperature = float(body.get("temperature", 0.0))
+            seed = int(body.get("seed", 0))
+            timeout = float(body["timeout"]) if "timeout" in body else None
+        except (KeyError, TypeError, ValueError):
+            return 400, {
+                "error": "body needs prompt_tokens (list[int]) and "
+                "max_new_tokens (int); temperature/seed/timeout must "
+                "be numeric"
+            }
+        if self._engine_error is not None:
+            return 500, {"error": f"engine failed: {self._engine_error}"}
+        with self._lock:
+            adm = self.engine.submit(
+                prompt,
+                max_new,
+                temperature=temperature,
+                seed=seed,
+                timeout=timeout,
+            )
+        if not adm.accepted:
+            # Only queue_full is transient (retry-after-backoff
+            # semantics); the validation reasons are permanent client
+            # errors — a 429 would invite retry loops on requests that
+            # can never be served.
+            status = 429 if adm.reason == QUEUE_FULL else 400
+            return status, {"error": adm.reason}
+        rid = adm.request.rid
+        while True:
+            with self._lock:
+                done = self.engine.pop_result(rid)
+            if done is not None:
+                break
+            if self._engine_error is not None:
+                return 500, {"error": f"engine failed: {self._engine_error}"}
+            if self._stop.is_set():
+                return 503, {"error": "server stopping"}
+            time.sleep(poll)
+        return 200, {
+            "rid": done.rid,
+            "status": done.status,
+            "prompt_tokens": done.prompt,
+            "tokens": done.tokens,
+            "ttft_s": round(done.ttft, 4),
+            "decode_tokens_per_s": round(done.decode_tokens_per_s, 2),
+        }
+
+    def snapshot(self, route: str) -> Optional[dict]:
+        if route == "/healthz":
+            with self._lock:
+                return {
+                    "ok": self._engine_error is None,
+                    "slots": self.engine.num_slots,
+                    "active": self.engine.active,
+                    "queue_depth": self.engine.scheduler.depth,
+                    **(
+                        {"engine_error": self._engine_error}
+                        if self._engine_error
+                        else {}
+                    ),
+                }
+        if route == "/stats":
+            with self._lock:
+                return self.engine.stats()
+        return None
+
+
+def _make_handler(server: LMServer):
+    class Handler(BaseHTTPRequestHandler):
+        # Quiet: request logging goes through metrics, not stderr.
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802
+            payload = server.snapshot(self.path)
+            if payload is None:
+                self._send(404, {"error": f"no route {self.path}"})
+            else:
+                # A dead engine must fail status-code liveness probes
+                # (`curl -f /healthz`), not just flip a JSON field.
+                status = 503 if payload.get("ok") is False else 200
+                self._send(status, payload)
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/generate":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            status, payload = server.submit_and_wait(body)
+            self._send(status, payload)
+
+    return Handler

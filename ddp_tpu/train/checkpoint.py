@@ -117,6 +117,77 @@ def _check_qkv_format(fmt: int | None, tree: Any, source: str) -> None:
         )
 
 
+# --- LM spec sidecar --------------------------------------------------
+#
+# The architecture fields an LM checkpoint's shapes cannot carry —
+# head count, MoE routing (top_k, gate normalization), sequence
+# strategy — ride next to the checkpoints as one JSON file, like the
+# tokenizer does (trainer writes ``tokenizer.json`` beside the epochs).
+# Inference tooling (scripts/predict.py, scripts/serve.py) merges it
+# over the shape-derived spec (models/lm.py derive_lm_spec), so a
+# checkpoint trained at --moe_top_k 1 serves with top-1 routing
+# instead of silently assuming the top-2 default (round-5 ADVICE).
+
+LM_SPEC_FILENAME = "lm_spec.json"
+
+
+def save_lm_spec(directory: str, spec: Any) -> str:
+    """Write ``spec`` (an LMSpec) as JSON beside the checkpoints."""
+    import json
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, LM_SPEC_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(spec._asdict()), f, indent=2, sort_keys=True)
+    os.replace(tmp, path)  # atomic like the checkpoint commits
+    return path
+
+
+def load_lm_spec_fields(directory: str) -> dict:
+    """Read the sidecar → field dict ({} when absent or unreadable).
+
+    Returns a plain dict (not an LMSpec) filtered to the fields the
+    CURRENT LMSpec knows, so older/newer sidecars degrade to whatever
+    subset still applies instead of failing construction.
+    """
+    import json
+
+    from ddp_tpu.models.lm import LMSpec
+
+    path = os.path.join(directory, LM_SPEC_FILENAME)
+    try:
+        with open(path) as f:
+            fields = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(fields, dict):
+        return {}
+    return {k: v for k, v in fields.items() if k in LMSpec._fields}
+
+
+def derive_spec_with_sidecar(
+    directory: str, params: Any, *, num_heads_fallback: int
+):
+    """Restored params + ``lm_spec.json`` sidecar → LMSpec.
+
+    The shared inference-tooling recipe (scripts/predict.py,
+    scripts/serve.py): shapes are ground truth, the sidecar supplies
+    what they cannot carry (head count, MoE routing, strategy), and
+    ``num_heads_fallback`` (a CLI flag) covers sidecar-less
+    checkpoints. Raises ValueError when the tree is not a causal-LM
+    tree or the head count does not explain the shapes.
+    """
+    from ddp_tpu.models.lm import derive_lm_spec
+
+    sidecar = load_lm_spec_fields(directory)
+    return derive_lm_spec(
+        params,
+        num_heads=sidecar.pop("num_heads", num_heads_fallback),
+        **sidecar,
+    )
+
+
 class CheckpointManager:
     """Per-epoch checkpoints with latest-epoch auto-resume.
 
@@ -151,34 +222,45 @@ class CheckpointManager:
         always preserved.
         """
         self._dir = os.path.abspath(directory)
-        preservation = None
-        if keep_best_metric:
-            from orbax.checkpoint.checkpoint_managers import (
-                AnyPreservationPolicy,
-                BestN,
-                LatestN,
-            )
-
-            preservation = AnyPreservationPolicy(
-                [
-                    LatestN(1),  # auto-resume anchor
-                    BestN(
-                        get_metric_fn=lambda m: m[keep_best_metric],
-                        # reverse=False keeps the HIGHEST metric values
-                        # (empirically: reverse=True retains the lowest)
-                        reverse=False,
-                        n=max_to_keep,
-                        keep_checkpoints_without_metrics=True,
-                    ),
-                ]
-            )
-        opts = ocp.CheckpointManagerOptions(
+        self._keep_best_fallback: tuple | None = None
+        opts_kwargs: dict = dict(
             max_to_keep=None if keep_best_metric else max_to_keep,
             create=True,
             enable_async_checkpointing=async_save,
             step_prefix="epoch",
-            preservation_policy=preservation,
         )
+        if keep_best_metric:
+            try:
+                from orbax.checkpoint.checkpoint_managers import (
+                    AnyPreservationPolicy,
+                    BestN,
+                    LatestN,
+                )
+
+                opts_kwargs["preservation_policy"] = AnyPreservationPolicy(
+                    [
+                        LatestN(1),  # auto-resume anchor
+                        BestN(
+                            get_metric_fn=lambda m: m[keep_best_metric],
+                            # reverse=False keeps the HIGHEST metric
+                            # values (empirically: reverse=True retains
+                            # the lowest)
+                            reverse=False,
+                            n=max_to_keep,
+                            keep_checkpoints_without_metrics=True,
+                        ),
+                    ]
+                )
+            except ImportError:
+                # orbax < 0.11: no preservation policies, and the old
+                # best_fn API cannot express best-N PLUS the latest
+                # anchor. Emulate with explicit deletes after each
+                # save (_prune_keep_best); metrics are tracked
+                # in-process, and saves whose metric was never seen
+                # are kept — the keep_checkpoints_without_metrics
+                # behaviour.
+                self._keep_best_fallback = (keep_best_metric, max_to_keep, {})
+        opts = ocp.CheckpointManagerOptions(**opts_kwargs)
         # Explicit handler so item_metadata works before any save/
         # restore call registered one (the template-free inference path
         # in a fresh process).
@@ -243,14 +325,45 @@ class CheckpointManager:
         # mid_batch 0 means the tagged epoch completed.
         tree = dict(
             state._asdict(),
-            spe=np.int32(steps_per_epoch),
-            mid_batch=np.int32(mid_batch),
-            fmt=np.int32(CHECKPOINT_FORMAT),
+            # 0-d arrays, not numpy scalars: older orbax
+            # StandardCheckpointHandlers reject np.int32(...) leaves.
+            spe=np.asarray(steps_per_epoch, np.int32),
+            mid_batch=np.asarray(mid_batch, np.int32),
+            fmt=np.asarray(CHECKPOINT_FORMAT, np.int32),
         )
         self._mgr.save(
             epoch, args=ocp.args.StandardSave(tree), metrics=metrics
         )
+        if self._keep_best_fallback is not None:
+            self._prune_keep_best(epoch, metrics)
         return True
+
+    def _prune_keep_best(self, epoch: int, metrics: dict | None) -> None:
+        """best-N ∪ latest retention for orbax versions without
+        preservation policies (see __init__). Runs after each save;
+        under async saving the in-flight step is not yet listed, so
+        the previous latest survives one extra round — pruned by the
+        next save, never the auto-resume anchor."""
+        metric_name, n, seen = self._keep_best_fallback
+        if metrics and metric_name in metrics:
+            seen[epoch] = metrics[metric_name]
+        steps = self._mgr.all_steps() or []
+        if not steps:
+            return
+        best = sorted(
+            (s for s in steps if s in seen),
+            key=lambda s: seen[s],
+            reverse=True,
+        )
+        # n=None means unbounded (the new-orbax path keeps every
+        # metric-bearing save then too) — only slice for a real bound.
+        if n is not None:
+            best = best[:n]
+        keep = set(best) | {max(steps)}
+        keep |= {s for s in steps if s not in seen}  # metric-less saves
+        for s in steps:
+            if s not in keep:
+                self._mgr.delete(s)
 
     def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:
         """Restore → (state, epoch). ``state_like`` supplies the tree
@@ -348,16 +461,22 @@ class CheckpointManager:
                 options=ocp.CheckpointManagerOptions(step_prefix="epoch"),
                 item_handlers=ocp.PyTreeCheckpointHandler(),
             )
-        return dict(
-            self._pytree_mgr.restore(
-                epoch,
-                args=ocp.args.PyTreeRestore(
-                    item=abstract,
-                    restore_args=restore_args,
-                    partial_restore=True,
-                ),
+        try:
+            args = ocp.args.PyTreeRestore(
+                item=abstract,
+                restore_args=restore_args,
+                partial_restore=True,
             )
-        )
+        except TypeError:
+            # orbax < 0.9: no partial_restore kwarg — an empty
+            # transforms dict is the era's partial-restore idiom
+            # (checkpoint keys absent from ``item`` are dropped).
+            args = ocp.args.PyTreeRestore(
+                item=abstract,
+                restore_args=restore_args,
+                transforms={},
+            )
+        return dict(self._pytree_mgr.restore(epoch, args=args))
 
     def restore_for_inference(
         self, epoch: int | None = None

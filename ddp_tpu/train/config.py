@@ -101,6 +101,12 @@ class TrainConfig:
     # family needs moe_every to divide --model_depth (stages must be
     # structure-uniform for parameter stacking — models/pipeline_lm.py).
     moe_every: int = 2
+    # Routing config for those MoE blocks: experts per token, and
+    # whether the surviving top-k gates renormalize to sum to 1.
+    # Recorded in the lm_spec.json checkpoint sidecar so the decode /
+    # serving path reproduces the training routing (round-5 ADVICE).
+    moe_top_k: int = 2
+    moe_normalize_gates: bool = True
     # Real LM data: a file read as raw bytes (--dataset text),
     # chunked into seq_len sequences (data/text.py). No tokenizer dep.
     text_file: str | None = None
@@ -224,6 +230,15 @@ class TrainConfig:
             help="route every k-th block's MLP (1 = all blocks)",
         )
         p.add_argument(
+            "--moe_top_k", type=int, default=cls.moe_top_k,
+            help="experts each token visits (GShard top-k routing)",
+        )
+        p.add_argument(
+            "--moe_raw_gates", action="store_true",
+            help="combine experts with raw top-k gate values instead "
+            "of renormalizing them to sum to 1",
+        )
+        p.add_argument(
             "--text_file", default=cls.text_file,
             help="byte-level corpus for --dataset text (causal_lm)",
         )
@@ -264,6 +279,7 @@ class TrainConfig:
     def from_namespace(cls, ns) -> "TrainConfig":
         kwargs = dict(vars(ns))
         kwargs["shuffle"] = not kwargs.pop("no_shuffle")
+        kwargs["moe_normalize_gates"] = not kwargs.pop("moe_raw_gates")
         # action flags, not config state (handled by train.py)
         kwargs.pop("list_models", None)
         kwargs.pop("list_datasets", None)

@@ -402,6 +402,8 @@ class Trainer:
                     remat=config.remat,
                     num_experts=config.moe_experts,
                     moe_every=config.moe_every,
+                    moe_top_k=config.moe_top_k,
+                    moe_normalize_gates=config.moe_normalize_gates,
                     num_kv_heads=config.num_kv_heads,
                 )
             else:
@@ -691,6 +693,8 @@ class Trainer:
                 num_kv_heads=config.num_kv_heads,
                 num_experts=config.moe_experts,
                 moe_every=config.moe_every,
+                moe_top_k=config.moe_top_k,
+                moe_normalize_gates=config.moe_normalize_gates,
                 ep_size=config.mesh_expert,
                 sp_size=config.mesh_seq,
                 sp_strategy=config.seq_strategy,
@@ -1273,6 +1277,15 @@ class Trainer:
 
     def train(self) -> dict[str, Any]:
         cfg = self.config
+        if self.lm_mode and self.ctx.is_main:
+            # Architecture sidecar for inference tooling: the fields
+            # the checkpoint shapes cannot carry (num_heads, MoE
+            # routing, strategy) persist beside the epochs, like the
+            # tokenizer does. Written here, not at construction — a
+            # Trainer that never trains must not create checkpoint_dir.
+            from ddp_tpu.train.checkpoint import save_lm_spec
+
+            save_lm_spec(cfg.checkpoint_dir, self.seq_spec)
         self.state, start_epoch = self._restore_or_init()
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit

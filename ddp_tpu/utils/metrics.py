@@ -14,8 +14,80 @@ from __future__ import annotations
 import json
 import math
 import os
+import random
 import time
 from typing import Any, IO
+
+
+class StatSummary:
+    """Streaming scalar summary: count / mean / min / max / percentiles.
+
+    The serving engine (ddp_tpu.serve) feeds per-request latencies
+    (TTFT, decode tokens/s) through these; ``snapshot()`` is what the
+    server's /stats endpoint and bench.py's serve record publish.
+    Memory is bounded — a long-lived server must not grow a float per
+    request forever: count/mean/min/max are exact running values, and
+    percentiles come from a fixed-size uniform reservoir
+    (Vitter's algorithm R; exact until ``max_samples`` requests).
+    The server snapshots under the lock that gates the decode loop,
+    so ``snapshot()`` sorts the bounded reservoir once, not an
+    unbounded list per percentile.
+    """
+
+    def __init__(self, *, max_samples: int = 4096, seed: int = 0) -> None:
+        self._samples: list[float] = []
+        self._max = max_samples
+        self._rng = random.Random(seed)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max_v = -math.inf
+
+    def add(self, value: float) -> None:
+        v = float(value)
+        if not math.isfinite(v):
+            return
+        self._count += 1
+        self._sum += v
+        self._min = min(self._min, v)
+        self._max_v = max(self._max_v, v)
+        if len(self._samples) < self._max:
+            self._samples.append(v)
+        else:
+            j = self._rng.randrange(self._count)
+            if j < self._max:
+                self._samples[j] = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> float | None:
+        """Nearest-rank percentile, q in [0, 100]; None when empty."""
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        return self._percentile_sorted(s, q)
+
+    @staticmethod
+    def _percentile_sorted(s: list, q: float) -> float:
+        rank = max(0, min(len(s) - 1, round(q / 100.0 * (len(s) - 1))))
+        return s[rank]
+
+    def snapshot(self, *, ndigits: int = 4) -> dict:
+        """One JSON-ready dict: {count, mean, min, p50, p95, max}."""
+        if not self._count:
+            return {"count": 0}
+        s = sorted(self._samples)
+        r = lambda v: round(v, ndigits)  # noqa: E731
+        return {
+            "count": self._count,
+            "mean": r(self._sum / self._count),
+            "min": r(self._min),
+            "p50": r(self._percentile_sorted(s, 50)),
+            "p95": r(self._percentile_sorted(s, 95)),
+            "max": r(self._max_v),
+        }
 
 
 class MetricsWriter:

@@ -202,8 +202,10 @@ def _generate_lm(args) -> None:
     import numpy as np
 
     from ddp_tpu.models.generate import generate
-    from ddp_tpu.models.lm import LMSpec
-    from ddp_tpu.train.checkpoint import CheckpointManager
+    from ddp_tpu.train.checkpoint import (
+        CheckpointManager,
+        derive_spec_with_sidecar,
+    )
 
     mgr = CheckpointManager(args.checkpoint_dir)
     params, _, epoch = mgr.restore_for_inference(args.epoch)
@@ -217,43 +219,19 @@ def _generate_lm(args) -> None:
         from ddp_tpu.data.bpe import BPETokenizer
 
         tokenizer = BPETokenizer.load(tok_path)
-    try:
-        vocab_size, d_model = params["embed"].shape
-        total_len = params["pos_embed"].shape[1]
-        depth = sum(1 for k in params if str(k).startswith("block"))
-    except (KeyError, AttributeError) as e:
-        raise SystemExit(
-            f"checkpoint in {args.checkpoint_dir} is not a causal_lm "
-            f"checkpoint (missing {e})"
-        )
     # MoE checkpoints decode too (round 5): generate.py routes each
-    # block by the presence of "moe" in its param tree, so no expert
-    # config needs recovering here.
-    num_heads = args.num_heads
-    if d_model % num_heads:
-        raise SystemExit(
-            f"--num_heads {num_heads} does not divide the checkpoint's "
-            f"d_model {d_model}"
+    # block by the presence of "moe" in its param tree. The lm_spec
+    # sidecar the trainer writes beside the epochs supplies the fields
+    # shapes cannot carry (num_heads, MoE routing config); CLI
+    # --num_heads remains the fallback for sidecar-less checkpoints.
+    try:
+        spec = derive_spec_with_sidecar(
+            args.checkpoint_dir, params, num_heads_fallback=args.num_heads
         )
-    # GQA is recoverable from shapes once num_heads is known: the qkv
-    # kernel has (H + 2·H_kv)·Dh output columns (vs 3·d for MHA).
-    head_dim = d_model // num_heads
-    qkv_cols = int(params["block1"]["attn"]["qkv"]["kernel"].shape[1])
-    num_kv_heads = (qkv_cols // head_dim - num_heads) // 2
-    if (num_kv_heads * 2 + num_heads) * head_dim != qkv_cols:
+    except ValueError as e:
         raise SystemExit(
-            f"checkpoint qkv kernel has {qkv_cols} columns, which no "
-            f"kv-head count explains at --num_heads {num_heads} — "
-            "wrong head count?"
+            f"checkpoint in {args.checkpoint_dir}: {e}"
         )
-    spec = LMSpec(
-        vocab_size=int(vocab_size),
-        total_len=int(total_len),
-        d_model=int(d_model),
-        depth=int(depth),
-        num_kv_heads=0 if num_kv_heads == num_heads else num_kv_heads,
-        num_heads=num_heads,
-    )
 
     if args.prompt_tokens is not None:
         toks = [int(t) for t in args.prompt_tokens.split(",") if t.strip()]

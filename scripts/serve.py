@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Serving CLI: load the latest LM checkpoint and answer traffic.
+
+    python scripts/serve.py --checkpoint_dir ./checkpoints --port 8000
+    curl -s localhost:8000/generate -d \
+        '{"prompt_tokens": [1, 2, 3], "max_new_tokens": 32}'
+
+Restores the checkpoint template-free (train/checkpoint.py
+``restore_for_inference`` — no optimizer construction), recovers the
+architecture from the parameter shapes plus the ``lm_spec.json``
+sidecar the trainer writes (num_heads, MoE routing config), and
+stands up the continuous-batching engine (ddp_tpu.serve) behind a
+stdlib HTTP frontend. ``--metrics_file`` streams serve_step /
+serve_request JSONL records through utils/metrics.MetricsWriter.
+
+``--init_demo`` skips the checkpoint and serves a randomly
+initialized model — a frontend/ops smoke path that needs no training
+run (and no checkpoint libraries) at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Applies the JAX_PLATFORMS env pin (see ddp_tpu/__init__.py) before
+# any backend init: CPU-forced serving never touches the TPU tunnel.
+import ddp_tpu  # noqa: F401,E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--checkpoint_dir", default="./checkpoints")
+    p.add_argument("--epoch", type=int, default=None, help="default: latest")
+    p.add_argument(
+        "--num_heads", type=int, default=4,
+        help="fallback when the checkpoint has no lm_spec.json sidecar",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument(
+        "--slots", type=int, default=4,
+        help="decode batch lanes (static — the serving batch shape)",
+    )
+    p.add_argument(
+        "--prefill_len", type=int, default=None,
+        help="padded prompt width (static; default total_len/2)",
+    )
+    p.add_argument("--max_queue", type=int, default=64)
+    p.add_argument("--metrics_file", default=None)
+    p.add_argument(
+        "--init_demo", action="store_true",
+        help="serve a freshly initialized tiny LM (no checkpoint)",
+    )
+    p.add_argument(
+        "--vocab_size", type=int, default=256,
+        help="--init_demo model vocabulary",
+    )
+    p.add_argument(
+        "--seq_len", type=int, default=128,
+        help="--init_demo model context length",
+    )
+    args = p.parse_args()
+
+    from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.serve.engine import ServeEngine
+    from ddp_tpu.serve.server import LMServer
+    from ddp_tpu.utils.metrics import MetricsWriter
+
+    if args.init_demo:
+        spec = LMSpec(
+            vocab_size=args.vocab_size, total_len=args.seq_len,
+            num_heads=args.num_heads,
+        )
+        params = init_lm(spec, seed=0)
+        epoch = -1
+    else:
+        from ddp_tpu.train.checkpoint import (
+            CheckpointManager,
+            derive_spec_with_sidecar,
+        )
+
+        mgr = CheckpointManager(args.checkpoint_dir)
+        params, _, epoch = mgr.restore_for_inference(args.epoch)
+        mgr.close()
+        try:
+            spec = derive_spec_with_sidecar(
+                args.checkpoint_dir, params,
+                num_heads_fallback=args.num_heads,
+            )
+        except ValueError as e:
+            raise SystemExit(
+                f"checkpoint in {args.checkpoint_dir}: {e}"
+            )
+
+    engine = ServeEngine(
+        spec,
+        params,
+        slots=args.slots,
+        prefill_len=args.prefill_len,
+        max_queue=args.max_queue,
+        metrics=MetricsWriter(args.metrics_file),
+    )
+    with LMServer(engine, host=args.host, port=args.port) as server:
+        print(
+            json.dumps(
+                {
+                    "serving": server.url,
+                    "epoch": epoch,
+                    "slots": engine.num_slots,
+                    "prefill_len": engine.prefill_len,
+                    "total_len": spec.total_len,
+                    "vocab_size": spec.vocab_size,
+                }
+            ),
+            flush=True,
+        )
+        try:
+            import threading
+
+            threading.Event().wait()  # serve until interrupted
+        except KeyboardInterrupt:
+            pass
+
+
+if __name__ == "__main__":
+    main()

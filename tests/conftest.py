@@ -35,6 +35,22 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Round 6 (jax 0.4.x image): finding 1 above no longer holds — on this
+# jax version the env-var cache DOES engage under pytest (tens of
+# thousands of entries appeared in .jax_cache), and loading them hits
+# exactly the machine-feature mismatch documented above (observed as
+# segfaults inside resumed-trainer tests; removing the cache dir fixed
+# them). Keep the env vars (finding 2: removing them deadlocks the
+# GPipe ppermute rendezvous) but turn the cache OFF at the config
+# level — which finding 1 showed was the effective state on the old
+# image anyway.
+jax.config.update("jax_enable_compilation_cache", False)
+# ... and the same for SUBPROCESSES (test_breadth / test_real_data_e2e
+# / multihost spawn train.py runs): they inherit the env vars above
+# but not this process's config state, so without this they repopulate
+# .jax_cache and then SIGSEGV loading their own entries on the next
+# spawned run (the resume-style tests are exactly two runs deep).
+os.environ.setdefault("JAX_ENABLE_COMPILATION_CACHE", "false")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -49,6 +65,12 @@ def pytest_configure(config):
         "markers",
         "smoke: fast representative per-subsystem tier "
         "(`pytest -m smoke`, <6 min; full suite is the round gate)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests (≥9s measured, or multihost spawns) "
+        "excluded from the tier-1 gate (`-m 'not slow'`); run them "
+        "via the full unfiltered suite",
     )
 
 
@@ -101,6 +123,9 @@ _SMOKE_PATTERNS = (
     "test_lm.py::test_causality_no_future_leakage",
     "test_gqa.py::TestGQAModel::test_cache_is_compact",
     "test_generate.py::TestFilterLogits::test_top_k_keeps_exactly_k",
+    # serving: admission front door + the static-shape pin
+    "test_serve.py::TestScheduler::test_admission_control",
+    "test_serve.py::TestEngine::test_no_recompilation_after_warmup",
     # config / metrics / watchdog / optim
     "test_config.py::test_reference_defaults",
     "test_metrics.py::test_writer_disabled_is_noop",
@@ -111,13 +136,155 @@ _SMOKE_PATTERNS = (
 )
 
 
+# Tests excluded from the tier-1 gate (`-m 'not slow'`), selected from
+# measured durations (round 6: with the jax-0.4.x compat shims in
+# place ~190 previously-erroring tests run for real, and the full
+# suite is ~37 min — far past the 870 s tier-1 budget). Entries are
+# node-id substrings like _SMOKE_PATTERNS: the heaviest individual
+# tests plus the `multihost` spawn tests (real worker processes,
+# ~20 s each and environment-sensitive). The full unfiltered suite
+# remains the round gate and still runs everything here.
+_SLOW_PATTERNS = (
+    # second measured cut: with the first cut applied, compile
+    # costs shift onto surviving module-mates — these re-crossed
+    # the 9 s line in a tier-1-only timing run (802 s wall, too
+    # close to the 870 s budget; ~510 s after this cut).
+    "test_breadth.py::TestElasticResume::test_resume_across_device_count_change",
+    "test_breadth.py::TestResetOptState::test_recipe_change_keeps_weights",
+    "test_ep_lm.py::test_ep_expert_memory_shards",
+    "test_models_zoo.py::test_ddp_step_trains_with_model_state[<lambda>1]",
+    "test_models_zoo.py::test_resnet18_forward_shape_and_bn_state",
+    "test_optim_extras.py::TestParamEma::test_resume_with_ema_enabled_grafts_from_params",
+    "test_pipe_fsdp.py::TestGPipeFsdp::test_matches_data_axis_run",
+    "test_pipe_fsdp.py::TestGPipeFsdp::test_params_and_moments_rest_sharded",
+    "test_pipeline_lm.py::test_interleaved_virtual_stages_match_sequential",
+    "test_preemption.py::test_preempt_after_imported_checkpoint_resumes_exactly",
+    "test_preemption.py::test_preempt_mid_epoch_then_resume_exactly",
+    "test_remat.py::test_remat_with_dropout_same_rng_stream",
+    "test_tp.py::test_tp_loss_parity[axes4-4]",
+    "test_tp.py::test_tp_rejects_indivisible_heads",
+    "test_tp.py::test_tp_with_accum_parity",
+    "test_train_step.py::TestTraining::test_loss_decreases",
+    "test_trainer_fast.py::test_fast_epoch_trains_and_resumes",
+    "test_trainer_fast.py::test_pipe_vit_fast_epoch_trains",
+    "test_trainer_pipe.py::test_pipe_trainer_augment_trains[1f1b]",
+    "test_trainer_pipe.py::test_pipe_trainer_augment_trains[gpipe]",
+    "test_trainer_pipe.py::test_pipe_trainer_augment_trains[interleaved]",
+    "test_trainer_pipe.py::test_pipe_trainer_trains_and_evals[1f1b]",
+    "test_trainer_pipe.py::test_pipe_trainer_trains_and_evals[gpipe]",
+    "test_trainer_seq.py::test_ulysses_strategy_trains",
+    "test_bpe.py::test_train_and_generate_text_e2e",
+    "test_breadth.py::TestInferenceRestore::test_predict_cli_dataset_and_npy",
+    "test_breadth.py::TestResumeEpoch::test_rewind_to_requested_epoch",
+    "test_checkpoint.py::TestGqaQkvFormat::test_gqa_convert_script_end_to_end",
+    "test_e2e.py::TestEndToEnd::test_rerun_at_same_epochs_trains_nothing",
+    "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",
+    "test_elastic_shard.py::test_fsdp_lm_checkpoint_restores_on_wider_fsdp",
+    "test_elastic_shard.py::test_replicated_checkpoint_restores_onto_fsdp_mesh",
+    "test_ep_lm.py::test_ep4_parity_with_dp4",
+    "test_ep_lm.py::test_ep_exact_parity_with_replicated",
+    "test_ep_lm.py::test_full_stack_gqa_moe_tp_ep_sp",
+    "test_fast.py::test_epoch_runner_trains",
+    "test_generate.py::TestBeamSearch::test_beam_one_is_greedy",
+    "test_generate.py::test_greedy_matches_stepwise_dense_argmax",
+    "test_generate.py::test_predict_cli_generates_from_trained_checkpoint[dense]",
+    "test_generate.py::test_predict_cli_generates_from_trained_checkpoint[moe]",
+    "test_gqa.py::TestGQATraining::test_gqa_tp_trains_with_parity",
+    "test_gqa.py::TestGQATraining::test_seq_parallel_step_matches_dense_reference",
+    "test_gqa.py::TestGQATraining::test_trainer_cli_and_guards",
+    "test_gqa.py::TestGQAxMoE::test_decode_matches_dense_forward",
+    "test_gqa.py::TestGQAxMoE::test_pipe_gqa_moe_matches_sequential",
+    "test_gqa.py::TestGQAxMoE::test_trains_and_loss_tracks_each_feature_alone",
+    "test_grad_accum.py::TestDDPAccum::test_accum_trains",
+    "test_grad_accum.py::TestSPMDAccum::test_accum_matches_full_batch_on_tp_mesh",
+    "test_interleaved.py::TestKernel::test_step_matches_single_device_reference",
+    "test_interleaved.py::TestKernel::test_trains_and_smoothing",
+    "test_interleaved.py::TestTrainer::test_cli_trains",
+    "test_lm.py::test_lm_learns_progressions",
+    "test_lm.py::test_remat_variant_runs",
+    "test_metrics.py::test_profile_dir_produces_trace",
+    "test_models_zoo.py::test_ddp_step_trains_with_model_state[<lambda>0]",
+    "test_moe.py::TestExpertParallel::test_ep_train_step_learns",
+    "test_moe_lm.py::test_moe_lm_through_trainer",
+    "test_moe_lm.py::test_moe_lm_trains_and_aux_contributes",
+    "test_pipe_fsdp.py::TestHandScheduledFsdp::test_1f1b_matches_gpipe_under_fsdp",
+    "test_pipe_fsdp.py::TestHandScheduledFsdp::test_interleaved_fsdp_matches_data_axis",
+    "test_pipe_fsdp.py::TestTrainerPipeFsdp::test_cli_trains_and_resumes",
+    "test_pipeline_lm.py::test_all_three_schedules_update_identically",
+    "test_pipeline_lm.py::test_gpipe_loss_matches_sequential_reference",
+    "test_pipeline_lm.py::test_moe_every_generalized_including_odd_depth",
+    "test_pipeline_lm.py::test_moe_pipe_matches_sequential",
+    "test_pipeline_lm.py::test_pp_ep_exact_parity_with_dp[1f1b]",
+    "test_pipeline_lm.py::test_pp_ep_exact_parity_with_dp[gpipe]",
+    "test_pipeline_lm.py::test_pp_ep_fsdp_composition",
+    "test_pipeline_lm.py::test_pp_ep_sp_triple_composition_exact",
+    "test_pipeline_lm.py::test_pp_ep_validation_and_trainer_e2e",
+    "test_pipeline_lm.py::test_pp_sp_matches_pipe_only[1f1b-ulysses]",
+    "test_pipeline_lm.py::test_pp_sp_matches_pipe_only[gpipe-ring]",
+    "test_pipeline_lm.py::test_pp_tp_interleaved_matches_pp_only",
+    "test_pipeline_lm.py::test_pp_tp_matches_pp_only[1f1b]",
+    "test_pipeline_lm.py::test_pp_tp_matches_pp_only[gpipe]",
+    "test_pipeline_lm.py::test_pp_tp_moe_gpipe_exact_and_handsched_refused",
+    "test_pipeline_lm.py::test_tied_embedding_gradient_sums_both_ends",
+    "test_pipeline_lm.py::test_trainer_cli_pipe_lm_e2e",
+    "test_pipeline_vit.py::Test1F1B::test_1f1b_step_matches_gpipe_step",
+    "test_pipeline_vit.py::Test1F1B::test_label_smoothing_schedules_agree",
+    "test_pipeline_vit.py::TestPpTp::test_pp_tp_matches_pp_only",
+    "test_real_data_e2e.py::test_train_cli_on_real_idx_files",
+    "test_remat.py::test_remat_grads_match_baseline[resnet18-kw1-shape1]",
+    "test_remat.py::test_remat_grads_match_baseline[vit_micro-kw0-shape0]",
+    "test_remat.py::test_remat_grads_match_baseline[vit_moe_micro-kw2-shape2]",
+    "test_remat.py::test_seq_transformer_remat_matches",
+    "test_seq_compose.py::test_fsdp_seq_step_matches_replicated",
+    "test_seq_compose.py::test_grad_accum_matches_single_step",
+    "test_seq_compose.py::test_trainer_composes_fsdp_accum_smoothing_text",
+    "test_seq_transformer.py::TestEquivalence::test_seq_parallel_matches_dense[ring]",
+    "test_seq_transformer.py::TestTraining::test_grads_match_dense_reference",
+    "test_seq_transformer.py::TestTraining::test_trains_on_dp_sp_mesh",
+    "test_serve.py::TestEngine::test_greedy_matches_generate",
+    "test_serve.py::TestEngine::test_moe_routing_config_threaded",
+    "test_spmd.py::test_tp_fsdp_matches_ddp",
+    "test_spmd.py::test_tp_only_mesh",
+    "test_tp.py::test_classifier_tp_parity",
+    "test_tp.py::test_tp_bf16_runs",
+    "test_tp.py::test_tp_loss_parity[axes0-2]",
+    "test_tp.py::test_tp_loss_parity[axes1-4]",
+    "test_tp.py::test_tp_loss_parity[axes2-4]",
+    "test_tp.py::test_tp_loss_parity[axes3-8]",
+    "test_tp.py::test_tp_ulysses_parity",
+    "test_trainer_fast.py::test_lm_fast_epoch_composes_with_fsdp",
+    "test_trainer_fast.py::test_lm_fast_epoch_loss_identical_to_step_loop",
+    "test_trainer_fast.py::test_pipe_fast_epoch_composes_with_fsdp_and_ep",
+    "test_trainer_fast.py::test_pipe_lm_fast_epoch_loss_identical_to_step_loop[1f1b]",
+    "test_trainer_fast.py::test_pipe_lm_fast_epoch_loss_identical_to_step_loop[gpipe]",
+    "test_trainer_pipe.py::test_pipe_schedules_agree",
+    "test_trainer_pipe.py::test_pipe_trainer_resumes",
+    "test_trainer_seq.py::TestCausalLMTrainer::test_bf16_runs",
+    "test_trainer_seq.py::TestCausalLMTrainer::test_train_eval_resume",
+    "test_trainer_seq.py::test_bf16_mixed_precision",
+    "test_trainer_seq.py::test_remat_composes",
+    "test_trainer_seq.py::test_train_eval_checkpoint_resume",
+    "test_trainer_spmd.py::test_expert_parallel_trainer",
+    "test_trainer_spmd.py::test_tp_fsdp_trainer_trains_and_resumes",
+    "test_zero1.py::test_trainer_zero1_checkpoints_and_resumes",
+    "test_zero1.py::test_zero1_adam_single_step_matches",
+    "test_zero1.py::test_zero1_step_matches_replicated_step",
+)
+
+
 def pytest_collection_modifyitems(config, items):
-    unmatched = set(_SMOKE_PATTERNS)
+    unmatched = set(_SMOKE_PATTERNS) | set(_SLOW_PATTERNS)
     for item in items:
         for pat in _SMOKE_PATTERNS:
             if pat in item.nodeid:
                 item.add_marker(pytest.mark.smoke)
                 unmatched.discard(pat)
+        for pat in _SLOW_PATTERNS:
+            if pat in item.nodeid:
+                item.add_marker(pytest.mark.slow)
+                unmatched.discard(pat)
+        if item.get_closest_marker("multihost"):
+            item.add_marker(pytest.mark.slow)
     # Only enforce when the full suite was collected — a targeted
     # `pytest tests/test_foo.py` run legitimately misses most patterns.
     if len(items) > 300 and unmatched:
