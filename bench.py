@@ -18,9 +18,20 @@ Python→C++ crossing per op and a collective sync per batch.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 50_000.0
+
+
+def _bench_trace_path(name: str) -> str:
+    """Where a bench's span trace lands (ddp_tpu.obs tracer export).
+
+    Default ./bench_traces beside the BENCH_*.json records;
+    DDP_TPU_BENCH_TRACE_DIR relocates (e.g. CI artifact dirs).
+    """
+    d = os.environ.get("DDP_TPU_BENCH_TRACE_DIR", "./bench_traces")
+    return os.path.abspath(os.path.join(d, f"{name}.trace.json"))
 
 
 def run_bench(
@@ -121,18 +132,32 @@ def run_bench(
         runner.steps_per_epoch = steps
     images_per_epoch = runner.steps_per_epoch * global_batch_size
 
+    from ddp_tpu.obs.goodput import cnn_train_flops, peak_flops_per_chip
+    from ddp_tpu.obs.tracer import Tracer
+
+    tracer = Tracer(enabled=True, ring_events=4096)
     for e in range(warmup_epochs):  # compile + stabilize clocks
-        state, metrics = runner(state, e)
-        jax.block_until_ready(metrics.loss)
+        with tracer.span("bench.warmup_epoch", {"epoch": e}):
+            state, metrics = runner(state, e)
+            jax.block_until_ready(metrics.loss)
 
     t0 = time.perf_counter()
     for e in range(warmup_epochs, warmup_epochs + timed_epochs):
-        state, metrics = runner(state, e)
+        with tracer.span("bench.epoch", {"epoch": e}):
+            state, metrics = runner(state, e)
     jax.block_until_ready(metrics.loss)
     seconds = time.perf_counter() - t0
 
     total_images = images_per_epoch * timed_epochs
     per_chip = total_images / seconds / len(devices)
+    # MFU vs the chip's peak (off-TPU: the nominal fallback peak —
+    # a trend line, not a hardware claim; `platform` disambiguates).
+    flops_per_image = cnn_train_flops((28, 28, 1), 10)
+    mfu = per_chip * flops_per_image / peak_flops_per_chip(devices[0])
+    try:
+        trace = tracer.export(_bench_trace_path("mnist_ddp"))
+    except OSError:
+        trace = None  # read-only checkout: the record survives
     return {
         "metric": "mnist_ddp_train_throughput",
         "value": round(per_chip, 1),
@@ -144,6 +169,8 @@ def run_bench(
         "timed_epochs": timed_epochs,
         "final_loss": round(float(metrics.loss[-1]), 4),
         "seconds": round(seconds, 3),
+        "mfu": round(mfu, 6),
+        "trace": trace,
     }
 
 
@@ -155,20 +182,20 @@ def run_bench(
 # MFU estimate. Results go to BENCH_EXTRA.json + stderr; stdout stays
 # the single headline JSON line (the driver contract).
 
-# bf16 peak FLOP/s per chip by device kind (public spec sheets).
-_TPU_BF16_PEAK = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# bf16 peak FLOP/s per chip by device kind: one table, owned by the
+# observability subsystem (ddp_tpu/obs/goodput.py) so bench and the
+# trainer's MFU accounting cannot drift.
 
 
 def _bf16_peak(device) -> float | None:
+    """Spec-sheet peak, or None off-TPU (the ``estimated_mfu`` fields
+    stay honest-None there; the ``mfu`` fields use the nominal
+    fallback peak via peak_flops_per_chip for a populated trend line).
+    """
+    from ddp_tpu.obs.goodput import TPU_BF16_PEAK
+
     kind = getattr(device, "device_kind", "")
-    for prefix, peak in _TPU_BF16_PEAK.items():
+    for prefix, peak in TPU_BF16_PEAK.items():
         if kind.startswith(prefix):
             return peak
     return None
@@ -270,6 +297,7 @@ def run_vit_bench(
     from jax import lax
 
     from ddp_tpu.models import get_model
+    from ddp_tpu.obs.goodput import peak_flops_per_chip
 
     device = jax.devices()[0]
     if use_cls_token:
@@ -348,6 +376,11 @@ def run_vit_bench(
         "final_loss": round(loss, 4),
         "train_flops_per_image": train_flops_per_image,
         "estimated_mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu": round(
+            images_per_sec * train_flops_per_image
+            / peak_flops_per_chip(device),
+            6,
+        ),
         "device_kind": getattr(device, "device_kind", "unknown"),
         "op_time_split": split,
         "profile_note": note,
@@ -375,6 +408,7 @@ def run_lm_bench(
     import optax
 
     from ddp_tpu.models.lm import LMSpec, create_lm_train_state
+    from ddp_tpu.obs.goodput import peak_flops_per_chip
     from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
     from ddp_tpu.train.fast import (
         device_put_replicated,
@@ -427,6 +461,11 @@ def run_lm_bench(
         "final_loss": round(loss, 4),
         "train_flops_per_token": round(train_flops_per_token),
         "estimated_mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu": round(
+            tokens_per_sec * train_flops_per_token
+            / peak_flops_per_chip(device),
+            6,
+        ),
         "device_kind": getattr(device, "device_kind", "unknown"),
     }
 
@@ -503,9 +542,25 @@ def run_decode_bench(
         lambda s, _seed: do_decode(*s), (params, logits, cache)
     )
     toks = batch * new_tokens
+    # Decode MFU: forward FLOPs/token (train estimate ÷ 3) over peak —
+    # the latency-bound regime's honest MXU number (it is SUPPOSED to
+    # be low; HBM bandwidth is the binding resource here).
+    from ddp_tpu.obs.goodput import (
+        lm_train_flops_per_token,
+        peak_flops_per_chip,
+    )
+
+    fwd_per_token = lm_train_flops_per_token(
+        vocab_size=vocab, total_len=spec.total_len, d_model=d,
+        depth=depth, num_heads=heads, num_kv_heads=num_kv_heads,
+        num_experts=num_experts,
+    ) / 3.0
     return {
         "metric": "kv_cache_decode_throughput",
         "value": round(toks / best, 1),
+        "mfu": round(
+            (toks / best) * fwd_per_token / peak_flops_per_chip(device), 6
+        ),
         "unit": "tokens/sec/chip",
         "batch": batch,
         "prompt_len": prompt_len,
@@ -554,6 +609,11 @@ def run_serve_bench(
     import numpy as np
 
     from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.obs.goodput import (
+        lm_train_flops_per_token,
+        peak_flops_per_chip,
+    )
+    from ddp_tpu.obs.tracer import Tracer
     from ddp_tpu.serve.engine import ServeEngine
 
     device = jax.devices()[0]
@@ -569,9 +629,10 @@ def run_serve_bench(
         d_model=d, depth=depth, num_heads=heads,
     )
     params = init_lm(spec, seed=0)
+    tracer = Tracer(enabled=True, ring_events=16384)
     engine = ServeEngine(
         spec, params, slots=slots, prefill_len=prefill_len,
-        max_queue=max(16, n_requests),
+        max_queue=max(16, n_requests), tracer=tracer,
     )
 
     rng = np.random.default_rng(seed)
@@ -635,9 +696,24 @@ def run_serve_bench(
         "serve bench recompiled after warmup — static-shape invariant "
         f"broken: {compile_counts} -> {engine.compile_counts()}"
     )
+    fwd_per_token = lm_train_flops_per_token(
+        vocab_size=vocab, total_len=spec.total_len, d_model=d,
+        depth=depth, num_heads=heads,
+    ) / 3.0
+    try:
+        trace = tracer.export(_bench_trace_path("serve_decode"))
+    except OSError:
+        trace = None
     return {
         "metric": "serve_decode_throughput",
         "value": round(total_tokens / wall, 1),
+        "mfu": round(
+            (total_tokens / wall) * fwd_per_token
+            / peak_flops_per_chip(device),
+            6,
+        ),
+        "trace": trace,
+        "engine_goodput": engine.goodput(),
         "unit": "tokens/sec/chip",
         "slots": slots,
         "prefill_len": prefill_len,

@@ -1,0 +1,47 @@
+"""Observability subsystem: spans, step-time attribution, goodput/MFU.
+
+Three zero-dependency layers, all off by default and pinned always-cheap
+when off (tests/test_obs.py: disabled mode triggers no jit compilation
+and no growing per-step allocations):
+
+- ``tracer``  — in-process span tracer with a bounded ring buffer and
+  crash-safe export to Perfetto/Chrome ``trace_event`` JSON;
+- ``steptime`` — splits each training step into host-input-wait /
+  dispatch / device-compute and flags recompiles via a process-wide
+  XLA compile-event counter;
+- ``goodput`` — per-model FLOPs estimators (CNN, ResNet, ViT, LM/MoE),
+  MFU arithmetic against per-chip peak, and a restart-aware goodput
+  accountant persisted in a sidecar next to the checkpoints.
+
+Wiring: ``--trace_dir`` on train.py (train/trainer.py), the serve
+engine/server (spans + ``/statusz``), runtime/launch.py (per-rank
+trace files, merged by scripts/trace_merge.py) and bench.py (``mfu``
+and ``trace`` fields per record). docs/OBSERVABILITY.md has the full
+story.
+"""
+
+from ddp_tpu.obs.goodput import (
+    GoodputAccountant,
+    peak_flops_per_chip,
+    train_flops_per_example,
+)
+from ddp_tpu.obs.steptime import CompileCounter, StepAttributor, StepTiming
+from ddp_tpu.obs.tracer import (
+    Tracer,
+    get_tracer,
+    install_from_env,
+    validate_trace_file,
+)
+
+__all__ = [
+    "CompileCounter",
+    "GoodputAccountant",
+    "StepAttributor",
+    "StepTiming",
+    "Tracer",
+    "get_tracer",
+    "install_from_env",
+    "peak_flops_per_chip",
+    "train_flops_per_example",
+    "validate_trace_file",
+]

@@ -1,0 +1,223 @@
+"""Step-time attribution: where did this training step's wall time go?
+
+A JAX training step has three host-observable segments:
+
+- **input wait** — the time ``next()`` on the loader blocks before the
+  batch exists on the host (data pipeline stall);
+- **dispatch** — the time the (async) step call takes to RETURN: under
+  normal operation this is trace/lowering-cache lookup plus enqueue
+  (sub-ms); a recompile or a full device pipeline shows up here;
+- **device compute** — ``block_until_ready`` on a step output after
+  dispatch returns: the device-side cost of the step (plus any queue
+  ahead of it).
+
+Attribution deliberately synchronizes every step (the bounded-inflight
+overlap the trainer normally runs is what it measures AWAY), so it is
+a diagnosis mode, not the default — enabled by ``--trace_dir`` and
+costing nothing when off (``StepAttributor(enabled=False)`` hands back
+the caller's iterator unchanged and ``on_step`` returns immediately).
+
+Recompiles are counted process-wide via ``jax.monitoring`` compile
+events (one ``backend_compile`` per executable built), not per-function
+``_cache_size()`` probes: the trainer's step may be a lambda over a
+jitted inner function, and a *process-level* counter also catches
+compiles hiding in eval, checkpoint restore, or a library call — if
+the count moved during a step, that step paid for a compile, whoever
+owned it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Iterable, Iterator, Optional
+
+from ddp_tpu.obs.tracer import Tracer
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class CompileCounter:
+    """Process-wide XLA compile counter (lazy jax.monitoring listener).
+
+    ``install()`` is idempotent and only ever called from enabled
+    attribution paths, so tracing-off runs never register the listener
+    (and never import jax from this module) — part of the disabled-
+    mode-is-free pin. jax.monitoring has no unregister; the listener
+    is one integer increment per *compilation*, which is noise even if
+    it outlives the attributor that installed it.
+    """
+
+    _count = 0
+    _installed = False
+
+    @classmethod
+    def install(cls) -> None:
+        if cls._installed:
+            return
+        import jax
+
+        def _on_event(name: str, *args: Any, **kw: Any) -> None:
+            if name == _COMPILE_EVENT:
+                cls._count += 1
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        cls._installed = True
+
+    @classmethod
+    def installed(cls) -> bool:
+        return cls._installed
+
+    @classmethod
+    def count(cls) -> int:
+        return cls._count
+
+
+@dataclass
+class StepTiming:
+    """One step's attribution (seconds; recompiles is a count)."""
+
+    input_wait_s: float
+    dispatch_s: float
+    compute_s: float
+    recompiles: int
+
+    @property
+    def wall_s(self) -> float:
+        return self.input_wait_s + self.dispatch_s + self.compute_s
+
+
+@dataclass
+class EpochAttribution:
+    """Sums over one epoch of attributed steps."""
+
+    steps: int = 0
+    input_wait_s: float = 0.0
+    dispatch_s: float = 0.0
+    compute_s: float = 0.0
+    recompiles: int = 0
+
+    def add(self, t: StepTiming) -> None:
+        self.steps += 1
+        self.input_wait_s += t.input_wait_s
+        self.dispatch_s += t.dispatch_s
+        self.compute_s += t.compute_s
+        self.recompiles += t.recompiles
+
+
+class StepAttributor:
+    """Per-step input-wait / dispatch / compute / recompile splitter.
+
+    Usage (the trainer's host loop)::
+
+        for batch in attr.batches(loader.epoch(e)):
+            state, metrics = train_step(state, ...)
+            timing = attr.on_step(metrics.loss)  # None when disabled
+
+    ``batches`` times the gap between iterations (input wait);
+    ``on_step`` times dispatch-return vs block_until_ready and reads
+    the compile-counter delta. Each attributed segment also lands in
+    ``tracer`` as a span, so the JSONL numbers and the Perfetto
+    picture come from the same measurements.
+    """
+
+    def __init__(
+        self, *, enabled: bool = False, tracer: Optional[Tracer] = None
+    ):
+        self.enabled = bool(enabled)
+        self.tracer = tracer if tracer is not None else Tracer()
+        self.epoch_totals = EpochAttribution()
+        self._input_wait = 0.0
+        self._fetch_end = 0.0
+        self._compiles_at_fetch = 0
+        if self.enabled:
+            CompileCounter.install()
+
+    def batches(self, iterable: Iterable) -> Iterator:
+        """Wrap a batch iterator, timing each ``next()``.
+
+        Disabled mode returns ``iter(iterable)`` itself — no wrapper
+        generator, no per-item overhead (pinned by tests).
+        """
+        if not self.enabled:
+            return iter(iterable)
+        return self._timed_iter(iterable)
+
+    def _timed_iter(self, iterable: Iterable) -> Iterator:
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            self._fetch_end = time.perf_counter()
+            self._input_wait = self._fetch_end - t0
+            self._compiles_at_fetch = CompileCounter.count()
+            yield batch
+
+    def on_step(self, sync_ref: Any) -> Optional[StepTiming]:
+        """Call right after the step call returns; blocks on
+        ``sync_ref`` to split dispatch from device compute."""
+        if not self.enabled:
+            return None
+        import jax
+
+        dispatched = time.perf_counter()
+        jax.block_until_ready(sync_ref)
+        done = time.perf_counter()
+        timing = StepTiming(
+            input_wait_s=self._input_wait,
+            dispatch_s=dispatched - self._fetch_end,
+            compute_s=done - dispatched,
+            recompiles=CompileCounter.count() - self._compiles_at_fetch,
+        )
+        self.epoch_totals.add(timing)
+        tr = self.tracer
+        if tr.enabled:
+            # Retroactive spans: begin/end stamps are already in hand.
+            tr.complete(
+                "step.input_wait",
+                self._fetch_end - timing.input_wait_s,
+                timing.input_wait_s,
+            )
+            tr.complete("step.dispatch", self._fetch_end, timing.dispatch_s)
+            tr.complete(
+                "step.compute", dispatched, timing.compute_s,
+                {"recompiles": timing.recompiles}
+                if timing.recompiles
+                else None,
+            )
+        # Prime for a loop body that never re-enters the iterator
+        # (last batch): keep fetch_end monotone.
+        self._fetch_end = done
+        self._input_wait = 0.0
+        self._compiles_at_fetch = CompileCounter.count()
+        return timing
+
+    def finish_epoch(self) -> EpochAttribution:
+        """Return and reset the epoch accumulator."""
+        totals = self.epoch_totals
+        self.epoch_totals = EpochAttribution()
+        return totals
+
+
+def dispatch_compute_split(run, *args) -> tuple[Any, float, float, int]:
+    """Time one whole-epoch dispatch (the ``--fast_epoch`` path).
+
+    Returns ``(result, dispatch_s, compute_s, recompiles)`` where
+    ``result`` is whatever ``run(*args)`` returned, dispatch is the
+    call-return time and compute the ``block_until_ready`` tail on its
+    outputs. Per-epoch granularity is all the host can see of a
+    compiled epoch — the scan body is one XLA program.
+    """
+    import jax
+
+    CompileCounter.install()
+    c0 = CompileCounter.count()
+    t0 = time.perf_counter()
+    result = run(*args)
+    t1 = time.perf_counter()
+    jax.block_until_ready(result)
+    t2 = time.perf_counter()
+    return result, t1 - t0, t2 - t1, CompileCounter.count() - c0

@@ -1,0 +1,346 @@
+"""In-process span tracer → Perfetto/Chrome ``trace_event`` JSON.
+
+``jax.profiler`` answers "which kernel is slow" but costs a heavyweight
+capture and says nothing about the *host* side — input wait, scheduler
+stalls, checkpoint flushes. This tracer is the complement: always-on-
+capable host-level spans with bounded memory (a ring of the last N
+events), thread-safe begin/end, and an export any Perfetto/
+``chrome://tracing`` instance loads directly.
+
+Design constraints, in priority order:
+
+1. **Disabled mode is free.** ``span()`` on a disabled tracer returns
+   one cached null context manager — the SAME object every call — and
+   ``instant()`` returns immediately. No jax import, no jit, no growing
+   allocation (pinned by tests/test_obs.py).
+2. **Enabled mode is bounded.** Events live in a ``deque(maxlen=
+   ring_events)``: a week-long serving process holds at most the ring.
+   Per-span-name duration summaries (utils/metrics.StatSummary) are
+   capped at ``MAX_SUMMARY_NAMES`` distinct names so a cardinality bug
+   upstream cannot grow memory either.
+3. **Export is crash-safe.** ``export()`` writes to a temp file in the
+   target directory and ``os.replace``s it — a crash mid-export leaves
+   the previous trace intact, never a half-written JSON. The launcher
+   path (``install_from_env``) additionally registers an atexit export
+   so a watchdog abort or uncaught exception still leaves a trace.
+
+Timestamps are Unix-epoch microseconds (``perf_counter`` deltas pinned
+to ``time.time`` at construction) so per-rank traces from different
+processes merge onto one comparable timeline (scripts/trace_merge.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from ddp_tpu.utils.metrics import StatSummary
+
+# Env vars the launcher/child processes use to switch tracing on
+# without plumbing flags through every worker signature.
+TRACE_DIR_ENV = "DDP_TPU_TRACE_DIR"
+RING_EVENTS_ENV = "DDP_TPU_TRACE_RING_EVENTS"
+
+DEFAULT_RING_EVENTS = 65536
+MAX_SUMMARY_NAMES = 256
+
+# Canonical per-rank trace filename (the launcher writes one per rank;
+# trace_merge globs this pattern).
+RANK_TRACE_FILENAME = "trace_rank{rank}.trace.json"
+
+
+class _NullSpan:
+    """The disabled-mode context manager: one shared immutable object."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live span: records duration on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._end_span(self.name, self._t0, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded span/instant recorder.
+
+    ``enabled=False`` (the default) makes every method a constant-cost
+    no-op. ``process_id`` becomes the Chrome ``pid`` so merged
+    multi-rank traces show one track group per rank.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = False,
+        ring_events: int = DEFAULT_RING_EVENTS,
+        process_id: int = 0,
+    ):
+        from collections import deque
+
+        self.enabled = bool(enabled)
+        self.process_id = int(process_id)
+        self.ring_events = max(1, int(ring_events))
+        self._events: Any = deque(maxlen=self.ring_events)
+        self._lock = threading.Lock()
+        self._summaries: dict[str, StatSummary] = {}
+        self._dropped = 0
+        # perf_counter→unix pin: exported ts are absolute µs, so traces
+        # from different ranks/processes align on one timeline.
+        self._unix_base = time.time() - time.perf_counter()
+
+    # ---- recording --------------------------------------------------
+
+    def span(self, name: str, args: Optional[dict] = None):
+        """Context manager timing one span. ``args`` (a plain dict or
+        None — not kwargs, to keep the disabled path allocation-free)
+        lands in the event's Perfetto ``args`` pane."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """A zero-duration marker event."""
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        self._record("i", name, now, 0.0, args)
+
+    def complete(
+        self,
+        name: str,
+        start_perf: float,
+        dur_s: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Record a span retroactively from stamps already in hand
+        (``start_perf`` from ``time.perf_counter``) — the attribution
+        path measures first and records after, so the recording cost
+        never sits inside the measured window."""
+        if not self.enabled:
+            return
+        self._record("X", name, start_perf, max(0.0, dur_s), args)
+
+    def _end_span(self, name: str, t0: float, args: Optional[dict]) -> None:
+        now = time.perf_counter()
+        self._record("X", name, t0, now - t0, args)
+
+    def _record(
+        self, ph: str, name: str, t0: float, dur_s: float,
+        args: Optional[dict],
+    ) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            if len(self._events) == self.ring_events:
+                self._dropped += 1
+            self._events.append((ph, name, t0, dur_s, tid, args))
+            if ph == "X":
+                summ = self._summaries.get(name)
+                if summ is None:
+                    if len(self._summaries) >= MAX_SUMMARY_NAMES:
+                        return
+                    summ = self._summaries[name] = StatSummary()
+                summ.add(dur_s)
+
+    # ---- export -----------------------------------------------------
+
+    def _event_dicts(self, limit: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            raw = list(self._events)
+        if limit is not None:
+            raw = raw[-limit:]
+        out = []
+        for ph, name, t0, dur_s, tid, args in raw:
+            ev: dict[str, Any] = {
+                "ph": ph,
+                "name": name,
+                "ts": round((self._unix_base + t0) * 1e6, 3),
+                "pid": self.process_id,
+                "tid": tid,
+            }
+            if ph == "X":
+                ev["dur"] = round(dur_s * 1e6, 3)
+            if ph == "i":
+                ev["s"] = "t"  # thread-scoped instant
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def summaries(self) -> dict[str, dict]:
+        """Per-span-name duration snapshots (seconds)."""
+        with self._lock:
+            names = list(self._summaries.items())
+        return {n: s.snapshot(ndigits=6) for n, s in names}
+
+    def summary_states(self) -> dict[str, dict]:
+        """Mergeable per-name StatSummary states (trace_merge input)."""
+        with self._lock:
+            names = list(self._summaries.items())
+        return {n: s.to_state() for n, s in names}
+
+    def snapshot(self, *, limit: Optional[int] = 512) -> dict:
+        """Live, JSON-ready view for the server's /statusz route."""
+        return {
+            "enabled": self.enabled,
+            "traceEvents": self._event_dicts(limit),
+            "dropped_events": self._dropped,
+            "span_summaries": self.summaries(),
+        }
+
+    def trace_document(self) -> dict:
+        """The full exportable Chrome/Perfetto trace object."""
+        meta = [
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": self.process_id,
+                "tid": 0,
+                "args": {"name": f"ddp_tpu rank {self.process_id}"},
+            }
+        ]
+        return {
+            "traceEvents": meta + self._event_dicts(),
+            "displayTimeUnit": "ms",
+            "ddp_tpu": {
+                "rank": self.process_id,
+                "dropped_events": self._dropped,
+                "span_summaries": self.summary_states(),
+            },
+        }
+
+    def export(self, path: str) -> str:
+        """Crash-safe write of the trace document to ``path``."""
+        path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.trace_document(), f)
+        os.replace(tmp, path)
+        return path
+
+    def export_to_dir(self, trace_dir: str) -> str:
+        return self.export(
+            os.path.join(
+                trace_dir,
+                RANK_TRACE_FILENAME.format(rank=self.process_id),
+            )
+        )
+
+
+# ---- process-global tracer (launcher / env wiring) -------------------
+
+_GLOBAL = Tracer()
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until someone installs one)."""
+    return _GLOBAL
+
+
+def install_from_env(
+    process_id: int = 0, *, register_atexit: bool = True
+) -> Tracer:
+    """Enable the global tracer iff ``DDP_TPU_TRACE_DIR`` is set.
+
+    Called by runtime/launch.py in every spawned child so worker
+    functions get per-rank trace files without new plumbing. The
+    atexit export makes the trace survive crashes and watchdog aborts
+    (``os._exit`` skips atexit — the watchdog dumps stacks instead;
+    everything softer than that still exports).
+    """
+    global _GLOBAL
+    trace_dir = os.environ.get(TRACE_DIR_ENV)
+    if not trace_dir:
+        return _GLOBAL
+    ring = int(os.environ.get(RING_EVENTS_ENV, DEFAULT_RING_EVENTS))
+    with _GLOBAL_LOCK:
+        tracer = Tracer(
+            enabled=True, ring_events=ring, process_id=process_id
+        )
+        _GLOBAL = tracer
+    if register_atexit:
+        import atexit
+
+        atexit.register(_export_quietly, tracer, trace_dir)
+    return tracer
+
+
+def _export_quietly(tracer: Tracer, trace_dir: str) -> None:
+    try:
+        tracer.export_to_dir(trace_dir)
+    except OSError:
+        pass  # interpreter teardown: never turn exit into a traceback
+
+
+# ---- schema validation (shared by tests and trace_merge) -------------
+
+
+def validate_trace_file(path: str) -> dict:
+    """Load ``path`` and check the Chrome ``trace_event`` essentials.
+
+    Raises ``ValueError`` with a precise reason on any violation —
+    this is what the smoke tier runs against an emitted trace so an
+    exporter regression fails tier-1 fast, and what trace_merge runs
+    on every input before merging. Returns the parsed document.
+    """
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}: not valid JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: top level must be an object")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents must be a list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: event {i} is not an object")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            raise ValueError(f"{path}: event {i} missing ph")
+        if not isinstance(ev.get("name"), str):
+            raise ValueError(f"{path}: event {i} missing name")
+        if ph == "M":
+            continue  # metadata events carry no timestamp
+        if not isinstance(ev.get("ts"), (int, float)):
+            raise ValueError(f"{path}: event {i} missing numeric ts")
+        if not isinstance(ev.get("pid"), int) or not isinstance(
+            ev.get("tid"), int
+        ):
+            raise ValueError(f"{path}: event {i} missing pid/tid")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(
+                    f"{path}: complete event {i} needs dur >= 0"
+                )
+    return doc

@@ -75,9 +75,18 @@ def _child_main(
         process_id=rank,
         backend="cpu",
     )
+    # Per-rank span tracing (ddp_tpu.obs): when the parent exported
+    # DDP_TPU_TRACE_DIR (inherited by this 'spawn' child), the global
+    # tracer turns on with pid=rank and registers an atexit export, so
+    # every rank leaves trace_rank{N}.trace.json for
+    # scripts/trace_merge.py — with zero changes to worker signatures.
+    from ddp_tpu.obs.tracer import get_tracer, install_from_env
+
+    install_from_env(process_id=rank)
     fn = _resolve(src_file, module_name, qualname)
     try:
-        fn(rank, world_size, *args)
+        with get_tracer().span("worker_main", {"rank": rank}):
+            fn(rank, world_size, *args)
     finally:
         dist.cleanup()
 

@@ -52,6 +52,7 @@ from ddp_tpu.models.generate import (
     write_slot,
 )
 from ddp_tpu.models.lm import LMSpec
+from ddp_tpu.obs.tracer import Tracer
 from ddp_tpu.serve.scheduler import Admission, Request, Scheduler
 from ddp_tpu.utils.metrics import MetricsWriter, StatSummary
 
@@ -113,6 +114,7 @@ class ServeEngine:
         prefill_len: Optional[int] = None,
         max_queue: int = 64,
         metrics: Optional[MetricsWriter] = None,
+        tracer: Optional[Tracer] = None,
         clock: Callable[[], float] = time.monotonic,
     ):
         if slots < 1:
@@ -129,6 +131,14 @@ class ServeEngine:
         self.prefill_len = prefill_len
         self.clock = clock
         self.metrics = metrics or MetricsWriter(None)
+        # Span tracing (ddp_tpu.obs): prefill/refill/decode device
+        # work lands on the host timeline; disabled by default and
+        # pinned free when off. Serving "goodput" here is device-busy
+        # over wall since engine start — the idle-poll complement of
+        # slot occupancy, published via stats()/statusz.
+        self.tracer = tracer or Tracer()
+        self._started_at = clock()
+        self._productive_s = 0.0
         self.scheduler = Scheduler(
             max_queue=max_queue,
             prefill_len=prefill_len,
@@ -221,6 +231,17 @@ class ServeEngine:
             "splice": self._splice._cache_size(),
         }
 
+    def goodput(self) -> dict:
+        """Device-busy seconds over wall seconds since engine start."""
+        wall = self.clock() - self._started_at
+        return {
+            "productive_s": round(self._productive_s, 4),
+            "wall_s": round(wall, 4),
+            "goodput": (
+                round(self._productive_s / wall, 6) if wall > 0 else None
+            ),
+        }
+
     def stats(self) -> dict:
         """JSON-ready operational snapshot (the /stats endpoint)."""
         return {
@@ -232,6 +253,7 @@ class ServeEngine:
             "ttft_s": self.ttft.snapshot(),
             "decode_tokens_per_s": self.decode_rate.snapshot(),
             "compile_counts": self.compile_counts(),
+            "goodput": self.goodput(),
         }
 
     # ---- engine loop ------------------------------------------------
@@ -277,10 +299,15 @@ class ServeEngine:
             produced += 1
 
         if self.active:
+            w0, t0 = self.clock(), time.perf_counter()
             logits, self._cache = self._decode(
                 self.params, self._cache, jnp.asarray(self._tokens)
             )
-            logits = np.asarray(logits)
+            logits = np.asarray(logits)  # host sync: decode really done
+            self._productive_s += self.clock() - w0
+            self.tracer.complete(
+                "serve.decode", t0, time.perf_counter() - t0, None
+            )
             for i, slot in enumerate(self._slots):
                 req = slot.request
                 if req is None or len(slot.tokens) >= req.max_new_tokens:
@@ -329,11 +356,34 @@ class ServeEngine:
         padded = jnp.asarray(
             [req.prompt + [0] * pad], jnp.int32
         )
+        traced = self.tracer.enabled
+        w0, t0 = self.clock(), time.perf_counter()
         logits, k, v = self._prefill(
             self.params, padded, jnp.int32(len(req.prompt))
         )
+        if traced:
+            # Only when measuring: the span must cover the device
+            # compute, not just the async enqueue — otherwise prefill
+            # cost is silently billed to the next decode span. The
+            # untraced path stays fully async (the np.asarray in
+            # _pick below is its natural sync point).
+            jax.block_until_ready(k)
+        t1 = time.perf_counter()
+        self.tracer.complete(
+            "serve.prefill", t0, t1 - t0,
+            {"rid": req.rid, "prompt_len": len(req.prompt)}
+            if traced
+            else None,
+        )
         self._cache = self._splice(
             self._cache, jnp.int32(index), k, v, jnp.int32(len(req.prompt))
+        )
+        if traced:
+            jax.block_until_ready(self._cache)
+        self._productive_s += self.clock() - w0
+        self.tracer.complete(
+            "serve.refill", t1, time.perf_counter() - t1,
+            {"slot": index} if traced else None,
         )
         slot.request = req
         slot.tokens = []

@@ -17,6 +17,10 @@ every container this repo targets, and the API is three routes:
   GET  /stats      → 200 engine.stats() (TTFT/throughput summaries,
                     compile counts — the static-shape invariant is an
                     OBSERVABLE, not a comment)
+  GET  /statusz    → 200 {"ok", "stats", "trace"} — stats plus the
+                    live span-trace tail (``.trace`` is a loadable
+                    Perfetto traceEvents document) and the engine's
+                    goodput snapshot (ddp_tpu.obs)
 
 The handler blocks until its request completes (simple request/
 response serving); queue position and slot availability decide
@@ -184,6 +188,18 @@ class LMServer:
         if route == "/stats":
             with self._lock:
                 return self.engine.stats()
+        if route == "/statusz":
+            # Live observability snapshot (ddp_tpu.obs): operational
+            # stats + goodput (inside engine.stats()) plus the tail of
+            # the span trace — the ``trace`` value is itself a valid
+            # Chrome/Perfetto ``traceEvents`` document, so
+            # ``curl .../statusz | jq .trace > t.json`` loads directly.
+            with self._lock:
+                return {
+                    "ok": self._engine_error is None,
+                    "stats": self.engine.stats(),
+                    "trace": self.engine.tracer.snapshot(limit=512),
+                }
         return None
 
 

@@ -144,6 +144,15 @@ class TrainConfig:
     synthetic_size: int | None = None
     profile_dir: str | None = None  # jax.profiler trace output
     metrics_file: str | None = None  # JSONL metrics from process 0
+    # Host-level observability (ddp_tpu.obs): per-rank span traces
+    # (Perfetto trace_event JSON), per-step input-wait/dispatch/compute
+    # attribution in the metrics stream, and MFU per step. Off (None)
+    # by default — disabled mode is pinned free (tests/test_obs.py).
+    # Attribution synchronizes each step, so expect the bounded-
+    # inflight overlap to disappear while it is on: a diagnosis mode.
+    trace_dir: str | None = None
+    # Bounded trace memory: the ring keeps the LAST this-many events.
+    trace_ring_events: int = 65536
     # Abort the process when no step completes for this many seconds
     # (0 = off). Converts a hung collective into a crash the launcher
     # detects, so restart+resume can recover. Set generously above the
@@ -262,6 +271,14 @@ class TrainConfig:
         p.add_argument("--synthetic_size", type=int, default=None)
         p.add_argument("--profile_dir", default=None)
         p.add_argument("--metrics_file", default=None)
+        p.add_argument(
+            "--trace_dir", default=None,
+            help="emit per-rank Perfetto span traces + step-time "
+            "attribution + MFU (ddp_tpu.obs; see docs/OBSERVABILITY.md)",
+        )
+        p.add_argument(
+            "--trace_ring_events", type=int, default=cls.trace_ring_events,
+        )
         p.add_argument(
             "--watchdog_timeout", type=float, default=cls.watchdog_timeout
         )

@@ -39,7 +39,7 @@ from ddp_tpu.parallel.ddp import (
 from ddp_tpu.runtime.mesh import data_axes
 
 
-def device_put_replicated(array, mesh: Mesh):
+def device_put_replicated(array, mesh: Mesh, tracer=None):
     """Stage one array on device, replicated across the mesh.
 
     Multi-process meshes can't ``device_put`` onto non-addressable
@@ -49,20 +49,37 @@ def device_put_replicated(array, mesh: Mesh):
     global — which is also the runner's correctness precondition: the
     per-epoch permutation is computed from the same key on every
     device, so identical staging ⇒ identical batches.
+
+    ``tracer`` (ddp_tpu.obs) spans the staging: for large datasets
+    this host→HBM copy is the fast path's one up-front cost, and it
+    belongs on the same timeline as the epochs it amortizes into.
     """
+    from ddp_tpu.obs.tracer import Tracer
+
     rep = NamedSharding(mesh, P())
-    if jax.process_count() == 1:
-        return jax.device_put(jnp.asarray(array), rep)
-    import numpy as np
+    with (tracer or Tracer()).span(
+        "fast.stage_dataset", {"bytes": int(array.nbytes)}
+    ):
+        if jax.process_count() == 1:
+            staged = jax.device_put(jnp.asarray(array), rep)
+        else:
+            import numpy as np
 
-    return jax.make_array_from_process_local_data(rep, np.asarray(array))
+            staged = jax.make_array_from_process_local_data(
+                rep, np.asarray(array)
+            )
+        if tracer is not None and tracer.enabled:
+            # Only when measuring: the span must cover the copy, not
+            # just its enqueue. Untraced staging stays async.
+            jax.block_until_ready(staged)
+        return staged
 
 
-def device_put_dataset(images, labels, mesh: Mesh):
+def device_put_dataset(images, labels, mesh: Mesh, tracer=None):
     """Stage the full (images, labels) dataset replicated on device."""
     return (
-        device_put_replicated(images, mesh),
-        device_put_replicated(labels, mesh),
+        device_put_replicated(images, mesh, tracer),
+        device_put_replicated(labels, mesh, tracer),
     )
 
 

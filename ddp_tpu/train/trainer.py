@@ -28,6 +28,14 @@ import numpy as np
 from ddp_tpu.data.loader import ShardedLoader
 from ddp_tpu.data.registry import load_dataset
 from ddp_tpu.models import get_model
+from ddp_tpu.obs.goodput import (
+    GoodputAccountant,
+    mfu as _mfu,
+    peak_flops_per_chip,
+    train_flops_per_example,
+)
+from ddp_tpu.obs.steptime import StepAttributor, dispatch_compute_split
+from ddp_tpu.obs.tracer import Tracer
 from ddp_tpu.parallel.ddp import (
     create_train_state,
     make_eval_step,
@@ -123,6 +131,18 @@ class Trainer:
             emulate_devices=config.emulate_devices,
         )
         setup_logging(self.ctx.process_id)
+        # Observability (ddp_tpu.obs), constructed first so dataset
+        # staging and step-builder work below can be spanned: tracer +
+        # per-step attribution, both gated on --trace_dir (disabled
+        # mode is pinned free by tests/test_obs.py).
+        self.tracer = Tracer(
+            enabled=bool(config.trace_dir),
+            ring_events=config.trace_ring_events,
+            process_id=self.ctx.process_id,
+        )
+        self._attr = StepAttributor(
+            enabled=bool(config.trace_dir), tracer=self.tracer
+        )
 
         if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
             # Repeat CLI runs skip the first-compile wait (~20-40s on
@@ -971,7 +991,8 @@ class Trainer:
                 from ddp_tpu.models.pipeline_lm import PipeLMState
 
                 dev_tokens = device_put_replicated(
-                    train_split.images, self.mesh  # tokens ride .images
+                    train_split.images, self.mesh,  # tokens ride .images
+                    tracer=self.tracer,
                 )
                 runner = make_pipe_lm_epoch_runner(
                     self.pipe_cfg, self.optimizer, self.mesh,
@@ -986,7 +1007,8 @@ class Trainer:
                 from ddp_tpu.models.pipeline_vit import PipeViTState
 
                 dev_images, dev_labels = device_put_dataset(
-                    train_split.images, train_split.labels, self.mesh
+                    train_split.images, train_split.labels, self.mesh,
+                    tracer=self.tracer,
                 )
                 runner = make_pipe_vit_epoch_runner(
                     self.pipe_cfg, self.optimizer, self.mesh,
@@ -1001,7 +1023,8 @@ class Trainer:
                 )
             elif self.lm_mode:
                 dev_tokens = device_put_replicated(
-                    train_split.images, self.mesh  # tokens ride .images
+                    train_split.images, self.mesh,  # tokens ride .images
+                    tracer=self.tracer,
                 )
                 self.fast_runner = make_lm_epoch_runner(
                     self.seq_spec, self.optimizer, self.mesh,
@@ -1017,7 +1040,8 @@ class Trainer:
                 # the step path's coverage — a static [:usable]
                 # truncation would exclude the same images every epoch.
                 dev_images, dev_labels = device_put_dataset(
-                    train_split.images, train_split.labels, self.mesh
+                    train_split.images, train_split.labels, self.mesh,
+                    tracer=self.tracer,
                 )
                 self.fast_runner = make_epoch_runner(
                     self.model, self.optimizer, self.mesh,
@@ -1044,6 +1068,19 @@ class Trainer:
         )
         self.metrics_writer = MetricsWriter(
             config.metrics_file, enabled=self.ctx.is_main
+        )
+        # Goodput accounting is always on — one tiny sidecar next to
+        # the checkpoints, loaded/written only during train().
+        self._goodput = GoodputAccountant(
+            os.path.join(config.checkpoint_dir, "goodput.json"),
+            enabled=self.ctx.is_main,
+        )
+        # Analytic train-FLOPs per example (None for unknown models —
+        # MFU is then absent, never silently zero) against the mesh's
+        # aggregate peak.
+        self._flops_per_example = self._estimate_flops_per_example()
+        self._peak_flops = (
+            peak_flops_per_chip(devices[0]) * self.mesh.devices.size
         )
         # Constructed here, armed in train() (start/stop bracket the run).
         self._watchdog = StepWatchdog(config.watchdog_timeout)
@@ -1091,6 +1128,86 @@ class Trainer:
                 "data shards — each microbatch shards over the data "
                 "axis"
             )
+
+    def _estimate_flops_per_example(self) -> float | None:
+        """Analytic train FLOPs per example for MFU (obs/goodput.py).
+
+        "Example" matches the throughput unit the trainer already
+        reports: an image for the image family, a whole sequence for
+        the token/sequence families. None when no estimator exists —
+        the metrics stream then omits ``mfu`` rather than lying.
+        """
+        from ddp_tpu.obs.goodput import (
+            lm_train_flops_per_sequence,
+            seq_classifier_train_flops,
+            vit_train_flops,
+        )
+
+        cfg = self.config
+        if self.lm_mode:
+            return lm_train_flops_per_sequence(self.seq_spec)
+        if self.seq_mode:
+            return seq_classifier_train_flops(self.seq_spec)
+        if self.pipe_lm_mode:
+            pc = self.pipe_cfg
+            total_depth = (
+                pc.num_stages * pc.depth_per_stage * pc.virtual_stages
+            )
+            from ddp_tpu.models.lm import LMSpec
+
+            return lm_train_flops_per_sequence(
+                LMSpec(
+                    vocab_size=pc.vocab_size,
+                    total_len=pc.seq_len,
+                    d_model=pc.d_model,
+                    depth=total_depth,
+                    num_heads=pc.num_heads,
+                    num_experts=pc.num_experts,
+                    moe_every=pc.moe_every,
+                    moe_top_k=pc.moe_top_k,
+                    num_kv_heads=pc.num_kv_heads,
+                )
+            )
+        if self.pipe_mode:
+            pc = self.pipe_cfg
+            return vit_train_flops(
+                tuple(self.train_split.images.shape[1:]),
+                pc.num_classes,
+                patch_size=pc.patch_size,
+                embed_dim=pc.embed_dim,
+                depth=pc.num_stages * pc.depth_per_stage * pc.virtual_stages,
+                num_heads=pc.num_heads,
+            )
+        from ddp_tpu.data.registry import NUM_CLASSES
+
+        return train_flops_per_example(
+            cfg.model,
+            image_shape=tuple(self.train_split.images.shape[1:]),
+            num_classes=cfg.num_classes or NUM_CLASSES.get(self.dataset, 10),
+            depth=cfg.model_depth,
+        )
+
+    def _step_obs_fields(self, timing) -> dict:
+        """JSONL fields for one attributed step ({} when attribution
+        is off — the step record's schema only widens under
+        --trace_dir)."""
+        if timing is None:
+            return {}
+        fields = {
+            "input_wait_s": round(timing.input_wait_s, 6),
+            "dispatch_s": round(timing.dispatch_s, 6),
+            "compute_s": round(timing.compute_s, 6),
+            "recompiles": timing.recompiles,
+        }
+        wall = timing.wall_s
+        m = _mfu(
+            self.global_batch_size / wall if wall > 0 else 0.0,
+            self._flops_per_example,
+            self._peak_flops,
+        )
+        if m is not None:
+            fields["mfu"] = round(m, 6)
+        return fields
 
     def _install_preemption_handler(self):
         """SIGTERM → finish the in-flight step, checkpoint, exit clean.
@@ -1287,6 +1404,10 @@ class Trainer:
 
             save_lm_spec(cfg.checkpoint_dir, self.seq_spec)
         self.state, start_epoch = self._restore_or_init()
+        # Restart-aware goodput: the sidecar (if any) carries the
+        # first launch's clock and prior productive seconds, so a
+        # preempt/resume cycle accumulates instead of resetting.
+        self._goodput.start_run()
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit
         # mid_batch marker; resume re-enters that epoch at that batch.
@@ -1338,7 +1459,8 @@ class Trainer:
                 for epoch in range(start_epoch, cfg.epochs):
                     skip = start_batch if epoch == start_epoch else 0
                     epoch_start_step = int(self.state.step)
-                    stats = self._train_epoch(epoch, skip)
+                    with self.tracer.span("epoch", {"epoch": epoch}):
+                        stats = self._train_epoch(epoch, skip)
                     # Agreement at the epoch boundary: a SIGTERM that
                     # landed after the last in-loop cadence check must
                     # still stop every host on the same side of the
@@ -1388,10 +1510,11 @@ class Trainer:
                     # rather than opening a delete-before-commit window;
                     # a later epoch's save supersedes it. If this was
                     # the LAST epoch, supersede explicitly below.
-                    saved = self.ckpt.save(
-                        epoch, self.state, steps_per_epoch=spe,
-                        metrics=metrics,
-                    )
+                    with self.tracer.span("checkpoint.save", {"epoch": epoch}):
+                        saved = self.ckpt.save(
+                            epoch, self.state, steps_per_epoch=spe,
+                            metrics=metrics,
+                        )
                     if not saved and epoch == cfg.epochs - 1:
                         self.ckpt.save(
                             epoch, self.state, overwrite=True,
@@ -1433,10 +1556,14 @@ class Trainer:
                     signal.SIGTERM,
                     prev_handler if prev_handler is not None else signal.SIG_DFL,
                 )
+            self._goodput.flush()
+            self._export_trace()
         logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
+        gp = self._goodput.snapshot()
         self.metrics_writer.write(
             "final", accuracy=final_acc, loss=final_loss,
             epochs_run=len(self.history),
+            **({"goodput": gp} if gp else {}),
             # The LM community's headline eval number; loss is the
             # mean next-token cross-entropy, so this is exp(loss).
             **(
@@ -1453,6 +1580,18 @@ class Trainer:
             "final_loss": final_loss,
             "history": [dataclasses.asdict(h) for h in self.history],
         }
+
+    def _export_trace(self) -> None:
+        """Per-rank crash-safe trace export (every rank writes its own
+        file; scripts/trace_merge.py joins them on one timeline)."""
+        if not (self.tracer.enabled and self.config.trace_dir):
+            return
+        try:
+            path = self.tracer.export_to_dir(self.config.trace_dir)
+        except OSError as e:
+            logger.warning("trace export failed: %s", e)
+            return
+        logger.info("Wrote span trace to %s", path)
 
     # How far the host may run ahead of the devices. Unbounded async
     # dispatch deadlocks the emulated-CPU collective rendezvous when the
@@ -1474,12 +1613,18 @@ class Trainer:
         last_metrics = None
         n_batches = 0
         inflight: deque = deque()
+        # Attribution (--trace_dir) times each loader fetch and splits
+        # dispatch-return from block_until_ready; disabled, batches()
+        # hands back the raw iterator and on_step returns immediately.
+        attr = self._attr
         for batch_idx, batch in enumerate(
-            self.loader.epoch(epoch, skip_batches), start=skip_batches
+            attr.batches(self.loader.epoch(epoch, skip_batches)),
+            start=skip_batches,
         ):
             self.state, metrics = self.train_step(
                 self.state, batch.images, batch.labels
             )
+            timing = attr.on_step(metrics.loss)
             last_metrics = metrics
             n_batches += 1
             inflight.append(metrics.loss)
@@ -1520,6 +1665,7 @@ class Trainer:
                     loss=loss,
                     lr=round(lr_at(self._lr_schedule, max(0, step_now - 1)), 8),
                     **gn,
+                    **self._step_obs_fields(timing),
                 )
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
@@ -1527,7 +1673,12 @@ class Trainer:
         return self._finish_epoch(epoch, losses, n_batches, seconds)
 
     def _finish_epoch(
-        self, epoch: int, losses: list, n_batches: int, seconds: float
+        self,
+        epoch: int,
+        losses: list,
+        n_batches: int,
+        seconds: float,
+        obs_extra: dict | None = None,
     ) -> EpochStats:
         """Shared epoch-summary contract for the step and fast paths."""
         images = n_batches * self.global_batch_size
@@ -1544,13 +1695,37 @@ class Trainer:
             seconds,
             stats.images_per_sec,
         )
-        extra = {}
+        extra = dict(obs_extra or {})
         if self.seq_mode:
             # For sequence models the sample rate is sequences/sec;
             # tokens/sec is the number the field actually compares.
             extra["tokens_per_sec"] = round(
                 stats.images_per_sec * self.config.seq_len, 1
             )
+        # Attribution totals from the step loop (empty on the fast
+        # path, which passes its own obs_extra; empty when disabled).
+        totals = self._attr.finish_epoch()
+        if totals.steps:
+            extra.update(
+                input_wait_s=round(totals.input_wait_s, 4),
+                dispatch_s=round(totals.dispatch_s, 4),
+                compute_s=round(totals.compute_s, 4),
+                recompiles=totals.recompiles,
+            )
+        # MFU needs only the epoch rate + the analytic estimate —
+        # reported whenever the model has an estimator, traced or not.
+        epoch_mfu = _mfu(
+            stats.images_per_sec, self._flops_per_example, self._peak_flops
+        )
+        if epoch_mfu is not None:
+            extra["mfu"] = round(epoch_mfu, 6)
+        # Goodput accrues per epoch and flushes per epoch: a kill
+        # between epochs loses at most one epoch of accounting.
+        self._goodput.add_productive(seconds)
+        self._goodput.flush()
+        gp = self._goodput.snapshot()
+        if gp:
+            extra["goodput"] = gp["goodput"]
         self.metrics_writer.write(
             "epoch",
             epoch=epoch,
@@ -1571,8 +1746,27 @@ class Trainer:
         """
         cfg = self.config
         logger.info("Starting epoch %d (compiled fast path)", epoch)
+        obs_extra = None
         t0 = time.perf_counter()
-        self.state, metrics = self.fast_runner(self.state, epoch)
+        if self._attr.enabled:
+            # Per-EPOCH attribution — the whole epoch is one dispatch,
+            # so dispatch-return vs block_until_ready is all the host
+            # can observe of it (steptime.dispatch_compute_split).
+            (self.state, metrics), disp_s, comp_s, recompiles = (
+                dispatch_compute_split(self.fast_runner, self.state, epoch)
+            )
+            self.tracer.complete("epoch.dispatch", t0, disp_s)
+            self.tracer.complete(
+                "epoch.compute", t0 + disp_s, comp_s,
+                {"recompiles": recompiles} if recompiles else None,
+            )
+            obs_extra = {
+                "dispatch_s": round(disp_s, 4),
+                "compute_s": round(comp_s, 4),
+                "recompiles": recompiles,
+            }
+        else:
+            self.state, metrics = self.fast_runner(self.state, epoch)
         losses_all = np.asarray(metrics.loss)
         gnorms_all = (
             None if metrics.grad_norm is None else np.asarray(metrics.grad_norm)
@@ -1600,7 +1794,9 @@ class Trainer:
                 lr=round(lr_at(self._lr_schedule, max(0, step_no - 1)), 8),
                 **gn,
             )
-        return self._finish_epoch(epoch, losses, n_batches, seconds)
+        return self._finish_epoch(
+            epoch, losses, n_batches, seconds, obs_extra
+        )
 
     # ---- eval (absent in the reference; required by the north star) ----
 

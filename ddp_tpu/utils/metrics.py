@@ -11,6 +11,7 @@ Perfetto/TensorBoard traces) for kernel-level views.
 
 from __future__ import annotations
 
+import atexit
 import json
 import math
 import os
@@ -89,15 +90,94 @@ class StatSummary:
             "max": r(self._max_v),
         }
 
+    # ---- cross-process merging (scripts/trace_merge.py) -------------
+
+    def to_state(self) -> dict:
+        """JSON-ready full state: exact scalars + the reservoir.
+
+        What a per-rank trace file embeds so summaries can be merged
+        offline; ``snapshot()`` stays the lossy human-facing view.
+        """
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "min": None if self._count == 0 else self._min,
+            "max": None if self._count == 0 else self._max_v,
+            "max_samples": self._max,
+            "samples": list(self._samples),
+        }
+
+    @classmethod
+    def from_state(cls, state: dict, *, seed: int = 0) -> "StatSummary":
+        s = cls(max_samples=int(state.get("max_samples", 4096)), seed=seed)
+        s._count = int(state["count"])
+        s._sum = float(state["sum"])
+        if s._count:
+            s._min = float(state["min"])
+            s._max_v = float(state["max"])
+        s._samples = [float(v) for v in state.get("samples", [])][: s._max]
+        return s
+
+    def merge(self, other: "StatSummary") -> "StatSummary":
+        """Fold ``other`` into self (per-rank → global summaries).
+
+        count/sum(→mean)/min/max combine EXACTLY (pinned by a property
+        test). The percentile reservoir merges by weighted subsampling:
+        each side's samples are kept with probability proportional to
+        the count it represents, so the merged reservoir stays an
+        (approximately) uniform draw from the union stream.
+        """
+        if other._count == 0:
+            return self
+        self._sum += other._sum
+        self._min = min(self._min, other._min)
+        self._max_v = max(self._max_v, other._max_v)
+        merged_count = self._count + other._count
+        pool = self._samples + other._samples
+        if len(pool) > self._max:
+            # Weight by represented counts: index < len(self._samples)
+            # stands for self's stream, the rest for other's.
+            weights = [
+                (self._count / max(1, len(self._samples)))
+                if i < len(self._samples)
+                else (other._count / max(1, len(other._samples)))
+                for i in range(len(pool))
+            ]
+            total = sum(weights)
+            picks = []
+            # Weighted sampling without replacement (Efraimidis-
+            # Spirakis keys): fine at reservoir scale (≤ 2·max_samples).
+            keyed = sorted(
+                (
+                    (self._rng.random() ** (total / (w * len(pool))), v)
+                    for w, v in zip(weights, pool)
+                ),
+                reverse=True,
+            )
+            picks = [v for _, v in keyed[: self._max]]
+            self._samples = picks
+        else:
+            self._samples = pool
+        self._count = merged_count
+        return self
+
 
 class MetricsWriter:
-    """Append-only JSONL metrics stream; no-op when disabled."""
+    """Append-only JSONL metrics stream; no-op when disabled.
+
+    Flushes on ``atexit`` as a backstop: line buffering covers the
+    normal case, but a short-lived process (scripts/serve.py smoke
+    runs, aborted CLIs) must not lose the tail of the stream because
+    nobody reached ``close()``. Explicit ``close()`` unregisters the
+    hook so writers don't accumulate across many constructions.
+    """
 
     def __init__(self, path: str | None, *, enabled: bool = True):
         self._f: IO[str] | None = None
         if path and enabled:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "a", buffering=1)  # line-buffered
+            atexit.register(self.close)
 
     def write(self, kind: str, **fields: Any) -> None:
         if self._f is None:
@@ -116,7 +196,12 @@ class MetricsWriter:
         }
         self._f.write(json.dumps(rec, allow_nan=False) + "\n")
 
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
             self._f = None
+            atexit.unregister(self.close)

@@ -16,11 +16,31 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Callable
 
 logger = logging.getLogger("ddp_tpu")
+
+
+def dump_all_stacks(file=None) -> None:
+    """Write every thread's Python stack to ``file`` (default stderr).
+
+    ``faulthandler`` works from any thread without touching the (hung)
+    main thread's interpreter loop, which is exactly the situation the
+    watchdog fires in. Never raises: the dump is best-effort evidence,
+    the abort must proceed regardless.
+    """
+    import faulthandler
+
+    try:
+        faulthandler.dump_traceback(
+            file=file if file is not None else sys.stderr,
+            all_threads=True,
+        )
+    except Exception:  # noqa: BLE001 — diagnostics must not block abort
+        pass
 
 
 def _default_abort(seconds: float) -> None:
@@ -30,6 +50,10 @@ def _default_abort(seconds: float) -> None:
         "the latest checkpoint",
         seconds,
     )
+    # The one chance to say WHERE it hung: os._exit skips atexit and
+    # every finally, so the stack dump must happen first — the logs
+    # are all a post-mortem of a reclaimed VM gets to keep.
+    dump_all_stacks()
     # sys.exit only raises in this thread; a hung main thread never
     # sees it. _exit is the point: make the process observably dead.
     os._exit(124)

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Observability end-to-end (docs/OBSERVABILITY.md): train with
+# --trace_dir to get (1) a Perfetto-loadable span trace per rank,
+# (2) per-step input-wait / dispatch / device-compute attribution +
+# recompile flags + MFU in the metrics JSONL, and (3) a restart-aware
+# goodput sidecar next to the checkpoints. Then kill-and-resume to
+# show goodput ACCUMULATING across the restart, and merge the
+# per-rank trace files into one timeline.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example13}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. Traced training run. Attribution synchronizes every step (it
+#    measures the async overlap away), so treat --trace_dir as a
+#    diagnosis mode, not the always-on default.
+python train.py --epochs 1 --batch_size 8 \
+    --emulate_devices 8 --synthetic_data --synthetic_size 1024 \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --trace_dir "$WORK/traces" \
+    --log_interval 8 --eval_every 0
+
+# Per-step attribution + MFU landed in the metrics stream:
+grep '"kind": "step"' "$WORK/metrics.jsonl" | head -2
+# Goodput (productive ÷ wall since first launch) persisted beside
+# the checkpoints:
+cat "$WORK/checkpoints/goodput.json"; echo
+
+# 2. Resume for one more epoch — the same sidecar keeps accumulating
+#    (restarts: 1, wall still counted from the FIRST launch).
+python train.py --epochs 2 --batch_size 8 \
+    --emulate_devices 8 --synthetic_data --synthetic_size 1024 \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --trace_dir "$WORK/traces" \
+    --log_interval 8 --eval_every 0
+cat "$WORK/checkpoints/goodput.json"; echo
+
+# 3. Merge per-rank traces (one file here; a launcher/multi-host run
+#    leaves trace_rank0..N-1) and validate the schema on the way.
+python scripts/trace_merge.py "$WORK/traces" \
+    -o "$WORK/traces/merged.trace.json"
+
+# Load $WORK/traces/merged.trace.json at https://ui.perfetto.dev (or
+# chrome://tracing): epoch > step.{input_wait,dispatch,compute} spans,
+# checkpoint saves, and recompile flags on the steps that paid one.
+echo "trace ready: $WORK/traces/merged.trace.json"

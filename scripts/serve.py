@@ -53,6 +53,16 @@ def main() -> None:
     p.add_argument("--max_queue", type=int, default=64)
     p.add_argument("--metrics_file", default=None)
     p.add_argument(
+        "--trace_dir", default=None,
+        help="span-trace prefill/refill/decode (ddp_tpu.obs): serves "
+        "the live tail at /statusz and exports a Perfetto "
+        "trace_event JSON here on shutdown",
+    )
+    p.add_argument(
+        "--trace_ring_events", type=int, default=65536,
+        help="bounded trace memory: keep the last N events",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -67,6 +77,7 @@ def main() -> None:
     args = p.parse_args()
 
     from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.obs.tracer import Tracer
     from ddp_tpu.serve.engine import ServeEngine
     from ddp_tpu.serve.server import LMServer
     from ddp_tpu.utils.metrics import MetricsWriter
@@ -97,34 +108,58 @@ def main() -> None:
                 f"checkpoint in {args.checkpoint_dir}: {e}"
             )
 
+    metrics = MetricsWriter(args.metrics_file)
+    tracer = Tracer(
+        enabled=bool(args.trace_dir),
+        ring_events=args.trace_ring_events,
+    )
     engine = ServeEngine(
         spec,
         params,
         slots=args.slots,
         prefill_len=args.prefill_len,
         max_queue=args.max_queue,
-        metrics=MetricsWriter(args.metrics_file),
+        metrics=metrics,
+        tracer=tracer,
     )
-    with LMServer(engine, host=args.host, port=args.port) as server:
-        print(
-            json.dumps(
-                {
-                    "serving": server.url,
-                    "epoch": epoch,
-                    "slots": engine.num_slots,
-                    "prefill_len": engine.prefill_len,
-                    "total_len": spec.total_len,
-                    "vocab_size": spec.vocab_size,
-                }
-            ),
-            flush=True,
-        )
-        try:
-            import threading
+    try:
+        with LMServer(engine, host=args.host, port=args.port) as server:
+            print(
+                json.dumps(
+                    {
+                        "serving": server.url,
+                        "epoch": epoch,
+                        "slots": engine.num_slots,
+                        "prefill_len": engine.prefill_len,
+                        "total_len": spec.total_len,
+                        "vocab_size": spec.vocab_size,
+                    }
+                ),
+                flush=True,
+            )
+            try:
+                import threading
 
-            threading.Event().wait()  # serve until interrupted
-        except KeyboardInterrupt:
-            pass
+                threading.Event().wait()  # serve until interrupted
+            except KeyboardInterrupt:
+                pass
+    finally:
+        # Short sessions must keep their telemetry tail: the span
+        # trace exports on the way out (crash-safe tmp+rename) and
+        # the JSONL stream is flushed/closed explicitly rather than
+        # trusting interpreter teardown ordering. An unwritable
+        # trace_dir must not turn a clean shutdown into a crash (or
+        # skip the metrics close below).
+        if args.trace_dir:
+            try:
+                path = tracer.export_to_dir(args.trace_dir)
+                print(json.dumps({"trace": path}), flush=True)
+            except OSError as e:
+                print(
+                    json.dumps({"trace_error": str(e)}),
+                    file=sys.stderr, flush=True,
+                )
+        metrics.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Merge per-rank span traces into one Perfetto-loadable timeline.
+
+    python scripts/trace_merge.py TRACE_DIR -o merged.trace.json
+    python scripts/trace_merge.py a.trace.json b.trace.json -o out.json
+
+Each rank of a launched run (runtime/launch.py) — or each process of a
+multi-host job pointed at a shared ``--trace_dir`` — exports its own
+``trace_rank{N}.trace.json`` (ddp_tpu/obs/tracer.py). Timestamps are
+already Unix-epoch microseconds and events carry ``pid = rank``, so
+merging is concatenation onto one comparable timeline; what needs real
+work is the per-span-name duration summaries each file embeds: those
+merge through ``StatSummary.merge`` (utils/metrics.py), whose
+count/mean/min/max are EXACT across the fold (property-tested) while
+percentiles ride the combined reservoir.
+
+Every input is schema-validated before merging — a half-written or
+hand-edited file fails loudly with the offending path and reason, not
+as a silently wrong merged view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_tpu.obs.tracer import validate_trace_file  # noqa: E402
+from ddp_tpu.utils.metrics import StatSummary  # noqa: E402
+
+
+def expand_inputs(paths: list[str], output: str | None = None) -> list[str]:
+    """Files stay files; a directory globs its ``*.trace.json``.
+
+    The output file is excluded from directory expansion: the
+    documented usage writes merged.trace.json INTO the trace dir, and
+    re-merging after more runs land must not ingest the previous
+    merged file (every event would duplicate and the exact-count
+    summary guarantee would silently break).
+    """
+    skip = os.path.abspath(output) if output else None
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = [
+                f
+                for f in sorted(glob.glob(os.path.join(p, "*.trace.json")))
+                if os.path.abspath(f) != skip
+            ]
+            if not found:
+                raise SystemExit(f"{p}: no *.trace.json files")
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
+def merge_traces(paths: list[str]) -> dict:
+    """Validated per-rank docs → one merged trace document."""
+    events: list[dict] = []
+    merged_summaries: dict[str, StatSummary] = {}
+    ranks: list[int] = []
+    dropped = 0
+    for path in paths:
+        doc = validate_trace_file(path)
+        events.extend(doc["traceEvents"])
+        side = doc.get("ddp_tpu", {})
+        ranks.append(int(side.get("rank", -1)))
+        dropped += int(side.get("dropped_events", 0))
+        for name, state in side.get("span_summaries", {}).items():
+            incoming = StatSummary.from_state(state)
+            if name in merged_summaries:
+                merged_summaries[name].merge(incoming)
+            else:
+                merged_summaries[name] = incoming
+    # Stable cross-rank ordering for humans scrolling raw JSON;
+    # Perfetto orders by ts itself, metadata events lead.
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "ddp_tpu": {
+            "merged_from": [os.path.basename(p) for p in paths],
+            "ranks": ranks,
+            "dropped_events": dropped,
+            "span_summaries": {
+                n: s.to_state() for n, s in merged_summaries.items()
+            },
+            "span_summary_snapshots": {
+                n: s.snapshot(ndigits=6)
+                for n, s in merged_summaries.items()
+            },
+        },
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "inputs", nargs="+",
+        help="trace files and/or directories of *.trace.json",
+    )
+    p.add_argument("-o", "--output", required=True)
+    args = p.parse_args(argv)
+
+    paths = expand_inputs(args.inputs, output=args.output)
+    merged = merge_traces(paths)
+    out = os.path.abspath(args.output)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out)
+    print(
+        json.dumps(
+            {
+                "merged": out,
+                "inputs": len(paths),
+                "events": len(merged["traceEvents"]),
+                "span_names": sorted(
+                    merged["ddp_tpu"]["span_summaries"]
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,11 @@ _SMOKE_PATTERNS = (
     "test_config.py::test_reference_defaults",
     "test_metrics.py::test_writer_disabled_is_noop",
     "test_watchdog.py::test_fires_when_beats_stop",
+    # observability: whole-tree syntax gate, trace-exporter schema pin,
+    # and the tracing-off-is-free guarantee (ddp_tpu.obs)
+    "test_obs.py::test_compileall_package_and_scripts",
+    "test_obs.py::test_trace_schema_valid",
+    "test_obs.py::test_disabled_tracer_is_pinned_free",
     "test_optim_extras.py::TestParamEma::test_recurrence_exact",
     # one real trainer e2e (the priciest smoke entry, ~1 min compile)
     "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",

@@ -23,6 +23,47 @@ def test_writer_none_path_is_noop():
     w.close()
 
 
+def test_writer_flush_and_idempotent_close(tmp_path):
+    """Tail-loss guard: flush() forces the buffer out, close() is
+    idempotent (the atexit backstop may fire after an explicit close),
+    and writes after close are silent no-ops."""
+    path = tmp_path / "m.jsonl"
+    w = MetricsWriter(str(path))
+    w.write("step", loss=1.0)
+    w.flush()
+    assert len(path.read_text().splitlines()) == 1
+    w.close()
+    w.close()  # atexit may call again — must not raise
+    w.write("step", loss=2.0)  # closed → dropped, not crashed
+    w.flush()
+    assert len(path.read_text().splitlines()) == 1
+
+
+def test_writer_atexit_backstop_flushes(tmp_path):
+    """A process that exits WITHOUT reaching close() keeps its tail:
+    the constructor registers an atexit close (the scripts/serve.py
+    shutdown story, end-to-end in a real interpreter)."""
+    import subprocess
+    import sys
+
+    path = tmp_path / "m.jsonl"
+    code = (
+        "from ddp_tpu.utils.metrics import MetricsWriter\n"
+        f"w = MetricsWriter({str(path)!r})\n"
+        "w.write('serve_request', rid=1)\n"
+        "# no close(): atexit must flush/close on interpreter exit\n"
+    )
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(path.read_text().splitlines()[0])["rid"] == 1
+
+
 def test_trainer_emits_step_epoch_final_records(tmp_path):
     metrics_path = tmp_path / "metrics.jsonl"
     cfg = TrainConfig(

@@ -61,6 +61,15 @@ def test_preempt_mid_epoch_then_resume_exactly(tmp_path):
     assert summary1["preempted"] is True
     assert summary1["epochs_run"] == 0  # epoch 0 incomplete
 
+    # Goodput sidecar (ddp_tpu.obs) written by the preempted run:
+    # productive time accrued even though the epoch never completed.
+    import json
+
+    sidecar_path = tmp_path / "ck" / "goodput.json"
+    side1 = json.loads(sidecar_path.read_text())
+    assert side1["restarts"] == 0
+    assert side1["productive_s"] > 0
+
     # Run 2: must resume at epoch 0, batch 3, and finish both epochs.
     t2 = Trainer(make_config(tmp_path))
     seen = []
@@ -76,6 +85,18 @@ def test_preempt_mid_epoch_then_resume_exactly(tmp_path):
     t2.close()
     assert "preempted" not in summary2 or not summary2.get("preempted")
     assert int(t2.state.step) == 32  # 2 epochs × 16 steps, no step lost
+    # Goodput survived the kill+resume: the relaunch counts as a
+    # restart, productive time ACCUMULATES (never resets), and the
+    # wall clock still runs from the FIRST launch.
+    side2 = json.loads(sidecar_path.read_text())
+    assert side2["restarts"] == 1
+    assert side2["productive_s"] > side1["productive_s"]
+    assert side2["first_launch_unix"] == side1["first_launch_unix"]
+    from ddp_tpu.obs.goodput import GoodputAccountant
+
+    acc = GoodputAccountant(str(sidecar_path))
+    acc.start_run()
+    assert 0.0 < acc.snapshot()["goodput"] <= 1.0
     # data order continues exactly where run 1 stopped
     expected = ref_labels[3:]
     assert len(seen) == len(expected)

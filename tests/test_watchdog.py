@@ -39,6 +39,43 @@ def test_disabled_is_noop():
     wd.stop()
 
 
+def test_dump_all_stacks_writes_every_thread(tmp_path):
+    """The hang post-mortem: the dump names all live threads' frames
+    (faulthandler), so a stuck collective is diagnosable from logs."""
+    from ddp_tpu.utils.watchdog import dump_all_stacks
+
+    blocker = threading.Event()
+    t = threading.Thread(target=blocker.wait, name="stuck-like", daemon=True)
+    t.start()
+    try:
+        with open(tmp_path / "dump.txt", "w+") as f:
+            dump_all_stacks(file=f)
+            f.seek(0)
+            text = f.read()
+    finally:
+        blocker.set()
+        t.join(2)
+    assert "Thread" in text and "test_watchdog.py" in text
+    # at least two threads: this one and the stuck one
+    assert text.count("Thread 0x") + text.count("Current thread") >= 2
+
+
+def test_default_abort_dumps_before_exit(monkeypatch, tmp_path):
+    """Order contract: stacks dump BEFORE os._exit(124) — _exit skips
+    every finally, so a post-exit dump would never happen."""
+    from ddp_tpu.utils import watchdog as wdmod
+
+    calls = []
+    monkeypatch.setattr(
+        wdmod, "dump_all_stacks", lambda file=None: calls.append("dump")
+    )
+    monkeypatch.setattr(
+        wdmod.os, "_exit", lambda code: calls.append(code)
+    )
+    wdmod._default_abort(12.0)
+    assert calls == ["dump", 124]
+
+
 def _hung_worker(rank, world):
     wd = StepWatchdog(0.5, poll_interval=0.1)  # default abort: os._exit(124)
     wd.start()
