@@ -597,11 +597,16 @@ def run_serve_bench(
 
     Complements run_decode_bench: that measures the raw decode scan
     (one batch, no arrivals); this measures the whole data plane —
-    admission, prefill-into-lane splicing, per-slot decode, retirement
-    — as sustained decode tokens/s and TTFT percentiles. Serving
-    metrics stream through utils/metrics.MetricsWriter the same way a
-    real deployment's would (here: discarded; scripts/serve.py wires
-    --metrics_file).
+    admission, bucketed chunked prefill co-scheduled with the fused
+    decode+sample step, device-resident token handoff, retirement —
+    as sustained decode tokens/s, TTFT percentiles and per-step
+    latency percentiles (p50/p99: chunk co-scheduling exists exactly
+    to keep the p99 step near the p50 — a monolithic prefill would
+    show up as a fat tail). The steady-state compile-count budget
+    (buckets + decode) is asserted so shape-explosion regressions
+    fail the bench fast. Serving metrics stream through
+    utils/metrics.MetricsWriter the same way a real deployment's
+    would (here: discarded; scripts/serve.py wires --metrics_file).
     """
     import time
 
@@ -642,10 +647,18 @@ def run_serve_bench(
         rng.integers(0, vocab, int(n)).tolist() for n in prompt_lens
     ]
 
-    # Warmup: compile the 3-program set outside the timed window.
-    engine.submit(prompts[0], 2)
-    engine.run()
-    compile_counts = engine.compile_counts()
+    # Warmup: eagerly compile the WHOLE bounded program set (one
+    # first-chunk + one continuation-chunk program per bucket width,
+    # plus the fused decode+sample step) outside the timed window —
+    # and assert the compile-count BUDGET: a shape explosion
+    # (per-length prefill, per-config decode) fails the bench before
+    # it pollutes a published record.
+    compile_counts = engine.warmup()
+    compile_budget = 2 * len(engine.buckets) + 1
+    assert sum(compile_counts.values()) <= compile_budget, (
+        f"engine program set {compile_counts} exceeds its budget of "
+        f"2 x {len(engine.buckets)} chunk buckets + 1 decode program"
+    )
 
     # Open-loop schedule: estimate per-step latency from a short
     # drive, then set the Poisson rate to ~1.5× service capacity.
@@ -660,6 +673,12 @@ def run_serve_bench(
 
     engine.ttft = StatSummary()
     engine.decode_rate = StatSummary()
+    engine.step_latency = StatSummary()
+    # The timed window runs UNTRACED: with tracing on, every dispatch
+    # blocks until ready for span fidelity, which disables the
+    # dispatch/retire overlap this bench exists to measure. The
+    # exported trace keeps the warmup/calibration spans.
+    tracer.enabled = False
     service_rate = slots / (step_s * float(np.mean(budgets)))
     arrival_rate = 1.5 * service_rate
     arrivals = np.cumsum(
@@ -686,6 +705,10 @@ def run_serve_bench(
         elif i < n_requests:
             time.sleep(min(0.005, max(0.0, arrivals[i] - now)))
     wall = time.perf_counter() - t_start
+    tracer.enabled = True
+    # The engine records its own per-step latency (reset above so the
+    # summary covers exactly the timed open-loop window).
+    step_lat = engine.step_latency
 
     total_tokens = sum(
         len(engine.result(r).tokens)
@@ -717,13 +740,33 @@ def run_serve_bench(
         "unit": "tokens/sec/chip",
         "slots": slots,
         "prefill_len": prefill_len,
+        "prefill_chunk": engine.prefill_chunk,
+        "prefill_buckets": list(engine.buckets),
+        "step_token_budget": engine.step_token_budget,
         "n_requests": n_requests,
         "rejected": rejected,
         "max_queue_depth": max_queue_depth,
         "arrival_rate_req_per_s": round(float(arrival_rate), 2),
         "ttft_s": engine.ttft.snapshot(),
         "decode_tokens_per_s_per_req": engine.decode_rate.snapshot(),
+        "step_latency_s": {
+            "count": step_lat.count,
+            "p50": (
+                round(step_lat.percentile(50), 6)
+                if step_lat.count else None
+            ),
+            "p99": (
+                round(step_lat.percentile(99), 6)
+                if step_lat.count else None
+            ),
+            "mean": (
+                round(step_lat.snapshot(ndigits=6).get("mean", 0.0), 6)
+                if step_lat.count
+                else None
+            ),
+        },
         "compile_counts": compile_counts,
+        "compile_budget": compile_budget,
         "wall_s": round(wall, 3),
         "d_model": d,
         "depth": depth,

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ddp_tpu.models.lm import LMSpec
-from ddp_tpu.ops.attention import best_attention
+from ddp_tpu.ops.attention import best_attention, dot_product_attention
 
 
 class DecodeCache(NamedTuple):
@@ -442,11 +442,15 @@ def beam_search(
 # The serving engine (serve/engine.py) keeps ONE static-shape decode
 # batch of S slots alive forever; requests of different ages share it.
 # That needs decode with a PER-SLOT position (DecodeCache.pos is one
-# scalar for the whole batch) plus lane-level refill: prefill one
-# request at a fixed padded width, then splice its K/V into a free
-# lane. All three primitives are shape-static — slot index, lengths
-# and positions are traced scalars/vectors — so a running engine
-# compiles each exactly once regardless of the request mix.
+# scalar for the whole batch) plus lane-level refill: prompts are
+# ingested by ``prefill_chunk`` — fixed-width chunks written straight
+# into a lane of the donated cache, co-scheduled with decode steps.
+# Every primitive is shape-static — slot index, lengths, positions and
+# sampling config are traced scalars/vectors — so a running engine's
+# compiled-program set is bounded by its chunk-width buckets
+# regardless of the request mix, and the decode loop is fully
+# device-resident (``slot_decode_sample_step`` fuses sampling; the
+# host sees [S] int32 tokens, never logits).
 
 
 class SlotCache(NamedTuple):
@@ -541,83 +545,315 @@ def slot_decode_step(
     )
 
 
-def prefill_slot(
-    spec: LMSpec, params: Any, prompt: jax.Array, length: jax.Array
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One request's prefill at a FIXED padded width → lane K/V.
+def nucleus_filter(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """``filter_logits``'s top-p branch with a TRACED threshold.
 
-    ``prompt``: [1, P_pad] int32, the real prompt in positions
-    [0, length) and arbitrary padding after; ``length`` is a traced
-    scalar, so every refill reuses one compiled prefill regardless of
-    the prompt's true length — the static-shape invariant the serving
-    engine is built on. Causal attention makes the padding harmless:
-    position t only attends to keys <= t, so K/V and logits at
-    positions < length never see the pad garbage, and the garbage K/V
-    the pad positions leave in the lane sit above the slot's live mask
-    until the decode loop overwrites them (write-then-attend order in
-    ``slot_decode_step``).
-
-    Returns ``(logits [vocab] at position length-1, k, v)`` with k/v
-    shaped [depth, P_pad, H_kv, Dh] for ``write_slot``.
+    1-D ``logits``; ``top_p`` a traced scalar, so one compiled program
+    serves every per-request nucleus setting (the serving engine's
+    requirement — the static-arg variant would recompile per value).
+    Semantics are identical to ``filter_logits(..., top_p=p)`` for
+    p < 1: keep the smallest probability-sorted prefix reaching p, the
+    best token always survives, masked entries become a large negative.
+    Callers that need exact parity with ``filter_logits`` at p == 1.0
+    (no filtering at all) must select the unfiltered logits themselves
+    — at p == 1.0 this function can drop zero-probability tail entries
+    whose preceding cumulative mass already rounds to 1.0.
     """
-    B, P = prompt.shape
-    if B != 1:
-        raise ValueError(f"prefill_slot is per-request: batch {B} != 1")
+    logits = logits.astype(jnp.float32)
+    neg = jnp.float32(jnp.finfo(jnp.float32).min / 2)
+    sorted_logits = jnp.sort(logits)[::-1]
+    probs = jax.nn.softmax(sorted_logits)
+    cum = jnp.cumsum(probs)
+    keep = jnp.concatenate(
+        [jnp.ones((1,), bool), cum[:-1] < top_p]
+    )
+    thresh = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.float32(jnp.inf))
+    )
+    return jnp.where(logits < thresh, neg, logits)
+
+
+def sample_token(
+    logits: jax.Array,
+    seed: jax.Array,
+    step: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """One on-device sampling decision → scalar int32 token.
+
+    The fused-sampling half of the device-resident decode loop: the
+    serving engine jits this INTO its decode/prefill programs so the
+    per-step host transfer is tokens, not logits. Matches
+    ``generate``'s ``pick`` decision-for-decision — greedy argmax at
+    ``temperature <= 0``; otherwise ``categorical`` under the key
+    ``fold_in(key(seed), step)`` over temperature-scaled,
+    nucleus-filtered logits — so a seeded sampled stream is
+    token-identical between the engine and per-request ``generate()``
+    (pinned by tests/test_serve.py). All of seed/step/temperature/
+    top_p are traced scalars: one compiled program covers any
+    per-request sampling config. ``top_k`` is not supported here (its
+    k is a SHAPE, so per-request values would recompile per mix);
+    serve-side requests get temperature + top_p only.
+    """
+    logits = logits.astype(jnp.float32)
+
+    def greedy(_):
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn(_):
+        key = jax.random.fold_in(jax.random.key(seed), step)
+        scaled = logits / temperature  # > 0 inside this branch
+        # filter_logits skips filtering entirely at top_p == 1.0;
+        # branch (not blend) so p == 1.0 stays bit-identical to
+        # generate AND skips the vocab sort at runtime.
+        cand = lax.cond(
+            top_p < 1.0,
+            lambda s: nucleus_filter(s, top_p),
+            lambda s: s,
+            scaled,
+        )
+        return jax.random.categorical(key, cand, axis=-1).astype(
+            jnp.int32
+        )
+
+    # Real branch skip (this is a scalar cond, not a vmapped one): a
+    # greedy request pays one argmax, no key derivation, no sort.
+    return lax.cond(temperature > 0.0, drawn, greedy, operand=None)
+
+
+def sample_slot_tokens(
+    logits: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    temps: jax.Array,
+    top_ps: jax.Array,
+) -> jax.Array:
+    """Per-slot on-device sampling over [S, V] logits → [S] int32.
+
+    Vectorized ``sample_token``, with the expensive machinery gated at
+    RUNTIME (``lax.cond`` on the batch's sampling config, traced — no
+    recompilation): a pure-greedy batch runs one argmax and never
+    touches key derivation, and the vocab sort of the nucleus filter
+    only runs when some lane actually sets top_p < 1. Mostly-greedy
+    serving traffic therefore pays (almost) nothing for the fused
+    sampling path — the reason the old engine kept sampling on host.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampling = temps > 0.0
+
+    def drawn(_):
+        keys = jax.vmap(
+            lambda s, st: jax.random.fold_in(jax.random.key(s), st)
+        )(seeds, steps)
+        safe_t = jnp.where(sampling, temps, jnp.float32(1.0))
+        scaled = logits.astype(jnp.float32) / safe_t[:, None]
+
+        def filtered(s):
+            # Per-lane blend (a vmapped cond would lower to select
+            # anyway): the lanes at top_p == 1.0 keep their unfiltered
+            # row bit-identical to generate.
+            return jax.vmap(
+                lambda row, p: jnp.where(
+                    p < 1.0, nucleus_filter(row, p), row
+                )
+            )(s, top_ps)
+
+        # Hoisted gates — these conds sit OUTSIDE the vmap, so the
+        # branch skip is real: the vocab sort only runs when some lane
+        # actually set top_p < 1.
+        cand = lax.cond(
+            jnp.any(sampling & (top_ps < 1.0)),
+            filtered,
+            lambda s: s,
+            scaled,
+        )
+        return jax.vmap(
+            lambda k, c: jax.random.categorical(k, c, axis=-1)
+        )(keys, cand).astype(jnp.int32)
+
+    # ...and a pure-greedy batch never derives a key at all.
+    toks = lax.cond(
+        jnp.any(sampling), drawn, lambda _: greedy, operand=None
+    )
+    return jnp.where(sampling, toks, greedy)
+
+
+def slot_decode_sample_step(
+    spec: LMSpec,
+    params: Any,
+    cache: SlotCache,
+    tokens: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    temps: jax.Array,
+    top_ps: jax.Array,
+) -> tuple[jax.Array, SlotCache, jax.Array]:
+    """``slot_decode_step`` with sampling fused → ([S] int32, cache,
+    advanced step counters).
+
+    The serving engine's steady-state program: advance all S lanes one
+    token AND pick each lane's next token on device, so the engine
+    transfers [S] int32 per step instead of [S, vocab] logits and the
+    per-slot host sampling loop disappears. ``seeds``/``steps``/
+    ``temps``/``top_ps`` are [S] per-slot sampling state living as
+    DEVICE-RESIDENT engine state (written by ``prefill_chunk`` at
+    refill, never re-uploaded per step): ``steps`` is each lane's
+    emitted-token index — the ``fold_in`` counter that keeps seeded
+    streams identical to ``generate`` — and is returned advanced by
+    one so the loop threads it like the cache. Idle lanes sample
+    garbage the engine ignores — their logits are finite (position 0
+    is always live), so no NaN can propagate.
+    """
+    logits, cache = slot_decode_step(spec, params, cache, tokens)
+    toks = sample_slot_tokens(logits, seeds, steps, temps, top_ps)
+    return toks, cache, steps + 1
+
+
+def prefill_chunk(
+    spec: LMSpec,
+    params: Any,
+    cache: SlotCache,
+    toks: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    temps: jax.Array,
+    top_ps: jax.Array,
+    slot: jax.Array,
+    chunk: jax.Array,
+    start: jax.Array,
+    length: jax.Array,
+    final: jax.Array,
+    seed: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    *,
+    lane_attend: bool = True,
+) -> tuple[SlotCache, jax.Array, jax.Array, jax.Array, jax.Array,
+           jax.Array, jax.Array]:
+    """Ingest ONE chunk of a prompt into a cache lane, in place.
+
+    The Sarathi-style stall-free replacement for monolithic
+    ``prefill_slot`` + ``write_slot``: a long prompt is split into
+    fixed-width chunks, each co-scheduled with decode steps so running
+    lanes never wait behind a full-width prefill. Per chunk:
+
+    - ``chunk``: [C] int32 — prompt tokens for absolute positions
+      [start, start + length), arbitrary padding after ``length``. C is
+      the compiled width (one program per bucketed width); ``start``/
+      ``length`` are traced, so chunk position never recompiles.
+    - K/V for all C positions are written into lane ``slot`` of the
+      DONATED ``cache`` first; attention then runs the C queries
+      against their causal prefix. ``lane_attend`` (PYTHON-static —
+      the engine compiles both variants) picks the key source: True
+      reads the full lane under the banded mask ``key <= start + i``
+      (``dot_product_attention(..., q_offset=start)``) — write-then-
+      attend, continuation chunks see earlier chunks' cache lines;
+      False attends the chunk against ITSELF (plain causal square),
+      correct exactly when ``start == 0`` and C ≥ the whole prompt —
+      the single-chunk fast path that keeps short prompts at
+      monolithic-prefill cost instead of total_len-wide reads. Pad
+      positions (>= length) write garbage ABOVE the lane's live
+      region; the decode loop overwrites each line before it becomes
+      attendable (the same invariant ``write_slot`` relied on).
+    - The lane's ``pos`` is set to ``start + length`` — which also
+      repairs the spurious ``pos`` advance idle-shape decode steps
+      apply to mid-prefill lanes between chunks.
+    - The lane's SAMPLING state is installed on device: ``seeds``/
+      ``temps``/``top_ps`` take the request's scalars at ``slot``, and
+      ``steps`` becomes 1 on the final chunk (the next decode samples
+      emitted-token index 1) — so the engine never re-uploads
+      per-slot sampling arrays on the steady-state path.
+    - When ``final`` (traced bool) the request's FIRST token is
+      sampled on device (``sample_token`` at step 0) and spliced into
+      ``toks`` at ``slot``, so the refilled lane joins the very next
+      decode step without any host round-trip.
+
+    Returns ``(cache, toks, seeds, steps, temps, top_ps, first_token)``
+    — ``first_token`` is the sampled scalar (0 unless ``final``; the
+    whole logits/sampling tail sits behind a ``final`` branch),
+    exposed so the engine can fetch the value asynchronously for the
+    completion record.
+    """
+    C = chunk.shape[0]
     H = spec.num_heads
     Dh = spec.d_model // H
     H_kv = _kv_heads(spec)
     G = H // H_kv
     embed = params["embed"]
-    x = embed[prompt]  # [1, P, d]
-    x = x + params["pos_embed"].astype(x.dtype)[:, :P]
-    attn_fn = best_attention(causal=True)
-    ks, vs = [], []
+    x = embed[chunk][None]  # [1, C, d]
+    pe = lax.dynamic_slice_in_dim(
+        params["pos_embed"], start, C, axis=1
+    )
+    x = x + pe.astype(x.dtype)
+    ck, cv = cache.k, cache.v
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
-        ks.append(k[0])
-        vs.append(v[0])
-        attn = attn_fn(
-            q.astype(jnp.float32),
-            jnp.repeat(k, G, axis=2).astype(jnp.float32),
-            jnp.repeat(v, G, axis=2).astype(jnp.float32),
+        ck = lax.dynamic_update_slice(
+            ck, k.astype(ck.dtype)[:, None], (i, slot, start, 0, 0)
         )
-        attn = attn.reshape(1, P, spec.d_model).astype(x.dtype)
+        cv = lax.dynamic_update_slice(
+            cv, v.astype(cv.dtype)[:, None], (i, slot, start, 0, 0)
+        )
+        if lane_attend:
+            lane_k = lax.dynamic_index_in_dim(
+                ck[i], slot, axis=0, keepdims=False
+            )
+            lane_v = lax.dynamic_index_in_dim(
+                cv[i], slot, axis=0, keepdims=False
+            )
+            attn = dot_product_attention(
+                q.astype(jnp.float32),
+                jnp.repeat(lane_k, G, axis=1)[None].astype(jnp.float32),
+                jnp.repeat(lane_v, G, axis=1)[None].astype(jnp.float32),
+                causal=True,
+                q_offset=start,
+            )
+        else:
+            attn = dot_product_attention(
+                q.astype(jnp.float32),
+                jnp.repeat(k, G, axis=2).astype(jnp.float32),
+                jnp.repeat(v, G, axis=2).astype(jnp.float32),
+                causal=True,
+            )
+        attn = attn.reshape(1, C, spec.d_model).astype(x.dtype)
         x = _block_finish(spec, p, x, attn)
-    # Logits at the last REAL position (length - 1), not the last
-    # padded one — a dynamic slice on a traced index, still one
-    # compiled shape.
-    xt = lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
-    xt = _layer_norm(xt, params["ln_final"])
-    logits = (xt[0, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
-    return logits, jnp.stack(ks), jnp.stack(vs)
+    def _sample_first(_):
+        # Only the FINAL chunk owes a token: the last-position layer
+        # norm, the [d]×[vocab] logits projection and the sampling
+        # draw sit behind a real branch (scalar cond) so every
+        # non-final chunk of a long prompt skips them entirely.
+        xt = lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+        xt = _layer_norm(xt, params["ln_final"])
+        logits = (
+            xt[0, 0] @ embed.T.astype(jnp.float32)
+        ).astype(jnp.float32)
+        tok = sample_token(
+            logits, seed, jnp.int32(0), temperature, top_p
+        )
+        return lax.dynamic_update_slice(toks, tok[None], (slot,)), tok
 
-
-def write_slot(
-    cache: SlotCache,
-    slot: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    length: jax.Array,
-) -> SlotCache:
-    """Splice a prefilled lane into the cache → cache with slot live.
-
-    ``k``/``v``: [depth, P_pad, H_kv, Dh] from ``prefill_slot``;
-    ``slot``/``length`` are traced scalars. The lane's positions past
-    P_pad keep whatever the previous occupant left — they sit above
-    the slot's live mask (pos starts at ``length`` <= P_pad) and the
-    decode loop overwrites each line before it becomes attendable.
-    """
-    new_k = lax.dynamic_update_slice(
-        cache.k, k[:, None].astype(cache.k.dtype), (0, slot, 0, 0, 0)
-    )
-    new_v = lax.dynamic_update_slice(
-        cache.v, v[:, None].astype(cache.v.dtype), (0, slot, 0, 0, 0)
+    new_toks, first = lax.cond(
+        final, _sample_first, lambda _: (toks, jnp.int32(0)),
+        operand=None,
     )
     new_pos = lax.dynamic_update_slice(
-        cache.pos, length[None].astype(jnp.int32), (slot,)
+        cache.pos, (start + length)[None].astype(jnp.int32), (slot,)
     )
-    return SlotCache(k=new_k, v=new_v, pos=new_pos)
+    put = lax.dynamic_update_slice
+    seeds = put(seeds, seed[None].astype(seeds.dtype), (slot,))
+    steps = put(
+        steps,
+        jnp.where(final, jnp.int32(1), jnp.int32(0))[None],
+        (slot,),
+    )
+    temps = put(temps, temperature[None].astype(temps.dtype), (slot,))
+    top_ps = put(top_ps, top_p[None].astype(top_ps.dtype), (slot,))
+    return (
+        SlotCache(k=ck, v=cv, pos=new_pos),
+        new_toks, seeds, steps, temps, top_ps, first,
+    )
 
 
 def cached_logits(

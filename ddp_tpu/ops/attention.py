@@ -110,7 +110,7 @@ def gspmd_flash_attention(mesh, *, causal: bool = False, block_q: int = 512,
     return fn
 
 
-def dot_product_attention(q, k, v, *, causal: bool = False):
+def dot_product_attention(q, k, v, *, causal: bool = False, q_offset=None):
     """Plain softmax attention, fp32 accumulation.
 
     [B, T, H, D] in/out. Softmax runs in fp32 regardless of input dtype
@@ -120,14 +120,23 @@ def dot_product_attention(q, k, v, *, causal: bool = False):
     convention, and exactly the flash kernel's mask, so the size
     dispatch in ``best_attention`` can never change the attention
     pattern); for square T == S this is the ordinary lower triangle.
+
+    ``q_offset`` (optional, may be a TRACED scalar) overrides the end
+    anchor: query t attends keys up to ``q_offset + t``. This is the
+    masked partial-prefill primitive the serving engine's chunked
+    prefill runs — the chunk's T queries start at absolute position
+    ``q_offset`` inside an S = total_len key lane, so the banded mask
+    depends on a runtime value while the compiled shape stays fixed
+    (one program per chunk width, any chunk position).
     """
     dtype = q.dtype
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
     if causal:
         T, S = logits.shape[-2:]
+        offset = (S - T) if q_offset is None else q_offset
         mask = (
-            jnp.arange(T)[:, None] + (S - T) >= jnp.arange(S)[None, :]
+            jnp.arange(T)[:, None] + offset >= jnp.arange(S)[None, :]
         )
         logits = jnp.where(mask, logits, MASK_VALUE)
     weights = jax.nn.softmax(logits, axis=-1)

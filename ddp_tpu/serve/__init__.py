@@ -8,8 +8,11 @@ JSONL metrics stream the trainer writes. See docs/SERVING.md.
 
 Layer map:
 
-  scheduler.py   admission control, FIFO queue, deadlines (pure host)
-  engine.py      slots, continuous batching, the 3-program compile set
+  scheduler.py   admission control, FIFO queue, deadlines, chunked-
+                 prefill planning (pure host)
+  engine.py      slots, continuous batching, the device-resident
+                 decode loop (fused on-device sampling, chunked
+                 bucketed prefill, bounded compile set)
   server.py      stdlib HTTP frontend + background engine thread
   scripts/serve.py (repo root)  checkpoint → listening server CLI
 """

@@ -9,30 +9,45 @@ static shapes:
 - the engine owns S decode **slots** — lanes of one SlotCache
   (models/generate.py) sized [depth, S, total_len, H_kv, Dh] at
   startup, never reshaped;
-- every engine step advances ALL S lanes by one token
-  (``slot_decode_step`` — one compiled program, mixed-age batch);
-- a finished/evicted slot is **refilled** in place: the queue head is
-  prefilled at one fixed padded width (``prefill_slot``) and spliced
-  into the freed lane (``write_slot``) while the other lanes keep
-  decoding on the next step;
-- therefore the engine compiles exactly THREE programs (prefill,
-  decode, splice) at warmup, and a varied request mix — staggered
-  arrivals, different lengths, evictions — triggers **zero further
-  compilation** (pinned by tests/test_serve.py via the jit cache
-  counters this class exposes in ``compile_counts``).
+- every engine step advances ALL S lanes by one token AND samples
+  each lane's next token on device (``slot_decode_sample_step`` — one
+  compiled program, mixed-age batch, fused sampling);
+- prompts are ingested by **chunked prefill** (Sarathi-style):
+  ``prefill_chunk`` writes one power-of-two-bucketed chunk straight
+  into the freed lane of the donated cache, co-scheduled with decode
+  steps under a per-step token budget (serve/scheduler.plan_chunks),
+  so running lanes never stall behind a long prompt;
+- therefore the engine compiles a BOUNDED program set — one decode
+  program plus one chunk program per bucket width — enumerable at
+  ``warmup()``, after which a varied request mix (staggered arrivals,
+  different lengths, evictions) triggers **zero further compilation**
+  (pinned by tests/test_serve.py via ``compile_counts``).
+
+The decode loop is **device-resident**: the only steady-state
+device→host transfer is the [S] int32 token vector of the PREVIOUS
+step, fetched after the current step's work has been dispatched
+(dispatch step i+1, then retire step i's tokens while the device
+computes) — no full-logits round-trip, no per-slot Python sampling
+(both pinned by tests). The cache is donated through every program
+(train/fast.py's convention) so XLA keeps one KV buffer; the token
+vector deliberately is NOT donated — the host still owes a read of
+the previous step's values.
 
 Scheduling policy lives in serve/scheduler.py (admission, FIFO,
-deadlines); this module is the data plane plus per-request
-bookkeeping. Observability flows through utils/metrics.MetricsWriter:
-``serve_step`` records (queue depth, slot occupancy, evictions) and
+deadlines, chunk planning); this module is the data plane plus
+per-request bookkeeping. Observability flows through
+utils/metrics.MetricsWriter: ``serve_step`` records (queue depth,
+slot occupancy, evictions, chunk tokens, dispatch/retire split) and
 ``serve_request`` records (status, TTFT, decode tokens/s) land in the
-same JSONL stream the trainer writes.
+same JSONL stream the trainer writes; span tracing emits
+``serve.prefill_chunk`` / ``serve.decode`` / ``serve.sample``.
 
 Sampling: greedy (temperature 0, the correctness-pinned path — token-
-identical to models/generate.generate) or host-side temperature
-sampling with a per-request numpy PRNG (deliberately NOT the jitted
-``jax.random`` path: per-request keys would either recompile per mix
-or burn a [S]-wide key tensor for mostly-greedy traffic).
+identical to models/generate.generate) or seeded temperature/top-p
+sampling fused into the jitted step via per-slot ``jax.random`` keys
+(ALSO token-identical to a seeded ``generate()`` — same fold_in
+stream, pinned by tests). ``top_k`` stays generate-only: its k is a
+compiled shape, so per-request values would recompile per mix.
 """
 
 from __future__ import annotations
@@ -45,32 +60,46 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Aliased: ``prefill_chunk`` is also an engine CONFIG name (the chunk
+# width __init__ parameter), which would shadow the function inside
+# closures defined there.
+from ddp_tpu.models.generate import init_slot_cache
+from ddp_tpu.models.generate import prefill_chunk as _prefill_chunk
 from ddp_tpu.models.generate import (
-    init_slot_cache,
-    prefill_slot,
-    slot_decode_step,
-    write_slot,
+    slot_decode_sample_step as _decode_sample,
 )
 from ddp_tpu.models.lm import LMSpec
 from ddp_tpu.obs.tracer import Tracer
-from ddp_tpu.serve.scheduler import Admission, Request, Scheduler
+from ddp_tpu.serve.scheduler import (
+    Admission,
+    Request,
+    Scheduler,
+    next_pow2,
+    prev_pow2,
+)
 from ddp_tpu.utils.metrics import MetricsWriter, StatSummary
 
 # Completion statuses.
 COMPLETE = "complete"
 TIMEOUT_EVICTED = "timeout_evicted"  # deadline hit while decoding
 TIMEOUT_QUEUE = "timeout_queue"  # deadline hit while queued
+REJECTED_TOO_LONG = "rejected_too_long"  # slipped past the front door
 
 
 @dataclass
 class Completion:
-    """One finished request: everything the frontend returns."""
+    """One finished request: everything the frontend returns.
+
+    ``ttft`` is None for requests that never produced a token (queue
+    timeouts, mid-prefill evictions, refill-time rejections) — they
+    must not pollute the TTFT summaries with queue-wait times.
+    """
 
     rid: int
     status: str
     prompt: list[int]
     tokens: list[int]
-    ttft: float  # seconds, submit → first token ready
+    ttft: Optional[float]  # seconds, submit → first token observed
     decode_seconds: float  # first token → finish
     submitted: float
     finished: float
@@ -87,22 +116,46 @@ class _Slot:
 
     request: Optional[Request] = None
     tokens: list[int] = field(default_factory=list)
-    first_token_at: float = 0.0
-    rng: Optional[np.random.Generator] = None
+    # Tokens SCHEDULED on device for this request, including ones whose
+    # values the host has not fetched yet (len(tokens) lags by the
+    # in-flight step). Retirement decisions use this — counts are
+    # host-known at dispatch time, values are not.
+    emitted: int = 0
+    prefill_pos: int = 0  # prompt tokens ingested so far
+    first_token_at: Optional[float] = None  # None = no token observed
 
     @property
     def free(self) -> bool:
         return self.request is None
 
+    @property
+    def prefilling(self) -> bool:
+        return (
+            self.request is not None
+            and self.prefill_pos < len(self.request.prompt)
+        )
+
+    @property
+    def decoding(self) -> bool:
+        return (
+            self.request is not None
+            and self.prefill_pos >= len(self.request.prompt)
+        )
+
 
 class ServeEngine:
     """Fixed-slot continuous-batching engine for one causal LM.
 
-    ``slots`` and ``prefill_len`` fix the static shapes (prefill_len
-    defaults to half the position table — prompts longer than it are
-    rejected at admission, budget for decode is what remains).
-    ``clock`` is injectable for deterministic tests; MetricsWriter
-    ``metrics`` may be shared with a trainer's stream or omitted.
+    ``slots`` fixes the decode batch shape; ``prefill_len`` is the
+    admission ceiling for prompts (default half the position table).
+    ``prefill_chunk`` (power of two, default min(pow2(prefill_len),
+    64)) is the full chunk width prompts are ingested at;
+    ``min_bucket`` floors the power-of-two bucket of the final partial
+    chunk; ``step_token_budget`` bounds chunk-plus-decode tokens
+    dispatched per step (default chunk + slots — one full-width chunk
+    can ride along with a full decode batch). ``clock`` is injectable
+    for deterministic tests; MetricsWriter ``metrics`` may be shared
+    with a trainer's stream or omitted.
     """
 
     def __init__(
@@ -112,6 +165,9 @@ class ServeEngine:
         *,
         slots: int = 4,
         prefill_len: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
+        min_bucket: Optional[int] = None,
+        step_token_budget: Optional[int] = None,
         max_queue: int = 64,
         metrics: Optional[MetricsWriter] = None,
         tracer: Optional[Tracer] = None,
@@ -125,17 +181,51 @@ class ServeEngine:
                 f"prefill_len {prefill_len} must leave room to decode "
                 f"inside total_len {spec.total_len}"
             )
+        chunk = next_pow2(
+            prefill_chunk
+            if prefill_chunk
+            else min(next_pow2(prefill_len), 64)
+        )
+        # A chunk's write region [start, start + width) must fit the
+        # cache at start = 0 — cap at the largest pow2 <= total_len.
+        chunk = min(chunk, prev_pow2(spec.total_len))
+        # The smallest bucket must fit the cache at ANY admissible
+        # start (max start = prefill_len - 1, so the space floor is
+        # total_len - prefill_len + 1 >= 2): a wider bucket's pad
+        # overhang would make dynamic_update_slice clamp the write
+        # start and silently shift the chunk over live cache lines.
+        min_bucket = min(
+            chunk,
+            next_pow2(min_bucket) if min_bucket else min(8, chunk),
+            prev_pow2(spec.total_len - prefill_len + 1),
+        )
         self.spec = spec
         self.params = params
         self.num_slots = slots
         self.prefill_len = prefill_len
+        self.prefill_chunk = chunk
+        self.min_bucket = min_bucket
+        self.step_token_budget = (
+            step_token_budget
+            if step_token_budget
+            else chunk + slots
+        )
+        if self.step_token_budget < min_bucket + slots:
+            # Below this floor the prefill head can starve forever
+            # while lanes decode (the budget never fits even the
+            # smallest bucket after decode tokens are accounted).
+            raise ValueError(
+                f"step_token_budget {self.step_token_budget} cannot "
+                f"sustain prefill progress: needs >= min_bucket "
+                f"({min_bucket}) + slots ({slots})"
+            )
         self.clock = clock
         self.metrics = metrics or MetricsWriter(None)
-        # Span tracing (ddp_tpu.obs): prefill/refill/decode device
-        # work lands on the host timeline; disabled by default and
-        # pinned free when off. Serving "goodput" here is device-busy
-        # over wall since engine start — the idle-poll complement of
-        # slot occupancy, published via stats()/statusz.
+        # Span tracing (ddp_tpu.obs): chunk/decode device work plus the
+        # sampled-token retirement land on the host timeline; disabled
+        # by default and pinned free when off. When ENABLED, dispatches
+        # block until ready so spans cover device compute — measuring
+        # mode trades the pipeline overlap for span fidelity.
         self.tracer = tracer or Tracer()
         self._started_at = clock()
         self._productive_s = 0.0
@@ -144,40 +234,66 @@ class ServeEngine:
             prefill_len=prefill_len,
             total_len=spec.total_len,
             vocab_size=spec.vocab_size,
+            chunk=chunk,
+            min_bucket=min_bucket,
+            token_budget=self.step_token_budget,
             clock=clock,
         )
+        # {min_bucket · 2^i} ∪ {chunk}: the whole compiled-width set.
+        self.buckets = self.scheduler.bucket_list()
         self._slots = [_Slot() for _ in range(slots)]
         self._cache = init_slot_cache(spec, slots)
-        self._tokens = np.zeros((slots,), np.int32)
+        # Device-resident token vector: output of the last decode (or
+        # chunk splice), input to the next — the decode loop never
+        # routes tokens through the host. NOT donated anywhere: the
+        # host still owes an async read of the previous step's values.
+        self._toks = jnp.zeros((slots,), jnp.int32)
+        # Per-slot sampling state, ALSO device-resident: the chunk
+        # program installs a request's (seed, temperature, top_p) at
+        # its lane and the decode program advances the fold_in step
+        # counters — the steady-state loop uploads NOTHING per step.
+        # Seeds are int32 to hit exactly generate()'s
+        # jax.random.key(seed) path.
+        self._seeds = jnp.zeros((slots,), jnp.int32)
+        self._sample_steps = jnp.zeros((slots,), jnp.int32)
+        self._temps = jnp.zeros((slots,), jnp.float32)
+        self._top_ps = jnp.ones((slots,), jnp.float32)
+        # Device values dispatched but not yet read back:
+        # ("first", scalar, slot) | ("decode", [S] array, lanes).
+        self._pending: list[tuple[str, Any, Any]] = []
         self._completed: dict[int, Completion] = {}
         self._steps = 0
         self.ttft = StatSummary()
         self.decode_rate = StatSummary()
-        # The engine's entire compiled surface: three programs, built
-        # once here. Slot index / length / positions are traced, so
-        # no request mix can grow this set after warmup.
-        self._prefill = jax.jit(
-            lambda p, prompt, n: prefill_slot(spec, p, prompt, n)
-        )
-        # The cache argument is DONATED in both cache-threading
-        # programs: the engine always overwrites self._cache with the
-        # result, and without donation XLA must preserve the input, so
-        # every decoded token would re-materialize the full
-        # [depth, S, total_len, H_kv, Dh] KV buffer (2× serving HBM +
-        # a copy per step). Same reason models/generate.py's scan
-        # donates its cache carry.
-        self._decode = jax.jit(
-            lambda p, cache, toks: slot_decode_step(spec, p, cache, toks),
-            donate_argnums=(1,),
-        )
-        # A fresh lambda (like the two above), NOT jax.jit(write_slot):
-        # jit tracing caches are shared per function object, so a bare
-        # write_slot wrapper would count OTHER engines' compilations in
-        # this engine's compile_counts — the static-shape pin must be
+        self.step_latency = StatSummary()
+        # The engine's entire compiled surface: ONE decode program
+        # (sampling fused) plus per bucket width one FIRST-chunk
+        # program (self-contained causal attention — short prompts pay
+        # bucket-sized compute, the monolithic-prefill cost) and one
+        # CONTINUATION program (banded attention against the full
+        # lane) — slot index / start / length / final / sampling
+        # config are all traced, so no request mix can grow the set
+        # past 2·len(buckets) + 1 after warmup(). Fresh lambdas (not
+        # bare function objects): jit tracing caches are shared per
+        # function object, and the static-shape pin must be
         # per-engine.
-        self._splice = jax.jit(
-            lambda c, s, k, v, n: write_slot(c, s, k, v, n),
-            donate_argnums=(0,),
+        def _chunk_fn(lane_attend):
+            return jax.jit(
+                lambda p, c, t, se, sp, tm, tp, s, ch, st, ln, fi, sd,
+                rtm, rtp: _prefill_chunk(
+                    spec, p, c, t, se, sp, tm, tp, s, ch, st, ln, fi,
+                    sd, rtm, rtp, lane_attend=lane_attend,
+                ),
+                donate_argnums=(1,),
+            )
+
+        self._chunk_first = _chunk_fn(False)
+        self._chunk_cont = _chunk_fn(True)
+        self._decode = jax.jit(
+            lambda p, c, t, sd, st, tm, tp: _decode_sample(
+                spec, p, c, t, sd, st, tm, tp
+            ),
+            donate_argnums=(1,),
         )
 
     # ---- frontend surface ------------------------------------------
@@ -188,6 +304,7 @@ class ServeEngine:
         max_new_tokens: int,
         *,
         temperature: float = 0.0,
+        top_p: float = 1.0,
         seed: int = 0,
         timeout: Optional[float] = None,
     ) -> Admission:
@@ -196,6 +313,7 @@ class ServeEngine:
             prompt,
             max_new_tokens,
             temperature=temperature,
+            top_p=top_p,
             seed=seed,
             timeout=timeout,
         )
@@ -224,12 +342,47 @@ class ServeEngine:
 
     def compile_counts(self) -> dict[str, int]:
         """Compiled-program count per engine function (the static-
-        shape pin: after warmup these must never grow)."""
+        shape pin: after ``warmup()`` these must never grow;
+        prefill_first and prefill_chunk are each bounded by
+        ``len(self.buckets)``)."""
         return {
-            "prefill": self._prefill._cache_size(),
+            "prefill_first": self._chunk_first._cache_size(),
+            "prefill_chunk": self._chunk_cont._cache_size(),
             "decode": self._decode._cache_size(),
-            "splice": self._splice._cache_size(),
         }
+
+    def warmup(self) -> dict[str, int]:
+        """Eagerly compile the engine's whole program set → counts.
+
+        Per bucket width one first-chunk and one continuation-chunk
+        program, plus the decode program — after this, steady state
+        compiles NOTHING (the zero-recompilation pin's baseline, and
+        a serving process's first-request latency is a decode step,
+        not an XLA compile). Must run on an idle engine: warmup
+        chunks write garbage K/V into lane 0, which the refill
+        invariant (every line is overwritten before it becomes
+        attendable) makes harmless only while no request owns a lane.
+        """
+        if self.active:
+            raise RuntimeError("warmup() requires an idle engine")
+        zero = jnp.int32(0)
+        for fn in (self._chunk_first, self._chunk_cont):
+            for w in self.buckets:
+                (self._cache, self._toks, self._seeds,
+                 self._sample_steps, self._temps, self._top_ps,
+                 _) = fn(
+                    self.params, self._cache, self._toks, self._seeds,
+                    self._sample_steps, self._temps, self._top_ps,
+                    zero, jnp.zeros((w,), jnp.int32), zero,
+                    jnp.int32(w), jnp.asarray(False), zero,
+                    jnp.float32(0.0), jnp.float32(1.0),
+                )
+        self._toks, self._cache, self._sample_steps = self._decode(
+            self.params, self._cache, self._toks, self._seeds,
+            self._sample_steps, self._temps, self._top_ps,
+        )
+        jax.block_until_ready(self._toks)
+        return self.compile_counts()
 
     def goodput(self) -> dict:
         """Device-busy seconds over wall seconds since engine start."""
@@ -252,75 +405,171 @@ class ServeEngine:
             "completed": len(self._completed),
             "ttft_s": self.ttft.snapshot(),
             "decode_tokens_per_s": self.decode_rate.snapshot(),
+            "step_latency_s": self.step_latency.snapshot(ndigits=6),
             "compile_counts": self.compile_counts(),
+            "prefill": {
+                "chunk": self.prefill_chunk,
+                "min_bucket": self.min_bucket,
+                "buckets": list(self.buckets),
+                "step_token_budget": self.step_token_budget,
+            },
             "goodput": self.goodput(),
         }
 
     # ---- engine loop ------------------------------------------------
 
     def step(self) -> int:
-        """One engine iteration → number of live tokens produced.
+        """One engine iteration → number of tokens scheduled.
 
-        Order: (1) retire finished / evict expired running requests,
-        (2) evict expired queued requests, (3) refill free slots from
-        the queue (prefill produces each request's FIRST token), (4)
-        one batched decode step over all slots. A slot refilled in (3)
-        also decodes in (4) — continuous batching, no drain barrier.
+        Order: (1) retire finished / evict expired requests (draining
+        any in-flight token values they are owed), (2) evict expired
+        queued requests, (3) admit queue heads into free slots, (4)
+        dispatch prefill chunks within the step token budget — a slot
+        whose FINAL chunk lands this step joins the decode batch
+        immediately (continuous batching, no drain barrier), (5)
+        dispatch one fused decode+sample step over all slots, (6)
+        retire the PREVIOUS step's [S] int32 token vector — the only
+        steady-state device→host transfer — while the device computes
+        what was just dispatched.
         """
         now = self.clock()
+        t_step = time.perf_counter()
+        traced = self.tracer.enabled
         evictions = 0
         for slot in self._slots:
             req = slot.request
             if req is None:
                 continue
-            if len(slot.tokens) >= req.max_new_tokens:
+            if slot.emitted >= req.max_new_tokens:
+                self._drain()  # the completion needs its token values
                 self._finish(slot, COMPLETE)
             elif req.expired(now):
+                self._drain()
                 self._finish(slot, TIMEOUT_EVICTED)
                 evictions += 1
         for req in self.scheduler.evict_expired():
             now2 = self.clock()
             self._completed[req.rid] = Completion(
                 rid=req.rid, status=TIMEOUT_QUEUE, prompt=req.prompt,
-                tokens=[], ttft=now2 - req.submitted, decode_seconds=0.0,
+                tokens=[], ttft=None, decode_seconds=0.0,
                 submitted=req.submitted, finished=now2,
             )
             self._record_request(self._completed[req.rid])
             evictions += 1
 
-        produced = 0
-        for i, slot in enumerate(self._slots):
+        for slot in self._slots:
             if not slot.free or self.scheduler.depth == 0:
                 continue
             req = self.scheduler.next_request()
             if req is None:
                 break
-            self._refill(i, slot, req)
-            produced += 1
+            self._admit_to_slot(slot, req)
 
-        if self.active:
-            w0, t0 = self.clock(), time.perf_counter()
-            logits, self._cache = self._decode(
-                self.params, self._cache, jnp.asarray(self._tokens)
+        # Everything below is device dispatch + the one-step-lagged
+        # retirement; anything fetched in (6) was dispatched LAST step
+        # and has been computing since.
+        prev_pending = self._pending
+        self._pending = []
+        produced = 0
+        w0 = self.clock()
+        t_dispatch = time.perf_counter()
+        device_work = False
+
+        prefilling = [
+            (i, s.prefill_pos, len(s.request.prompt) - s.prefill_pos)
+            for i, s in enumerate(self._slots)
+            if s.prefilling
+        ]
+        # plan_chunks' FIFO contract is ADMISSION order, not slot-index
+        # order: under a tight budget the head gets full width and
+        # followers shrink/defer, so a newer request refilled into a
+        # lower-index lane must not starve an older one's prefill.
+        prefilling.sort(key=lambda t: self._slots[t[0]].request.rid)
+        decode_lanes = [i for i, s in enumerate(self._slots) if s.decoding]
+        chunk_tokens = 0
+        for i, width in self.scheduler.plan_chunks(
+            prefilling, len(decode_lanes)
+        ):
+            slot = self._slots[i]
+            req = slot.request
+            start = slot.prefill_pos
+            live = min(width, len(req.prompt) - start)
+            final = start + live == len(req.prompt)
+            buf = np.zeros((width,), np.int32)
+            buf[:live] = req.prompt[start : start + live]
+            # First chunk: self-contained causal attention (the chunk
+            # IS its own causal prefix at start == 0) — short prompts
+            # never pay a total_len-wide lane read. Continuations
+            # attend the full lane under the banded q_offset mask.
+            fn = self._chunk_first if start == 0 else self._chunk_cont
+            t0 = time.perf_counter()
+            (self._cache, self._toks, self._seeds, self._sample_steps,
+             self._temps, self._top_ps, first) = fn(
+                self.params, self._cache, self._toks, self._seeds,
+                self._sample_steps, self._temps, self._top_ps,
+                jnp.int32(i), jnp.asarray(buf), jnp.int32(start),
+                jnp.int32(live), jnp.asarray(final),
+                # Exact int32 seed (admission range-checks it): any
+                # masking here would break token-identity with
+                # generate(seed=...) for negative seeds.
+                jnp.int32(req.seed),
+                jnp.float32(req.temperature), jnp.float32(req.top_p),
             )
-            logits = np.asarray(logits)  # host sync: decode really done
-            self._productive_s += self.clock() - w0
+            device_work = True
+            slot.prefill_pos = start + live
+            chunk_tokens += live
+            if traced:
+                jax.block_until_ready(self._toks)
             self.tracer.complete(
-                "serve.decode", t0, time.perf_counter() - t0, None
+                "serve.prefill_chunk", t0, time.perf_counter() - t0,
+                {"rid": req.rid, "slot": i, "start": start,
+                 "width": width, "final": final}
+                if traced
+                else None,
             )
-            for i, slot in enumerate(self._slots):
-                req = slot.request
-                if req is None or len(slot.tokens) >= req.max_new_tokens:
-                    # Idle lane, or a request whose budget the prefill
-                    # token already filled — it retires next step; the
-                    # lane's decode output is discarded.
-                    continue
-                tok = self._pick(slot, logits[i])
-                slot.tokens.append(tok)
-                self._tokens[i] = tok
+            if final:
+                slot.emitted = 1
                 produced += 1
+                self._pending.append(("first", first, i))
+                decode_lanes.append(i)
+
+        emit_lanes = [
+            i
+            for i in decode_lanes
+            if self._slots[i].emitted
+            < self._slots[i].request.max_new_tokens
+        ]
+        # Dispatch only when some lane will actually emit: a step whose
+        # every decoding lane already filled its budget (all retiring
+        # next step) would compute a full [S, total_len] decode and
+        # throw the entire output away.
+        if emit_lanes:
+            t0 = time.perf_counter()
+            self._toks, self._cache, self._sample_steps = self._decode(
+                self.params, self._cache, self._toks, self._seeds,
+                self._sample_steps, self._temps, self._top_ps,
+            )
+            device_work = True
+            if traced:
+                jax.block_until_ready(self._toks)
+            self.tracer.complete(
+                "serve.decode", t0, time.perf_counter() - t0,
+                {"lanes": len(decode_lanes)} if traced else None,
+            )
+            for i in emit_lanes:
+                self._slots[i].emitted += 1
+            self._pending.append(("decode", self._toks, emit_lanes))
+            produced += len(emit_lanes)
+
+        dispatch_s = time.perf_counter() - t_dispatch
+        t_retire = time.perf_counter()
+        drained = self._drain(prev_pending)
+        retire_s = time.perf_counter() - t_retire
+        if device_work or drained:
+            self._productive_s += self.clock() - w0
 
         self._steps += 1
+        self.step_latency.add(time.perf_counter() - t_step)
         self.metrics.write(
             "serve_step",
             step=self._steps,
@@ -329,6 +578,9 @@ class ServeEngine:
             slot_occupancy=round(self.active / self.num_slots, 4),
             evictions=evictions,
             tokens=produced,
+            prefill_chunk_tokens=chunk_tokens,
+            dispatch_s=round(dispatch_s, 6),
+            retire_s=round(retire_s, 6),
         )
         return produced
 
@@ -350,75 +602,85 @@ class ServeEngine:
 
     # ---- internals --------------------------------------------------
 
-    def _refill(self, index: int, slot: _Slot, req: Request) -> None:
-        """Prefill ``req`` into lane ``index``; emits the first token."""
-        pad = self.prefill_len - len(req.prompt)
-        padded = jnp.asarray(
-            [req.prompt + [0] * pad], jnp.int32
-        )
-        traced = self.tracer.enabled
-        w0, t0 = self.clock(), time.perf_counter()
-        logits, k, v = self._prefill(
-            self.params, padded, jnp.int32(len(req.prompt))
-        )
-        if traced:
-            # Only when measuring: the span must cover the device
-            # compute, not just the async enqueue — otherwise prefill
-            # cost is silently billed to the next decode span. The
-            # untraced path stays fully async (the np.asarray in
-            # _pick below is its natural sync point).
-            jax.block_until_ready(k)
-        t1 = time.perf_counter()
-        self.tracer.complete(
-            "serve.prefill", t0, t1 - t0,
-            {"rid": req.rid, "prompt_len": len(req.prompt)}
-            if traced
-            else None,
-        )
-        self._cache = self._splice(
-            self._cache, jnp.int32(index), k, v, jnp.int32(len(req.prompt))
-        )
-        if traced:
-            jax.block_until_ready(self._cache)
-        self._productive_s += self.clock() - w0
-        self.tracer.complete(
-            "serve.refill", t1, time.perf_counter() - t1,
-            {"slot": index} if traced else None,
-        )
+    def _admit_to_slot(self, slot: _Slot, req: Request) -> bool:
+        """Bind a popped request to a lane; False = rejected instead.
+
+        The belt to admission's braces: a prompt that cannot be served
+        (longer than the admission ceiling, or leaving no room to
+        decode) but slipped past the front door — a mutated scheduler
+        config, a future code path — completes as REJECTED_TOO_LONG
+        here rather than surfacing as a cryptic shape error from the
+        middle of a jitted program.
+        """
+        if len(req.prompt) > min(self.prefill_len, self.spec.total_len - 1):
+            now = self.clock()
+            self._completed[req.rid] = Completion(
+                rid=req.rid, status=REJECTED_TOO_LONG, prompt=req.prompt,
+                tokens=[], ttft=None, decode_seconds=0.0,
+                submitted=req.submitted, finished=now,
+            )
+            self._record_request(self._completed[req.rid])
+            return False
         slot.request = req
         slot.tokens = []
-        slot.rng = (
-            np.random.default_rng(req.seed)
-            if req.temperature > 0.0
-            else None
-        )
-        tok = self._pick(slot, np.asarray(logits))
-        slot.tokens.append(tok)
-        self._tokens[index] = tok
-        slot.first_token_at = self.clock()
-        self.ttft.add(slot.first_token_at - req.submitted)
+        slot.emitted = 0
+        slot.prefill_pos = 0
+        slot.first_token_at = None
+        # Sampling config reaches the device with the request's first
+        # chunk (prefill_chunk installs it at the lane) — nothing to
+        # upload here.
+        return True
 
-    def _pick(self, slot: _Slot, logits: np.ndarray) -> int:
-        """Greedy argmax, or host-side temperature sampling."""
-        req = slot.request
-        if req.temperature <= 0.0:
-            return int(np.argmax(logits))
-        z = logits.astype(np.float64) / req.temperature
-        z -= z.max()
-        p = np.exp(z)
-        p /= p.sum()
-        return int(slot.rng.choice(len(p), p=p))
+    def _drain(self, items: Optional[list] = None) -> int:
+        """Fetch dispatched-but-unread token values → tokens appended.
+
+        The steady-state host sync: each item is either the previous
+        decode step's [S] int32 vector or a final chunk's first-token
+        scalar — never logits. Called with the previous step's items
+        after this step's dispatches (the fetch overlaps the device
+        computing the new work), and with everything outstanding when
+        a retirement needs its values now.
+        """
+        if items is None:
+            items = self._pending
+            self._pending = []
+        if not items:
+            return 0
+        traced = self.tracer.enabled
+        t0 = time.perf_counter()
+        appended = 0
+        for kind, arr, meta in items:
+            vals = np.asarray(arr)
+            if kind == "first":
+                slot = self._slots[meta]
+                slot.tokens.append(int(vals))
+                appended += 1
+                slot.first_token_at = self.clock()
+                if slot.request is not None:
+                    self.ttft.add(
+                        slot.first_token_at - slot.request.submitted
+                    )
+            else:
+                for i in meta:
+                    self._slots[i].tokens.append(int(vals[i]))
+                    appended += 1
+        self.tracer.complete(
+            "serve.sample", t0, time.perf_counter() - t0,
+            {"tokens": appended} if traced else None,
+        )
+        return appended
 
     def _finish(self, slot: _Slot, status: str) -> None:
         req = slot.request
         now = self.clock()
+        first = slot.first_token_at  # None = evicted before any token
         c = Completion(
             rid=req.rid,
             status=status,
             prompt=req.prompt,
             tokens=list(slot.tokens),
-            ttft=slot.first_token_at - req.submitted,
-            decode_seconds=now - slot.first_token_at,
+            ttft=(first - req.submitted) if first is not None else None,
+            decode_seconds=(now - first) if first is not None else 0.0,
             submitted=req.submitted,
             finished=now,
         )
@@ -428,15 +690,21 @@ class ServeEngine:
         self._record_request(c)
         slot.request = None
         slot.tokens = []
-        slot.rng = None
+        slot.emitted = 0
+        slot.prefill_pos = 0
+        slot.first_token_at = None
 
     def _record_request(self, c: Completion) -> None:
-        self.metrics.write(
-            "serve_request",
+        fields = dict(
             rid=c.rid,
             status=c.status,
             prompt_len=len(c.prompt),
             new_tokens=len(c.tokens),
-            ttft_s=round(c.ttft, 4),
             decode_tokens_per_s=round(c.decode_tokens_per_s, 2),
         )
+        # Requests that never produced a token carry no ttft_s at all:
+        # downstream aggregation must see only real first-token
+        # latencies, not queue-timeout wait times.
+        if c.ttft is not None:
+            fields["ttft_s"] = round(c.ttft, 4)
+        self.metrics.write("serve_request", **fields)

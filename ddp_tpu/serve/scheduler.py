@@ -14,6 +14,12 @@ module owns everything that happens before a request reaches one:
   they stopped mattering; the engine applies the same deadline to
   RUNNING requests (status ``timeout_evicted``), freeing the slot for
   the queue head.
+- **Chunked-prefill planning**: the engine ingests prompts in
+  power-of-two-bucketed chunks co-scheduled with decode steps
+  (Sarathi-style stall-free prefill); ``plan_chunks`` decides which
+  mid-prefill slots advance this step, accounting each chunk's width
+  plus one token per decoding lane against a per-step token budget so
+  one long prompt can never head-of-line-block the running lanes.
 
 Pure host-side Python — no JAX here. ``clock`` is injectable so tests
 drive time explicitly.
@@ -36,6 +42,25 @@ PROMPT_TOO_LONG = "prompt_too_long"
 BUDGET_NONPOSITIVE = "max_new_tokens_nonpositive"
 BUDGET_EXCEEDS_CONTEXT = "budget_exceeds_context"
 TOKEN_OUT_OF_RANGE = "token_out_of_range"
+TOP_P_OUT_OF_RANGE = "top_p_out_of_range"
+TOP_P_WITHOUT_SAMPLING = "top_p_without_sampling"
+SEED_OUT_OF_RANGE = "seed_out_of_range"
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def prev_pow2(n: int) -> int:
+    """Largest power of two <= n (requires n >= 1)."""
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
 
 
 @dataclass
@@ -46,6 +71,7 @@ class Request:
     prompt: list[int]
     max_new_tokens: int
     temperature: float = 0.0
+    top_p: float = 1.0
     seed: int = 0
     deadline: Optional[float] = None  # absolute, in clock() time
     submitted: float = 0.0
@@ -78,6 +104,11 @@ class Scheduler:
     prefill_len: int
     total_len: int
     vocab_size: int = 0  # 0 = skip the token-range check
+    # Chunked-prefill policy (the engine sets these from its bucket
+    # config; the defaults keep a bare Scheduler usable in tests).
+    chunk: int = 0  # 0 = one prefill_len-wide chunk per prompt
+    min_bucket: int = 0  # 0 = no bucketing below the chunk width
+    token_budget: int = 0  # 0 = unlimited (no co-scheduling bound)
     clock: Callable[[], float] = time.monotonic
     _queue: deque = field(default_factory=deque)
     _ids: "itertools.count" = field(default_factory=itertools.count)
@@ -88,6 +119,7 @@ class Scheduler:
         max_new_tokens: int,
         *,
         temperature: float = 0.0,
+        top_p: float = 1.0,
         seed: int = 0,
         timeout: Optional[float] = None,
     ) -> Admission:
@@ -110,6 +142,19 @@ class Scheduler:
             0 <= t < self.vocab_size for t in prompt
         ):
             return Admission(False, TOKEN_OUT_OF_RANGE)
+        if not 0.0 < float(top_p) <= 1.0:
+            return Admission(False, TOP_P_OUT_OF_RANGE)
+        if float(temperature) <= 0.0 and float(top_p) < 1.0:
+            # generate() refuses this combination for the same reason:
+            # greedy decoding ignores the nucleus filter, and refusing
+            # beats silently recording a setting that had no effect.
+            return Admission(False, TOP_P_WITHOUT_SAMPLING)
+        if not -(2**31) <= int(seed) < 2**31:
+            # The engine threads seeds through int32 device state, and
+            # generate()'s own jnp.asarray(seed) overflows past int32 —
+            # out-of-range seeds can never sample the documented
+            # stream, so they are a front-door error.
+            return Admission(False, SEED_OUT_OF_RANGE)
         if len(self._queue) >= self.max_queue:
             return Admission(False, QUEUE_FULL)
         now = self.clock()
@@ -118,12 +163,102 @@ class Scheduler:
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
+            top_p=float(top_p),
             seed=int(seed),
             deadline=None if timeout is None else now + float(timeout),
             submitted=now,
         )
         self._queue.append(req)
         return Admission(True, request=req)
+
+    # ---- chunked-prefill planning -----------------------------------
+
+    def bucket_list(self) -> list[int]:
+        """The compiled chunk-width set, ascending: {min_bucket · 2^i}
+        up to and including ``chunk``. Bounded, warmup-enumerable."""
+        chunk = self.chunk or next_pow2(self.prefill_len)
+        widths = []
+        w = min(self.min_bucket or chunk, chunk)
+        while w < chunk:
+            widths.append(w)
+            w *= 2
+        widths.append(chunk)
+        return widths
+
+    def chunk_width(
+        self,
+        start: int,
+        remaining: int,
+        budget: Optional[int] = None,
+    ) -> Optional[int]:
+        """Compiled width for the next chunk at position ``start`` with
+        ``remaining`` prompt tokens left; None if nothing fits
+        ``budget``.
+
+        Preference: the smallest bucket covering ``remaining`` (a
+        short prompt/tail pays bucket-sized compute, not
+        ``prefill_len``-sized). Two fit constraints shrink it:
+
+        - ``start + width <= total_len`` ALWAYS — a wider chunk's pad
+          positions would overrun the cache, and XLA's clamped
+          dynamic_update_slice would silently shift the whole write
+          over live lines (the engine's min_bucket clamp guarantees at
+          least one bucket fits any admissible start);
+        - ``width <= budget`` when given — rather than stalling a
+          prompt whose covering bucket exceeds the step's leftover
+          budget, ingest the largest budget-fitting bucket now and the
+          rest on later steps (the chunk is simply non-final).
+        """
+        cap = self.total_len - start
+        if budget is not None:
+            cap = min(cap, budget)
+        fitting = [w for w in self.bucket_list() if w <= cap]
+        if not fitting:
+            return None
+        for w in fitting:
+            if w >= remaining:
+                return w
+        return fitting[-1]
+
+    def plan_chunks(
+        self,
+        prefilling: Sequence[tuple[int, int, int]],
+        decoding: int,
+    ) -> list[tuple[int, int]]:
+        """Which mid-prefill slots advance this step → [(slot, width)].
+
+        ``prefilling``: (slot, start, remaining-prompt-tokens) in
+        refill order; ``decoding``: running lanes decoding this step
+        (one token each). Sarathi-style accounting: every planned
+        chunk's width plus the decode tokens must fit
+        ``token_budget``, so a long prompt is ingested across steps
+        while running lanes keep decoding — never a full-prompt
+        stall. Order is preserved (no short prompt overtakes within a
+        step); a tight budget shrinks the head's chunk rather than
+        starving it. Liveness: when nothing is decoding and the
+        budget would starve even the first chunk, one unbudgeted
+        chunk is planned anyway — an idle engine must make prefill
+        progress.
+        """
+        budget = (
+            self.token_budget - decoding
+            if self.token_budget > 0
+            else None
+        )
+        plan: list[tuple[int, int]] = []
+        for slot, start, remaining in prefilling:
+            width = self.chunk_width(start, remaining, budget)
+            if width is None:
+                break  # FIFO: later slots wait with the blocked head
+            plan.append((slot, width))
+            if budget is not None:
+                budget -= width
+        if not plan and prefilling and decoding == 0:
+            slot, start, remaining = prefilling[0]
+            width = self.chunk_width(start, remaining)
+            if width is not None:  # None: no bucket fits this config
+                plan.append((slot, width))
+        return plan
 
     def evict_expired(self) -> list[Request]:
         """Drop queued requests past their deadline → the evicted."""

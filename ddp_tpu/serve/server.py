@@ -7,7 +7,7 @@ one device program's state). No web framework: ``http.server`` is in
 every container this repo targets, and the API is three routes:
 
   POST /generate   {"prompt_tokens": [...], "max_new_tokens": N,
-                    "temperature"?, "seed"?, "timeout"?}
+                    "temperature"?, "top_p"?, "seed"?, "timeout"?}
                    → 200 {"rid", "status", "tokens", "ttft_s", ...}
                    → 429 {"error": "queue_full"} on backpressure
                    → 400 {"error": "prompt_too_long" | ...} on
@@ -126,13 +126,14 @@ class LMServer:
             prompt = list(body["prompt_tokens"])
             max_new = int(body["max_new_tokens"])
             temperature = float(body.get("temperature", 0.0))
+            top_p = float(body.get("top_p", 1.0))
             seed = int(body.get("seed", 0))
             timeout = float(body["timeout"]) if "timeout" in body else None
         except (KeyError, TypeError, ValueError):
             return 400, {
                 "error": "body needs prompt_tokens (list[int]) and "
-                "max_new_tokens (int); temperature/seed/timeout must "
-                "be numeric"
+                "max_new_tokens (int); temperature/top_p/seed/timeout "
+                "must be numeric"
             }
         if self._engine_error is not None:
             return 500, {"error": f"engine failed: {self._engine_error}"}
@@ -141,6 +142,7 @@ class LMServer:
                 prompt,
                 max_new,
                 temperature=temperature,
+                top_p=top_p,
                 seed=seed,
                 timeout=timeout,
             )
@@ -167,7 +169,10 @@ class LMServer:
             "status": done.status,
             "prompt_tokens": done.prompt,
             "tokens": done.tokens,
-            "ttft_s": round(done.ttft, 4),
+            # null for requests that never produced a token (queue
+            # timeout / rejected at refill) — not a fake queue-wait.
+            "ttft_s": round(done.ttft, 4) if done.ttft is not None
+            else None,
             "decode_tokens_per_s": round(done.decode_tokens_per_s, 2),
         }
 

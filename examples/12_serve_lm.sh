@@ -5,8 +5,9 @@
 # HTTP frontend and exercises the whole surface with curl: generation,
 # admission-control rejection (4xx with a machine-readable reason),
 # and the /stats observable that pins the static-shape invariant
-# (compile_counts stays at {prefill: 1, decode: 1, splice: 1} no
-# matter the request mix).
+# (compile_counts — one first-chunk + one continuation-chunk program
+# per prefill bucket plus one fused decode+sample program, compiled
+# at warmup and frozen no matter the request mix).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -62,9 +63,10 @@ wait
 curl -s -w '\nHTTP %{http_code}\n' "127.0.0.1:$PORT/generate" -d \
     "{\"prompt_tokens\": [$(seq -s, 1 200)], \"max_new_tokens\": 8}"
 
-# 5. The operational snapshot: TTFT/decode-rate percentiles, slot
-#    occupancy, and the compile counts (the static-shape invariant as
-#    an observable — three programs, forever).
+# 5. The operational snapshot: TTFT/decode-rate/step-latency
+#    percentiles, slot occupancy, the chunk/bucket config, and the
+#    compile counts (the static-shape invariant as an observable — a
+#    bounded warmup-compiled set, forever).
 curl -s "127.0.0.1:$PORT/stats"
 echo
 tail -3 "$WORK/serve_metrics.jsonl"

@@ -48,7 +48,31 @@ def main() -> None:
     )
     p.add_argument(
         "--prefill_len", type=int, default=None,
-        help="padded prompt width (static; default total_len/2)",
+        help="max admissible prompt length (default total_len/2)",
+    )
+    p.add_argument(
+        "--prefill_chunk", type=int, default=None,
+        help="chunked-prefill width (rounded to a power of two; "
+        "default min(pow2(prefill_len), 64)) — prompts are ingested "
+        "in chunks co-scheduled with decode steps",
+    )
+    p.add_argument(
+        "--min_bucket", type=int, default=None,
+        help="smallest power-of-two bucket for the final partial "
+        "chunk (default min(8, prefill_chunk); clamped so the "
+        "smallest bucket always fits total_len - prefill_len + 1) — "
+        "short prompts pay bucket-sized compute, not "
+        "prefill_len-sized",
+    )
+    p.add_argument(
+        "--step_token_budget", type=int, default=None,
+        help="max prefill-chunk tokens + decode tokens dispatched "
+        "per engine step (default prefill_chunk + slots)",
+    )
+    p.add_argument(
+        "--no_warmup", action="store_true",
+        help="skip eager compilation of the engine program set "
+        "(first requests then pay the XLA compiles)",
     )
     p.add_argument("--max_queue", type=int, default=64)
     p.add_argument("--metrics_file", default=None)
@@ -118,10 +142,18 @@ def main() -> None:
         params,
         slots=args.slots,
         prefill_len=args.prefill_len,
+        prefill_chunk=args.prefill_chunk,
+        min_bucket=args.min_bucket,
+        step_token_budget=args.step_token_budget,
         max_queue=args.max_queue,
         metrics=metrics,
         tracer=tracer,
     )
+    if not args.no_warmup:
+        # Compile the bounded program set (one chunk program per
+        # bucket width + decode) before the first request arrives:
+        # first-request TTFT is then a decode step, not an XLA build.
+        engine.warmup()
     try:
         with LMServer(engine, host=args.host, port=args.port) as server:
             print(
@@ -131,8 +163,12 @@ def main() -> None:
                         "epoch": epoch,
                         "slots": engine.num_slots,
                         "prefill_len": engine.prefill_len,
+                        "prefill_chunk": engine.prefill_chunk,
+                        "buckets": engine.buckets,
+                        "step_token_budget": engine.step_token_budget,
                         "total_len": spec.total_len,
                         "vocab_size": spec.vocab_size,
+                        "compile_counts": engine.compile_counts(),
                     }
                 ),
                 flush=True,

@@ -248,6 +248,8 @@ _SLOW_PATTERNS = (
     "test_seq_transformer.py::TestTraining::test_trains_on_dp_sp_mesh",
     "test_serve.py::TestEngine::test_greedy_matches_generate",
     "test_serve.py::TestEngine::test_moe_routing_config_threaded",
+    "test_serve.py::TestDecodePath::test_bucket_boundary_greedy_matches_generate",
+    "test_serve.py::TestDecodePath::test_seeded_sampling_matches_generate",
     "test_spmd.py::test_tp_fsdp_matches_ddp",
     "test_spmd.py::test_tp_only_mesh",
     "test_tp.py::test_classifier_tp_parity",

@@ -174,3 +174,34 @@ def test_causal_rectangular_is_end_anchored():
     np.testing.assert_allclose(
         np.asarray(dense), np.asarray(flash), atol=2e-5
     )
+
+
+def test_q_offset_matches_causal_row_slice():
+    """The masked partial-prefill primitive: a chunk of queries at
+    absolute offset s against a full key lane (q_offset=s, traced)
+    reproduces exactly the corresponding rows of one full causal
+    attention — chunked prefill can never change the pattern."""
+    import jax
+
+    rng = np.random.default_rng(5)
+    B, L, H, D, C = 1, 24, 2, 8, 8
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    full = dot_product_attention(q, k, v, causal=True)
+    fn = jax.jit(
+        lambda qq, off: dot_product_attention(
+            qq, k, v, causal=True, q_offset=off
+        )
+    )
+    for s in (0, 8, 16):
+        chunk = fn(q[:, s : s + C], jnp.int32(s))  # one program, any s
+        np.testing.assert_allclose(
+            np.asarray(chunk), np.asarray(full[:, s : s + C]),
+            rtol=1e-5, atol=1e-6,
+        )
+    # default end-anchored behaviour is q_offset = S - T
+    tail = dot_product_attention(q[:, -C:], k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(tail), np.asarray(full[:, -C:]), rtol=1e-5, atol=1e-6
+    )

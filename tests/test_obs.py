@@ -390,9 +390,11 @@ def test_serve_spans_statusz_and_goodput(tmp_path):
     stats = engine.stats()
     gp = stats["goodput"]
     assert gp["productive_s"] > 0 and 0 < gp["goodput"] <= 1
-    # spans for prefill / refill / decode all present
+    # spans for chunked prefill / decode / sampled-token retirement
     doc_names = {e["name"] for e in tracer.trace_document()["traceEvents"]}
-    assert {"serve.prefill", "serve.refill", "serve.decode"} <= doc_names
+    assert {
+        "serve.prefill_chunk", "serve.decode", "serve.sample",
+    } <= doc_names
     # /statusz serves stats + a loadable live trace tail
     server = LMServer(engine)
     try:
@@ -456,7 +458,9 @@ def test_serve_cli_session_emits_valid_trace(tmp_path):
             proc.wait(10)
     doc = validate_trace_file(str(tmp_path / "trace_rank0.trace.json"))
     names = {e["name"] for e in doc["traceEvents"]}
-    assert {"serve.prefill", "serve.refill", "serve.decode"} <= names
+    assert {
+        "serve.prefill_chunk", "serve.decode", "serve.sample",
+    } <= names
     # the metrics tail survived shutdown (explicit close in the CLI)
     recs = [
         json.loads(l)

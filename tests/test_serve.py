@@ -1,20 +1,31 @@
 """ddp_tpu.serve: continuous batching, admission control, HTTP front.
 
-The two ISSUE-1 acceptance pins live here:
+The acceptance pins live here:
 
-- **Correctness**: for greedy decoding the engine produces
-  token-identical outputs to per-request models/generate.py decode,
-  for requests of different lengths admitted at different times into
-  one running batch (``TestEngine::test_greedy_matches_generate``,
-  plus the MoE-routing variant).
-- **Static shapes**: after warmup, a varied request mix (staggered
-  arrivals, mixed lengths, evictions, refills) triggers no new XLA
-  compilations — asserted via the engine's jit compilation-cache
-  counters (``TestEngine::test_no_recompilation_after_warmup``).
+- **Correctness**: for greedy decoding AND seeded temperature/top-p
+  sampling the engine produces token-identical outputs to per-request
+  models/generate.py decode, for requests of different lengths
+  admitted at different times into one running batch — including
+  prompt lengths straddling every chunk-bucket boundary
+  (``TestEngine::test_greedy_matches_generate``,
+  ``TestDecodePath``).
+- **Static shapes**: ``warmup()`` compiles the engine's WHOLE program
+  set (one first-chunk + one continuation-chunk program per bucket
+  width + one fused decode+sample program, ≤ 2·len(buckets) + 1),
+  after which a varied request mix
+  (staggered arrivals, mixed lengths, evictions, refills) triggers no
+  new XLA compilations — asserted via the engine's jit
+  compilation-cache counters
+  (``TestEngine::test_no_recompilation_after_warmup``).
+- **Device-resident decode**: the steady-state per-step device→host
+  transfer is the [num_slots] int32 token vector (plus per-refill
+  first-token scalars) — never logits
+  (``TestDecodePath::test_steady_state_transfer_is_slot_tokens``).
 """
 
 import json
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -23,6 +34,7 @@ from ddp_tpu.models.generate import generate
 from ddp_tpu.models.lm import LMSpec, init_lm
 from ddp_tpu.serve.engine import (
     COMPLETE,
+    REJECTED_TOO_LONG,
     TIMEOUT_EVICTED,
     TIMEOUT_QUEUE,
     ServeEngine,
@@ -33,7 +45,10 @@ from ddp_tpu.serve.scheduler import (
     PROMPT_EMPTY,
     PROMPT_TOO_LONG,
     QUEUE_FULL,
+    SEED_OUT_OF_RANGE,
     TOKEN_OUT_OF_RANGE,
+    TOP_P_OUT_OF_RANGE,
+    TOP_P_WITHOUT_SAMPLING,
     Scheduler,
 )
 
@@ -55,11 +70,11 @@ class FakeClock:
         return self.t
 
 
-def _reference(spec, params, prompt, n):
+def _reference(spec, params, prompt, n, **sampling):
     return np.asarray(
         generate(
             spec, params, jnp.asarray([prompt], jnp.int32),
-            max_new_tokens=n,
+            max_new_tokens=n, **sampling,
         )
     )[0, len(prompt):].tolist()
 
@@ -81,6 +96,14 @@ class TestScheduler:
         assert s.submit([1] * 8, 9).reason == BUDGET_EXCEEDS_CONTEXT
         assert s.submit([1, 99], 4).reason == TOKEN_OUT_OF_RANGE
         assert s.submit([1, -1], 4).reason == TOKEN_OUT_OF_RANGE
+        assert s.submit([1, 2], 4, top_p=0.0).reason == TOP_P_OUT_OF_RANGE
+        assert s.submit([1, 2], 4, top_p=1.5).reason == TOP_P_OUT_OF_RANGE
+        # greedy + nucleus filter: generate() refuses it, so does the door
+        assert (
+            s.submit([1, 2], 4, top_p=0.8).reason
+            == TOP_P_WITHOUT_SAMPLING
+        )
+        assert s.submit([1, 2], 4, seed=2**31).reason == SEED_OUT_OF_RANGE
         assert s.depth == 0  # nothing bad was queued
         assert s.submit([1, 2], 4).accepted
         assert s.submit([3], 2).accepted
@@ -105,6 +128,62 @@ class TestScheduler:
         evicted = s.evict_expired()
         assert [r.rid for r in evicted] == [drop.rid]
         assert s.depth == 1 and s.next_request().rid == keep.rid
+
+    def test_chunk_width_powers_of_two(self):
+        s = self.mk(prefill_len=64, total_len=128, chunk=32, min_bucket=4)
+        assert s.bucket_list() == [4, 8, 16, 32]
+        # full chunks while a full chunk remains
+        assert s.chunk_width(0, 32) == 32
+        assert s.chunk_width(0, 100) == 32
+        # partial chunk: smallest pow2 covering the remainder, floored
+        # at min_bucket, capped at chunk
+        assert s.chunk_width(32, 1) == 4
+        assert s.chunk_width(32, 4) == 4
+        assert s.chunk_width(32, 5) == 8
+        assert s.chunk_width(32, 9) == 16
+        assert s.chunk_width(32, 17) == 32
+
+    def test_chunk_width_never_overruns_cache(self):
+        """The covering bucket shrinks when its pad overhang would
+        cross total_len — an overrunning dynamic_update_slice would
+        CLAMP the write start and silently shift the chunk over live
+        cache lines (the PR-3 review repro: start 32, remaining 4,
+        total_len 38 must pick 4, not the covering-by-default 8)."""
+        s = self.mk(prefill_len=36, total_len=38, chunk=16, min_bucket=2)
+        assert s.chunk_width(32, 4) == 4  # 8 would overrun 38
+        assert s.chunk_width(34, 2) == 2
+        # no covering bucket fits: take the largest that does (the
+        # chunk becomes non-final and the tail continues next step)
+        assert s.chunk_width(32, 6) == 4
+
+    def test_plan_chunks_token_budget(self):
+        """Sarathi accounting: chunk widths + decode lanes fit the
+        per-step budget; FIFO order is preserved; a tight budget
+        shrinks the head's chunk instead of starving it; an idle
+        engine always makes progress."""
+        s = self.mk(
+            prefill_len=64, total_len=128,
+            chunk=16, min_bucket=4, token_budget=24,
+        )
+        # 4 decode lanes leave 20 budget tokens: one full 16-chunk
+        # fits, the next (width 16) shrinks to the leftover 4 — FIFO
+        # preserved, head never blocks followers it already served.
+        plan = s.plan_chunks([(0, 0, 40), (1, 0, 30), (2, 0, 2)],
+                             decoding=4)
+        assert plan == [(0, 16), (1, 4)]
+        # no decode lanes: 24 tokens fit 16 + 4 (bucketed) + 4 (shrunk)
+        plan = s.plan_chunks([(0, 0, 40), (1, 0, 3), (2, 0, 50)],
+                             decoding=0)
+        assert plan == [(0, 16), (1, 4), (2, 4)]
+        # starvation guard: budget smaller than any width still plans
+        # one chunk when nothing is decoding
+        tight = self.mk(
+            prefill_len=64, total_len=128,
+            chunk=16, min_bucket=4, token_budget=2,
+        )
+        assert tight.plan_chunks([(3, 0, 40)], decoding=0) == [(3, 16)]
+        # ...but defers to running lanes when there are any
+        assert tight.plan_chunks([(3, 0, 40)], decoding=2) == []
 
 
 class TestEngine:
@@ -152,20 +231,39 @@ class TestEngine:
             )
 
     def test_no_recompilation_after_warmup(self, params):
-        """THE static-shape pin: after warmup the compiled-program set
-        is frozen — staggered arrivals, every distinct prompt length,
-        evictions and refills reuse the same three programs."""
+        """THE static-shape pin: ``warmup()`` compiles the engine's
+        WHOLE bounded program set — one chunk program per bucket width
+        plus the fused decode+sample program — and a varied mix
+        (staggered arrivals, every prompt length, mixed sampling
+        configs, evictions, refills) grows it by NOTHING."""
         clock = FakeClock()
-        eng = ServeEngine(SPEC, params, slots=3, prefill_len=8, clock=clock)
-        eng.submit([1, 2, 3], 4)
-        eng.run()
-        warm = eng.compile_counts()
-        assert sum(warm.values()) == 3  # prefill + decode + splice
+        eng = ServeEngine(
+            SPEC, params, slots=3, prefill_len=8,
+            prefill_chunk=8, min_bucket=2, clock=clock,
+        )
+        assert eng.buckets == [2, 4, 8]
+        warm = eng.warmup()
+        # The compile-count BUDGET: a shape explosion (per-length
+        # prefill, per-sampling-config decode) fails here fast.
+        assert warm["prefill_first"] == len(eng.buckets)
+        assert warm["prefill_chunk"] == len(eng.buckets)
+        assert warm["decode"] == 1
+        assert sum(warm.values()) <= 2 * len(eng.buckets) + 1
 
-        # Varied mix: all 8 prompt lengths, mixed budgets, a queued
+        # Varied mix: all 8 prompt lengths (covering every bucket),
+        # mixed budgets, per-request sampling configs, a queued
         # timeout, a running eviction, slot churn across 3 slots.
         for plen in range(1, 9):
-            eng.submit(list(range(1, plen + 1)), 3 + plen % 4)
+            temp = 0.5 * (plen % 3)
+            adm = eng.submit(
+                list(range(1, plen + 1)), 3 + plen % 4,
+                temperature=temp,
+                # nucleus only on sampling lanes (greedy+top_p is a
+                # front-door error, like generate())
+                top_p=1.0 - 0.1 * (plen % 2) if temp > 0 else 1.0,
+                seed=plen,
+            )
+            assert adm.accepted
             eng.step()
         eng.submit([4, 4], 6, timeout=1e-9)  # expires in the queue
         victim = eng.submit([6, 6, 6], 20, timeout=5.0).request
@@ -204,6 +302,90 @@ class TestEngine:
             SPEC, params, [1, 2], 1
         )
 
+    def test_too_long_past_front_door_rejected_with_status(self, params):
+        """A prompt longer than the engine can serve that SLIPPED PAST
+        admission (misconfigured front door) completes as
+        REJECTED_TOO_LONG — a distinct machine-readable status, not a
+        cryptic shape error from inside a jitted program."""
+        eng = ServeEngine(SPEC, params, slots=1, prefill_len=4)
+        # Simulate the front-door/engine config drift the guard is
+        # for: the scheduler's ceiling is mutated above the engine's.
+        eng.scheduler.prefill_len = 31
+        adm = eng.submit([1] * 9, 2)
+        assert adm.accepted  # the (broken) front door let it through
+        eng.run()
+        done = eng.result(adm.request.rid)
+        assert done is not None
+        assert done.status == REJECTED_TOO_LONG
+        assert done.tokens == [] and done.ttft is None
+        # ...and the engine survives to serve the next valid request.
+        ok = eng.submit([1, 2], 2).request
+        eng.run()
+        assert eng.result(ok.rid).status == COMPLETE
+
+    def test_mid_prefill_eviction_frees_lane(self, params):
+        """A deadline that fires BETWEEN prefill chunks (possible now
+        that long prompts are ingested across steps) evicts with no
+        tokens and ttft=None, and the half-prefilled lane's garbage
+        K/V never leaks into the next occupant (write-before-attend
+        invariant)."""
+        clock = FakeClock()
+        eng = ServeEngine(
+            SPEC, params, slots=1, prefill_len=16, prefill_chunk=4,
+            min_bucket=4, step_token_budget=5, clock=clock,
+        )
+        victim = eng.submit(
+            list(range(1, 13)), 8, timeout=5.0
+        ).request  # 12 tokens = 3 chunks, 1 per budgeted step
+        eng.step()
+        assert eng._slots[0].prefilling
+        assert eng._slots[0].prefill_pos == 4
+        clock.t = 6.0  # expires mid-prefill, before any token
+        eng.run()
+        dead = eng.result(victim.rid)
+        assert dead.status == TIMEOUT_EVICTED
+        assert dead.tokens == [] and dead.ttft is None
+        # the lane serves the next request token-identically
+        ok = eng.submit([1, 2, 3], 3).request
+        eng.run()
+        assert eng.result(ok.rid).tokens == _reference(
+            SPEC, params, [1, 2, 3], 3
+        )
+
+    def test_queue_timeout_ttft_excluded(self, params, tmp_path):
+        """Requests that never produced a token (queue timeout) carry
+        ttft=None and are EXCLUDED from the TTFT summary + metrics —
+        queue-wait times must not pollute first-token latency."""
+        from ddp_tpu.utils.metrics import MetricsWriter
+
+        clock = FakeClock()
+        path = str(tmp_path / "serve.jsonl")
+        writer = MetricsWriter(path)
+        eng = ServeEngine(
+            SPEC, params, slots=1, prefill_len=8, clock=clock,
+            metrics=writer,
+        )
+        served = eng.submit([1, 2], 3).request  # owns the only slot
+        starved = eng.submit([3, 4], 3, timeout=5.0).request  # queued
+        eng.step()
+        clock.t = 6.0  # starved expires before ever reaching a slot
+        eng.run()
+        writer.close()
+        dead = eng.result(starved.rid)
+        assert dead.status == TIMEOUT_QUEUE and dead.ttft is None
+        ok = eng.result(served.rid)
+        assert ok.status == COMPLETE and ok.ttft is not None
+        # Summary aggregates exactly the requests that saw a token.
+        assert eng.ttft.count == 1
+        records = [
+            json.loads(line) for line in open(path).read().splitlines()
+        ]
+        by_rid = {
+            r["rid"]: r for r in records if r["kind"] == "serve_request"
+        }
+        assert "ttft_s" not in by_rid[starved.rid]
+        assert by_rid[served.rid]["ttft_s"] >= 0.0
+
     def test_metrics_stream(self, params, tmp_path):
         """serve_step / serve_request / serve_reject records land in
         the JSONL stream with their operational fields."""
@@ -237,6 +419,134 @@ class TestEngine:
         assert rej and rej[0]["reason"] == QUEUE_FULL
 
 
+class TestDecodePath:
+    """The device-resident decode loop's acceptance pins: equivalence
+    across chunk/bucket boundaries for greedy AND seeded sampling, and
+    the [num_slots]-int32 steady-state transfer bound."""
+
+    def test_bucket_boundary_greedy_matches_generate(self, params):
+        """Greedy outputs are token-identical to generate() for prompt
+        lengths straddling every power-of-two bucket edge and the
+        full-chunk boundary (buckets {4, 8}, chunk 8, prompts up to
+        2×chunk) — the chunked/masked partial prefill computes exactly
+        the monolithic prefill's math."""
+        eng = ServeEngine(
+            SPEC, params, slots=2, prefill_len=16,
+            prefill_chunk=8, min_bucket=4,
+        )
+        assert eng.buckets == [4, 8]
+        reqs = []
+        # around the 4-edge, the 8-edge, and the chunk boundary (9,
+        # 12, 15, 16 take a full chunk + a bucketed remainder)
+        for plen in (1, 3, 4, 5, 7, 8, 9, 12, 15, 16):
+            prompt = [(7 * plen + i) % SPEC.vocab_size for i in range(plen)]
+            reqs.append((prompt, eng.submit(prompt, 5).request))
+            eng.step()  # staggered admission: mixed-age batch
+        eng.run()
+        for prompt, req in reqs:
+            got = eng.result(req.rid)
+            assert got.status == COMPLETE
+            assert got.tokens == _reference(SPEC, params, prompt, 5), (
+                f"prompt_len {len(prompt)} diverged across a bucket edge"
+            )
+
+    def test_seeded_sampling_matches_generate(self, params):
+        """On-device fused sampling is token-identical to a seeded
+        generate(): same fold_in key stream, same temperature scaling,
+        same nucleus filter — per slot, in one mixed-config batch."""
+        eng = ServeEngine(
+            SPEC, params, slots=3, prefill_len=8, min_bucket=4,
+        )
+        cases = [
+            ([3, 1, 4, 1], 6, dict(temperature=0.8, seed=7)),
+            ([2, 7], 5, dict(temperature=1.3, top_p=0.9, seed=3)),
+            # negative seed: must hit generate()'s exact key(-3), not
+            # a masked rewrite of it
+            ([5, 3, 5, 8, 9], 4, dict(temperature=0.6, top_p=0.7,
+                                      seed=-3)),
+            ([9, 9], 5, dict()),  # greedy lane sharing the batch
+        ]
+        reqs = [
+            (p, n, kw, eng.submit(p, n, **kw).request)
+            for p, n, kw in cases
+        ]
+        eng.run()
+        for p, n, kw, req in reqs:
+            got = eng.result(req.rid)
+            assert got.status == COMPLETE
+            assert got.tokens == _reference(SPEC, params, p, n, **kw), (
+                f"sampling config {kw} diverged from generate()"
+            )
+
+    def test_tail_chunk_near_total_len_matches_generate(self, params):
+        """PR-3 review regression: a final chunk whose covering bucket
+        would overrun an UNALIGNED total_len (prompt 17 in a 19-long
+        cache: tail at start 16 must take width 2, not a min_bucket-8
+        that would cross 19) stays token-identical — an overrunning
+        dynamic_update_slice would clamp-shift the write over live
+        cache lines and silently corrupt the output."""
+        spec = SPEC._replace(total_len=19)
+        p19 = init_lm(spec, seed=0)
+        eng = ServeEngine(
+            spec, p19, slots=1, prefill_len=17, prefill_chunk=8,
+            min_bucket=8,  # engine clamps to fit total_len - prefill_len
+        )
+        assert eng.min_bucket == 2  # prev_pow2(19 - 17 + 1)
+        prompt = [(3 * i + 1) % spec.vocab_size for i in range(17)]
+        req = eng.submit(prompt, 2).request
+        eng.run()
+        got = eng.result(req.rid)
+        assert got.status == COMPLETE
+        assert got.tokens == _reference(spec, p19, prompt, 2)
+
+    def test_step_token_budget_floor_validated(self, params):
+        """A budget that cannot sustain prefill progress while lanes
+        decode is a config error at construction, not a silent
+        TTFT-balloon at runtime."""
+        with pytest.raises(ValueError, match="step_token_budget"):
+            ServeEngine(
+                SPEC, params, slots=4, prefill_len=8,
+                min_bucket=8, step_token_budget=4,
+            )
+
+    def test_steady_state_transfer_is_slot_tokens(self, params,
+                                                  monkeypatch):
+        """THE transfer pin: once all lanes are decoding, the only
+        device→host reads are [num_slots] int32 token vectors (and
+        per-refill first-token scalars) — never [slots, vocab] logits."""
+        import ddp_tpu.serve.engine as engine_mod
+
+        eng = ServeEngine(SPEC, params, slots=2, prefill_len=8)
+        eng.submit([1, 2, 3], 12)
+        eng.submit([4, 5], 12)
+        for _ in range(3):  # both lanes past prefill, mid-decode
+            eng.step()
+
+        fetched = []
+        real_np = np
+
+        class _NpSpy:
+            def asarray(self, x, *a, **k):
+                if isinstance(x, jax.Array):
+                    fetched.append(tuple(x.shape))
+                return real_np.asarray(x, *a, **k)
+
+            def __getattr__(self, name):
+                return getattr(real_np, name)
+
+        monkeypatch.setattr(engine_mod, "np", _NpSpy())
+        for _ in range(4):
+            eng.step()
+        monkeypatch.undo()
+        assert fetched, "steady-state steps fetched nothing"
+        assert all(
+            shape == () or shape == (eng.num_slots,) for shape in fetched
+        ), f"steady-state path fetched non-token arrays: {fetched}"
+        # ...and the token vector itself is [S] int32 on device.
+        assert eng._toks.shape == (2,) and eng._toks.dtype == jnp.int32
+        eng.run()
+
+
 class TestServer:
     def test_http_roundtrip(self, params):
         """POST /generate parity + healthz/stats + error codes, one
@@ -263,6 +573,17 @@ class TestServer:
             )
             assert status == 200 and out["status"] == COMPLETE
             assert out["tokens"] == _reference(SPEC, params, [1, 2, 3], 5)
+
+            # seeded sampling through the HTTP surface (top_p wired)
+            status, out = post(
+                {"prompt_tokens": [2, 7], "max_new_tokens": 4,
+                 "temperature": 0.9, "top_p": 0.8, "seed": 5}
+            )
+            assert status == 200
+            assert out["tokens"] == _reference(
+                SPEC, params, [2, 7], 4,
+                temperature=0.9, top_p=0.8, seed=5,
+            )
 
             status, out = post({"prompt_tokens": [1] * 99,
                                 "max_new_tokens": 2})
