@@ -719,6 +719,15 @@ def run_serve_bench(
         "serve bench recompiled after warmup — static-shape invariant "
         f"broken: {compile_counts} -> {engine.compile_counts()}"
     )
+    # The /metricsz exposition must stay scrapeable under a real
+    # traffic mix: render the live engine counters and run the lint
+    # (obs/promtext.py) so a renderer regression fails the bench too,
+    # not just the smoke tier.
+    from ddp_tpu.obs.promtext import render_serve, validate_promtext
+
+    promtext_samples = validate_promtext(
+        render_serve(engine.stats(), up=True)
+    )
     fwd_per_token = lm_train_flops_per_token(
         vocab_size=vocab, total_len=spec.total_len, d_model=d,
         depth=depth, num_heads=heads,
@@ -767,6 +776,7 @@ def run_serve_bench(
         },
         "compile_counts": compile_counts,
         "compile_budget": compile_budget,
+        "promtext_samples": promtext_samples,
         "wall_s": round(wall, 3),
         "d_model": d,
         "depth": depth,

@@ -553,6 +553,8 @@ def make_lm_train_step(
     grad_accum_steps: int = 1,
     label_smoothing: float = 0.0,
     jit: bool = True,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """dp×sp[×fsdp] causal-LM step: ``step(state, tokens)``.
 
@@ -619,11 +621,21 @@ def make_lm_train_step(
             )
             grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
             loss = loss_sum / grad_accum_steps
+        if health_inject is not None:
+            from ddp_tpu.obs.health import inject_nan
+
+            grads = inject_nan(grads, state.step, health_inject)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         params = optax.apply_updates(state.params, updates)
         accuracy = correct / (tokens.shape[0] * (tokens.shape[1] - 1))
+        if health:
+            from ddp_tpu.obs.health import health_stats
+
+            hstats = health_stats(grads, state.params, updates)
+        else:
+            hstats = None
         return (
             state._replace(
                 step=state.step + 1, params=params, opt_state=opt_state
@@ -631,6 +643,7 @@ def make_lm_train_step(
             StepMetrics(
                 loss=loss, accuracy=accuracy,
                 grad_norm=optax.global_norm(grads),
+                health=hstats,
             ),
         )
 

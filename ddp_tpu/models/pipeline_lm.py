@@ -498,6 +498,8 @@ def make_pipe_lm_train_step(
     compute_dtype=jnp.float32,
     donate: bool = True,
     jit: bool = True,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """GPipe (AD-derived backward) train step over dp×pp[×fsdp×tp].
 
@@ -523,7 +525,8 @@ def make_pipe_lm_train_step(
         )
         return _apply_update(
             cfg, optimizer, mesh, state, grads, loss, correct,
-            tokens.shape, lead=1,
+            tokens.shape, lead=1, health=health,
+            health_inject=health_inject,
         )
 
     if not jit:
@@ -538,16 +541,29 @@ def _cast_params(params: PipeLMParams, compute_dtype) -> PipeLMParams:
 
 
 def _apply_update(
-    cfg, optimizer, mesh, state, grads, loss, correct, tok_shape, *, lead
+    cfg, optimizer, mesh, state, grads, loss, correct, tok_shape, *,
+    lead, health=False, health_inject=None,
 ):
     grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
     grads = _constrain_tp(cfg, grads, mesh, lead)
+    if health_inject is not None:
+        from ddp_tpu.obs.health import inject_nan
+
+        grads = inject_nan(grads, state.step, health_inject)
     updates, opt_state = optimizer.update(
         grads, state.opt_state, state.params
     )
     params = _constrain_tp(
         cfg, optax.apply_updates(state.params, updates), mesh, lead
     )
+    if health:
+        # Per-layer-group health vectors (obs/health.py); stage-
+        # stacked leaves reduce under GSPMD like any sharded tree.
+        from ddp_tpu.obs.health import health_stats
+
+        hstats = health_stats(grads, state.params, updates)
+    else:
+        hstats = None
     B, T = tok_shape
     denom = B * (T - 1)
     return (
@@ -555,6 +571,7 @@ def _apply_update(
         StepMetrics(
             loss=loss, accuracy=correct / denom,
             grad_norm=optax.global_norm(grads),
+            health=hstats,
         ),
     )
 
@@ -583,6 +600,8 @@ def _make_handsched_lm_step(
     compute_dtype,
     donate: bool,
     jit: bool = True,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """Shared 1F1B/interleaved step: hand-scheduled backward, loss
     inside the last stage, tied-embed grads summed across both ends."""
@@ -693,7 +712,8 @@ def _make_handsched_lm_step(
         loss = loss_sum / denom
         return _apply_update(
             cfg, optimizer, mesh, state, grads, loss, correct,
-            tokens.shape, lead=lead,
+            tokens.shape, lead=lead, health=health,
+            health_inject=health_inject,
         )
 
     if not jit:
@@ -709,6 +729,8 @@ def make_pipe_lm_1f1b_train_step(
     compute_dtype=jnp.float32,
     donate: bool = True,
     jit: bool = True,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """1F1B: O(S) activation stash, loss inside stage S−1."""
     from ddp_tpu.parallel.one_f1b import schedule_1f1b, spmd_pipeline_1f1b
@@ -718,6 +740,7 @@ def make_pipe_lm_1f1b_train_step(
         cfg, optimizer, mesh, spmd_pipeline_1f1b,
         schedule_1f1b(S, cfg.num_microbatches),
         lead=1, compute_dtype=compute_dtype, donate=donate, jit=jit,
+        health=health, health_inject=health_inject,
     )
 
 
@@ -729,6 +752,8 @@ def make_pipe_lm_interleaved_train_step(
     compute_dtype=jnp.float32,
     donate: bool = True,
     jit: bool = True,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """Interleaved-1F1B: v chunks per device, bubble (S−1)/(vM+S−1)."""
     from ddp_tpu.parallel.interleaved import (
@@ -747,6 +772,7 @@ def make_pipe_lm_interleaved_train_step(
     return _make_handsched_lm_step(
         cfg, optimizer, mesh, spmd_pipeline_interleaved, sched,
         lead=2, compute_dtype=compute_dtype, donate=donate, jit=jit,
+        health=health, health_inject=health_inject,
     )
 
 

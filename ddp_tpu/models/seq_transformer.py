@@ -291,6 +291,8 @@ def make_seq_parallel_train_step(
     compute_dtype=jnp.float32,
     grad_accum_steps: int = 1,
     label_smoothing: float = 0.0,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ):
     """Full dp×sp[×fsdp] train step through the collective forward.
 
@@ -355,11 +357,21 @@ def make_seq_parallel_train_step(
             )
             grads = jax.tree.map(lambda g: g / grad_accum_steps, g_sum)
             loss = loss_sum / grad_accum_steps
+        if health_inject is not None:
+            from ddp_tpu.obs.health import inject_nan
+
+            grads = inject_nan(grads, state.step, health_inject)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
         params = optax.apply_updates(state.params, updates)
         accuracy = correct / x.shape[0]
+        if health:
+            from ddp_tpu.obs.health import health_stats
+
+            hstats = health_stats(grads, state.params, updates)
+        else:
+            hstats = None
         # _replace keeps the caller's state type: SeqTrainState from
         # this module's API, or the trainer's TrainState (which adds a
         # model_state field this model never uses).
@@ -370,6 +382,7 @@ def make_seq_parallel_train_step(
             StepMetrics(
                 loss=loss, accuracy=accuracy,
                 grad_norm=optax.global_norm(grads),
+                health=hstats,
             ),
         )
 

@@ -1,8 +1,8 @@
-"""Observability subsystem: spans, step-time attribution, goodput/MFU.
+"""Observability subsystem: spans, attribution, goodput, run health.
 
-Three zero-dependency layers, all off by default and pinned always-cheap
-when off (tests/test_obs.py: disabled mode triggers no jit compilation
-and no growing per-step allocations):
+Layers, all off by default and pinned always-cheap when off
+(tests/test_obs.py + tests/test_health.py: disabled mode triggers no
+jit compilation and no growing per-step allocations):
 
 - ``tracer``  — in-process span tracer with a bounded ring buffer and
   crash-safe export to Perfetto/Chrome ``trace_event`` JSON;
@@ -11,13 +11,25 @@ and no growing per-step allocations):
   XLA compile-event counter;
 - ``goodput`` — per-model FLOPs estimators (CNN, ResNet, ViT, LM/MoE),
   MFU arithmetic against per-chip peak, and a restart-aware goodput
-  accountant persisted in a sidecar next to the checkpoints.
+  accountant persisted in a sidecar next to the checkpoints;
+- ``health`` — jit-fused per-layer-group gradient stats with NaN/Inf
+  provenance (first offending layer path + step) and the one-step-
+  behind HealthMonitor;
+- ``sentry`` — rolling-window anomaly detectors (loss spike, grad
+  explosion, straggler, recompile storm) with warn/checkpoint/halt
+  actions;
+- ``recorder`` — the flight recorder: a bounded ring of step records
+  dumped crash-safely on exception, SIGTERM, and watchdog kill;
+- ``promtext`` — Prometheus text exposition of the live counters,
+  served at ``/metricsz`` (serve frontend + trainer metrics port),
+  with a matching lint.
 
-Wiring: ``--trace_dir`` on train.py (train/trainer.py), the serve
-engine/server (spans + ``/statusz``), runtime/launch.py (per-rank
-trace files, merged by scripts/trace_merge.py) and bench.py (``mfu``
-and ``trace`` fields per record). docs/OBSERVABILITY.md has the full
-story.
+Wiring: ``--trace_dir`` / ``--health`` / ``--metrics_port`` on
+train.py (train/trainer.py), the serve engine/server (spans +
+``/statusz`` + ``/metricsz``), runtime/launch.py (per-rank trace
+files, merged by scripts/trace_merge.py), bench.py, and
+scripts/health_report.py (JSONL → triage report).
+docs/OBSERVABILITY.md has the full story.
 """
 
 from ddp_tpu.obs.goodput import (
@@ -25,6 +37,21 @@ from ddp_tpu.obs.goodput import (
     peak_flops_per_chip,
     train_flops_per_example,
 )
+from ddp_tpu.obs.health import (
+    HealthMonitor,
+    HealthStats,
+    NonFiniteLossError,
+    group_layout,
+    health_stats,
+)
+from ddp_tpu.obs.promtext import (
+    PromBuilder,
+    render_serve,
+    render_train,
+    validate_promtext,
+)
+from ddp_tpu.obs.recorder import FlightRecorder
+from ddp_tpu.obs.sentry import AnomalySentry, SentryConfig
 from ddp_tpu.obs.steptime import CompileCounter, StepAttributor, StepTiming
 from ddp_tpu.obs.tracer import (
     Tracer,
@@ -34,14 +61,26 @@ from ddp_tpu.obs.tracer import (
 )
 
 __all__ = [
+    "AnomalySentry",
     "CompileCounter",
+    "FlightRecorder",
     "GoodputAccountant",
+    "HealthMonitor",
+    "HealthStats",
+    "NonFiniteLossError",
+    "PromBuilder",
+    "SentryConfig",
     "StepAttributor",
     "StepTiming",
     "Tracer",
     "get_tracer",
+    "group_layout",
+    "health_stats",
     "install_from_env",
     "peak_flops_per_chip",
+    "render_serve",
+    "render_train",
     "train_flops_per_example",
+    "validate_promtext",
     "validate_trace_file",
 ]

@@ -1,0 +1,426 @@
+"""Prometheus text exposition for the live counters and summaries.
+
+Runs are already observable via the metrics JSONL — but a scraper
+should not have to tail and parse a file. This module renders the live
+state (train health, serve TTFT/occupancy/rejects, goodput/MFU) in the
+Prometheus text format (version 0.0.4), served at:
+
+- ``GET /metricsz`` on the serve HTTP frontend (serve/server.py);
+- ``GET /metricsz`` on the trainer's optional metrics port
+  (``--metrics_port``; :class:`MetricsPort` below).
+
+Zero dependencies: the format is lines of ``# HELP`` / ``# TYPE``
+comments and ``name{label="v"} value`` samples. :func:`validate_promtext`
+is the matching lint — metric/label name validity, quote escaping, no
+duplicate samples, TYPE-before-samples — run by the smoke tier against
+both expositions so a renderer regression fails tier-1 fast (the
+trace-schema validator's sibling).
+
+StatSummary snapshots render as Prometheus ``summary`` families:
+``name{quantile="0.5"|"0.95"}``, ``name_sum``, ``name_count`` (plus
+``name_min``/``name_max`` gauges — the snapshot carries exact
+extremes, and dropping them would waste the only exact tail signal a
+reservoir summary has).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)(\s+-?\d+)?$"
+)
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'
+)
+_TYPES = ("counter", "gauge", "summary", "histogram", "untyped")
+
+CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class PromBuilder:
+    """Accumulate samples per metric family, render once.
+
+    Families keep insertion order; samples within a family keep theirs.
+    ``add`` validates names eagerly (a bad series should fail where it
+    was written, not at scrape time) and rejects duplicate
+    (name, labelset) samples — the lint's rules, enforced at build.
+    """
+
+    def __init__(self):
+        # name -> {"type": str, "help": str|None, "samples": [(labels, v)]}
+        self._families: dict[str, dict] = {}
+        self._seen: set = set()
+
+    def add(
+        self,
+        name: str,
+        value,
+        *,
+        labels: Optional[dict] = None,
+        metric_type: str = "gauge",
+        help: Optional[str] = None,
+    ) -> "PromBuilder":
+        if value is None:
+            return self  # absent metric, not zero — same rule as MFU
+        if not _METRIC_NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        if metric_type not in _TYPES:
+            raise ValueError(f"bad metric type {metric_type!r}")
+        labels = dict(labels or {})
+        for k in labels:
+            if not _LABEL_NAME_RE.match(k):
+                raise ValueError(f"bad label name {k!r} on {name}")
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        if key in self._seen:
+            raise ValueError(f"duplicate sample {name} {labels}")
+        self._seen.add(key)
+        fam = self._families.setdefault(
+            name, {"type": metric_type, "help": help, "samples": []}
+        )
+        if fam["type"] != metric_type:
+            raise ValueError(
+                f"{name}: conflicting types {fam['type']} vs {metric_type}"
+            )
+        fam["samples"].append((labels, float(value)))
+        return self
+
+    def summary(
+        self,
+        name: str,
+        snapshot: Optional[dict],
+        *,
+        labels: Optional[dict] = None,
+        help: Optional[str] = None,
+    ) -> "PromBuilder":
+        """A StatSummary ``snapshot()`` → one summary family (+ exact
+        min/max gauges). Empty snapshots render count 0 only."""
+        snap = snapshot or {}
+        count = int(snap.get("count", 0))
+        base = dict(labels or {})
+        self.add(
+            f"{name}_count", count, labels=base,
+            metric_type="counter", help=help,
+        )
+        if count == 0:
+            return self
+        # Prefer the exact running sum; mean×count is the fallback for
+        # foreign snapshots and is NOT monotone under mean rounding.
+        total = (
+            float(snap["sum"])
+            if "sum" in snap
+            else float(snap["mean"]) * count
+        )
+        self.add(f"{name}_sum", total, labels=base, metric_type="counter")
+        for q, field in (("0.5", "p50"), ("0.95", "p95")):
+            if field in snap:
+                self.add(
+                    name, snap[field],
+                    labels={**base, "quantile": q},
+                    metric_type="summary",
+                )
+        for ext in ("min", "max"):
+            if ext in snap:
+                self.add(f"{name}_{ext}", snap[ext], labels=base)
+        return self
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for name, fam in self._families.items():
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['type']}")
+            for labels, value in fam["samples"]:
+                if labels:
+                    body = ",".join(
+                        f'{k}="{_escape(v)}"'
+                        for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{name}{{{body}}} {_fmt_value(value)}")
+                else:
+                    lines.append(f"{name} {_fmt_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def validate_promtext(text: str) -> int:
+    """Lint a text exposition; → sample count, raises ValueError.
+
+    Checks the rules scrapers actually break on: name/label validity,
+    quote escaping (labels must reconstruct exactly), float-parseable
+    values, no duplicate (name, labelset) samples, at most one TYPE
+    per family and declared before its samples, trailing newline.
+    """
+    if not text.endswith("\n"):
+        raise ValueError("exposition must end with a newline")
+    samples = 0
+    seen: set = set()
+    typed: dict[str, str] = {}
+    sampled_names: set[str] = set()
+    for n, line in enumerate(text.split("\n"), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("TYPE", "HELP"):
+                mname = parts[2]
+                if not _METRIC_NAME_RE.match(mname):
+                    raise ValueError(f"line {n}: bad metric name {mname!r}")
+                if parts[1] == "TYPE":
+                    if len(parts) < 4 or parts[3] not in _TYPES:
+                        raise ValueError(f"line {n}: bad TYPE line")
+                    if mname in typed:
+                        raise ValueError(f"line {n}: duplicate TYPE {mname}")
+                    if mname in sampled_names:
+                        raise ValueError(
+                            f"line {n}: TYPE {mname} after its samples"
+                        )
+                    typed[mname] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {n}: unparseable sample {line!r}")
+        name, _, labelbody, value, _ts = m.groups()
+        pairs: tuple = ()
+        if labelbody:
+            found = _LABEL_PAIR_RE.findall(labelbody)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in found)
+            if rebuilt != labelbody.rstrip(","):
+                raise ValueError(f"line {n}: malformed labels {labelbody!r}")
+            names = [k for k, _ in found]
+            if len(set(names)) != len(names):
+                raise ValueError(f"line {n}: repeated label name")
+            pairs = tuple(sorted(found))
+        if value not in ("NaN", "+Inf", "-Inf"):
+            try:
+                float(value)
+            except ValueError:
+                raise ValueError(f"line {n}: bad value {value!r}")
+        # quantile/le label participates in dedup — identical full
+        # labelsets are what scrapers reject.
+        key = (name, pairs)
+        if key in seen:
+            raise ValueError(f"line {n}: duplicate sample {name} {pairs}")
+        seen.add(key)
+        # A summary's name_sum/name_count samples belong to family
+        # `name`; approximate by exact-name tracking (enough to catch
+        # TYPE-after-sample for the family head).
+        sampled_names.add(name)
+        samples += 1
+    return samples
+
+
+# ---- renderers -------------------------------------------------------
+
+
+def render_serve(stats: dict, *, up: Optional[bool] = None) -> str:
+    """ServeEngine.stats() → exposition (the /metricsz payload)."""
+    b = PromBuilder()
+    if up is not None:
+        b.add(
+            "ddp_tpu_serve_up", 1 if up else 0,
+            help="1 while the engine loop is healthy",
+        )
+    b.add("ddp_tpu_serve_slots", stats.get("slots"), help="decode lanes")
+    b.add(
+        "ddp_tpu_serve_active_slots", stats.get("active"),
+        help="lanes bound to a request",
+    )
+    slots = stats.get("slots") or 0
+    if slots:
+        b.add(
+            "ddp_tpu_serve_slot_occupancy",
+            (stats.get("active") or 0) / slots,
+            help="active / slots",
+        )
+    b.add("ddp_tpu_serve_queue_depth", stats.get("queue_depth"))
+    b.add(
+        "ddp_tpu_serve_steps_total", stats.get("steps"),
+        metric_type="counter", help="engine iterations",
+    )
+    for reason, count in sorted((stats.get("rejects") or {}).items()):
+        b.add(
+            "ddp_tpu_serve_rejects_total", count,
+            labels={"reason": reason}, metric_type="counter",
+        )
+    for status, count in sorted(
+        (stats.get("requests_by_status") or {}).items()
+    ):
+        b.add(
+            "ddp_tpu_serve_requests_total", count,
+            labels={"status": status}, metric_type="counter",
+        )
+    b.summary(
+        "ddp_tpu_serve_ttft_seconds", stats.get("ttft_s"),
+        help="submit to first token",
+    )
+    b.summary(
+        "ddp_tpu_serve_decode_tokens_per_second",
+        stats.get("decode_tokens_per_s"),
+    )
+    b.summary(
+        "ddp_tpu_serve_step_latency_seconds", stats.get("step_latency_s")
+    )
+    for prog, count in sorted((stats.get("compile_counts") or {}).items()):
+        b.add(
+            "ddp_tpu_serve_compiled_programs", count,
+            labels={"program": prog},
+            help="jit cache entries (static-shape pin observable)",
+        )
+    gp = stats.get("goodput") or {}
+    b.add("ddp_tpu_serve_productive_seconds_total", gp.get("productive_s"),
+          metric_type="counter")
+    b.add("ddp_tpu_serve_goodput", gp.get("goodput"))
+    return b.render()
+
+
+def render_train(snap: dict) -> str:
+    """Trainer telemetry snapshot → exposition.
+
+    ``snap`` is the trainer's live dict (step/loss/grad_norm/mfu/
+    goodput/recompiles/health events/step-time summary); absent keys
+    render no series — absent and zero are different facts.
+    """
+    b = PromBuilder()
+    b.add("ddp_tpu_train_up", 1, help="trainer process is live")
+    b.add("ddp_tpu_train_step", snap.get("step"), help="global step")
+    b.add("ddp_tpu_train_epoch", snap.get("epoch"))
+    b.add("ddp_tpu_train_loss", snap.get("loss"), help="last logged loss")
+    b.add("ddp_tpu_train_grad_norm", snap.get("grad_norm"))
+    b.add("ddp_tpu_train_learning_rate", snap.get("lr"))
+    b.add("ddp_tpu_train_accuracy", snap.get("accuracy"))
+    b.add("ddp_tpu_train_mfu", snap.get("mfu"), help="model FLOP/s / peak")
+    b.add(
+        "ddp_tpu_train_goodput", snap.get("goodput"),
+        help="productive seconds / wall since first launch",
+    )
+    b.add(
+        "ddp_tpu_train_examples_per_second", snap.get("images_per_sec")
+    )
+    b.add(
+        "ddp_tpu_train_recompiles_total", snap.get("recompiles"),
+        metric_type="counter",
+    )
+    for det, count in sorted((snap.get("health_events") or {}).items()):
+        b.add(
+            "ddp_tpu_train_health_events_total", count,
+            labels={"detector": det}, metric_type="counter",
+            help="anomaly sentry detections",
+        )
+    if snap.get("nonfinite_layer") is not None or (
+        snap.get("nonfinite_step") is not None
+    ):
+        b.add(
+            "ddp_tpu_train_nonfinite", 1,
+            labels={
+                "layer": snap.get("nonfinite_layer") or "unknown",
+                "step": str(snap.get("nonfinite_step")),
+            },
+            help="first non-finite gradient/loss observation",
+        )
+    b.summary("ddp_tpu_train_step_seconds", snap.get("step_time"))
+    return b.render()
+
+
+# ---- the trainer's metrics port --------------------------------------
+
+
+class MetricsPort:
+    """Minimal HTTP endpoint: GET /metricsz → ``text_fn()``.
+
+    ``port=0`` binds ephemeral (tests); ``.port`` is the bound one.
+    One daemon thread; ``stop()`` (or context exit) shuts it down.
+    The handler never lets a renderer exception kill the scrape
+    endpoint — it answers 500 with the error text instead.
+    """
+
+    def __init__(
+        self,
+        text_fn: Callable[[], str],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+            def _send(self, status: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metricsz":
+                    try:
+                        text = outer.text_fn()
+                    except Exception as e:  # noqa: BLE001
+                        self._send(500, f"render failed: {e}\n", "text/plain")
+                        return
+                    self._send(200, text, CONTENT_TYPE)
+                elif self.path == "/healthz":
+                    self._send(200, '{"ok": true}', "application/json")
+                else:
+                    self._send(404, f"no route {self.path}\n", "text/plain")
+
+        self.text_fn = text_fn
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsPort":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="ddp-tpu-metrics-port",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsPort":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

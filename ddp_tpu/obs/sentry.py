@@ -1,0 +1,191 @@
+"""Anomaly sentry: rolling-window detectors over per-step records.
+
+Host-side (no device work), fed by the HealthMonitor's one-step-behind
+ingestion. Four detectors, each against its own rolling baseline so a
+slowly-drifting run never false-positives while a discontinuity fires
+on the step that caused it:
+
+- ``loss_spike``      — loss > mean + k·std of the window AND > 1.5×
+                        the window mean (the second clause keeps a
+                        flat-loss window's zero std from arming a
+                        hair trigger);
+- ``grad_explosion``  — global grad norm > k× the window median;
+- ``straggler``       — host step interval > k× the rolling p50
+                        (utils/metrics.StatSummary carries the
+                        distribution — same machinery as serve TTFT);
+- ``recompile_storm`` — more than N steps in the window paid an XLA
+                        compile after the warmup grace (a shape leak:
+                        steady-state training must compile nothing).
+
+Detectors arm only after ``min_steps`` observations (the baselines
+need mass) and re-emit at most once per ``cooldown`` steps — an
+anomaly is one event, not one event per step until the window forgets.
+
+What to DO about an event is the trainer's decision (``--health_action
+warn | checkpoint | halt``); the sentry only detects and describes.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from dataclasses import dataclass
+
+from ddp_tpu.utils.metrics import StatSummary
+
+ACTIONS = ("warn", "checkpoint", "halt")
+
+
+@dataclass(frozen=True)
+class SentryConfig:
+    window: int = 32  # rolling-baseline length (steps)
+    min_steps: int = 8  # observations before any detector arms
+    loss_spike_sigma: float = 6.0
+    grad_explode_factor: float = 10.0
+    straggler_factor: float = 4.0
+    recompile_limit: int = 3  # tolerated compiling steps per window
+    cooldown: int = 32  # min steps between repeats per detector
+
+    def __post_init__(self):
+        if self.window < 2 or self.min_steps < 2:
+            raise ValueError("sentry window/min_steps must be >= 2")
+
+
+class AnomalySentry:
+    """Stateful detector bank; ``observe`` one step → events list."""
+
+    def __init__(self, config: SentryConfig | None = None):
+        self.cfg = config or SentryConfig()
+        w = self.cfg.window
+        self._losses: deque[float] = deque(maxlen=w)
+        self._gnorms: deque[float] = deque(maxlen=w)
+        self._times = StatSummary(max_samples=max(64, 4 * w))
+        self._compiling_steps: deque[int] = deque(maxlen=w)
+        self._seen = 0
+        self._last_emit: dict[str, int] = {}
+        self.counts: dict[str, int] = {}
+
+    # ---- internals ---------------------------------------------------
+
+    def _emit(self, events: list, detector: str, step: int, **fields):
+        last = self._last_emit.get(detector)
+        if last is not None and step - last < self.cfg.cooldown:
+            return
+        self._last_emit[detector] = step
+        self.counts[detector] = self.counts.get(detector, 0) + 1
+        events.append({"detector": detector, "step": step, **fields})
+
+    @property
+    def _armed(self) -> bool:
+        return self._seen >= self.cfg.min_steps
+
+    # ---- the one entry point ----------------------------------------
+
+    def observe(
+        self,
+        step: int,
+        *,
+        loss: float | None = None,
+        grad_norm: float | None = None,
+        step_time_s: float | None = None,
+        recompiles: int = 0,
+    ) -> list[dict]:
+        """Feed one step's scalars; baselines update AFTER the checks
+        so an anomalous value never dilutes the window it is judged
+        against."""
+        cfg = self.cfg
+        events: list[dict] = []
+
+        # Anomalous values never enter their own baseline (whether the
+        # cooldown let them emit or not): a spike absorbed into the
+        # window would raise the threshold and mask the NEXT spike.
+        # A genuine regime shift then re-fires once per cooldown —
+        # the honest reading of a baseline that no longer holds.
+        if loss is not None and math.isfinite(loss):
+            spiking = False
+            if self._armed and len(self._losses) >= cfg.min_steps:
+                mean = math.fsum(self._losses) / len(self._losses)
+                var = math.fsum(
+                    (v - mean) ** 2 for v in self._losses
+                ) / len(self._losses)
+                std = math.sqrt(var)
+                spiking = (
+                    loss > mean + cfg.loss_spike_sigma * std
+                    and loss > 1.5 * mean + 1e-6
+                )
+                if spiking:
+                    self._emit(
+                        events, "loss_spike", step,
+                        value=round(loss, 6),
+                        baseline=round(mean, 6),
+                    )
+            if not spiking:
+                self._losses.append(loss)
+
+        if grad_norm is not None and math.isfinite(grad_norm):
+            exploding = False
+            if self._armed and len(self._gnorms) >= cfg.min_steps:
+                med = sorted(self._gnorms)[len(self._gnorms) // 2]
+                exploding = (
+                    med > 0 and grad_norm > cfg.grad_explode_factor * med
+                )
+                if exploding:
+                    self._emit(
+                        events, "grad_explosion", step,
+                        value=round(grad_norm, 6),
+                        baseline=round(med, 6),
+                    )
+            if not exploding:
+                self._gnorms.append(grad_norm)
+
+        if step_time_s is not None and step_time_s >= 0:
+            p50 = self._times.percentile(50)
+            straggling = (
+                self._armed
+                and self._times.count >= cfg.min_steps
+                and p50 is not None
+                and p50 > 0
+                and step_time_s > cfg.straggler_factor * p50
+            )
+            if straggling:
+                self._emit(
+                    events, "straggler", step,
+                    value=round(step_time_s, 6),
+                    baseline=round(p50, 6),
+                )
+            else:
+                self._times.add(step_time_s)
+
+        if recompiles > 0:
+            # Record the OBSERVATION index, not the step number: a
+            # resumed run's steps start wherever the checkpoint left
+            # off, so a step-number grace would excuse nothing and the
+            # fresh process's legitimate warmup compiles would read as
+            # a storm (fatal under --health_action halt).
+            self._compiling_steps.append(self._seen)
+        if self._armed:
+            in_window = sum(
+                1
+                for s in self._compiling_steps
+                # Only observations past the warmup grace count: the
+                # first min_steps observations legitimately compile
+                # the program set.
+                if s >= cfg.min_steps and self._seen - s < cfg.window
+            )
+            if in_window > cfg.recompile_limit:
+                self._emit(
+                    events, "recompile_storm", step,
+                    value=in_window,
+                    baseline=cfg.recompile_limit,
+                )
+                self._compiling_steps.clear()
+
+        self._seen += 1
+        return events
+
+    def snapshot(self) -> dict:
+        return {
+            "observed_steps": self._seen,
+            "events": dict(self.counts),
+            "step_time_s": self._times.snapshot(ndigits=6),
+        }

@@ -30,6 +30,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ddp_tpu.obs.health import health_stats, inject_nan
 from ddp_tpu.parallel.common import (
     _preprocess,
     _train_kwarg,
@@ -66,6 +67,10 @@ class StepMetrics(NamedTuple):
     # metrics stream omit the field — a missing norm must not read as
     # a vanished (0.0) gradient.
     grad_norm: jax.Array | float | None = None
+    # Per-layer-group health vectors (obs/health.HealthStats) when the
+    # step was built with ``health=True``; None (an empty pytree — the
+    # disabled graph is byte-identical) otherwise.
+    health: Any = None
 
 
 def create_train_state(
@@ -102,6 +107,8 @@ def make_per_shard_step(
     grad_accum_steps: int = 1,
     augment_fn=None,
     label_smoothing: float = 0.0,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """The per-device SPMD step body (runs inside shard_map).
 
@@ -113,6 +120,12 @@ def make_per_shard_step(
     and applies ONE optimizer update and ONE all-reduce — how large
     effective batches fit in HBM. The reference has no accumulation
     (SURVEY.md §2c: one step per batch, train_ddp.py:196-200).
+
+    ``health=True`` fuses the per-layer-group stats pass
+    (obs/health.py) over grads/params/updates into the step; the
+    vectors land on ``StepMetrics.health``. ``health_inject`` is the
+    NaN fault-injection hook (``(layer_group, step)``). Both default
+    off, and off traces the IDENTICAL graph (Python-level branch).
     """
 
     loss_fn = make_loss_fn(
@@ -149,6 +162,8 @@ def make_per_shard_step(
         # (SURVEY.md §2b N4) is this one line. pmean = psum / world.
         grads = lax.pmean(grads, axes)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        if health_inject is not None:
+            grads = inject_nan(grads, state.step, health_inject)
         # SyncBN-style: average non-gradient stats (BatchNorm running
         # mean/var) across replicas so they stay identical. The torch
         # reference keeps per-rank stats and checkpoints rank 0's;
@@ -162,6 +177,11 @@ def make_per_shard_step(
             loss=lax.pmean(loss, axes),
             accuracy=lax.psum(correct, axes) / (n_labels * world),
             grad_norm=optax.global_norm(grads),
+            # Post-pmean grads (and therefore updates) are replicated,
+            # so the [G] vectors come out identical on every shard.
+            health=health_stats(grads, state.params, updates)
+            if health
+            else None,
         )
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 
@@ -180,6 +200,8 @@ def make_train_step(
     grad_accum_steps: int = 1,
     augment_fn=None,
     label_smoothing: float = 0.0,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """Build the compiled DDP train step for ``mesh``.
 
@@ -199,6 +221,8 @@ def make_train_step(
         grad_accum_steps=grad_accum_steps,
         augment_fn=augment_fn,
         label_smoothing=label_smoothing,
+        health=health,
+        health_inject=health_inject,
     )
     sharded = jax.shard_map(
         per_shard_step,

@@ -39,6 +39,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ddp_tpu.obs.health import health_stats, inject_nan
 from ddp_tpu.parallel.common import (
     _preprocess,
     _train_kwarg,
@@ -256,6 +257,8 @@ def make_spmd_train_step(
     augment_fn=None,
     label_smoothing: float = 0.0,
     zero1: bool = False,
+    health: bool = False,
+    health_inject: tuple[str, int] | None = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
     """``step(state, images, labels) -> (state, metrics)`` under GSPMD.
 
@@ -315,6 +318,8 @@ def make_spmd_train_step(
 
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         grads = constrain_tree(grads, mesh, rules)
+        if health_inject is not None:
+            grads = inject_nan(grads, state.step, health_inject)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         # ZeRO-1: the moment/trace math runs on data-sharded slices;
         # applying the (replicated-constrained) updates below is the
@@ -328,7 +333,15 @@ def make_spmd_train_step(
             optax.apply_updates(state.params, updates), mesh, rules
         )
         metrics = StepMetrics(
-            loss=loss, accuracy=correct, grad_norm=optax.global_norm(grads)
+            loss=loss,
+            accuracy=correct,
+            grad_norm=optax.global_norm(grads),
+            # GSPMD reduces the per-group sums across whatever sharding
+            # the leaves rest in — the [G] outputs are tiny replicated
+            # vectors either way.
+            health=health_stats(grads, state.params, updates)
+            if health
+            else None,
         )
         return TrainState(state.step + 1, params, opt_state, new_ms), metrics
 

@@ -266,6 +266,11 @@ class ServeEngine:
         self.ttft = StatSummary()
         self.decode_rate = StatSummary()
         self.step_latency = StatSummary()
+        # Monotone aggregate counters (the /metricsz exposition needs
+        # totals, not just the JSONL event stream): admission rejects
+        # by reason, finished requests by status.
+        self.reject_counts: dict[str, int] = {}
+        self.status_counts: dict[str, int] = {}
         # The engine's entire compiled surface: ONE decode program
         # (sampling fused) plus per bucket width one FIRST-chunk
         # program (self-contained causal attention — short prompts pay
@@ -318,6 +323,9 @@ class ServeEngine:
             timeout=timeout,
         )
         if not adm.accepted:
+            self.reject_counts[adm.reason] = (
+                self.reject_counts.get(adm.reason, 0) + 1
+            )
             self.metrics.write(
                 "serve_reject",
                 reason=adm.reason,
@@ -406,6 +414,8 @@ class ServeEngine:
             "ttft_s": self.ttft.snapshot(),
             "decode_tokens_per_s": self.decode_rate.snapshot(),
             "step_latency_s": self.step_latency.snapshot(ndigits=6),
+            "rejects": dict(self.reject_counts),
+            "requests_by_status": dict(self.status_counts),
             "compile_counts": self.compile_counts(),
             "prefill": {
                 "chunk": self.prefill_chunk,
@@ -695,6 +705,7 @@ class ServeEngine:
         slot.first_token_at = None
 
     def _record_request(self, c: Completion) -> None:
+        self.status_counts[c.status] = self.status_counts.get(c.status, 0) + 1
         fields = dict(
             rid=c.rid,
             status=c.status,

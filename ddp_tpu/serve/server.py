@@ -21,6 +21,10 @@ every container this repo targets, and the API is three routes:
                     live span-trace tail (``.trace`` is a loadable
                     Perfetto traceEvents document) and the engine's
                     goodput snapshot (ddp_tpu.obs)
+  GET  /metricsz   → 200 Prometheus text exposition of the live
+                    counters/summaries (TTFT, occupancy, rejects,
+                    goodput — obs/promtext.py), so runs are
+                    scrapeable without parsing JSONL
 
 The handler blocks until its request completes (simple request/
 response serving); queue position and slot availability decide
@@ -176,7 +180,8 @@ class LMServer:
             "decode_tokens_per_s": round(done.decode_tokens_per_s, 2),
         }
 
-    def snapshot(self, route: str) -> Optional[dict]:
+    def snapshot(self, route: str) -> Optional[dict | str]:
+        """Route → JSON-ready dict, Prometheus text (str), or None."""
         if route == "/healthz":
             with self._lock:
                 return {
@@ -193,6 +198,15 @@ class LMServer:
         if route == "/stats":
             with self._lock:
                 return self.engine.stats()
+        if route == "/metricsz":
+            # Prometheus text, not JSON: rendered under the engine
+            # lock from the same stats() snapshot /stats serves.
+            from ddp_tpu.obs.promtext import render_serve
+
+            with self._lock:
+                return render_serve(
+                    self.engine.stats(), up=self._engine_error is None
+                )
         if route == "/statusz":
             # Live observability snapshot (ddp_tpu.obs): operational
             # stats + goodput (inside engine.stats()) plus the tail of
@@ -214,18 +228,28 @@ def _make_handler(server: LMServer):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send(self, status: int, payload: dict) -> None:
-            data = json.dumps(payload).encode()
+        def _send_text(self, status: int, text: str, ctype: str) -> None:
+            data = text.encode()
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
+
+        def _send(self, status: int, payload: dict) -> None:
+            self._send_text(
+                status, json.dumps(payload), "application/json"
+            )
 
         def do_GET(self):  # noqa: N802
             payload = server.snapshot(self.path)
             if payload is None:
                 self._send(404, {"error": f"no route {self.path}"})
+            elif isinstance(payload, str):
+                # /metricsz: Prometheus text exposition, not JSON.
+                from ddp_tpu.obs.promtext import CONTENT_TYPE
+
+                self._send_text(200, payload, CONTENT_TYPE)
             else:
                 # A dead engine must fail status-code liveness probes
                 # (`curl -f /healthz`), not just flip a JSON field.

@@ -158,6 +158,33 @@ class TrainConfig:
     # detects, so restart+resume can recover. Set generously above the
     # first-step compile time.
     watchdog_timeout: float = 0.0
+    # Run health (ddp_tpu.obs.health): fuse per-layer-group gradient
+    # stats (norms, max-abs, non-finite counts, update/param ratio)
+    # into the train step, retire them one step behind the dispatch,
+    # attribute the FIRST non-finite gradient to its layer path and
+    # step, and run the anomaly sentry (loss spike / grad explosion /
+    # straggler / recompile storm) over the per-step records. Off by
+    # default; disabled mode is pinned free (tests/test_health.py).
+    health: bool = False
+    # What an anomaly-sentry event does: log loudly ("warn"), save an
+    # overwrite mid-epoch checkpoint and keep going ("checkpoint"), or
+    # raise HealthHaltError after dumping the flight recorder ("halt").
+    health_action: str = "warn"
+    # Sentry rolling-baseline window (steps).
+    health_window: int = 32
+    # Fault injection for drills and tests: poison one layer group's
+    # gradients with NaN at one step, INSIDE the compiled graph —
+    # "layer/group@step", e.g. "block1/attn@3". Requires --health.
+    health_inject_nan: str | None = None
+    # Flight recorder (ddp_tpu.obs.recorder): ring of the last N step
+    # records + config/env/mesh context, dumped crash-safely (per
+    # rank, next to the checkpoints) on exception, SIGTERM, the
+    # non-finite final-loss gate, and watchdog kill. 0 disables.
+    flight_records: int = 256
+    # Serve the live train counters as Prometheus text at
+    # http://127.0.0.1:PORT/metricsz (obs/promtext.py). None = off;
+    # 0 binds an ephemeral port (logged at startup).
+    metrics_port: int | None = None
 
     # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
     # + env:// rendezvous at utils.py:7-11)
@@ -281,6 +308,36 @@ class TrainConfig:
         )
         p.add_argument(
             "--watchdog_timeout", type=float, default=cls.watchdog_timeout
+        )
+        p.add_argument(
+            "--health", action="store_true",
+            help="per-layer gradient health stats + NaN provenance + "
+            "anomaly sentry (ddp_tpu.obs.health; see "
+            "docs/OBSERVABILITY.md)",
+        )
+        p.add_argument(
+            "--health_action", default=cls.health_action,
+            choices=("warn", "checkpoint", "halt"),
+            help="what an anomaly event does: log / overwrite-"
+            "checkpoint and continue / halt with HealthHaltError",
+        )
+        p.add_argument(
+            "--health_window", type=int, default=cls.health_window,
+        )
+        p.add_argument(
+            "--health_inject_nan", default=None, metavar="LAYER@STEP",
+            help="fault injection: NaN one layer group's grads at one "
+            "step (drills/tests; requires --health)",
+        )
+        p.add_argument(
+            "--flight_records", type=int, default=cls.flight_records,
+            help="flight-recorder ring size (last N step records "
+            "dumped on crash/SIGTERM/watchdog kill; 0 = off)",
+        )
+        p.add_argument(
+            "--metrics_port", type=int, default=None,
+            help="serve live train counters as Prometheus text at "
+            "/metricsz on this port (0 = ephemeral)",
         )
         # Discovery: print the registries and exit (handled in train.py
         # before config construction).

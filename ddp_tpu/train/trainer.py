@@ -34,6 +34,15 @@ from ddp_tpu.obs.goodput import (
     peak_flops_per_chip,
     train_flops_per_example,
 )
+from ddp_tpu.obs.health import (
+    HealthHaltError,
+    HealthMonitor,
+    NonFiniteLossError,
+    group_layout,
+    parse_inject,
+)
+from ddp_tpu.obs.recorder import FlightRecorder, snapshot_env
+from ddp_tpu.obs.sentry import AnomalySentry, SentryConfig
 from ddp_tpu.obs.steptime import StepAttributor, dispatch_compute_split
 from ddp_tpu.obs.tracer import Tracer
 from ddp_tpu.parallel.ddp import (
@@ -143,6 +152,49 @@ class Trainer:
         self._attr = StepAttributor(
             enabled=bool(config.trace_dir), tracer=self.tracer
         )
+        # Run health (obs/health.py): the in-graph stats pass rides the
+        # step builders; the monitor/sentry are constructed after the
+        # metrics writer below. Validated here so a bad combination
+        # fails before any device work.
+        self._health_inject = parse_inject(config.health_inject_nan)
+        if self._health_inject is not None and not config.health:
+            raise ValueError("--health_inject_nan requires --health")
+        if config.health and config.fast_epoch:
+            raise ValueError(
+                "--health retires per-step gradient stats, but "
+                "--fast_epoch runs a whole epoch as ONE dispatch with "
+                "no per-step host visibility — drop one of the two"
+            )
+        if config.health and config.model == "pipe_vit":
+            raise ValueError(
+                "--health needs a step that computes gradient stats; "
+                "the pipe_vit step does not (it reports no grad_norm "
+                "either) — use pipe_lm or a non-pipe model"
+            )
+        if (
+            config.health
+            and config.health_action != "warn"
+            and self.ctx.num_processes > 1
+        ):
+            # Straggler/recompile events come from HOST-local signals
+            # (wall-clock deltas, the process compile counter), so one
+            # rank can see an anomaly its peers don't — but ckpt.save
+            # is collective and a one-rank halt leaves peers blocked
+            # in the next step's collective. Cross-host agreement
+            # (the _preempt_agreed pattern) is the upgrade path; until
+            # then only the log-only action is multi-process-safe.
+            raise ValueError(
+                "--health_action checkpoint/halt acts on rank-local "
+                "sentry events but checkpointing is collective — "
+                "multi-process runs must use --health_action warn"
+            )
+        # Keyword bundle for the step builders that support the fused
+        # health pass; {} leaves unsupported builders' graphs untouched.
+        hkw = (
+            dict(health=True, health_inject=self._health_inject)
+            if config.health
+            else {}
+        )
 
         if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
             # Repeat CLI runs skip the first-compile wait (~20-40s on
@@ -151,11 +203,25 @@ class Trainer:
             # disables — including un-setting a cache a previous
             # Trainer in this process enabled (the config is
             # process-global).
+            #
+            # CPU backends leave the DEFAULT cache off: XLA:CPU AOT
+            # deserialization is machine-feature-sensitive (the
+            # tests/conftest.py round-6 finding — cache-loaded
+            # executables SIGSEGV/SIGABRT on mismatched hosts;
+            # reproduced on resumed --health runs, whose larger step
+            # crosses the 1s persistence threshold), and a CPU
+            # compile is seconds, not the 20-40s the cache exists to
+            # save. An explicit --compile_cache_dir (≠ the default)
+            # or the env var still opts in anywhere.
+            cache_dir = config.compile_cache_dir
+            if (
+                cache_dir == TrainConfig.compile_cache_dir
+                and jax.default_backend() == "cpu"
+            ):
+                cache_dir = ""
             jax.config.update(
                 "jax_compilation_cache_dir",
-                os.path.expanduser(config.compile_cache_dir)
-                if config.compile_cache_dir
-                else None,
+                os.path.expanduser(cache_dir) if cache_dir else None,
             )
 
         devices = jax.devices()
@@ -611,6 +677,7 @@ class Trainer:
                     compute_dtype=compute_dtype,
                     grad_accum_steps=config.grad_accum_steps,
                     label_smoothing=config.label_smoothing,
+                    **hkw,
                 )
                 # labels ride the loader but the LM has no use for
                 # them — targets are the shifted tokens.
@@ -634,6 +701,7 @@ class Trainer:
                     compute_dtype=compute_dtype,
                     grad_accum_steps=config.grad_accum_steps,
                     label_smoothing=config.label_smoothing,
+                    **hkw,
                 )
                 self.eval_step = make_seq_parallel_eval_step(
                     self.seq_spec, self.mesh, compute_dtype=compute_dtype,
@@ -751,6 +819,7 @@ class Trainer:
             pipe_step = make_step(
                 self.pipe_cfg, self.optimizer, self.mesh,
                 compute_dtype=compute_dtype,
+                **hkw,
             )
 
             def step(ts, tokens, labels):
@@ -929,6 +998,7 @@ class Trainer:
                 augment_fn=augment_fn,
                 label_smoothing=config.label_smoothing,
                 zero1=config.zero1,
+                **hkw,
             )
             self.eval_step = make_spmd_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
@@ -945,6 +1015,7 @@ class Trainer:
                 grad_accum_steps=config.grad_accum_steps,
                 augment_fn=augment_fn,
                 label_smoothing=config.label_smoothing,
+                **hkw,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
@@ -1084,6 +1155,55 @@ class Trainer:
         )
         # Constructed here, armed in train() (start/stop bracket the run).
         self._watchdog = StepWatchdog(config.watchdog_timeout)
+        # Flight recorder: host-dict ring next to the checkpoints, one
+        # file per rank; the directory is only created on dump (a
+        # Trainer that never trains must not create checkpoint_dir).
+        self._recorder = FlightRecorder(
+            config.checkpoint_dir,
+            rank=self.ctx.process_id,
+            capacity=config.flight_records,
+        )
+        # Anomaly sentry + one-step-behind health monitor. The group-
+        # path layout comes from the SAME group_layout the in-graph
+        # pass uses, so the [G] vectors decode without drift.
+        self._sentry = (
+            AnomalySentry(
+                SentryConfig(
+                    window=config.health_window,
+                    min_steps=max(2, min(8, config.health_window // 2)),
+                    cooldown=config.health_window,
+                )
+            )
+            if config.health
+            else None
+        )
+        self._health = HealthMonitor(
+            enabled=config.health,
+            paths=group_layout(self.state.params)[0]
+            if config.health
+            else (),
+            sentry=self._sentry,
+            metrics=self.metrics_writer,
+            tracer=self.tracer,
+            recorder=self._recorder,
+        )
+        self._last_health_ckpt: int | None = None
+        # Live Prometheus exposition (--metrics_port): one daemon
+        # thread serving /metricsz from the snapshot dict the loop
+        # updates at the log cadence. Stopped in close().
+        self._prom_state: dict[str, Any] = {}
+        self._metrics_port = None
+        if config.metrics_port is not None and self.ctx.is_main:
+            from ddp_tpu.obs.promtext import MetricsPort, render_train
+
+            self._metrics_port = MetricsPort(
+                lambda: render_train(self._prom_snapshot()),
+                port=config.metrics_port,
+            ).start()
+            logger.info(
+                "Prometheus exposition at %s/metricsz",
+                self._metrics_port.url,
+            )
         self._raw_eval_count = 0  # companion raw evals under EMA
         self._preempt_requested = False
         self.history: list[EpochStats] = []
@@ -1209,6 +1329,79 @@ class Trainer:
             fields["mfu"] = round(m, 6)
         return fields
 
+    def _prom_snapshot(self) -> dict:
+        """Live dict for the /metricsz train exposition (promtext)."""
+        snap = dict(self._prom_state)
+        if self._health.enabled:
+            h = self._health.snapshot()
+            snap.setdefault("loss", h.get("loss"))
+            snap.setdefault("grad_norm", h.get("grad_norm"))
+            snap["health_events"] = h.get("events")
+            if "nonfinite_layer" in h or "nonfinite_step" in h:
+                snap["nonfinite_layer"] = h.get("nonfinite_layer")
+                snap["nonfinite_step"] = h.get("nonfinite_step")
+        if self._sentry is not None:
+            snap["step_time"] = self._sentry.snapshot()["step_time_s"]
+        gp = self._goodput.snapshot()
+        if gp:
+            snap["goodput"] = gp.get("goodput")
+        return snap
+
+    def _on_health_events(
+        self, events, *, epoch: int, ran: int
+    ) -> None:
+        """Apply --health_action to a batch of sentry/provenance
+        events. ``ran`` = batches completed within this epoch (the
+        mid-epoch checkpoint position, host-known — no sync)."""
+        for ev in events:
+            logger.warning(
+                "health[%s] at step %s: %s",
+                ev.get("detector"),
+                ev.get("step"),
+                {k: v for k, v in ev.items() if k not in ("detector", "step")},
+            )
+        action = self.config.health_action
+        if action == "halt":
+            dump = self._recorder.dump("health_halt")
+            raise HealthHaltError(list(events), dump_path=dump)
+        if action == "checkpoint":
+            # Never "rescue" a non-finite state: by the time the
+            # provenance event is ingested (one step behind) the
+            # params already took NaN updates — overwrite-saving them
+            # would shadow the last GOOD checkpoint and auto-resume
+            # would restore straight into the divergence. Sentry
+            # anomalies (spike/explosion/straggler/recompiles) are
+            # still-finite states worth pinning; nonfinite is not.
+            rescuable = [
+                e for e in events if e.get("detector") != "nonfinite"
+            ]
+            if not rescuable:
+                return
+            # At most one rescue checkpoint per sentry window: a storm
+            # of events must not turn into a storm of checkpoint I/O.
+            step = int(rescuable[-1].get("step", 0))
+            if (
+                self._last_health_ckpt is not None
+                and step - self._last_health_ckpt
+                < self.config.health_window
+            ):
+                return
+            self._last_health_ckpt = step
+            spe = self.loader.steps_per_epoch()
+            self.ckpt.save(
+                epoch, self.state, overwrite=True, steps_per_epoch=spe,
+                mid_batch=ran if 0 < ran < spe else 0,
+            )
+            # Block until committed: the async save must not still be
+            # writing this epoch tag when the epoch-boundary save (or
+            # a second rescue) touches it — and a rescue checkpoint
+            # that a crash can outrun would be no rescue at all.
+            self.ckpt.wait()
+            logger.warning(
+                "health: checkpoint-and-continue saved epoch %d at "
+                "batch %d (step %d)", epoch, ran, step,
+            )
+
     def _install_preemption_handler(self):
         """SIGTERM → finish the in-flight step, checkpoint, exit clean.
 
@@ -1229,6 +1422,13 @@ class Trainer:
                 "boundary and exit"
             )
             self._preempt_requested = True
+            # Dump NOW, not at the checkpoint boundary: preemption
+            # grace windows are short, and a second SIGKILL-style
+            # reclaim must still find the post-mortem on disk. The
+            # boundary checkpoint then supersedes nothing — the dump
+            # is evidence, not state.
+            self._recorder.record("signal", signal="SIGTERM")
+            self._recorder.dump("sigterm")
 
         try:
             return (True, signal.signal(signal.SIGTERM, _on_term))
@@ -1408,6 +1608,16 @@ class Trainer:
         # first launch's clock and prior productive seconds, so a
         # preempt/resume cycle accumulates instead of resetting.
         self._goodput.start_run()
+        # Flight-recorder context: what a post-mortem needs but no
+        # step record carries — config, env, mesh, rank.
+        self._recorder.set_context(
+            config=dataclasses.asdict(cfg),
+            env=snapshot_env(),
+            mesh={a: int(self.mesh.shape[a]) for a in self.mesh.axis_names},
+            rank=self.ctx.process_id,
+            num_processes=self.ctx.num_processes,
+        )
+        self._recorder.record("run_start", start_epoch=start_epoch)
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit
         # mid_batch marker; resume re-enters that epoch at that batch.
@@ -1450,6 +1660,18 @@ class Trainer:
             jax.profiler.start_trace(cfg.profile_dir)
             profiling = True
         self._watchdog.start()
+        # Watchdog forensics: a hang must leave the same post-mortem
+        # artifacts as a crash. os._exit(124) skips every finally, so
+        # the dump/export run from the abort path itself.
+        wd_forensic = None
+        if self._recorder.enabled or self.tracer.enabled:
+            from ddp_tpu.utils.watchdog import register_forensics
+
+            def wd_forensic():
+                self._recorder.dump("watchdog_timeout")
+                self._export_trace()
+
+            register_forensics(wd_forensic)
         self._preempt_requested = False
         handler_installed, prev_handler = self._install_preemption_handler()
         preempted = False
@@ -1528,6 +1750,9 @@ class Trainer:
                             epoch,
                             *last_eval,
                         )
+                        # Eval accuracy joins the live exposition
+                        # (render_train's ddp_tpu_train_accuracy).
+                        self._prom_state["accuracy"] = last_eval[0]
             finally:
                 if profiling:
                     jax.profiler.stop_trace()
@@ -1544,7 +1769,23 @@ class Trainer:
             # Still inside the watchdog window: a hang in the final
             # eval collective or checkpoint flush must crash, not stall.
             final_acc, final_loss = last_eval or self.evaluate()
+        except BaseException as e:
+            # Post-mortem on ANY exit-by-exception. Errors that
+            # already dumped (HealthHaltError, NonFiniteLossError)
+            # carry their path — don't overwrite their reason.
+            if getattr(e, "dump_path", None) is None:
+                self._recorder.record(
+                    "exception",
+                    type=type(e).__name__,
+                    message=str(e)[:500],
+                )
+                self._recorder.dump(f"exception:{type(e).__name__}")
+            raise
         finally:
+            if wd_forensic is not None:
+                from ddp_tpu.utils.watchdog import unregister_forensics
+
+                unregister_forensics(wd_forensic)
             self._watchdog.stop()
             if handler_installed:
                 import signal
@@ -1559,6 +1800,7 @@ class Trainer:
             self._goodput.flush()
             self._export_trace()
         logger.info("Final test accuracy %.4f (loss %.4f)", final_acc, final_loss)
+        self._prom_state["accuracy"] = final_acc
         gp = self._goodput.snapshot()
         self.metrics_writer.write(
             "final", accuracy=final_acc, loss=final_loss,
@@ -1574,6 +1816,21 @@ class Trainer:
                 else {}
             ),
         )
+        # The end-of-run finiteness gate: a diverged run must FAIL
+        # with its provenance (layer/step when health was on) and the
+        # flight-recorder dump path — not end 0 with a silently
+        # degraded final record. The record above is still written
+        # (loss serializes as null) so the stream shows the death.
+        # The empty-test-split degenerate case (evaluate() returns
+        # nan by construction) is not a divergence.
+        if not np.isfinite(final_loss) and len(self.test_split[0]) > 0:
+            self.metrics_writer.flush()
+            dump = self._recorder.dump("nonfinite_final_loss")
+            raise NonFiniteLossError(
+                float(final_loss),
+                dump_path=dump,
+                first_nonfinite=self._health.first_nonfinite,
+            )
         return {
             "epochs_run": len(self.history),
             "final_accuracy": final_acc,
@@ -1609,6 +1866,10 @@ class Trainer:
 
         logger.info("Starting epoch %d", epoch)  # train_ddp.py:194 parity
         t0 = time.perf_counter()
+        # Host-side step numbering: the k-th dispatch of this epoch
+        # sees step0 + k in-graph. One sync at epoch entry; the loop
+        # itself never reads the device step counter.
+        step0 = int(self.state.step)
         losses = []
         last_metrics = None
         n_batches = 0
@@ -1625,6 +1886,19 @@ class Trainer:
                 self.state, batch.images, batch.labels
             )
             timing = attr.on_step(metrics.loss)
+            host_step = step0 + n_batches  # this dispatch's in-graph step
+            self._recorder.record(
+                "step", epoch=epoch, batch=batch_idx, step=host_step
+            )
+            if self._health.enabled:
+                # Retires the PREVIOUS step's [G] health vectors (one
+                # step behind the dispatch — the only added sync) and
+                # runs the sentry; events apply --health_action.
+                events = self._health.on_step(host_step, metrics)
+                if events:
+                    self._on_health_events(
+                        events, epoch=epoch, ran=batch_idx + 1
+                    )
             last_metrics = metrics
             n_batches += 1
             inflight.append(metrics.loss)
@@ -1657,18 +1931,40 @@ class Trainer:
                     if metrics.grad_norm is None
                     else {"grad_norm": round(float(metrics.grad_norm), 6)}
                 )
+                lr_now = round(
+                    lr_at(self._lr_schedule, max(0, step_now - 1)), 8
+                )
+                obs_fields = self._step_obs_fields(timing)
                 self.metrics_writer.write(
                     "step",
                     epoch=epoch,
                     batch=batch_idx,
                     step=step_now,
                     loss=loss,
-                    lr=round(lr_at(self._lr_schedule, max(0, step_now - 1)), 8),
+                    lr=lr_now,
                     **gn,
-                    **self._step_obs_fields(timing),
+                    **obs_fields,
                 )
+                self._recorder.record(
+                    "log", step=step_now, epoch=epoch, batch=batch_idx,
+                    loss=loss, **gn,
+                )
+                # Live exposition state (--metrics_port /metricsz).
+                self._prom_state.update(
+                    step=step_now, epoch=epoch, loss=loss, lr=lr_now,
+                    **gn,
+                )
+                if "mfu" in obs_fields:
+                    self._prom_state["mfu"] = obs_fields["mfu"]
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
+        # The monitor still owes the LAST step's ingestion (it runs
+        # one behind); provenance for a final-step NaN lands here.
+        tail_events = self._health.drain()
+        if tail_events:
+            self._on_health_events(
+                tail_events, epoch=epoch, ran=n_batches + skip_batches
+            )
         seconds = time.perf_counter() - t0
         return self._finish_epoch(epoch, losses, n_batches, seconds)
 
@@ -1726,6 +2022,12 @@ class Trainer:
         gp = self._goodput.snapshot()
         if gp:
             extra["goodput"] = gp["goodput"]
+        if self._health.enabled:
+            # Cumulative sentry/provenance event count: a triage pass
+            # over epoch records sees WHERE anomalies clustered.
+            extra["health_events"] = int(
+                sum(self._health.events_total.values())
+            )
         self.metrics_writer.write(
             "epoch",
             epoch=epoch,
@@ -1735,6 +2037,18 @@ class Trainer:
             mean_loss=stats.mean_loss,
             **extra,
         )
+        self._recorder.record(
+            "epoch", epoch=epoch, batches=n_batches,
+            seconds=round(seconds, 3), mean_loss=stats.mean_loss,
+        )
+        self._prom_state["epoch"] = epoch
+        self._prom_state["images_per_sec"] = round(stats.images_per_sec, 1)
+        if epoch_mfu is not None:
+            self._prom_state["mfu"] = round(epoch_mfu, 6)
+        if totals.steps:
+            self._prom_state["recompiles"] = (
+                self._prom_state.get("recompiles", 0) + totals.recompiles
+            )
         return stats
 
     def _train_epoch_fast(self, epoch: int) -> EpochStats:
@@ -1884,3 +2198,6 @@ class Trainer:
         self.loader.close()
         self.ckpt.close()
         self.metrics_writer.close()
+        if self._metrics_port is not None:
+            self._metrics_port.stop()
+            self._metrics_port = None

@@ -76,7 +76,14 @@ class StatSummary:
         return s[rank]
 
     def snapshot(self, *, ndigits: int = 4) -> dict:
-        """One JSON-ready dict: {count, mean, min, p50, p95, max}."""
+        """One JSON-ready dict: {count, mean, sum, min, p50, p95, max}.
+
+        ``sum`` is the EXACT running total (rounded for display, which
+        preserves monotonicity) — the Prometheus summary exposition's
+        ``_sum`` counter must come from it, not from ``mean × count``:
+        a counter reconstructed from the rounded mean can DECREASE
+        between scrapes, which scrapers read as a reset.
+        """
         if not self._count:
             return {"count": 0}
         s = sorted(self._samples)
@@ -84,6 +91,7 @@ class StatSummary:
         return {
             "count": self._count,
             "mean": r(self._sum / self._count),
+            "sum": r(self._sum),
             "min": r(self._min),
             "p50": r(self._percentile_sorted(s, 50)),
             "p95": r(self._percentile_sorted(s, 95)),

@@ -43,6 +43,39 @@ def dump_all_stacks(file=None) -> None:
         pass
 
 
+# Forensics callbacks run before the abort: the trainer registers the
+# flight-recorder dump and live-trace export here so a HANG leaves the
+# same post-mortem artifacts as a crash (os._exit skips atexit and
+# every finally — this is their only chance to run). Each callback is
+# exception-isolated: evidence collection must never block the abort.
+_FORENSICS: list[Callable[[], None]] = []
+
+
+def register_forensics(fn: Callable[[], None]) -> Callable[[], None]:
+    """Add a pre-abort evidence collector; returns ``fn`` (so callers
+    can keep the handle for :func:`unregister_forensics`)."""
+    _FORENSICS.append(fn)
+    return fn
+
+
+def unregister_forensics(fn: Callable[[], None]) -> None:
+    """Remove a collector; missing entries are ignored (a finished
+    Trainer must be able to clean up unconditionally)."""
+    try:
+        _FORENSICS.remove(fn)
+    except ValueError:
+        pass
+
+
+def run_forensics() -> None:
+    """Run every registered collector, isolating failures."""
+    for fn in list(_FORENSICS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — diagnostics must not block abort
+            pass
+
+
 def _default_abort(seconds: float) -> None:
     logger.error(
         "watchdog: no training progress for %.0fs — aborting so the "
@@ -51,8 +84,10 @@ def _default_abort(seconds: float) -> None:
         seconds,
     )
     # The one chance to say WHERE it hung: os._exit skips atexit and
-    # every finally, so the stack dump must happen first — the logs
-    # are all a post-mortem of a reclaimed VM gets to keep.
+    # every finally, so forensics (flight recorder, trace export) and
+    # the stack dump must happen first — the logs and these files are
+    # all a post-mortem of a reclaimed VM gets to keep.
+    run_forensics()
     dump_all_stacks()
     # sys.exit only raises in this thread; a hung main thread never
     # sees it. _exit is the point: make the process observably dead.

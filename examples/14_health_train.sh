@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Run health end-to-end (docs/OBSERVABILITY.md §Run health): train
+# with --health to get per-layer gradient stats, the anomaly sentry,
+# and a flight recorder; scrape the live Prometheus exposition; then
+# inject a NaN into one layer IN-GRAPH to watch provenance name the
+# layer and step (and the end-of-run gate fail structured, leaving a
+# readable flight-recorder dump). Finishes with the one-screen triage
+# report over the metrics JSONL.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example14}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. Healthy run with health stats on and the Prometheus port bound.
+python train.py --epochs 1 --batch_size 8 \
+    --emulate_devices 8 --synthetic_data --synthetic_size 1024 \
+    --checkpoint_dir "$WORK/checkpoints" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --health --metrics_port 9109 \
+    --log_interval 8 --eval_every 0 &
+TRAIN_PID=$!
+# Scrape the live exposition once the port is up (the trainer binds
+# it at construction; poll past the JAX startup). Ignore failure if
+# the short run already finished.
+for _ in $(seq 1 60); do
+    if curl -sf http://127.0.0.1:9109/metricsz > "$WORK/scrape.txt"; then
+        head -12 "$WORK/scrape.txt"
+        break
+    fi
+    sleep 0.5
+done
+wait "$TRAIN_PID"
+
+# 2. Fault-injection drill: poison block `conv2/kernel`'s gradients
+#    at step 3. The health record names that layer and step, and the
+#    run ends in NonFiniteLossError with a flight-recorder dump —
+#    exit code nonzero is the EXPECTED outcome here.
+python train.py --epochs 1 --batch_size 8 \
+    --emulate_devices 8 --synthetic_data --synthetic_size 1024 \
+    --checkpoint_dir "$WORK/ck_drill" --data_root "$WORK/data" \
+    --metrics_file "$WORK/drill.jsonl" \
+    --health --health_inject_nan conv2/kernel@3 \
+    --log_interval 2 --eval_every 0 \
+    && echo "UNEXPECTED: drill run did not fail" && exit 1 \
+    || echo "drill failed as intended"
+
+# Provenance in the metrics stream:
+grep '"kind": "health"' "$WORK/drill.jsonl"
+# Post-mortem on disk (reason, config, env, last step records):
+python - <<PY
+import json
+d = json.load(open("$WORK/ck_drill/flight_rank0.json"))
+print("flight dump:", d["reason"], "-", len(d["records"]), "records")
+PY
+
+# 3. One-screen triage over either stream.
+python scripts/health_report.py "$WORK/drill.jsonl"

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""One-screen triage report from a metrics JSONL stream.
+
+    python scripts/health_report.py metrics.jsonl
+
+Summarizes what the first five minutes of an incident actually need:
+step-time p50/p99 and the input-wait fraction (is it the data
+pipeline?), MFU and goodput (is the chip earning its keep?), the
+grad-norm trajectory (was it diverging before it died?), anomaly
+sentry events, NaN provenance, and recompile counts (shape leak?).
+Reads only the JSONL the trainer always writes — works on a live
+file mid-run, a dead run's tail, or a finished run.
+
+Output is deterministic for a given input (golden-pinned in
+tests/test_health.py), so it is also greppable from cron/CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_tpu.utils.metrics import StatSummary  # noqa: E402
+
+
+def _fmt(v, nd: int = 4) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def load_records(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                # A live (or killed) run's last line can be partial;
+                # the report must still answer from the rest.
+                continue
+    return records
+
+
+def build_report(records: list[dict]) -> str:
+    steps = [r for r in records if r.get("kind") == "step"]
+    epochs = [r for r in records if r.get("kind") == "epoch"]
+    health = [r for r in records if r.get("kind") == "health"]
+    finals = [r for r in records if r.get("kind") == "final"]
+
+    lines = ["ddp_tpu run health report", "=" * 25]
+
+    losses = [r["loss"] for r in steps if r.get("loss") is not None]
+    lines.append(
+        f"steps logged  : {len(steps)} (epochs {len(epochs)})"
+    )
+    if losses:
+        lines.append(
+            f"loss          : first {_fmt(losses[0])} -> last "
+            f"{_fmt(losses[-1])} (min {_fmt(min(losses))})"
+        )
+        nulls = sum(1 for r in steps if r.get("loss") is None)
+        if nulls:
+            lines.append(
+                f"                {nulls} step record(s) with "
+                "non-finite loss (serialized null)"
+            )
+    gnorms = [
+        (r.get("step"), r["grad_norm"])
+        for r in steps
+        if r.get("grad_norm") is not None
+    ]
+    if gnorms:
+        peak_step, peak = max(gnorms, key=lambda t: t[1])
+        lines.append(
+            f"grad norm     : first {_fmt(gnorms[0][1])} -> last "
+            f"{_fmt(gnorms[-1][1])} (max {_fmt(peak)} @ step {peak_step})"
+        )
+
+    # Step wall time from the attribution fields (--trace_dir runs);
+    # falls back to per-epoch seconds/batches when untraced.
+    times = StatSummary()
+    wait = StatSummary()
+    for r in steps:
+        if "compute_s" in r:
+            wall = (
+                r.get("input_wait_s", 0.0)
+                + r.get("dispatch_s", 0.0)
+                + r["compute_s"]
+            )
+            times.add(wall)
+            if wall > 0:
+                wait.add(r.get("input_wait_s", 0.0) / wall)
+    if times.count:
+        frac = wait.snapshot().get("mean")
+        lines.append(
+            f"step time     : p50 {_fmt(times.percentile(50), 4)}s  "
+            f"p99 {_fmt(times.percentile(99), 4)}s  "
+            f"(input-wait {_fmt(100.0 * frac, 1)}%)"
+        )
+    elif epochs:
+        per = [
+            e["seconds"] / e["batches"]
+            for e in epochs
+            if e.get("batches")
+        ]
+        if per:
+            lines.append(
+                f"step time     : ~{_fmt(sum(per) / len(per), 4)}s "
+                "mean (epoch-level; re-run with --trace_dir for "
+                "per-step attribution)"
+            )
+
+    mfus = [e["mfu"] for e in epochs if e.get("mfu") is not None]
+    if mfus:
+        lines.append(f"mfu           : last {_fmt(mfus[-1], 6)}")
+    # Prefer the final record's full goodput snapshot (it carries the
+    # restart count); fall back to the latest epoch fraction mid-run.
+    final_gp = finals[-1].get("goodput") if finals else None
+    if isinstance(final_gp, dict):
+        lines.append(
+            f"goodput       : {_fmt(final_gp.get('goodput'), 6)} "
+            f"({_fmt(final_gp.get('restarts'))} restart(s))"
+        )
+    else:
+        epoch_gps = [
+            e["goodput"] for e in epochs if e.get("goodput") is not None
+        ]
+        if epoch_gps:
+            lines.append(f"goodput       : {_fmt(epoch_gps[-1], 6)}")
+
+    recompiles = sum(e.get("recompiles", 0) for e in epochs)
+    if any("recompiles" in e for e in epochs):
+        lines.append(f"recompiles    : {recompiles}")
+
+    sentry = [h for h in health if h.get("detector") != "nonfinite"]
+    if sentry:
+        by_det: dict[str, int] = {}
+        for h in sentry:
+            d = h.get("detector", "?")
+            by_det[d] = by_det.get(d, 0) + 1
+        detail = ", ".join(f"{k}: {v}" for k, v in sorted(by_det.items()))
+        lines.append(f"anomalies     : {len(sentry)} ({detail})")
+    else:
+        lines.append("anomalies     : none")
+
+    nonfinite = [h for h in health if h.get("detector") == "nonfinite"]
+    if nonfinite:
+        first = nonfinite[0]
+        layer = first.get("layer") or "<loss only>"
+        lines.append(
+            f"nonfinite     : layer {layer} at step {first.get('step')}"
+        )
+    else:
+        lines.append("nonfinite     : none")
+
+    if finals:
+        f = finals[-1]
+        tail = (
+            f"accuracy {_fmt(f.get('accuracy'))}  "
+            f"loss {_fmt(f.get('loss'))}"
+        )
+        if f.get("perplexity") is not None:
+            tail += f"  perplexity {_fmt(f.get('perplexity'))}"
+        lines.append(f"final         : {tail}")
+    else:
+        lines.append("final         : (run not finished)")
+
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("metrics_file", help="JSONL stream from --metrics_file")
+    args = p.parse_args()
+    records = load_records(args.metrics_file)
+    if not records:
+        raise SystemExit(f"{args.metrics_file}: no readable records")
+    sys.stdout.write(build_report(records))
+
+
+if __name__ == "__main__":
+    main()

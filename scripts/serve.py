@@ -160,6 +160,9 @@ def main() -> None:
                 json.dumps(
                     {
                         "serving": server.url,
+                        # Scrape target: Prometheus text exposition of
+                        # the live engine counters (obs/promtext.py).
+                        "metricsz": server.url + "/metricsz",
                         "epoch": epoch,
                         "slots": engine.num_slots,
                         "prefill_len": engine.prefill_len,

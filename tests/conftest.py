@@ -135,6 +135,10 @@ _SMOKE_PATTERNS = (
     "test_obs.py::test_compileall_package_and_scripts",
     "test_obs.py::test_trace_schema_valid",
     "test_obs.py::test_disabled_tracer_is_pinned_free",
+    # run health: health-off-is-free pin + the Prometheus-text
+    # exposition lint (the trace-schema validator's siblings)
+    "test_health.py::test_disabled_health_is_pinned_free",
+    "test_promtext.py::test_builder_render_and_validate",
     "test_optim_extras.py::TestParamEma::test_recurrence_exact",
     # one real trainer e2e (the priciest smoke entry, ~1 min compile)
     "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",

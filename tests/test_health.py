@@ -1,0 +1,513 @@
+"""Run health (ddp_tpu.obs.health/sentry): per-layer gradient stats,
+NaN provenance, the anomaly sentry, and the trainer wiring.
+
+Acceptance pins:
+
+1. **Provenance is exact** — an injected non-finite gradient is
+   attributed to the correct layer-group path and step, on both the
+   SPMD-family and pipeline trainers.
+2. **Disabled is free** — health off adds no compile events and no
+   growing per-step allocations (the tracer's pin, applied here), and
+   the step metrics schema only widens under ``--health``.
+3. **Detectors detect** — loss spike / grad explosion / straggler /
+   recompile storm fire on discontinuities, not on drift, and honor
+   the cooldown.
+4. **The end-of-run gate raises** — a diverged run ends in a
+   structured NonFiniteLossError carrying the flight-recorder dump
+   path, never a silently-degraded final record.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddp_tpu.obs.health import (
+    HealthHaltError,
+    HealthMonitor,
+    NonFiniteLossError,
+    group_layout,
+    health_stats,
+    inject_nan,
+    parse_inject,
+)
+from ddp_tpu.obs.sentry import AnomalySentry, SentryConfig
+from ddp_tpu.obs.steptime import CompileCounter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- in-graph stats --------------------------------------------------
+
+
+def test_group_layout_and_stats_values():
+    """[G] vectors match numpy reductions, group order is sorted and
+    identical between the traced pass and the host decoder."""
+    import jax
+    import jax.numpy as jnp
+
+    grads = {
+        "block1": {
+            "attn": {"qkv": {"kernel": jnp.ones((4, 4))}},
+            "mlp": {"kernel": jnp.full((4, 4), 2.0)},
+        },
+        "embed": jnp.full((8, 4), 0.5),
+    }
+    params = jax.tree.map(lambda x: x * 3.0, grads)
+    updates = jax.tree.map(lambda x: -0.1 * x, grads)
+    paths, gidx = group_layout(grads)
+    assert paths == ("block1/attn", "block1/mlp", "embed")
+    hs = jax.jit(health_stats)(grads, params, updates)
+    np.testing.assert_allclose(
+        np.asarray(hs.grad_norm),
+        [4.0, math.sqrt(16 * 4.0), math.sqrt(32 * 0.25)],
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(np.asarray(hs.grad_maxabs), [1.0, 2.0, 0.5])
+    assert np.asarray(hs.grad_nonfinite).tolist() == [0, 0, 0]
+    # updates are -0.1×params/3 → ratio == 0.1/3 for every group
+    np.testing.assert_allclose(
+        np.asarray(hs.update_ratio), [0.1 / 3] * 3, rtol=1e-5
+    )
+
+
+def test_inject_nan_gates_on_step_and_group():
+    import jax
+    import jax.numpy as jnp
+
+    grads = {"a": {"w": jnp.ones((3,))}, "b": {"w": jnp.ones((3,))}}
+    spec = parse_inject("a/w@2")
+    poisoned = jax.jit(lambda g, s: inject_nan(g, s, spec))
+    clean = poisoned(grads, jnp.int32(1))
+    assert all(
+        bool(jnp.isfinite(l).all()) for l in jax.tree.leaves(clean)
+    )
+    hit = poisoned(grads, jnp.int32(2))
+    assert not bool(jnp.isfinite(hit["a"]["w"]).any())
+    assert bool(jnp.isfinite(hit["b"]["w"]).all())
+    # unknown group fails at TRACE time, naming the valid ones
+    with pytest.raises(ValueError, match="a/w"):
+        inject_nan(grads, jnp.int32(0), ("nope/xyz", 1))
+    with pytest.raises(ValueError, match="layer/group@step"):
+        parse_inject("missing-separator")
+    assert parse_inject(None) is None
+
+
+# ---- disabled is free ------------------------------------------------
+
+
+def test_disabled_health_is_pinned_free():
+    """Health off: the monitor returns ONE cached empty tuple, no
+    compile listener installed by construction, zero compile events
+    and constant memory across a hot loop (the tracer pin's sibling,
+    run in the smoke tier)."""
+    from ddp_tpu.parallel.ddp import StepMetrics
+
+    assert StepMetrics(loss=0.0, accuracy=0.0).health is None
+    mon = HealthMonitor(enabled=False)
+    m = StepMetrics(loss=0.0, accuracy=0.0)
+    assert mon.on_step(0, m) is mon.on_step(1, m)  # same object
+    assert mon.drain() == ()
+    CompileCounter.install()
+    before = CompileCounter.count()
+    import tracemalloc
+
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    for i in range(20_000):
+        mon.on_step(i, m)
+    growth = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    assert CompileCounter.count() == before
+    assert growth < 64 * 1024, f"disabled health leaked {growth} bytes"
+    assert mon.first_nonfinite is None and mon.events_total == {}
+
+
+# ---- sentry ----------------------------------------------------------
+
+
+def _sentry(**kw):
+    base = dict(window=16, min_steps=4, cooldown=8)
+    base.update(kw)
+    return AnomalySentry(SentryConfig(**base))
+
+
+def test_sentry_loss_spike_and_cooldown():
+    s = _sentry()
+    for i in range(8):
+        assert s.observe(i, loss=1.0 + 0.01 * (i % 2)) == []
+    ev = s.observe(8, loss=50.0)
+    assert [e["detector"] for e in ev] == ["loss_spike"]
+    assert ev[0]["step"] == 8
+    # within cooldown: suppressed; after: fires again
+    assert s.observe(9, loss=50.0) == []
+    for i in range(10, 17):
+        s.observe(i, loss=1.0)
+    assert [e["detector"] for e in s.observe(17, loss=60.0)] == [
+        "loss_spike"
+    ]
+    assert s.counts["loss_spike"] == 2
+
+
+def test_sentry_slow_drift_does_not_fire():
+    s = _sentry()
+    loss = 5.0
+    for i in range(200):
+        assert s.observe(i, loss=loss) == []
+        loss *= 0.98  # healthy convergence, 2%/step
+
+
+def test_sentry_grad_explosion_and_straggler():
+    s = _sentry()
+    for i in range(6):
+        assert s.observe(i, grad_norm=2.0, step_time_s=0.1) == []
+    ev = s.observe(6, grad_norm=200.0, step_time_s=0.1)
+    assert [e["detector"] for e in ev] == ["grad_explosion"]
+    ev = s.observe(7, grad_norm=2.0, step_time_s=3.0)
+    assert [e["detector"] for e in ev] == ["straggler"]
+    assert ev[0]["value"] == 3.0
+
+
+def test_sentry_recompile_storm():
+    s = _sentry(recompile_limit=2)
+    # warmup compiles (first min_steps observations) are grace —
+    # never an event
+    for i in range(6):
+        assert s.observe(i, recompiles=1 if i < 3 else 0) == []
+    # steady state: a storm of compiling steps past the limit fires
+    assert s.observe(6, recompiles=2) == []
+    assert s.observe(7, recompiles=1) == []
+    ev = s.observe(8, recompiles=1)
+    assert [e["detector"] for e in ev] == ["recompile_storm"]
+
+
+def test_sentry_recompile_grace_is_observation_based():
+    """A RESUMED run's steps start at the checkpoint's counter, not 0;
+    the warmup grace must key off observations, or the fresh
+    process's legitimate first compiles read as a storm."""
+    s = _sentry(recompile_limit=2)
+    # same shape as above but step numbers offset as after a resume
+    for i in range(6):
+        assert s.observe(5000 + i, recompiles=1 if i < 3 else 0) == []
+    assert s.observe(5006, recompiles=2) == []
+    assert s.observe(5007, recompiles=1) == []
+    ev = s.observe(5008, recompiles=1)
+    assert [e["detector"] for e in ev] == ["recompile_storm"]
+
+
+# ---- monitor ---------------------------------------------------------
+
+
+class _FakeMetrics:
+    def __init__(self, loss, health=None):
+        self.loss = np.float32(loss)
+        self.health = health
+
+
+def test_monitor_retires_one_step_behind():
+    mon = HealthMonitor(enabled=True, paths=("a", "b"))
+    assert not mon.on_step(0, _FakeMetrics(1.0))  # nothing pending yet
+    assert not mon.on_step(1, _FakeMetrics(2.0))  # step 0 was finite
+    assert mon.last_loss == 1.0  # ...and exactly one step behind
+    ev = mon.on_step(2, _FakeMetrics(float("nan")))
+    assert not ev and mon.last_loss == 2.0
+    ev = mon.drain()  # ingests step 2
+    assert ev[0]["detector"] == "nonfinite" and ev[0]["step"] == 2
+    assert ev[0]["layer"] is None  # loss-only observation
+    assert mon.first_nonfinite == (None, 2)
+
+
+# ---- trainer integration --------------------------------------------
+
+
+def _config(tmp_path, **kw):
+    from ddp_tpu.train.config import TrainConfig
+
+    defaults = dict(
+        epochs=1,
+        batch_size=4,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=256,  # 8 steps at 4×8
+        log_interval=2,
+        eval_every=0,
+        metrics_file=str(tmp_path / "metrics.jsonl"),
+        health=True,
+    )
+    defaults.update(kw)
+    return TrainConfig(**defaults)
+
+
+def _records(tmp_path):
+    lines = (tmp_path / "metrics.jsonl").read_text().splitlines()
+    return [json.loads(l) for l in lines]
+
+
+def test_nan_provenance_spmd_trainer(tmp_path):
+    """Acceptance pin (SPMD family): inject into one layer at a known
+    step on an fsdp mesh; halt names that layer and step."""
+    from ddp_tpu.train.trainer import Trainer
+
+    t = Trainer(
+        _config(
+            tmp_path,
+            mesh_fsdp=2,
+            health_inject_nan="conv2/kernel@3",
+            health_action="halt",
+        )
+    )
+    assert t.use_spmd  # the GSPMD step, not plain DDP
+    with pytest.raises(HealthHaltError) as e:
+        t.train()
+    t.close()
+    assert e.value.events[0]["layer"] == "conv2/kernel"
+    assert e.value.events[0]["step"] == 3
+    assert e.value.dump_path and os.path.exists(e.value.dump_path)
+    rec = next(r for r in _records(tmp_path) if r["kind"] == "health")
+    assert rec["detector"] == "nonfinite"
+    assert rec["layer"] == "conv2/kernel" and rec["step"] == 3
+
+
+def test_nan_provenance_pipe_trainer(tmp_path):
+    """Acceptance pin (pipeline family): same contract through the
+    pipelined LM's stage-stacked gradient tree."""
+    from ddp_tpu.train.trainer import Trainer
+
+    t = Trainer(
+        _config(
+            tmp_path,
+            model="pipe_lm",
+            mesh_pipe=2,
+            num_microbatches=4,
+            model_dim=32,
+            model_depth=1,
+            seq_len=64,
+            vocab_size=64,
+            synthetic_size=64,
+            health_inject_nan="stages/block1@2",
+            health_action="halt",
+        )
+    )
+    with pytest.raises(HealthHaltError) as e:
+        t.train()
+    t.close()
+    assert e.value.events[0]["layer"] == "stages/block1"
+    assert e.value.events[0]["step"] == 2
+
+
+def test_nonfinite_final_loss_raises_structured(tmp_path):
+    """Satellite pin: action=warn lets the poisoned run reach the end;
+    the finiteness gate raises NonFiniteLossError carrying provenance
+    and the dump path — after writing the final record (loss null)."""
+    from ddp_tpu.obs.recorder import load_dump
+    from ddp_tpu.train.trainer import Trainer
+
+    t = Trainer(
+        _config(tmp_path, health_inject_nan="conv1/kernel@2")
+    )
+    with pytest.raises(NonFiniteLossError) as e:
+        t.train()
+    t.close()
+    assert e.value.first_nonfinite == ("conv1/kernel", 2)
+    dump = load_dump(e.value.dump_path)
+    assert dump["reason"] == "nonfinite_final_loss"
+    kinds = {r["kind"] for r in dump["records"]}
+    assert {"step", "log", "health"} <= kinds
+    final = next(r for r in _records(tmp_path) if r["kind"] == "final")
+    assert final["loss"] is None  # null, never a bare NaN
+    # epoch record counts the event
+    epoch = next(r for r in _records(tmp_path) if r["kind"] == "epoch")
+    assert epoch["health_events"] >= 1
+
+
+def test_health_checkpoint_action_saves_and_continues(tmp_path):
+    """checkpoint-and-continue: a sentry anomaly saves an overwrite
+    mid-epoch rescue checkpoint and training proceeds — but a
+    ``nonfinite`` event must NOT rescue (the params already took NaN
+    updates by ingestion time; overwriting the last good checkpoint
+    with a poisoned state would make auto-resume resume into the
+    divergence)."""
+    from ddp_tpu.train.trainer import Trainer
+
+    # Unit-level pin, in its own checkpoint dir (a rescue save here
+    # must not become a mid-epoch resume point for the e2e below):
+    # nonfinite events never checkpoint; sentry events do, recording
+    # the mid-epoch position.
+    unit = Trainer(
+        _config(
+            tmp_path,
+            checkpoint_dir=str(tmp_path / "ck_unit"),
+            health_action="checkpoint",
+        )
+    )
+    unit._on_health_events(
+        [{"detector": "nonfinite", "step": 2, "layer": "x"}],
+        epoch=0, ran=2,
+    )
+    assert unit.ckpt.latest_epoch() is None
+    unit._on_health_events(
+        [{"detector": "grad_explosion", "step": 3, "value": 9.0}],
+        epoch=0, ran=3,
+    )
+    assert unit.ckpt.latest_epoch() == 0
+    assert int(
+        unit.ckpt.read_partial(0, ("mid_batch",)).get("mid_batch", 0)
+    ) == 3
+    unit.close()
+    # End-to-end: the injected NaN run continues under this action all
+    # the way to the structured end-of-run gate (no rescue save, so
+    # the run is NOT shortened by a poisoned resume point).
+    t = Trainer(
+        _config(
+            tmp_path,
+            health_inject_nan="conv1/kernel@4",
+            health_action="checkpoint",
+        )
+    )
+    with pytest.raises(NonFiniteLossError):
+        t.train()
+    t.close()
+    steps = [r for r in _records(tmp_path) if r["kind"] == "step"]
+    assert len(steps) == 4  # all 8 batches ran (logged every 2nd)
+
+
+def test_monitor_drain_resets_interval_clock():
+    """Epoch boundaries (eval + checkpoint + bookkeeping) must never
+    reach the straggler detector as a step time: drain() resets the
+    interval clock, so the next epoch's first step has no dt."""
+    seen = []
+
+    class SpySentry:
+        def observe(self, step, **kw):
+            seen.append((step, kw["step_time_s"]))
+            return []
+
+    mon = HealthMonitor(enabled=True, sentry=SpySentry())
+    mon.on_step(0, _FakeMetrics(1.0))
+    mon.on_step(1, _FakeMetrics(1.0))
+    mon.drain()
+    mon.on_step(2, _FakeMetrics(1.0))  # first step of the next epoch
+    mon.on_step(3, _FakeMetrics(1.0))
+    mon.drain()
+    by_step = dict(seen)
+    assert by_step[1] is not None  # intra-epoch interval measured
+    assert by_step[2] is None  # cross-epoch gap NOT measured
+    assert by_step[3] is not None
+
+
+def test_health_rejects_bad_combinations(tmp_path):
+    from ddp_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="requires --health"):
+        Trainer(
+            _config(
+                tmp_path, health=False, health_inject_nan="conv1/kernel@1"
+            )
+        )
+    with pytest.raises(ValueError, match="fast_epoch"):
+        Trainer(_config(tmp_path, fast_epoch=True))
+    with pytest.raises(ValueError, match="pipe_vit"):
+        Trainer(
+            _config(
+                tmp_path, model="pipe_vit", mesh_pipe=2,
+                num_microbatches=4,
+            )
+        )
+    # Rank-local events vs collective checkpointing: non-warn actions
+    # reject multi-process contexts at construction.
+
+    class _FakeCtx:
+        process_id = 0
+        num_processes = 2
+        is_main = True
+
+    with pytest.raises(ValueError, match="health_action warn"):
+        Trainer(
+            _config(tmp_path, health_action="halt"), ctx=_FakeCtx()
+        )
+
+
+def test_health_disabled_trainer_schema_unchanged(tmp_path):
+    """Health off: no ``health`` records, no ``health_events`` epoch
+    field — the stream only widens under --health."""
+    from ddp_tpu.train.trainer import Trainer
+
+    t = Trainer(_config(tmp_path, health=False))
+    assert t._health.enabled is False
+    t.train()
+    t.close()
+    recs = _records(tmp_path)
+    assert not [r for r in recs if r["kind"] == "health"]
+    epoch = next(r for r in recs if r["kind"] == "epoch")
+    assert "health_events" not in epoch
+
+
+# ---- scripts/health_report.py ---------------------------------------
+
+_REPORT_FIXTURE = [
+    {"kind": "step", "time": 1, "epoch": 0, "batch": 0, "step": 1,
+     "loss": 2.5, "lr": 0.01, "grad_norm": 4.0, "input_wait_s": 0.01,
+     "dispatch_s": 0.001, "compute_s": 0.089, "recompiles": 1,
+     "mfu": 0.02},
+    {"kind": "step", "time": 2, "epoch": 0, "batch": 2, "step": 3,
+     "loss": 2.0, "lr": 0.01, "grad_norm": 5.5, "input_wait_s": 0.02,
+     "dispatch_s": 0.001, "compute_s": 0.079, "recompiles": 0,
+     "mfu": 0.02},
+    {"kind": "health", "time": 2.5, "detector": "grad_explosion",
+     "step": 4, "value": 55.0, "baseline": 5.0},
+    {"kind": "health", "time": 2.6, "detector": "nonfinite", "step": 5,
+     "layer": "block1/attn", "layers": ["block1/attn"], "loss": 2.0},
+    {"kind": "step", "time": 3, "epoch": 0, "batch": 4, "step": 5,
+     "loss": None, "lr": 0.01, "input_wait_s": 0.01,
+     "dispatch_s": 0.001, "compute_s": 0.109, "recompiles": 0,
+     "mfu": 0.02},
+    {"kind": "epoch", "time": 4, "epoch": 0, "batches": 6,
+     "seconds": 0.6, "images_per_sec": 320.0, "mean_loss": 2.25,
+     "mfu": 0.02, "goodput": 0.9, "recompiles": 1, "health_events": 2},
+    {"kind": "final", "time": 5, "accuracy": 0.5, "loss": None,
+     "epochs_run": 1,
+     "goodput": {"productive_s": 0.6, "wall_s": 1.0, "goodput": 0.6,
+                 "restarts": 1}},
+]
+
+
+def test_health_report_golden(tmp_path):
+    """Golden-file pin: the triage report's exact rendering for a
+    fixed stream. Any formatting change must update the golden
+    deliberately (tests/golden/health_report.txt)."""
+    fixture = tmp_path / "metrics.jsonl"
+    fixture.write_text(
+        "".join(json.dumps(r) + "\n" for r in _REPORT_FIXTURE)
+        + '{"kind": "step", "trunc'  # torn tail line: must be skipped
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "health_report.py"),
+            str(fixture),
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    golden = open(
+        os.path.join(REPO, "tests", "golden", "health_report.txt")
+    ).read()
+    assert proc.stdout == golden
+    # an empty file fails loudly, naming itself
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    proc2 = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "health_report.py"),
+            str(empty),
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc2.returncode != 0
+    assert "empty.jsonl" in proc2.stderr

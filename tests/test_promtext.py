@@ -1,0 +1,194 @@
+"""Prometheus text exposition (ddp_tpu.obs.promtext): builder, lint,
+the serve /metricsz route, and the trainer's metrics port.
+
+The lint is the trace-schema validator's sibling: it runs in the smoke
+tier against both live expositions so a renderer regression (bad
+label, duplicate sample, TYPE after samples) fails tier-1 fast.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from ddp_tpu.obs.promtext import (
+    PromBuilder,
+    render_serve,
+    render_train,
+    validate_promtext,
+)
+
+
+def test_builder_render_and_validate():
+    """Smoke-tier pin: a representative exposition — gauges, labeled
+    counters, escaped label values, summaries — renders valid."""
+    b = PromBuilder()
+    b.add("up", 1, help="liveness")
+    b.add(
+        "requests_total", 7, labels={"status": 'quo"ted\\path'},
+        metric_type="counter",
+    )
+    b.add("requests_total", 2, labels={"status": "other"},
+          metric_type="counter")
+    b.summary(
+        "latency_seconds",
+        {"count": 4, "mean": 0.5, "min": 0.1, "p50": 0.4, "p95": 0.9,
+         "max": 1.0},
+        help="end to end",
+    )
+    b.summary("empty_seconds", {"count": 0})
+    text = b.render()
+    n = validate_promtext(text)
+    # up + 2×requests + {count,sum,q50,q95,min,max} + empty_count
+    assert n == 10
+    assert 'requests_total{status="quo\\"ted\\\\path"} 7' in text
+    assert "latency_seconds_sum 2" in text  # mean×count
+    assert 'latency_seconds{quantile="0.5"} 0.4' in text
+    assert "empty_seconds_count 0" in text
+    # None values render NO series (absent ≠ zero, the MFU rule)
+    assert "missing" not in PromBuilder().add("missing", None).render()
+
+
+def test_summary_sum_prefers_exact_running_total():
+    """The _sum counter comes from StatSummary's exact running sum
+    when present — mean×count regresses under mean rounding (a
+    decreasing counter reads as a reset to scrapers)."""
+    from ddp_tpu.utils.metrics import StatSummary
+
+    b = PromBuilder()
+    b.summary(
+        "t_seconds",
+        {"count": 1000, "mean": 0.0031, "sum": 3.1415, "p50": 0.003,
+         "p95": 0.004, "min": 0.001, "max": 0.01},
+    )
+    assert "t_seconds_sum 3.1415" in b.render()  # not 0.0031×1000
+    # ...and live snapshots carry it now
+    s = StatSummary()
+    s.add(1.5)
+    s.add(2.5)
+    assert s.snapshot()["sum"] == 4.0
+
+
+def test_builder_rejects_bad_series():
+    b = PromBuilder()
+    with pytest.raises(ValueError, match="bad metric name"):
+        b.add("1bad", 1)
+    with pytest.raises(ValueError, match="bad label name"):
+        b.add("ok", 1, labels={"0bad": "x"})
+    b.add("dup", 1, labels={"a": "x"})
+    with pytest.raises(ValueError, match="duplicate"):
+        b.add("dup", 2, labels={"a": "x"})
+    with pytest.raises(ValueError, match="conflicting types"):
+        b.add("dup", 2, labels={"a": "y"}, metric_type="counter")
+
+
+def test_validate_rejects_malformed():
+    for bad, why in (
+        ("x 1", "newline"),  # no trailing newline
+        ("x 1\nx 1\n", "duplicate"),
+        ('x{l="a"} 1\nx{l="a"} 2\n', "duplicate"),
+        ("1bad 2\n", "unparseable"),
+        ("x notanumber\n", "bad value"),
+        ('x{l="unclosed} 1\n', "unparseable|malformed"),
+        ("x 1\n# TYPE x gauge\n", "after its samples"),
+        ("# TYPE x gauge\n# TYPE x gauge\nx 1\n", "duplicate TYPE"),
+        ("# TYPE x wrongtype\nx 1\n", "bad TYPE"),
+    ):
+        with pytest.raises(ValueError, match=why):
+            validate_promtext(bad)
+    # NaN/Inf are legal sample values
+    assert validate_promtext("x NaN\ny +Inf\n") == 2
+
+
+def test_render_train_includes_health_series():
+    text = render_train(
+        {
+            "step": 10, "epoch": 1, "loss": 0.5, "grad_norm": 1.25,
+            "lr": 0.01, "mfu": 0.1, "goodput": 0.9, "recompiles": 2,
+            "images_per_sec": 100.0,
+            "health_events": {"loss_spike": 2, "straggler": 1},
+            "nonfinite_layer": "block1/attn", "nonfinite_step": 7,
+            "step_time": {"count": 3, "mean": 0.2, "min": 0.1,
+                          "p50": 0.2, "p95": 0.3, "max": 0.3},
+        }
+    )
+    validate_promtext(text)
+    assert 'ddp_tpu_train_health_events_total{detector="loss_spike"} 2' in text
+    assert (
+        'ddp_tpu_train_nonfinite{layer="block1/attn",step="7"} 1' in text
+    )
+    assert "ddp_tpu_train_step_seconds_count 3" in text
+    # sparse snapshot (startup, nothing logged yet) still renders valid
+    assert validate_promtext(render_train({})) >= 1
+
+
+def test_serve_metricsz_route_end_to_end(tmp_path):
+    """The serve frontend serves a scrapeable /metricsz whose series
+    cover traffic, rejects, TTFT, occupancy, and goodput."""
+    from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.serve.engine import ServeEngine
+    from ddp_tpu.serve.server import LMServer
+
+    spec = LMSpec(
+        vocab_size=37, total_len=32, d_model=32, depth=2, num_heads=4
+    )
+    engine = ServeEngine(
+        spec, init_lm(spec, seed=0), slots=2, prefill_len=8
+    )
+    engine.submit([1, 2, 3], 4)
+    engine.submit([4, 5], 3)
+    engine.submit(list(range(30)), 2)  # prompt_too_long reject
+    engine.run()
+    text = render_serve(engine.stats(), up=True)
+    validate_promtext(text)
+    with LMServer(engine) as server:
+        with urllib.request.urlopen(
+            server.url + "/metricsz", timeout=30
+        ) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    validate_promtext(body)
+    assert "ddp_tpu_serve_up 1" in body
+    assert 'ddp_tpu_serve_requests_total{status="complete"} 2' in body
+    assert (
+        'ddp_tpu_serve_rejects_total{reason="prompt_too_long"} 1' in body
+    )
+    assert "ddp_tpu_serve_ttft_seconds_count 2" in body
+    assert "ddp_tpu_serve_slot_occupancy 0" in body  # drained engine
+    assert "ddp_tpu_serve_goodput" in body
+
+
+def test_trainer_metrics_port(tmp_path):
+    """--metrics_port: live train series during/after a run, valid
+    exposition, port closed by close()."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    t = Trainer(
+        TrainConfig(
+            epochs=1, batch_size=4,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True, synthetic_size=128,
+            log_interval=2, eval_every=0,
+            metrics_file=str(tmp_path / "m.jsonl"),
+            metrics_port=0, health=True,
+        )
+    )
+    url = t._metrics_port.url
+    # scrapeable before the first step (sparse but valid)
+    with urllib.request.urlopen(url + "/metricsz", timeout=30) as r:
+        validate_promtext(r.read().decode())
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["ok"] is True
+    t.train()
+    with urllib.request.urlopen(url + "/metricsz", timeout=30) as r:
+        body = r.read().decode()
+    validate_promtext(body)
+    assert "ddp_tpu_train_loss" in body
+    assert "ddp_tpu_train_step " in body
+    assert "ddp_tpu_train_goodput" in body
+    assert "ddp_tpu_train_step_seconds_count" in body  # sentry summary
+    t.close()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(url + "/healthz", timeout=5)

@@ -1,0 +1,149 @@
+"""Flight recorder (ddp_tpu.obs.recorder): bounded ring, crash-safe
+dump, and the post-mortem-on-every-exit-class contract.
+
+Acceptance pins: a SIGTERM'd run and a watchdog-killed run both leave
+a readable ``flight_rank{r}.json`` (the subprocess tests; slow tier),
+and the dump discipline (tmp + os.replace, never raises) holds under
+fault (in-process tests; tier 1).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ddp_tpu.obs.recorder import FlightRecorder, load_dump, snapshot_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_bounded_and_dump_atomic(tmp_path):
+    rec = FlightRecorder(str(tmp_path), rank=3, capacity=8)
+    rec.set_context(config={"epochs": 2}, mesh={"data": 8})
+    for i in range(50):
+        rec.record("step", step=i)
+    path = rec.dump("test")
+    assert path.endswith("flight_rank3.json")
+    doc = load_dump(path)
+    assert doc["reason"] == "test" and doc["rank"] == 3
+    assert len(doc["records"]) == 8  # ring kept only the last 8
+    assert [r["step"] for r in doc["records"]] == list(range(42, 50))
+    assert doc["context"]["config"]["epochs"] == 2
+    # re-dump overwrites atomically; no tmp litter remains
+    rec.record("health", detector="nonfinite", loss=float("nan"))
+    path2 = rec.dump("later")
+    assert path2 == path
+    doc2 = load_dump(path)
+    assert doc2["reason"] == "later" and doc2["dumps"] == 2
+    # non-finite floats sanitized to null — strict JSON always
+    assert doc2["records"][-1]["loss"] is None
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_disabled_and_unwritable_never_raise(tmp_path):
+    off = FlightRecorder(None)
+    off.record("step", step=1)
+    assert off.dump("x") is None and off.path is None
+    off2 = FlightRecorder(str(tmp_path), capacity=0)
+    assert off2.enabled is False and off2.dump("x") is None
+    # An uncreatable directory (a FILE where a parent dir must go —
+    # robust even when the suite runs as root, unlike chmod): the
+    # dump refuses quietly, never a traceback.
+    as_file = tmp_path / "not_a_dir"
+    as_file.write_text("x")
+    rec = FlightRecorder(str(as_file / "sub"))
+    rec.record("step", step=1)
+    assert rec.dump("x") is None
+
+
+def test_snapshot_env_is_filtered(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "must-not-leak")
+    env = snapshot_env()["env"]
+    assert "JAX_PLATFORMS" in env
+    assert "AWS_SECRET_ACCESS_KEY" not in env
+
+
+def test_load_dump_rejects_non_dumps(tmp_path):
+    p = tmp_path / "x.json"
+    p.write_text('{"something": "else"}')
+    with pytest.raises(ValueError, match="x.json"):
+        load_dump(str(p))
+
+
+# ---- exit-class contracts (real processes; slow tier) ----------------
+
+
+def _train_cmd(tmp_path, *extra):
+    return [
+        sys.executable, os.path.join(REPO, "train.py"),
+        "--epochs", "20", "--batch_size", "4", "--synthetic_data",
+        "--synthetic_size", "256", "--log_interval", "2",
+        "--eval_every", "0",
+        "--checkpoint_dir", str(tmp_path / "ck"),
+        "--data_root", str(tmp_path / "data"),
+        "--metrics_file", str(tmp_path / "m.jsonl"),
+        *extra,
+    ]
+
+
+def _wait_for(path, proc, timeout):
+    """Wait for ``path`` to have CONTENT (the writer opens the file at
+    construction, before the SIGTERM handler is installed — an empty
+    file is too early to preempt)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            return True
+        if proc.poll() is not None:
+            return False
+        time.sleep(0.25)
+    return False
+
+
+@pytest.mark.slow
+def test_sigterm_run_leaves_flight_dump(tmp_path):
+    """Acceptance pin: a preempted (SIGTERM) run's dump is on disk
+    even before the boundary checkpoint lands."""
+    proc = subprocess.Popen(
+        _train_cmd(tmp_path), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # First metrics line = training started; then preempt.
+        assert _wait_for(str(tmp_path / "m.jsonl"), proc, 240), (
+            proc.communicate()[0]
+        )
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30)
+    assert proc.returncode == 0, out  # graceful preemption exit
+    doc = load_dump(str(tmp_path / "ck" / "flight_rank0.json"))
+    assert doc["reason"] == "sigterm"
+    assert any(r["kind"] == "signal" for r in doc["records"])
+    assert doc["context"]["config"]["epochs"] == 20
+
+
+@pytest.mark.slow
+def test_watchdog_killed_run_leaves_flight_dump(tmp_path):
+    """Acceptance pin: a hang (watchdog os._exit(124)) leaves the same
+    post-mortem as a crash, via the forensics hook."""
+    proc = subprocess.Popen(
+        # A timeout far below the first-step compile time: the
+        # watchdog fires mid-compile, exactly the hang shape.
+        _train_cmd(tmp_path, "--watchdog_timeout", "1.5"),
+        cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    out, _ = proc.communicate(timeout=300)
+    assert proc.returncode == 124, out
+    doc = load_dump(str(tmp_path / "ck" / "flight_rank0.json"))
+    assert doc["reason"] == "watchdog_timeout"
+    assert any(r["kind"] == "run_start" for r in doc["records"])

@@ -61,8 +61,9 @@ def test_dump_all_stacks_writes_every_thread(tmp_path):
 
 
 def test_default_abort_dumps_before_exit(monkeypatch, tmp_path):
-    """Order contract: stacks dump BEFORE os._exit(124) — _exit skips
-    every finally, so a post-exit dump would never happen."""
+    """Order contract: forensics run, then stacks dump, then
+    os._exit(124) — _exit skips every finally, so anything after it
+    would never happen. A broken forensic must not block the abort."""
     from ddp_tpu.utils import watchdog as wdmod
 
     calls = []
@@ -72,8 +73,43 @@ def test_default_abort_dumps_before_exit(monkeypatch, tmp_path):
     monkeypatch.setattr(
         wdmod.os, "_exit", lambda code: calls.append(code)
     )
-    wdmod._default_abort(12.0)
-    assert calls == ["dump", 124]
+
+    def broken():
+        calls.append("broken")
+        raise RuntimeError("evidence collection failed")
+
+    fn = wdmod.register_forensics(lambda: calls.append("forensic"))
+    wdmod.register_forensics(broken)
+    try:
+        wdmod._default_abort(12.0)
+    finally:
+        wdmod.unregister_forensics(fn)
+        wdmod.unregister_forensics(broken)
+    assert calls == ["forensic", "broken", "dump", 124]
+    # unregistering twice is a no-op, not an error
+    wdmod.unregister_forensics(fn)
+
+
+def test_forensics_export_flight_dump(monkeypatch, tmp_path):
+    """The trainer's registration shape: a watchdog abort leaves the
+    flight-recorder dump on disk (the hang-as-crash post-mortem)."""
+    from ddp_tpu.obs.recorder import FlightRecorder, load_dump
+    from ddp_tpu.utils import watchdog as wdmod
+
+    rec = FlightRecorder(str(tmp_path), rank=0, capacity=16)
+    rec.record("step", step=7)
+    fn = wdmod.register_forensics(
+        lambda: rec.dump("watchdog_timeout")
+    )
+    monkeypatch.setattr(wdmod, "dump_all_stacks", lambda file=None: None)
+    monkeypatch.setattr(wdmod.os, "_exit", lambda code: None)
+    try:
+        wdmod._default_abort(3.0)
+    finally:
+        wdmod.unregister_forensics(fn)
+    doc = load_dump(str(tmp_path / "flight_rank0.json"))
+    assert doc["reason"] == "watchdog_timeout"
+    assert doc["records"][-1]["step"] == 7
 
 
 def _hung_worker(rank, world):
