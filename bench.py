@@ -171,6 +171,11 @@ def run_bench(
         "seconds": round(seconds, 3),
         "mfu": round(mfu, 6),
         "trace": trace,
+        # How many times this measurement was respawned before a
+        # record landed (the supervisor overwrites with the real
+        # count): a nonzero value in the trajectory means the headline
+        # paid restart overhead and is not comparing like with like.
+        "restarts": 0,
     }
 
 
@@ -1285,15 +1290,18 @@ def _supervise() -> dict:
 
     env = dict(os.environ)
     attempts: list[str] = []
+    launches = 0
     for i in range(3):
         probe_budget = max(5.0, min(120.0, remaining() - _CPU_RESERVE_S))
         if _probe_backend(timeout=probe_budget):
             attempts.append(f"probe[{i}]: ok")
             worker_budget = max(60.0, remaining() - _CPU_RESERVE_S)
+            launches += 1
             rec = _run_worker(env, timeout=worker_budget)
             if rec is not None:
                 label = "worker: " + rec.get("note", "ok")
                 rec["capture_attempts"] = attempts + [label]
+                rec["restarts"] = launches - 1
                 return rec
             attempts.append("worker: failed")
             break
@@ -1310,11 +1318,15 @@ def _supervise() -> dict:
             print("bench: retrying probe in 45s", file=sys.stderr)
             time.sleep(45.0)
     cpu_env = dict(env, JAX_PLATFORMS="cpu")
+    launches += 1
     rec = _run_worker(cpu_env, timeout=max(60.0, remaining()))
     if rec is not None:
         rec["capture_attempts"] = attempts + [
             "cpu worker: " + rec.get("note", "ok")
         ]
+        # Worker relaunches consumed before this record landed —
+        # respawn overhead is part of the published trajectory.
+        rec["restarts"] = launches - 1
         return rec
     attempts.append("cpu worker: failed")
     return _error_record("all capture attempts failed", attempts)

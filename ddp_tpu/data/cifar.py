@@ -17,8 +17,6 @@ from __future__ import annotations
 
 import os
 import tarfile
-import urllib.error
-import urllib.request
 
 import numpy as np
 
@@ -48,16 +46,12 @@ def _fetch_tar(root: str, name: str) -> str:
     if os.path.exists(path):
         return path
     os.makedirs(root, exist_ok=True)
-    last_err: Exception | None = None
-    for mirror in _MIRRORS:
-        try:
-            tmp = path + ".part"
-            urllib.request.urlretrieve(mirror + fname, tmp)
-            os.replace(tmp, path)
-            return path
-        except (urllib.error.URLError, OSError) as e:
-            last_err = e
-    raise RuntimeError(f"could not download {fname} from any mirror: {last_err}")
+    # Mirror rotation with per-mirror bounded jittered retry
+    # (data/fetch.py) — transient mirror failures recover; offline
+    # (DNS) fails fast.
+    from ddp_tpu.data.fetch import fetch_from_mirrors
+
+    return fetch_from_mirrors(_MIRRORS, fname, path)
 
 
 def parse_records(raw: bytes, *, name: str) -> Split:

@@ -1,0 +1,162 @@
+"""Shared dataset-download helper: bounded, jittered retry.
+
+A transient mirror failure (HTTP 5xx, a reset connection, a truncated
+body) used to kill a training run on first touch of the dataset —
+the single most avoidable failure in a fresh container. Downloads now
+retry with bounded exponential backoff and deterministic jitter
+(seeded per URL, so retry timing cannot synchronize a fleet of
+workers into a thundering herd against the same mirror).
+
+Only failures that another attempt could plausibly fix are retried.
+DNS resolution failure (``socket.gaierror``), refused connections and
+unreachable networks fail FAST — they mean "offline" or "mirror
+gone", and retrying them would stall every offline run (the synthetic
+fallback path constructs a Trainer in seconds precisely because these
+fail immediately). Callers keep their own mirror rotation; this
+module makes each mirror attempt robust, not the mirror list.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import random
+import socket
+import time
+import urllib.error
+import urllib.request
+import zlib
+from typing import Callable
+
+DEFAULT_ATTEMPTS = 3
+DEFAULT_BASE_DELAY_S = 0.5
+DEFAULT_MAX_DELAY_S = 8.0
+DEFAULT_JITTER = 0.25  # ± fraction of the backoff delay
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Could a retry plausibly succeed?
+
+    Transient: HTTP 5xx / 408 / 429, truncated bodies, timeouts,
+    reset/broken connections. NOT transient: 4xx client errors, DNS
+    failure, refused/unreachable networks — those are configuration
+    or offline conditions a 2-second backoff cannot fix.
+    """
+    if isinstance(exc, urllib.error.ContentTooShortError):
+        return True  # truncated body — the canonical torn download
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500 or exc.code in (408, 429)
+    if isinstance(exc, http.client.IncompleteRead):
+        return True
+    reason = getattr(exc, "reason", exc)
+    if isinstance(reason, socket.gaierror):
+        return False  # no DNS — offline, fail fast to the fallback
+    if isinstance(
+        reason, (ConnectionRefusedError, OSError)
+    ) and getattr(reason, "errno", None) in (
+        101,  # ENETUNREACH
+        111,  # ECONNREFUSED
+        113,  # EHOSTUNREACH
+    ):
+        return False
+    if isinstance(
+        reason,
+        (socket.timeout, TimeoutError, ConnectionResetError, BrokenPipeError),
+    ):
+        return True
+    # Remaining URLError/OSError: unknown cause — one retry is cheap
+    # relative to losing the run.
+    return isinstance(exc, (urllib.error.URLError, OSError))
+
+
+def backoff_delays(
+    url: str,
+    attempts: int = DEFAULT_ATTEMPTS,
+    *,
+    base_delay: float = DEFAULT_BASE_DELAY_S,
+    max_delay: float = DEFAULT_MAX_DELAY_S,
+    jitter: float = DEFAULT_JITTER,
+    salt: int | None = None,
+) -> list[float]:
+    """The (attempts - 1) sleep durations between retries of ``url``.
+
+    Deterministic within a process: jitter is seeded from the URL plus
+    a per-process ``salt`` (default: the pid), so one worker's
+    schedule is reproducible while different files — and different
+    WORKERS fetching the same file — desynchronize instead of
+    retrying in lockstep against the same mirror. Bounded by
+    ``(1 + jitter) * max_delay`` per gap by construction.
+    """
+    if salt is None:
+        salt = os.getpid()
+    rng = random.Random(zlib.crc32(url.encode()) ^ salt)
+    delays = []
+    for i in range(max(0, attempts - 1)):
+        d = min(max_delay, base_delay * (2.0 ** i))
+        delays.append(max(0.0, d * (1.0 + jitter * rng.uniform(-1.0, 1.0))))
+    return delays
+
+
+def fetch_with_retry(
+    url: str,
+    dest: str,
+    *,
+    attempts: int = DEFAULT_ATTEMPTS,
+    base_delay: float = DEFAULT_BASE_DELAY_S,
+    max_delay: float = DEFAULT_MAX_DELAY_S,
+    jitter: float = DEFAULT_JITTER,
+    retrieve: Callable[[str, str], object] = urllib.request.urlretrieve,
+    sleep: Callable[[float], None] = time.sleep,
+) -> str:
+    """Download ``url`` → ``dest`` atomically, retrying transient
+    failures up to ``attempts`` times with jittered exponential
+    backoff. Raises the last error (non-transient errors raise
+    immediately). ``retrieve``/``sleep`` are injectable for tests.
+    """
+    delays = backoff_delays(
+        url, attempts,
+        base_delay=base_delay, max_delay=max_delay, jitter=jitter,
+    )
+    tmp = dest + ".part"
+    last: BaseException | None = None
+    for attempt in range(max(1, attempts)):
+        try:
+            retrieve(url, tmp)
+            os.replace(tmp, dest)
+            return dest
+        except (urllib.error.URLError, OSError, http.client.HTTPException) as e:
+            last = e
+            try:  # never leave a torn .part for the next attempt
+                os.remove(tmp)
+            except OSError:
+                pass
+            if not is_transient(e) or attempt >= len(delays):
+                raise
+            sleep(delays[attempt])
+    raise last  # pragma: no cover — loop always returns or raises
+
+
+def fetch_from_mirrors(
+    mirrors,
+    fname: str,
+    dest: str,
+    *,
+    attempts: int = DEFAULT_ATTEMPTS,
+) -> str:
+    """Mirror rotation over ``fetch_with_retry`` (the shared loader
+    loop — MNIST and CIFAR must not drift on which exceptions rotate
+    to the next mirror). Note ``http.client.HTTPException`` (e.g.
+    IncompleteRead) is not an OSError — missing it would abandon the
+    remaining mirrors. Raises RuntimeError naming the last error when
+    every mirror fails."""
+    last_err: BaseException | None = None
+    for mirror in mirrors:
+        try:
+            return fetch_with_retry(mirror + fname, dest, attempts=attempts)
+        except (
+            urllib.error.URLError, OSError, http.client.HTTPException
+        ) as e:
+            last_err = e
+    raise RuntimeError(
+        f"could not download {fname} from any mirror: {last_err}"
+    )

@@ -23,8 +23,6 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-import urllib.error
-import urllib.request
 from typing import NamedTuple
 
 import numpy as np
@@ -109,16 +107,13 @@ def _fetch(root: str, fname: str, variant: str = "mnist") -> str:
             "--data_root at a checkout that committed data/uci_digits/"
         )
     os.makedirs(base, exist_ok=True)
-    last_err: Exception | None = None
-    for mirror in _VARIANT_MIRRORS[variant]:
-        try:
-            tmp = path + ".part"
-            urllib.request.urlretrieve(mirror + fname, tmp)
-            os.replace(tmp, path)
-            return path
-        except (urllib.error.URLError, OSError) as e:
-            last_err = e
-    raise RuntimeError(f"could not download {fname} from any mirror: {last_err}")
+    # Mirror rotation with per-mirror bounded jittered retry
+    # (data/fetch.py): a transient mirror hiccup no longer kills the
+    # run on first touch; genuinely-offline failures (DNS) still fail
+    # fast so the synthetic fallback stays instant.
+    from ddp_tpu.data.fetch import fetch_from_mirrors
+
+    return fetch_from_mirrors(_VARIANT_MIRRORS[variant], fname, path)
 
 
 def _read_idx_file(path: str) -> np.ndarray:

@@ -237,13 +237,23 @@ def validate_promtext(text: str) -> int:
 # ---- renderers -------------------------------------------------------
 
 
-def render_serve(stats: dict, *, up: Optional[bool] = None) -> str:
+def render_serve(
+    stats: dict,
+    *,
+    up: Optional[bool] = None,
+    draining: Optional[bool] = None,
+) -> str:
     """ServeEngine.stats() → exposition (the /metricsz payload)."""
     b = PromBuilder()
     if up is not None:
         b.add(
             "ddp_tpu_serve_up", 1 if up else 0,
             help="1 while the engine loop is healthy",
+        )
+    if draining is not None:
+        b.add(
+            "ddp_tpu_serve_draining", 1 if draining else 0,
+            help="1 while shutdown drain rejects new admissions",
         )
     b.add("ddp_tpu_serve_slots", stats.get("slots"), help="decode lanes")
     b.add(

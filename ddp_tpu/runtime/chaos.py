@@ -1,0 +1,318 @@
+"""Deterministic fault injection — drills for the fault-tolerance layer.
+
+Preemptible TPU fleets make failure the common case; a recovery path
+that is only exercised by real outages is a recovery path that does
+not work. This module turns the ``--health_inject_nan`` precedent into
+a general harness: a seeded, *deterministic* schedule of process
+kills, SIGTERMs, checkpoint corruption, and input-pipeline stalls that
+fire at exact points of a run — usable from the CLI (``--chaos``) and
+from tests, and safe to leave in a relaunch loop because every event
+fires **once** across restarts (a per-rank ledger file next to the
+checkpoints records what already fired; the resumed run replays the
+same steps without replaying the faults).
+
+Spec grammar (comma-separated events; see docs/ROBUSTNESS.md)::
+
+    kill:rank<R>@step<N>          SIGKILL rank R before global step N
+    kill:rank<R>@epoch<N>         ... at the top of epoch N
+    sigterm:rank<R>@step<N>       graceful-preemption signal instead
+    sigterm:rank<R>@epoch<N>
+    stall:input@step<N>:<S>s      sleep S seconds before step N's
+                                  dispatch, on every rank (an input-
+                                  pipeline stall the straggler sentry
+                                  should see)
+    ckpt_corrupt:latest           at process start (rank 0, before
+                                  restore): truncate the largest file
+                                  of the latest checkpoint on disk —
+                                  the torn-write drill for the
+                                  manifest/quarantine fallback path
+
+"Step N" means the global optimizer-step counter (which survives
+restarts via the checkpoint), checked at the step boundary before the
+dispatch that would run step N — so a resumed run re-approaches the
+same trigger point deterministically, and the ledger is what stops a
+second firing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+import signal
+import time
+from typing import Iterable, Sequence
+
+logger = logging.getLogger("ddp_tpu")
+
+KINDS = ("kill", "sigterm", "stall", "ckpt_corrupt")
+
+_EVENT_RE = re.compile(
+    r"^(?P<kind>kill|sigterm)"
+    r":rank(?P<rank>\d+)"
+    r"@(?P<unit>step|epoch)(?P<at>\d+)$"
+)
+_STALL_RE = re.compile(
+    r"^stall:input@(?P<unit>step|epoch)(?P<at>\d+)"
+    r":(?P<seconds>\d+(?:\.\d+)?)s$"
+)
+_CORRUPT_RE = re.compile(r"^ckpt_corrupt:latest$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled fault. ``rank`` None = every rank (stalls);
+    ``step``/``epoch`` are mutually exclusive trigger points; kills
+    and sigterms name exactly one rank; ``seconds`` is the stall
+    duration. ``ckpt_corrupt`` has no trigger point — it fires at
+    process start on rank 0, before checkpoint discovery."""
+
+    kind: str
+    rank: int | None = None
+    step: int | None = None
+    epoch: int | None = None
+    seconds: float = 0.0
+
+    @property
+    def token(self) -> str:
+        """Canonical spec token (the ledger id; format/parse round-trip)."""
+        if self.kind == "ckpt_corrupt":
+            return "ckpt_corrupt:latest"
+        at = (
+            f"step{self.step}" if self.step is not None
+            else f"epoch{self.epoch}"
+        )
+        if self.kind == "stall":
+            return f"stall:input@{at}:{self.seconds:g}s"
+        return f"{self.kind}:rank{self.rank}@{at}"
+
+
+def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
+    """``--chaos`` string → events. Raises ValueError naming the bad
+    token; an empty/None spec parses to ()."""
+    if not spec or not spec.strip():
+        return ()
+    events = []
+    for token in spec.split(","):
+        token = token.strip()
+        if not token:
+            continue
+        m = _EVENT_RE.match(token)
+        if m:
+            at = int(m.group("at"))
+            events.append(
+                ChaosEvent(
+                    kind=m.group("kind"),
+                    rank=int(m.group("rank")),
+                    step=at if m.group("unit") == "step" else None,
+                    epoch=at if m.group("unit") == "epoch" else None,
+                )
+            )
+            continue
+        m = _STALL_RE.match(token)
+        if m:
+            at = int(m.group("at"))
+            seconds = float(m.group("seconds"))
+            if seconds <= 0:
+                raise ValueError(
+                    f"chaos stall duration must be > 0: {token!r}"
+                )
+            events.append(
+                ChaosEvent(
+                    kind="stall",
+                    step=at if m.group("unit") == "step" else None,
+                    epoch=at if m.group("unit") == "epoch" else None,
+                    seconds=seconds,
+                )
+            )
+            continue
+        if _CORRUPT_RE.match(token):
+            events.append(ChaosEvent(kind="ckpt_corrupt"))
+            continue
+        raise ValueError(
+            f"bad chaos event {token!r}; grammar: "
+            "kill:rank<R>@step<N>|epoch<N>, "
+            "sigterm:rank<R>@step<N>|epoch<N>, "
+            "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest"
+        )
+    return tuple(events)
+
+
+def format_chaos(events: Iterable[ChaosEvent]) -> str:
+    """Events → canonical spec string (``parse_chaos`` round-trips)."""
+    return ",".join(e.token for e in events)
+
+
+def corrupt_latest_checkpoint(
+    directory: str, *, seed: int = 0
+) -> str | None:
+    """Truncate the largest file of the latest committed checkpoint.
+
+    The deterministic torn-write drill: picks ``epoch_<max>`` under
+    ``directory``, then its largest file (ties broken by path, so the
+    choice is stable), and truncates it to half its size — exactly the
+    artifact a mid-write kill leaves. Returns the corrupted path, or
+    None when there is nothing to corrupt. ``seed`` varies WHERE the
+    truncation lands (half ± a seeded offset) without changing which
+    file is hit.
+    """
+    try:
+        epochs = [
+            (int(m.group(1)), name)
+            for name in os.listdir(directory)
+            for m in [re.match(r"^epoch_(\d+)$", name)]
+            if m and os.path.isdir(os.path.join(directory, name))
+        ]
+    except OSError:
+        return None
+    if not epochs:
+        return None
+    _, latest = max(epochs)
+    step_dir = os.path.join(directory, latest)
+    files = []
+    for root, _, names in os.walk(step_dir):
+        for n in names:
+            p = os.path.join(root, n)
+            try:
+                files.append((os.path.getsize(p), p))
+            except OSError:
+                continue
+    if not files:
+        return None
+    size, victim = max(files, key=lambda t: (t[0], t[1]))
+    # Half the file ± up to 25%, seeded — never 0 (an empty file is a
+    # different failure than a torn one) unless the file was tiny.
+    cut = max(1, size // 2 + (seed % max(1, size // 4)) - size // 8)
+    cut = min(cut, max(0, size - 1))
+    with open(victim, "r+b") as f:
+        f.truncate(cut)
+    logger.warning(
+        "chaos: truncated %s from %d to %d bytes", victim, size, cut
+    )
+    return victim
+
+
+class ChaosEngine:
+    """Arms a rank's share of a chaos plan and fires events once.
+
+    The trainer calls ``on_start`` (before checkpoint discovery),
+    ``on_epoch`` (top of each epoch) and ``on_step`` (each step
+    boundary, with the global step counter). Events that already fired
+    — in this process or a previous incarnation, per the ledger — are
+    skipped, which is what makes ``--chaos kill:...`` + a restart loop
+    terminate instead of crash-looping.
+    """
+
+    def __init__(
+        self,
+        events: Sequence[ChaosEvent] | str | None,
+        *,
+        rank: int = 0,
+        ledger_path: str | None = None,
+        seed: int = 0,
+    ):
+        if isinstance(events, str) or events is None:
+            events = parse_chaos(events)
+        self.events = tuple(events)
+        self.rank = int(rank)
+        self.seed = int(seed)
+        self._ledger_path = ledger_path
+        self._fired: set[str] | None = None  # lazy ledger load
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.events)
+
+    def has_step_events(self) -> bool:
+        return any(e.step is not None for e in self.events)
+
+    # ---- ledger ------------------------------------------------------
+
+    def _load_ledger(self) -> set[str]:
+        if self._fired is not None:
+            return self._fired
+        fired: set[str] = set()
+        if self._ledger_path:
+            try:
+                with open(self._ledger_path) as f:
+                    data = json.load(f)
+                fired = set(data.get("fired", []))
+            except (OSError, ValueError):
+                fired = set()
+        self._fired = fired
+        return fired
+
+    def _mark_fired(self, ev: ChaosEvent) -> None:
+        fired = self._load_ledger()
+        fired.add(ev.token)
+        if not self._ledger_path:
+            return
+        # Durable BEFORE the fault lands: a kill must not forget it
+        # fired, or the relaunched run kills itself at the same step
+        # forever. Atomic (tmp+replace) like every sidecar here.
+        try:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self._ledger_path)),
+                exist_ok=True,
+            )
+            tmp = f"{self._ledger_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"fired": sorted(fired)}, f)
+            os.replace(tmp, self._ledger_path)
+        except OSError as e:  # unwritable dir: fire anyway, log why
+            logger.warning("chaos ledger write failed: %s", e)
+
+    # ---- trigger points ----------------------------------------------
+
+    def _mine(self, ev: ChaosEvent) -> bool:
+        if ev.kind == "ckpt_corrupt":
+            return self.rank == 0  # one filesystem, one corruptor
+        return ev.rank is None or ev.rank == self.rank
+
+    def _fire(self, ev: ChaosEvent, checkpoint_dir: str | None = None) -> None:
+        self._mark_fired(ev)
+        logger.warning("chaos: firing %s (rank %d)", ev.token, self.rank)
+        if ev.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif ev.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif ev.kind == "stall":
+            time.sleep(ev.seconds)
+        elif ev.kind == "ckpt_corrupt" and checkpoint_dir:
+            corrupt_latest_checkpoint(checkpoint_dir, seed=self.seed)
+
+    def _pending(self) -> Iterable[ChaosEvent]:
+        fired = self._load_ledger()
+        return (
+            e for e in self.events
+            if self._mine(e) and e.token not in fired
+        )
+
+    def on_start(self, checkpoint_dir: str | None) -> None:
+        """Process-start events (``ckpt_corrupt``) — call BEFORE
+        checkpoint discovery/restore."""
+        if not self.events:
+            return
+        for ev in list(self._pending()):
+            if ev.kind == "ckpt_corrupt":
+                self._fire(ev, checkpoint_dir=checkpoint_dir)
+
+    def on_epoch(self, epoch: int) -> None:
+        if not self.events:
+            return
+        for ev in list(self._pending()):
+            if ev.epoch is not None and ev.epoch == epoch:
+                self._fire(ev)
+
+    def on_step(self, step: int) -> None:
+        """``step`` = the global optimizer step the NEXT dispatch will
+        run (the counter restored from checkpoints, so trigger points
+        survive restarts). Chaos-off is free: the guard is one tuple
+        truthiness test in the hot loop."""
+        if not self.events:
+            return
+        for ev in list(self._pending()):
+            if ev.step is not None and ev.step == step:
+                self._fire(ev)

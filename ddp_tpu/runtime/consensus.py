@@ -1,0 +1,89 @@
+"""Cross-process agreement primitives.
+
+The general form of the trainer's preemption handshake: rank-local
+signals (a SIGTERM that landed on one host, an anomaly only one rank's
+sentry saw, a watchdog close to firing) must become a *collective*
+decision before anyone acts, because the actions — checkpoint save,
+halt, epoch exit — are collectives themselves, and a one-rank exit
+leaves every peer blocked in the next collective forever (the
+reference's hang failure mode, SURVEY.md §5).
+
+``agree_any`` / ``agree_all`` are **collective calls**: every process
+must reach them the same number of times in the same order, at a
+deterministic point (a fixed batch cadence, an epoch boundary). They
+accept a scalar flag or a flat sequence of flags; a sequence is ORed /
+ANDed *elementwise* so one gather can carry several independent
+decisions (the trainer piggybacks preempt + health-halt + health-
+rescue on a single allgather per cadence point).
+
+Single-process runs short-circuit without touching ``jax.distributed``
+— the primitives are safe to call unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, overload
+
+import numpy as np
+
+
+def _gather(flags: Sequence[bool]) -> np.ndarray:
+    """allgather a bool vector → [num_processes, len(flags)]."""
+    from jax.experimental import multihost_utils
+
+    out = np.asarray(
+        multihost_utils.process_allgather(np.asarray(flags, dtype=bool))
+    )
+    return out.reshape(-1, len(flags))
+
+
+def _agree(flags, reduce, num_processes):
+    scalar = isinstance(flags, (bool, np.bool_, int))
+    vec = [bool(flags)] if scalar else [bool(f) for f in flags]
+    if num_processes is None:
+        import jax
+
+        num_processes = jax.process_count()
+    if num_processes <= 1:
+        agreed = vec
+    else:
+        agreed = [bool(v) for v in reduce(_gather(vec), axis=0)]
+    return agreed[0] if scalar else agreed
+
+
+@overload
+def agree_any(flags: bool, *, num_processes: int | None = ...) -> bool: ...
+@overload
+def agree_any(
+    flags: Sequence[bool], *, num_processes: int | None = ...
+) -> list[bool]: ...
+
+
+def agree_any(flags, *, num_processes=None):
+    """Collective OR: True wherever ANY process raised the flag.
+
+    The escalation primitive — "somebody saw it, everybody acts".
+    Scalar in → scalar out; sequence in → elementwise list out.
+    ``num_processes`` overrides the ``jax.process_count()`` default
+    (the trainer passes its DistContext's count so an emulated
+    multi-process context exercises the gather path).
+    """
+    return _agree(flags, np.any, num_processes)
+
+
+@overload
+def agree_all(flags: bool, *, num_processes: int | None = ...) -> bool: ...
+@overload
+def agree_all(
+    flags: Sequence[bool], *, num_processes: int | None = ...
+) -> list[bool]: ...
+
+
+def agree_all(flags, *, num_processes=None):
+    """Collective AND: True only where EVERY process raised the flag.
+
+    The readiness primitive — "proceed only when the whole world is
+    ready" (e.g. every rank finished verifying a checkpoint before
+    anyone restores it).
+    """
+    return _agree(flags, np.all, num_processes)

@@ -121,6 +121,30 @@ def setup(
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
     )
     if multi_host:
+        # Careful: nothing here may touch the backend (even
+        # jax.default_backend() would initialize it, and initialize()
+        # refuses to run after that) — decide from the requested
+        # backend / platform config only.
+        platforms = (
+            getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS")
+            or ""
+        )
+        if backend == "cpu" or platforms.split(",")[0] == "cpu":
+            # Multi-process collectives on the CPU backend need the
+            # gloo transport; without it every cross-process program
+            # dies with "Multiprocess computations aren't implemented
+            # on the CPU backend" (XLA's default CPU client). This is
+            # the reference's 2-proc gloo quickstart made literal —
+            # and what lets the whole multihost test tier (and the
+            # --spawn restart loop) run on a dev box. Must be set
+            # before initialize(); harmless when already set.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except (AttributeError, ValueError):  # older jaxlib
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -14,18 +14,56 @@ Workers must be module-level callables ``fn(rank, world_size, *args)``
 (the reference's ``ddp_train`` signature). They are resolved by source
 file + qualified name in the child, so functions from test modules and
 scripts work even when those modules aren't importable by package name.
+
+Restart-with-resume (``max_restarts > 0``): when any rank dies, the
+parent classifies the exit (signal vs exception vs the watchdog's
+124), reaps the WHOLE world — survivors are typically blocked in a
+collective waiting for the dead peer and would hang forever — and
+relaunches every rank on a fresh coordinator port after a bounded
+exponential backoff. Recovery itself is the workers' job: the trainer
+auto-resumes from the latest checkpoint, and its ``goodput.json``
+sidecar counts the relaunch as a restart, so goodput accounting
+reflects the crash loop's true cost (obs/goodput.py).
 """
 
 from __future__ import annotations
 
 import importlib
 import importlib.util
+import logging
 import multiprocessing
 import os
+import signal as _signal
 import socket
 import sys
 import time
 from typing import Callable, Sequence
+
+logger = logging.getLogger("ddp_tpu")
+
+# Exit code the step watchdog uses for its os._exit on hang
+# (utils/watchdog.py) — a hang converted into a classifiable crash.
+WATCHDOG_EXIT_CODE = 124
+
+
+def classify_exit(exitcode: int | None) -> str:
+    """Human-readable failure class for a dead worker's exit code.
+
+    multiprocessing reports death-by-signal as a NEGATIVE code;
+    ``WATCHDOG_EXIT_CODE`` is the step watchdog's hang conversion;
+    anything else is an uncaught exception (Python exits 1).
+    """
+    if exitcode is None:
+        return "unknown"
+    if exitcode < 0:
+        try:
+            name = _signal.Signals(-exitcode).name
+        except ValueError:
+            name = f"signal {-exitcode}"
+        return f"killed by {name}"
+    if exitcode == WATCHDOG_EXIT_CODE:
+        return "watchdog timeout (hang converted to exit 124)"
+    return f"exception (exit {exitcode})"
 
 
 def free_port() -> int:
@@ -91,6 +129,26 @@ def _child_main(
         dist.cleanup()
 
 
+def _reap_world(procs: list, grace: float) -> None:
+    """Terminate every surviving rank: SIGTERM (the trainer's graceful
+    preemption path — a healthy survivor checkpoints and exits clean),
+    ``grace`` seconds to comply, then SIGKILL for ranks wedged in a
+    collective whose peer is already dead (C-level blocks never run the
+    Python signal handler). No rank may outlive its world: a leaked
+    survivor would hold the coordinator port and the next generation's
+    rendezvous would join a half-dead gang."""
+    for p in procs:
+        if p.is_alive():
+            p.terminate()
+    deadline = time.monotonic() + grace
+    for p in procs:
+        p.join(max(0.5, deadline - time.monotonic()))
+    for p in procs:
+        if p.is_alive():
+            p.kill()
+            p.join(10)
+
+
 def spawn(
     fn: Callable,
     nprocs: int,
@@ -100,64 +158,120 @@ def spawn(
     coordinator_port: int | None = None,
     timeout: float | None = 600.0,
     grace: float = 15.0,
-) -> None:
+    max_restarts: int = 0,
+    restart_backoff: float = 1.0,
+) -> int:
     """Run ``fn(rank, world_size, *args)`` in ``nprocs`` processes.
 
     Same contract as the reference's launcher (spawn prepends the rank,
     train_ddp.py:222-224) with the c10d env:// rendezvous replaced by a
     localhost ``jax.distributed`` coordinator. Blocks until every rank
-    exits (``timeout=None`` waits forever). Fails fast: the first rank
-    to die with a non-zero exit code is reported as the culprit, and
-    surviving ranks — typically blocked in a collective waiting for the
-    dead one, the reference's hang failure mode (SURVEY.md §5) — get
-    ``grace`` seconds to exit before being terminated.
+    exits (``timeout=None`` waits forever; a finite ``timeout`` spans
+    ALL generations). Fails fast: the first rank to die with a non-zero
+    exit code is reported as the culprit, and surviving ranks —
+    typically blocked in a collective waiting for the dead one, the
+    reference's hang failure mode (SURVEY.md §5) — get ``grace``
+    seconds to exit before being terminated (then killed).
+
+    ``max_restarts > 0`` adds restart-with-resume: after a failed
+    generation the whole world is reaped and relaunched (fresh
+    coordinator port — the dead coordinator's socket may linger) with
+    exponential backoff ``restart_backoff * 2^i`` seconds (capped at
+    30 s). Workers are responsible for resuming from their own durable
+    state; the trainer's latest-checkpoint auto-resume makes the
+    combination an automatic kill-and-recover loop. Returns the number
+    of restarts consumed. The overall ``timeout`` is never restarted.
     """
     import inspect
 
     src_file = os.path.abspath(inspect.getfile(fn))
-    port = coordinator_port or free_port()
     ctx = multiprocessing.get_context("spawn")
-    procs = [
-        ctx.Process(
-            target=_child_main,
-            args=(
-                src_file,
-                fn.__module__,
-                fn.__qualname__,
-                rank,
-                nprocs,
-                port,
-                devices_per_process,
-                tuple(args),
-            ),
-            daemon=False,
-        )
-        for rank in range(nprocs)
-    ]
-    for p in procs:
-        p.start()
     deadline = None if timeout is None else time.monotonic() + timeout
-    try:
-        while True:
-            exited = {r: p.exitcode for r, p in enumerate(procs) if not p.is_alive()}
-            bad = {r: c for r, c in exited.items() if c != 0}
-            if bad:
-                # Give blocked survivors a moment, then report the
-                # actual failure rather than a survivor's timeout.
-                grace_end = time.monotonic() + grace
-                for p in procs:
-                    p.join(max(0.0, grace_end - time.monotonic()))
-                raise RuntimeError(f"worker failures (rank: exitcode): {bad}")
-            if len(exited) == nprocs:
-                return
-            if deadline is not None and time.monotonic() > deadline:
-                alive = [r for r, p in enumerate(procs) if p.is_alive()]
-                raise RuntimeError(
-                    f"ranks {alive} still running after {timeout}s"
-                )
-            time.sleep(0.2)
-    finally:
+    restarts = 0
+    while True:
+        # An explicit coordinator_port only pins generation 0: the
+        # dead coordinator's socket may linger (TIME_WAIT) and a
+        # relaunch binding the same port would burn every restart on
+        # the rendezvous instead of the workload.
+        port = (
+            coordinator_port
+            if coordinator_port and restarts == 0
+            else free_port()
+        )
+        procs = [
+            ctx.Process(
+                target=_child_main,
+                args=(
+                    src_file,
+                    fn.__module__,
+                    fn.__qualname__,
+                    rank,
+                    nprocs,
+                    port,
+                    devices_per_process,
+                    tuple(args),
+                ),
+                daemon=False,
+            )
+            for rank in range(nprocs)
+        ]
         for p in procs:
-            if p.is_alive():
-                p.terminate()
-                p.join(10)
+            p.start()
+        bad: dict[int, int] = {}
+        try:
+            while True:
+                exited = {
+                    r: p.exitcode
+                    for r, p in enumerate(procs)
+                    if not p.is_alive()
+                }
+                bad = {r: c for r, c in exited.items() if c != 0}
+                if bad:
+                    # Give blocked survivors a moment to exit on their
+                    # own before the reap, so the report names the
+                    # actual failure rather than a survivor's kill.
+                    grace_end = time.monotonic() + grace
+                    for p in procs:
+                        p.join(max(0.0, grace_end - time.monotonic()))
+                    break
+                if len(exited) == nprocs:
+                    return restarts
+                if deadline is not None and time.monotonic() > deadline:
+                    alive = [r for r, p in enumerate(procs) if p.is_alive()]
+                    raise RuntimeError(
+                        f"ranks {alive} still running after {timeout}s"
+                        + (f" ({restarts} restart(s))" if restarts else "")
+                    )
+                time.sleep(0.2)
+        finally:
+            _reap_world(procs, grace)
+        classified = {
+            r: classify_exit(c) for r, c in sorted(bad.items())
+        }
+        if restarts >= max_restarts:
+            raise RuntimeError(
+                f"worker failures (rank: exitcode): {bad} — "
+                + "; ".join(
+                    f"rank {r}: {why}" for r, why in classified.items()
+                )
+                + (
+                    f"; {restarts}/{max_restarts} restarts exhausted"
+                    if max_restarts
+                    else ""
+                )
+            )
+        backoff = min(30.0, restart_backoff * (2.0 ** restarts))
+        restarts += 1
+        logger.warning(
+            "launch: generation failed (%s) — restart %d/%d in %.1fs",
+            "; ".join(f"rank {r}: {why}" for r, why in classified.items()),
+            restarts,
+            max_restarts,
+            backoff,
+        )
+        if deadline is not None and time.monotonic() + backoff > deadline:
+            raise RuntimeError(
+                f"worker failures (rank: exitcode): {bad}; no budget "
+                f"left to restart (timeout {timeout}s)"
+            )
+        time.sleep(backoff)

@@ -13,6 +13,8 @@ every container this repo targets, and the API is three routes:
                    → 400 {"error": "prompt_too_long" | ...} on
                      permanently-invalid requests
                    → 400 on malformed bodies
+                   → 503 {"error": "draining"} + ``Retry-After``
+                     while the server drains for shutdown
   GET  /healthz    → 200 {"ok": true, "slots": S, ...} (liveness)
   GET  /stats      → 200 engine.stats() (TTFT/throughput summaries,
                     compile counts — the static-shape invariant is an
@@ -60,10 +62,16 @@ class LMServer:
         *,
         host: str = "127.0.0.1",
         port: int = 0,
+        drain_retry_after: float = 5.0,
     ):
         self.engine = engine
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        # Advertised in the 503 Retry-After header while draining: a
+        # well-behaved client re-resolves (the replacement process) and
+        # retries after this many seconds.
+        self.drain_retry_after = float(drain_retry_after)
         self._engine_error: Optional[str] = None
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -89,6 +97,40 @@ class LMServer:
         self._httpd.server_close()
         for t in self._threads:
             t.join(timeout=5)
+
+    # ---- graceful drain ---------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        """Stop ADMITTING (new POSTs get 503 + Retry-After) while
+        already-running lanes keep decoding to completion. Idempotent;
+        visible on /healthz, /statusz and as the ``_draining`` gauge
+        on /metricsz."""
+        self._draining.set()
+
+    def drain(self, timeout: float = 30.0, *, poll: float = 0.01) -> bool:
+        """``begin_drain`` + wait for in-flight work to finish.
+
+        The SIGTERM shutdown path (scripts/serve.py): a preempted
+        serving process answers its running requests instead of
+        killing them, bounded by ``timeout`` (a preemption grace
+        window is finite). Returns True when the engine went idle,
+        False when the timeout expired with lanes still running —
+        either way the caller should exit afterwards.
+        """
+        self.begin_drain()
+        deadline = time.monotonic() + max(0.0, timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                idle = not self.engine.pending
+            if idle or self._engine_error is not None:
+                return True
+            time.sleep(poll)
+        with self._lock:
+            return not self.engine.pending
 
     def __enter__(self) -> "LMServer":
         return self.start()
@@ -141,6 +183,14 @@ class LMServer:
             }
         if self._engine_error is not None:
             return 500, {"error": f"engine failed: {self._engine_error}"}
+        if self._draining.is_set():
+            # Draining: admitted work finishes, new work goes to the
+            # replacement process. retry_after_s rides the JSON too so
+            # in-process callers (no HTTP headers) see it.
+            return 503, {
+                "error": "draining",
+                "retry_after_s": self.drain_retry_after,
+            }
         with self._lock:
             adm = self.engine.submit(
                 prompt,
@@ -189,6 +239,7 @@ class LMServer:
                     "slots": self.engine.num_slots,
                     "active": self.engine.active,
                     "queue_depth": self.engine.scheduler.depth,
+                    "draining": self.draining,
                     **(
                         {"engine_error": self._engine_error}
                         if self._engine_error
@@ -205,7 +256,9 @@ class LMServer:
 
             with self._lock:
                 return render_serve(
-                    self.engine.stats(), up=self._engine_error is None
+                    self.engine.stats(),
+                    up=self._engine_error is None,
+                    draining=self.draining,
                 )
         if route == "/statusz":
             # Live observability snapshot (ddp_tpu.obs): operational
@@ -216,6 +269,7 @@ class LMServer:
             with self._lock:
                 return {
                     "ok": self._engine_error is None,
+                    "draining": self.draining,
                     "stats": self.engine.stats(),
                     "trace": self.engine.tracer.snapshot(limit=512),
                 }
@@ -228,17 +282,25 @@ def _make_handler(server: LMServer):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send_text(self, status: int, text: str, ctype: str) -> None:
+        def _send_text(
+            self, status: int, text: str, ctype: str,
+            headers: Optional[dict] = None,
+        ) -> None:
             data = text.encode()
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
-        def _send(self, status: int, payload: dict) -> None:
+        def _send(
+            self, status: int, payload: dict,
+            headers: Optional[dict] = None,
+        ) -> None:
             self._send_text(
-                status, json.dumps(payload), "application/json"
+                status, json.dumps(payload), "application/json", headers
             )
 
         def do_GET(self):  # noqa: N802
@@ -269,6 +331,13 @@ def _make_handler(server: LMServer):
                 self._send(400, {"error": f"bad JSON body: {e}"})
                 return
             status, payload = server.submit_and_wait(body)
-            self._send(status, payload)
+            headers = None
+            if status == 503 and payload.get("error") == "draining":
+                # RFC 9110 Retry-After: tells clients/load-balancers
+                # when to come back (to the replacement process).
+                headers = {
+                    "Retry-After": str(int(server.drain_retry_after))
+                }
+            self._send(status, payload, headers)
 
     return Handler

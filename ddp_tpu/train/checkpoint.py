@@ -30,8 +30,10 @@ SURVEY.md §2a #8):
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import zlib
 from typing import Any
 
 import jax
@@ -115,6 +117,107 @@ def _check_qkv_format(fmt: int | None, tree: Any, source: str) -> None:
             "scripts/convert_qkv_layout.py --num_heads <H> "
             "--num_kv_heads <K>."
         )
+
+
+# --- checkpoint integrity manifests -----------------------------------
+#
+# Orbax's commit protocol makes a *crash mid-save* atomic, but nothing
+# defends the committed bytes afterwards: a torn copy, a truncated
+# restore from object storage, bit rot, or a chaos drill
+# (runtime/chaos.py ckpt_corrupt) leaves a "latest" that passes
+# discovery and fails — or worse, silently corrupts — the restore.
+# Every save therefore gets a sidecar manifest (``epoch_N.manifest.json``
+# next to the step directory) listing each file's size and CRC-32;
+# restore-time discovery verifies the latest manifest and, on mismatch,
+# QUARANTINES the step directory (renamed aside, never deleted — it is
+# evidence) and falls back to the previous intact epoch, so the
+# auto-resume path recovers instead of crashing. CRC-32 is an
+# integrity check against accidents, not an authenticity check against
+# adversaries. Manifest-less epochs (pre-upgrade checkpoints, or a
+# save whose process died before ``wait()``) are accepted unverified —
+# integrity never makes old checkpoints unreadable.
+
+MANIFEST_SUFFIX = ".manifest.json"
+QUARANTINE_PREFIX = "quarantine."
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def _manifest_path(root: str, epoch: int) -> str:
+    return os.path.join(root, f"epoch_{epoch}{MANIFEST_SUFFIX}")
+
+
+def build_manifest(step_dir: str) -> dict:
+    """Walk a committed step directory → {relpath: {size, crc32}}."""
+    files: dict[str, dict] = {}
+    for dirpath, _, names in os.walk(step_dir):
+        for name in sorted(names):
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, step_dir)
+            files[rel] = {
+                "size": os.path.getsize(path),
+                "crc32": _crc32_file(path),
+            }
+    return {"version": 1, "files": files}
+
+
+def write_manifest(root: str, epoch: int) -> str | None:
+    """Manifest the committed ``epoch_<N>`` dir (atomic tmp+replace).
+    Returns the manifest path, or None when the step dir is absent."""
+    step_dir = os.path.join(root, f"epoch_{epoch}")
+    if not os.path.isdir(step_dir):
+        return None
+    manifest = build_manifest(step_dir)
+    path = _manifest_path(root, epoch)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def verify_manifest(root: str, epoch: int) -> list[str] | None:
+    """Check ``epoch_<N>`` against its manifest.
+
+    Returns ``None`` when no (readable) manifest exists — the epoch is
+    UNVERIFIABLE and accepted for compatibility; ``[]`` when every
+    listed file matches; otherwise a list of human-readable problems
+    (missing file / size mismatch / checksum mismatch). Files present
+    on disk but absent from the manifest are ignored — descriptors and
+    later tooling may legitimately add them.
+    """
+    path = _manifest_path(root, epoch)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        listed = dict(manifest["files"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    step_dir = os.path.join(root, f"epoch_{epoch}")
+    problems: list[str] = []
+    for rel, meta in sorted(listed.items()):
+        p = os.path.join(step_dir, rel)
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            problems.append(f"{rel}: missing")
+            continue
+        if size != meta.get("size"):
+            problems.append(
+                f"{rel}: size {size} != manifest {meta.get('size')}"
+            )
+            continue
+        if _crc32_file(p) != meta.get("crc32"):
+            problems.append(f"{rel}: checksum mismatch")
+    return problems
 
 
 # --- LM spec sidecar --------------------------------------------------
@@ -264,10 +367,17 @@ class CheckpointManager:
         # Explicit handler so item_metadata works before any save/
         # restore call registered one (the template-free inference path
         # in a fresh process).
+        self._opts = opts
         self._mgr = ocp.CheckpointManager(
             self._dir, options=opts,
             item_handlers=ocp.StandardCheckpointHandler(),
         )
+        # Integrity bookkeeping: epochs saved but not yet manifested
+        # (async saves aren't durable until committed — manifests are
+        # written at the next wait()/save()), and what THIS process
+        # quarantined (the trainer surfaces these as fallback events).
+        self._manifest_pending: set[int] = set()
+        self.quarantined: list[dict] = []
 
     @property
     def directory(self) -> str:
@@ -276,6 +386,166 @@ class CheckpointManager:
     def latest_epoch(self) -> int | None:
         """Discovery: the reference's "latest file in ./checkpoints"."""
         return self._mgr.latest_step()
+
+    # ---- integrity: manifests, verification, quarantine --------------
+
+    @staticmethod
+    def _is_manifest_writer() -> bool:
+        # One writer per world: every process shares the filesystem in
+        # single-host spawns, and concurrent identical writes would
+        # only race on the rename.
+        return jax.process_index() == 0
+
+    def _flush_manifests(self) -> None:
+        """Write manifests for pending epochs that are now COMMITTED.
+
+        Commit is detected by the final ``epoch_<N>`` directory
+        existing — NOT by ``all_steps()``, which orbax populates
+        optimistically at ``save()`` time while an async save is still
+        writing into its ``...orbax-checkpoint-tmp-...`` directory
+        (the atomic rename to ``epoch_<N>`` is the commit point).
+        Cheap to call opportunistically; in-flight saves simply stay
+        pending until ``wait()``/``close()``.
+        """
+        for epoch in sorted(self._manifest_pending):
+            if not os.path.isdir(
+                os.path.join(self._dir, f"epoch_{epoch}")
+            ):
+                continue  # async save not yet committed
+            self._manifest_pending.discard(epoch)
+            if not self._is_manifest_writer():
+                continue
+            try:
+                write_manifest(self._dir, epoch)
+            except OSError as e:  # integrity is best-effort, never fatal
+                logger.warning(
+                    "manifest write for epoch %d failed: %s", epoch, e
+                )
+
+    def _drop_manifest(self, epoch: int) -> None:
+        self._manifest_pending.discard(epoch)
+        try:
+            os.remove(_manifest_path(self._dir, epoch))
+        except OSError:
+            pass
+
+    def _delete_epoch(self, epoch: int) -> None:
+        self._mgr.delete(epoch)
+        self._drop_manifest(epoch)
+
+    def _reload_steps(self) -> None:
+        """Refresh the manager's step view after an out-of-band rename
+        (quarantine). ``reload()`` re-scans the directory — safe
+        because quarantined names use a DASH (``quarantine.epoch-N``):
+        orbax's step scanner splits names on "_", so an underscore
+        name would still parse as step N and the re-scan would then
+        fail to find its directory. Deliberately not a manager
+        rebuild: ``CheckpointManager.__init__``/``close()`` are not
+        process-symmetric-safe, and only SOME ranks reload (a one-rank
+        rebuild deadlocked the multi-process resume)."""
+        self._mgr.reload()
+
+    def verify_epoch(self, epoch: int) -> list[str] | None:
+        """Manifest check → problems ([] ok, None unverifiable)."""
+        return verify_manifest(self._dir, epoch)
+
+    def quarantine_epoch(self, epoch: int, problems: list[str]) -> str | None:
+        """Rename a corrupt epoch ASIDE (never delete — it is the
+        post-mortem evidence) so discovery stops seeing it; its
+        manifest moves inside the quarantined directory. Concurrent
+        ranks race benignly: the loser's rename fails and the epoch is
+        already gone. Returns the quarantine path (None if a peer got
+        there first)."""
+        src = os.path.join(self._dir, f"epoch_{epoch}")
+        # Dash, not underscore: orbax's step scanner splits names on
+        # "_", so "quarantine.epoch_1" would still parse as step 1 —
+        # the quarantined name must not contain an epoch_<N> token.
+        dst = os.path.join(
+            self._dir, f"{QUARANTINE_PREFIX}epoch-{epoch}"
+        )
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(
+                self._dir, f"{QUARANTINE_PREFIX}epoch-{epoch}.{n}"
+            )
+        try:
+            os.rename(src, dst)
+        except OSError:
+            dst = None  # a peer rank quarantined it first
+        else:
+            try:
+                os.replace(
+                    _manifest_path(self._dir, epoch),
+                    os.path.join(dst, "ddp_tpu" + MANIFEST_SUFFIX),
+                )
+            except OSError:
+                pass
+            logger.error(
+                "Checkpoint epoch %d failed integrity verification "
+                "(%s) — quarantined to %s; falling back to the "
+                "previous intact checkpoint",
+                epoch, "; ".join(problems) or "unknown", dst,
+            )
+        self._manifest_pending.discard(epoch)
+        self.quarantined.append(
+            {"epoch": epoch, "path": dst, "problems": list(problems)}
+        )
+        self._reload_steps()
+        return dst
+
+    def latest_intact_epoch(self) -> int | None:
+        """Latest epoch that passes integrity verification, walking
+        backwards past (and quarantining) corrupt ones. Manifest-less
+        epochs are accepted unverified. None when nothing usable is
+        left.
+
+        Multi-process: only process 0 verifies and quarantines —
+        peers would multiply the CRC read of a multi-GB checkpoint by
+        world size and race the quarantine renames. A barrier pairs
+        the two sides (every process calls it exactly once), so peers
+        read the post-quarantine view; this also sequences rank 0's
+        process-start chaos (``ckpt_corrupt``) before any peer's
+        discovery. Assumes the checkpoint dir is one shared (local/
+        NFS) filesystem, like every sidecar here.
+        """
+        multi = jax.process_count() > 1
+
+        def barrier():
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ckpt_integrity_verify")
+
+        if multi and jax.process_index() != 0:
+            barrier()
+            self._reload_steps()  # see process 0's quarantine renames
+            return self._mgr.latest_step()
+        try:
+            while True:
+                epoch = self._mgr.latest_step()
+                if epoch is None:
+                    return None
+                problems = self.verify_epoch(epoch)
+                if not problems:  # [] verified-ok, or None unverifiable
+                    return epoch
+                if self.quarantine_epoch(epoch, problems) is None and (
+                    epoch == self._mgr.latest_step()
+                ):
+                    # The rename failed AND the epoch is still visible
+                    # (read-only dir, not a peer's racing quarantine):
+                    # looping would verify the same bytes forever.
+                    raise RuntimeError(
+                        f"checkpoint epoch {epoch} fails integrity "
+                        f"verification ({'; '.join(problems)}) and "
+                        f"cannot be quarantined — is {self._dir} "
+                        "writable?"
+                    )
+        finally:
+            # Process 0 reaches this on EVERY exit (including the
+            # raise above — peers then fail on their own rather than
+            # hanging in a barrier no one will join).
+            if multi:
+                barrier()
 
     def all_epochs(self) -> list[int]:
         """Every saved epoch tag, ascending."""
@@ -318,7 +588,7 @@ class CheckpointManager:
                     epoch,
                 )
                 return False
-            self._mgr.delete(epoch)
+            self._delete_epoch(epoch)
         # steps_per_epoch and the explicit mid-epoch batch position ride
         # along so resume needs no step-counter arithmetic (which a
         # changed config or an imported foreign checkpoint would break);
@@ -334,6 +604,11 @@ class CheckpointManager:
         self._mgr.save(
             epoch, args=ocp.args.StandardSave(tree), metrics=metrics
         )
+        # Integrity manifest: pending until the (possibly async) save
+        # commits — flushed opportunistically now (earlier saves have
+        # committed by this point) and at wait()/close().
+        self._manifest_pending.add(epoch)
+        self._flush_manifests()
         if self._keep_best_fallback is not None:
             self._prune_keep_best(epoch, metrics)
         return True
@@ -363,15 +638,32 @@ class CheckpointManager:
         keep |= {s for s in steps if s not in seen}  # metric-less saves
         for s in steps:
             if s not in keep:
-                self._mgr.delete(s)
+                self._delete_epoch(s)
 
     def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:
         """Restore → (state, epoch). ``state_like`` supplies the tree
-        structure/shardings (its values are discarded)."""
+        structure/shardings (its values are discarded).
+
+        ``epoch=None`` runs verified discovery: corrupt/truncated
+        epochs are quarantined and discovery falls back to the
+        previous intact one (``latest_intact_epoch``). An EXPLICIT
+        epoch that fails verification raises instead — the caller
+        named that state on purpose; silently substituting another
+        would be worse than failing.
+        """
         if epoch is None:
-            epoch = self.latest_epoch()
+            epoch = self.latest_intact_epoch()
             if epoch is None:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        else:
+            problems = self.verify_epoch(epoch)
+            if problems:
+                raise RuntimeError(
+                    f"checkpoint epoch {epoch} fails integrity "
+                    f"verification: {'; '.join(problems)} — restore a "
+                    "different epoch, or delete its manifest to force "
+                    "an unverified read"
+                )
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like._asdict())
         abstract["spe"] = jax.ShapeDtypeStruct((), np.int32)
         abstract["mid_batch"] = jax.ShapeDtypeStruct((), np.int32)
@@ -427,7 +719,7 @@ class CheckpointManager:
         """
         stale = sorted(e for e in (self._mgr.all_steps() or []) if e > epoch)
         for e in stale:
-            self._mgr.delete(e)
+            self._delete_epoch(e)
         return stale
 
     _pytree_mgr = None
@@ -485,12 +777,20 @@ class CheckpointManager:
 
         Inference tooling (scripts/predict.py) loads ANY run's
         checkpoint without knowing which optimizer produced it; the
-        optimizer state is never read.
+        optimizer state is never read. Discovery is integrity-verified
+        like ``restore`` (corrupt latest → quarantine + fall back).
         """
         if epoch is None:
-            epoch = self.latest_epoch()
+            epoch = self.latest_intact_epoch()
             if epoch is None:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        else:
+            problems = self.verify_epoch(epoch)
+            if problems:
+                raise RuntimeError(
+                    f"checkpoint epoch {epoch} fails integrity "
+                    f"verification: {'; '.join(problems)}"
+                )
         restored = self.read_partial(epoch, ("params", "model_state", "fmt"))
         fmt = restored.pop("fmt", None)
         _check_qkv_format(
@@ -508,20 +808,42 @@ class CheckpointManager:
         Mirrors train_ddp.py:49-89's flag dance — resume from latest
         epoch + 1 when a checkpoint exists, else epoch 0 fresh.
         """
-        latest = self.latest_epoch()
-        if latest is None:
+        # Single-process only: multi-process ranks may reach this
+        # pre-check at different times relative to process 0's
+        # quarantine renames, and a rank that short-circuits here
+        # would skip the verification barrier its peers are blocked
+        # in. Multi-process ALWAYS enters restore() (the barrier
+        # pairs), and "nothing usable" surfaces as FileNotFoundError
+        # on every rank consistently.
+        if jax.process_count() == 1 and self.latest_epoch() is None:
             logger.info("No checkpoint found — starting from scratch")
             return state, 0
-        restored, epoch = self.restore(state, latest)
+        try:
+            # epoch=None → verified discovery with quarantine fallback.
+            restored, epoch = self.restore(state, None)
+        except FileNotFoundError:
+            # Nothing to restore — either the directory is empty, or
+            # EVERY checkpoint failed verification and was quarantined
+            # (recompute beats restoring corruption, and the
+            # quarantined evidence survives for the post-mortem).
+            logger.warning(
+                "No intact checkpoint in %s (%d quarantined) — "
+                "starting from scratch",
+                self._dir, len(self.quarantined),
+            )
+            return state, 0
         logger.info("Resumed from checkpoint epoch %d", epoch)
         return restored, epoch + 1
 
     def wait(self) -> None:
-        """Block until async saves are durable (call before exit)."""
+        """Block until async saves are durable (call before exit);
+        durable saves then get their integrity manifests."""
         self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
         self._mgr.close()
         if self._pytree_mgr is not None:
             self._pytree_mgr.close()

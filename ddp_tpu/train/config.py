@@ -185,6 +185,22 @@ class TrainConfig:
     # http://127.0.0.1:PORT/metricsz (obs/promtext.py). None = off;
     # 0 binds an ephemeral port (logged at startup).
     metrics_port: int | None = None
+    # Deterministic fault injection (runtime/chaos.py): a comma-
+    # separated schedule of kills / SIGTERMs / input stalls /
+    # checkpoint corruption at exact steps/epochs, e.g.
+    # "kill:rank1@step20,stall:input@step5:2.5s,ckpt_corrupt:latest".
+    # Every event fires ONCE across restarts (per-rank ledger next to
+    # the checkpoints) — see docs/ROBUSTNESS.md for the grammar.
+    chaos: str | None = None
+    # Restart-with-resume under --spawn: when a rank dies, the
+    # launcher reaps the whole world and relaunches it (fresh
+    # coordinator, exponential backoff) up to this many times; each
+    # generation auto-resumes from the latest checkpoint and counts
+    # as a restart in goodput.json. 0 = fail fast (the old behavior).
+    max_restarts: int = 0
+    # Base seconds for the launcher's exponential restart backoff
+    # (backoff = restart_backoff * 2^i, capped at 30 s).
+    restart_backoff: float = 1.0
 
     # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
     # + env:// rendezvous at utils.py:7-11)
@@ -338,6 +354,23 @@ class TrainConfig:
             "--metrics_port", type=int, default=None,
             help="serve live train counters as Prometheus text at "
             "/metricsz on this port (0 = ephemeral)",
+        )
+        p.add_argument(
+            "--chaos", default=None, metavar="SPEC",
+            help="deterministic fault injection, e.g. "
+            "'kill:rank1@step20,sigterm:rank0@epoch1,"
+            "stall:input@step5:2.5s,ckpt_corrupt:latest' "
+            "(docs/ROBUSTNESS.md; events fire once across restarts)",
+        )
+        p.add_argument(
+            "--max_restarts", type=int, default=cls.max_restarts,
+            help="with --spawn: relaunch the whole world from the "
+            "latest checkpoint up to N times after a rank dies",
+        )
+        p.add_argument(
+            "--restart_backoff", type=float,
+            default=cls.restart_backoff,
+            help="base seconds for the exponential restart backoff",
         )
         # Discovery: print the registries and exit (handled in train.py
         # before config construction).

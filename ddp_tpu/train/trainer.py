@@ -51,7 +51,8 @@ from ddp_tpu.parallel.ddp import (
     make_train_step,
     replicate_state,
 )
-from ddp_tpu.runtime import dist
+from ddp_tpu.runtime import consensus, dist
+from ddp_tpu.runtime.chaos import ChaosEngine
 from ddp_tpu.runtime.mesh import MeshSpec, data_axes, make_mesh
 from ddp_tpu.train.checkpoint import CheckpointManager
 from ddp_tpu.train.config import TrainConfig
@@ -171,23 +172,18 @@ class Trainer:
                 "the pipe_vit step does not (it reports no grad_norm "
                 "either) — use pipe_lm or a non-pipe model"
             )
-        if (
-            config.health
-            and config.health_action != "warn"
-            and self.ctx.num_processes > 1
-        ):
-            # Straggler/recompile events come from HOST-local signals
-            # (wall-clock deltas, the process compile counter), so one
-            # rank can see an anomaly its peers don't — but ckpt.save
-            # is collective and a one-rank halt leaves peers blocked
-            # in the next step's collective. Cross-host agreement
-            # (the _preempt_agreed pattern) is the upgrade path; until
-            # then only the log-only action is multi-process-safe.
-            raise ValueError(
-                "--health_action checkpoint/halt acts on rank-local "
-                "sentry events but checkpointing is collective — "
-                "multi-process runs must use --health_action warn"
-            )
+        # Multi-process --health_action checkpoint|halt: sentry events
+        # come from HOST-local signals (wall-clock deltas, the process
+        # compile counter), so one rank can see an anomaly its peers
+        # don't — but ckpt.save is collective and a one-rank halt
+        # leaves peers blocked in the next step's collective. Events
+        # are therefore DEFERRED to the next agreement point (the same
+        # deterministic cadence the preemption flag uses), where one
+        # allgather (runtime/consensus.agree_any) turns "any rank saw
+        # it" into "every rank acts together" — the PR-4 restriction,
+        # lifted. Deferred events ride these queues:
+        self._pending_halt: list[dict] = []
+        self._pending_rescue: list[dict] = []
         # Keyword bundle for the step builders that support the fused
         # health pass; {} leaves unsupported builders' graphs untouched.
         hkw = (
@@ -1155,6 +1151,26 @@ class Trainer:
         )
         # Constructed here, armed in train() (start/stop bracket the run).
         self._watchdog = StepWatchdog(config.watchdog_timeout)
+        # Deterministic fault injection (--chaos, runtime/chaos.py):
+        # each rank arms its share of the plan; the per-rank ledger
+        # next to the checkpoints makes every event once-only across
+        # restarts, so a relaunch loop recovers instead of re-dying.
+        self._chaos = ChaosEngine(
+            config.chaos,
+            rank=self.ctx.process_id,
+            ledger_path=os.path.join(
+                config.checkpoint_dir,
+                f"chaos_ledger.rank{self.ctx.process_id}.json",
+            ),
+            seed=config.seed,
+        )
+        if self._chaos.has_step_events() and config.fast_epoch:
+            raise ValueError(
+                "--chaos step-triggered events need the per-step loop, "
+                "but --fast_epoch runs a whole epoch as ONE dispatch — "
+                "use epoch-triggered events (…@epochN) or drop "
+                "--fast_epoch"
+            )
         # Flight recorder: host-dict ring next to the checkpoints, one
         # file per rank; the directory is only created on dump (a
         # Trainer that never trains must not create checkpoint_dir).
@@ -1188,6 +1204,11 @@ class Trainer:
             recorder=self._recorder,
         )
         self._last_health_ckpt: int | None = None
+        # Epoch tag held by a rescue save from THIS run: the boundary
+        # save must then force-overwrite, or the completed epoch's
+        # state (and its keep_best metric) would silently stay the
+        # stale mid-epoch rescue until epoch+1 commits.
+        self._rescued_epoch: int | None = None
         # Live Prometheus exposition (--metrics_port): one daemon
         # thread serving /metricsz from the snapshot dict the loop
         # updates at the log cadence. Stopped in close().
@@ -1352,7 +1373,14 @@ class Trainer:
     ) -> None:
         """Apply --health_action to a batch of sentry/provenance
         events. ``ran`` = batches completed within this epoch (the
-        mid-epoch checkpoint position, host-known — no sync)."""
+        mid-epoch checkpoint position, host-known — no sync).
+
+        Single process acts immediately. Multi-process DEFERS: the
+        events are rank-local but halt/checkpoint are collective, so
+        they queue for the next agreement point (``_sync_flags`` at
+        the log cadence / epoch boundary), where every rank adopts the
+        OR and enters the collective action together.
+        """
         for ev in events:
             logger.warning(
                 "health[%s] at step %s: %s",
@@ -1361,6 +1389,14 @@ class Trainer:
                 {k: v for k, v in ev.items() if k not in ("detector", "step")},
             )
         action = self.config.health_action
+        if action != "warn" and self.ctx.num_processes > 1:
+            if action == "halt":
+                self._pending_halt.extend(events)
+            else:  # checkpoint: nonfinite states are never rescuable
+                self._pending_rescue.extend(
+                    e for e in events if e.get("detector") != "nonfinite"
+                )
+            return
         if action == "halt":
             dump = self._recorder.dump("health_halt")
             raise HealthHaltError(list(events), dump_path=dump)
@@ -1387,6 +1423,7 @@ class Trainer:
             ):
                 return
             self._last_health_ckpt = step
+            self._rescued_epoch = epoch
             spe = self.loader.steps_per_epoch()
             self.ckpt.save(
                 epoch, self.state, overwrite=True, steps_per_epoch=spe,
@@ -1435,28 +1472,67 @@ class Trainer:
         except ValueError:  # non-main interpreter contexts
             return (False, None)
 
-    def _preempt_agreed(self) -> bool:
-        """Cross-host agreement on the preemption flag.
-
-        Single process: the local flag. Multi-host: SIGTERM lands on
-        hosts at different times, so every process contributes its flag
-        to an all-gather and all adopt the OR — callers invoke this at
-        deterministic points (a fixed batch cadence, epoch boundaries)
-        so every process takes the same branch with identical state and
-        the subsequent collective checkpoint save is safe.
+    def _sync_flags(self, host_step: int) -> tuple[bool, bool, bool]:
+        """ONE allgather carrying the three rank-local escalations →
+        world-agreed (preempt, halt, rescue). A collective call: every
+        rank must reach it at the same deterministic point (the log
+        cadence in the step loop, and each epoch boundary). The rescue
+        flag already folds in this rank's throttle window so an agreed
+        rescue is performed by every rank unconditionally — any
+        post-agreement local filtering would desynchronize the
+        collective save.
         """
-        if self.ctx.num_processes == 1:
-            return self._preempt_requested
-        from jax.experimental import multihost_utils
-
-        agreed = bool(
-            multihost_utils.process_allgather(
-                np.asarray(self._preempt_requested)
-            ).any()
+        rescue_ok = (
+            self._last_health_ckpt is None
+            or host_step - self._last_health_ckpt
+            >= self.config.health_window
         )
-        if agreed:
+        pre, halt, rescue = consensus.agree_any(
+            [
+                self._preempt_requested,
+                bool(self._pending_halt),
+                bool(self._pending_rescue) and rescue_ok,
+            ],
+            num_processes=self.ctx.num_processes,
+        )
+        if pre:
             self._preempt_requested = True
-        return agreed
+        return pre, halt, rescue
+
+    def _act_on_agreed(
+        self, halt: bool, rescue: bool, *, epoch: int, ran: int,
+        host_step: int,
+    ) -> None:
+        """Perform the world-agreed health action on THIS rank.
+
+        Every rank calls this after ``_sync_flags`` said halt/rescue,
+        with identical (epoch, ran, host_step) — ranks whose own
+        sentry saw nothing still participate (their event list is a
+        ``peer`` placeholder): the save is collective and the halt
+        must take every rank down together, not strand survivors in
+        the next step's collective.
+        """
+        if halt:
+            events = self._pending_halt or [
+                {"detector": "peer", "step": host_step}
+            ]
+            self._pending_halt = []
+            dump = self._recorder.dump("health_halt")
+            raise HealthHaltError(list(events), dump_path=dump)
+        if rescue:
+            self._pending_rescue = []
+            self._last_health_ckpt = host_step
+            self._rescued_epoch = epoch
+            spe = self.loader.steps_per_epoch()
+            self.ckpt.save(
+                epoch, self.state, overwrite=True, steps_per_epoch=spe,
+                mid_batch=ran if 0 < ran < spe else 0,
+            )
+            self.ckpt.wait()
+            logger.warning(
+                "health: world-agreed checkpoint-and-continue saved "
+                "epoch %d at batch %d (step %d)", epoch, ran, host_step,
+            )
 
     def _restore_or_init(self):
         """Auto-resume, tolerant of --ema_decay being turned ON since
@@ -1492,12 +1568,20 @@ class Trainer:
         if self.config.reset_opt_state:
             # Weights only; the optimizer (schedules, moments, step
             # counter, EMA) starts fresh — the explicit recipe-change
-            # path, layout-independent by construction.
-            if self.ckpt.latest_epoch() is None:
+            # path, layout-independent by construction. No
+            # latest_epoch() pre-check: in multi-process runs a rank
+            # short-circuiting on a racing view of the directory would
+            # skip the verification barrier inside the restore (the
+            # restore_or_init pairing rule) — absence surfaces as
+            # FileNotFoundError on every rank consistently instead.
+            try:
+                params, model_state, epoch = (
+                    self.ckpt.restore_for_inference(
+                        self.config.resume_epoch
+                    )
+                )
+            except FileNotFoundError:
                 return self.state, 0
-            params, model_state, epoch = self.ckpt.restore_for_inference(
-                self.config.resume_epoch
-            )
             if self.config.resume_epoch is not None:
                 prune_rewound_branch(epoch)
             # A mid-epoch preemption artifact (mid_batch > 0) tags an
@@ -1603,7 +1687,30 @@ class Trainer:
             from ddp_tpu.train.checkpoint import save_lm_spec
 
             save_lm_spec(cfg.checkpoint_dir, self.seq_spec)
+        # Process-start chaos (ckpt_corrupt) fires BEFORE discovery so
+        # the integrity/quarantine fallback below is what it drills.
+        self._chaos.on_start(cfg.checkpoint_dir)
         self.state, start_epoch = self._restore_or_init()
+        # Integrity fallbacks during discovery (train/checkpoint.py):
+        # a corrupt latest was quarantined and an earlier epoch
+        # restored. Surface each as a metrics record + flight-recorder
+        # event so triage (scripts/health_report.py) sees WHAT state
+        # the run actually resumed from.
+        resumed = start_epoch - 1 if start_epoch > 0 else None
+        for q in self.ckpt.quarantined:
+            self.metrics_writer.write(
+                "fallback",
+                epoch=q["epoch"],
+                resumed_epoch=resumed,
+                quarantined_path=q["path"],
+                problems=q["problems"][:8],
+            )
+            self._recorder.record(
+                "ckpt_fallback",
+                epoch=q["epoch"],
+                resumed_epoch=resumed,
+                problems=q["problems"][:8],
+            )
         # Restart-aware goodput: the sidecar (if any) carries the
         # first launch's clock and prior productive seconds, so a
         # preempt/resume cycle accumulates instead of resetting.
@@ -1617,7 +1724,10 @@ class Trainer:
             rank=self.ctx.process_id,
             num_processes=self.ctx.num_processes,
         )
-        self._recorder.record("run_start", start_epoch=start_epoch)
+        self._recorder.record(
+            "run_start", start_epoch=start_epoch,
+            restarts=self._goodput.restarts,
+        )
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit
         # mid_batch marker; resume re-enters that epoch at that batch.
@@ -1684,11 +1794,25 @@ class Trainer:
                     with self.tracer.span("epoch", {"epoch": epoch}):
                         stats = self._train_epoch(epoch, skip)
                     # Agreement at the epoch boundary: a SIGTERM that
-                    # landed after the last in-loop cadence check must
-                    # still stop every host on the same side of the
-                    # epoch, or survivors would block in the next
-                    # epoch's first collective.
-                    if self._preempt_agreed():
+                    # landed after the last in-loop cadence check —
+                    # or a health event the monitor drained at the
+                    # epoch tail — must still stop every host on the
+                    # same side of the epoch, or survivors would
+                    # block in the next epoch's first collective.
+                    if self.ctx.num_processes > 1:
+                        boundary_step = int(self.state.step)
+                        pre, halt, rescue = self._sync_flags(
+                            boundary_step
+                        )
+                        if halt or rescue:
+                            ran = boundary_step - epoch_start_step + skip
+                            self._act_on_agreed(
+                                halt, rescue, epoch=epoch, ran=ran,
+                                host_step=boundary_step,
+                            )
+                    else:
+                        pre = self._preempt_requested
+                    if pre:
                         # Mid-epoch state, tagged with the incomplete
                         # epoch; overwrite any older preemption save.
                         # No metrics on purpose: metric-less saves are
@@ -1737,7 +1861,16 @@ class Trainer:
                             epoch, self.state, steps_per_epoch=spe,
                             metrics=metrics,
                         )
-                    if not saved and epoch == cfg.epochs - 1:
+                    if not saved and (
+                        epoch == cfg.epochs - 1
+                        or self._rescued_epoch == epoch
+                    ):
+                        # The tag is held by the LAST epoch's earlier
+                        # artifact, or by THIS run's mid-epoch rescue
+                        # save — both must be superseded by the
+                        # completed-epoch state (with its keep_best
+                        # metric). Prior-run preemption artifacts keep
+                        # the redo-on-crash semantics above.
                         self.ckpt.save(
                             epoch, self.state, overwrite=True,
                             steps_per_epoch=spe, metrics=metrics,
@@ -1857,6 +1990,9 @@ class Trainer:
     MAX_INFLIGHT_STEPS = 8
 
     def _train_epoch(self, epoch: int, skip_batches: int = 0) -> EpochStats:
+        # Epoch-triggered chaos (…@epochN) fires on BOTH paths; step
+        # triggers need the per-step loop (guarded at construction).
+        self._chaos.on_epoch(epoch)
         if self.fast_runner is not None:
             # The fast path has no mid-epoch granularity (one dispatch
             # per epoch); preemption is honored between epochs.
@@ -1882,6 +2018,11 @@ class Trainer:
             attr.batches(self.loader.epoch(epoch, skip_batches)),
             start=skip_batches,
         ):
+            # Chaos trigger point (--chaos): "step N" fires before the
+            # dispatch that would run global step N — kills/SIGTERMs
+            # land here, input stalls sleep here (the straggler sentry
+            # and goodput accounting see them like real ones).
+            self._chaos.on_step(step0 + n_batches)
             self.state, metrics = self.train_step(
                 self.state, batch.images, batch.labels
             )
@@ -1911,12 +2052,21 @@ class Trainer:
             if self.ctx.num_processes == 1:
                 if self._preempt_requested:
                     break  # caller checkpoints the mid-epoch state
-            elif batch_idx % cfg.log_interval == 0 and self._preempt_agreed():
+            elif batch_idx % cfg.log_interval == 0:
                 # Multi-host: breaking on the local flag alone would
-                # leave peers blocked in the next step's collective;
-                # _preempt_agreed runs at this deterministic cadence so
-                # every process exits at the SAME batch.
-                break
+                # leave peers blocked in the next step's collective.
+                # ONE agreement gather at this deterministic cadence
+                # carries the preemption flag AND the deferred health
+                # escalations (_on_health_events), so every process
+                # halts / checkpoints / exits at the SAME batch.
+                pre, halt, rescue = self._sync_flags(host_step)
+                if halt or rescue:
+                    self._act_on_agreed(
+                        halt, rescue, epoch=epoch, ran=batch_idx + 1,
+                        host_step=host_step,
+                    )
+                if pre:
+                    break
             if batch_idx % cfg.log_interval == 0:
                 # train_ddp.py:201-202 parity: rank-0 loss print. .item()
                 # syncs, so only at the log cadence.

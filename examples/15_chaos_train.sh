@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Fault-tolerance drills (docs/ROBUSTNESS.md): deterministic chaos
+# injection, restart-with-resume, and the checkpoint-integrity
+# fallback — all on a CPU dev box. Failure is the common case on
+# preemptible fleets; this is how the recovery paths stay exercised.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example15}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. Kill-and-recover: a 2-process run where rank 1 is SIGKILLed
+#    mid-epoch-1 (after epoch 0's checkpoint committed). The launcher
+#    classifies the death, reaps the surviving rank out of its hung
+#    collective, backs off, and relaunches the world — which
+#    auto-resumes from the latest checkpoint. The chaos ledger
+#    (chaos_ledger.rank1.json) stops the kill from re-firing, so the
+#    relaunch replays the lost steps and completes.
+python train.py --spawn 2 --epochs 2 --batch_size 4 \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --log_interval 4 --eval_every 0 \
+    --chaos "kill:rank1@step12" --max_restarts 2 --restart_backoff 0.5
+
+# goodput.json accumulated across the kill: exactly one restart, and
+# the wall clock still runs from the FIRST launch.
+python - <<PY
+import json
+side = json.load(open("$WORK/ck/goodput.json"))
+print("restarts:", side["restarts"], " productive_s:", round(side["productive_s"], 2))
+assert side["restarts"] == 1
+ledger = json.load(open("$WORK/ck/chaos_ledger.rank1.json"))
+print("chaos ledger:", ledger["fired"])
+PY
+
+# 2. Checkpoint-integrity fallback: corrupt the LATEST checkpoint on
+#    disk (the torn-write drill, ckpt_corrupt:latest fires at process
+#    start, before discovery). The per-save manifest catches it, the
+#    corrupt directory is QUARANTINED (renamed aside, never deleted),
+#    and auto-resume falls back to the previous intact epoch instead
+#    of crashing. Asking for one more epoch gives the run work to do.
+python train.py --epochs 3 --batch_size 4 \
+    --emulate_devices 2 --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --log_interval 4 --eval_every 0 \
+    --chaos "ckpt_corrupt:latest"
+
+ls "$WORK/ck" | grep quarantine   # the evidence survives
+grep '"kind": "fallback"' "$WORK/metrics.jsonl"
+
+# 3. The triage line: restarts + fallbacks in one screen.
+python scripts/health_report.py "$WORK/metrics.jsonl"

@@ -138,6 +138,27 @@ def build_report(records: list[dict]) -> str:
         if epoch_gps:
             lines.append(f"goodput       : {_fmt(epoch_gps[-1], 6)}")
 
+    # Restart/fallback triage (the fault-tolerance layer): how many
+    # times the run was relaunched, and whether auto-resume ever had
+    # to quarantine a corrupt checkpoint and fall back to an earlier
+    # epoch ("fallback" records from the trainer's restore path).
+    fallbacks = [r for r in records if r.get("kind") == "fallback"]
+    restart_n = (
+        final_gp.get("restarts") if isinstance(final_gp, dict) else None
+    )
+    if restart_n or fallbacks:
+        lines.append(
+            f"restarts      : {restart_n or 0} restart(s), "
+            f"{len(fallbacks)} checkpoint fallback(s)"
+        )
+        if fallbacks:
+            fb = fallbacks[-1]
+            lines.append(
+                f"                last fallback: epoch "
+                f"{_fmt(fb.get('epoch'))} quarantined -> resumed "
+                f"epoch {_fmt(fb.get('resumed_epoch'))}"
+            )
+
     recompiles = sum(e.get("recompiles", 0) for e in epochs)
     if any("recompiles" in e for e in epochs):
         lines.append(f"recompiles    : {recompiles}")

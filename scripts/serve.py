@@ -87,6 +87,12 @@ def main() -> None:
         help="bounded trace memory: keep the last N events",
     )
     p.add_argument(
+        "--drain_timeout", type=float, default=30.0,
+        help="SIGTERM graceful drain: stop admitting (503 + "
+        "Retry-After), let running lanes finish up to this many "
+        "seconds, then exit cleanly",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -154,6 +160,17 @@ def main() -> None:
         # bucket width + decode) before the first request arrives:
         # first-request TTFT is then a decode step, not an XLA build.
         engine.warmup()
+    # Graceful drain on SIGTERM (the preemption signal): the handler
+    # only sets an event; the main thread wakes, stops admitting
+    # (503 + Retry-After), waits for running lanes up to
+    # --drain_timeout, and exits through the normal telemetry-flush
+    # path below. Installed before serving so a reclaim racing
+    # startup still drains.
+    import signal
+    import threading
+
+    stop_event = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_event.set())
     try:
         with LMServer(engine, host=args.host, port=args.port) as server:
             print(
@@ -177,11 +194,21 @@ def main() -> None:
                 flush=True,
             )
             try:
-                import threading
-
-                threading.Event().wait()  # serve until interrupted
+                stop_event.wait()  # serve until SIGTERM (or ctrl-C)
             except KeyboardInterrupt:
                 pass
+            if stop_event.is_set():
+                drained = server.drain(args.drain_timeout)
+                print(
+                    json.dumps(
+                        {
+                            "draining": True,
+                            "drained": drained,
+                            "drain_timeout": args.drain_timeout,
+                        }
+                    ),
+                    flush=True,
+                )
     finally:
         # Short sessions must keep their telemetry tail: the span
         # trace exports on the way out (crash-safe tmp+rename) and

@@ -126,6 +126,11 @@ _SMOKE_PATTERNS = (
     # serving: admission front door + the static-shape pin
     "test_serve.py::TestScheduler::test_admission_control",
     "test_serve.py::TestEngine::test_no_recompilation_after_warmup",
+    # fault tolerance: chaos-spec round-trip property + the
+    # corruption→quarantine→fallback pin (ISSUE 5 smoke-tier entries)
+    "test_chaos.py::test_chaos_spec_roundtrip_property",
+    "test_chaos.py::test_corrupt_latest_quarantines_and_falls_back",
+    "test_fetch.py::test_retries_transient_then_succeeds",
     # config / metrics / watchdog / optim
     "test_config.py::test_reference_defaults",
     "test_metrics.py::test_writer_disabled_is_noop",
@@ -167,6 +172,7 @@ _SLOW_PATTERNS = (
     "test_pipe_fsdp.py::TestGPipeFsdp::test_matches_data_axis_run",
     "test_pipe_fsdp.py::TestGPipeFsdp::test_params_and_moments_rest_sharded",
     "test_pipeline_lm.py::test_interleaved_virtual_stages_match_sequential",
+    "test_chaos.py::test_chaos_sigterm_preempts_then_resume_completes",
     "test_preemption.py::test_preempt_after_imported_checkpoint_resumes_exactly",
     "test_preemption.py::test_preempt_mid_epoch_then_resume_exactly",
     "test_remat.py::test_remat_with_dropout_same_rng_stream",

@@ -1,0 +1,488 @@
+"""Fault-tolerance layer: chaos injection, consensus, restart-with-
+resume, checkpoint integrity/quarantine fallback.
+
+Acceptance pins (ISSUE 5):
+
+1. **Kill-and-recover e2e** — a 2-process run with
+   ``kill:rank1@step…`` and ``max_restarts=2`` completes; the final
+   metrics match an uninjected run; ``goodput.json`` records exactly
+   one restart (slow tier — real spawned worlds).
+2. **Corruption fallback** — a corrupted latest checkpoint is
+   quarantined (renamed aside, never deleted) and auto-resume falls
+   back to the previous intact epoch (fast smoke tier).
+3. **Consensus halt** — ``--health_action halt`` takes down ALL ranks
+   of a 2-process run together via agreement, never stranding a peer
+   in a collective (slow tier).
+4. **The chaos spec round-trips** — format(parse(s)) is stable for
+   every valid plan (seeded property test, smoke tier).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ddp_tpu.runtime.chaos import (
+    ChaosEngine,
+    ChaosEvent,
+    corrupt_latest_checkpoint,
+    format_chaos,
+    parse_chaos,
+)
+from ddp_tpu.runtime.consensus import agree_all, agree_any
+from ddp_tpu.runtime.launch import classify_exit, spawn
+
+
+# ---- spec parser -----------------------------------------------------
+
+
+def test_chaos_spec_roundtrip_property():
+    """Seeded property test: any generated plan formats to a spec that
+    parses back EQUAL — the grammar and the formatter cannot drift."""
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        events = []
+        for _ in range(int(rng.integers(1, 6))):
+            kind = ("kill", "sigterm", "stall", "ckpt_corrupt")[
+                int(rng.integers(0, 4))
+            ]
+            at = int(rng.integers(0, 10_000))
+            by_step = bool(rng.integers(0, 2))
+            if kind == "ckpt_corrupt":
+                events.append(ChaosEvent(kind="ckpt_corrupt"))
+            elif kind == "stall":
+                events.append(
+                    ChaosEvent(
+                        kind="stall",
+                        step=at if by_step else None,
+                        epoch=None if by_step else at,
+                        # one decimal place: the formatter prints %g,
+                        # so generate only exactly-representable specs
+                        seconds=round(float(rng.integers(1, 400)) / 10, 1),
+                    )
+                )
+            else:
+                events.append(
+                    ChaosEvent(
+                        kind=kind,
+                        rank=int(rng.integers(0, 16)),
+                        step=at if by_step else None,
+                        epoch=None if by_step else at,
+                    )
+                )
+        spec = format_chaos(events)
+        assert parse_chaos(spec) == tuple(events), spec
+        assert format_chaos(parse_chaos(spec)) == spec
+
+
+def test_chaos_spec_parses_documented_example_and_rejects_garbage():
+    ev = parse_chaos(
+        "kill:rank1@step20,sigterm:rank0@epoch1,"
+        "ckpt_corrupt:latest,stall:input@step5:2.5s"
+    )
+    assert [e.kind for e in ev] == [
+        "kill", "sigterm", "ckpt_corrupt", "stall",
+    ]
+    assert ev[0] == ChaosEvent(kind="kill", rank=1, step=20)
+    assert ev[3].seconds == 2.5
+    assert parse_chaos(None) == () and parse_chaos("  ") == ()
+    for bad in (
+        "kill:rank1",          # no trigger point
+        "kill@step3",          # no rank
+        "stall:input@step3",   # no duration
+        "stall:input@step3:0s",  # zero duration
+        "explode:rank0@step1",   # unknown kind
+        "ckpt_corrupt:oldest",   # only 'latest' exists
+    ):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+def test_chaos_ledger_fires_once_across_engines(tmp_path):
+    """An event fires exactly once per ledger — the property that lets
+    a restart loop replay the same steps without replaying the fault."""
+    ledger = str(tmp_path / "ledger.json")
+    sleeps = []
+    ev = parse_chaos("stall:input@step3:0.5s")
+    eng = ChaosEngine(ev, rank=0, ledger_path=ledger)
+    import ddp_tpu.runtime.chaos as chaos_mod
+
+    orig_sleep = chaos_mod.time.sleep
+    chaos_mod.time.sleep = lambda s: sleeps.append(s)
+    try:
+        eng.on_step(2)
+        assert sleeps == []
+        eng.on_step(3)
+        assert sleeps == [0.5]
+        eng.on_step(3)  # same process: once only
+        assert sleeps == [0.5]
+        # a NEW engine (the relaunched process) reads the ledger
+        eng2 = ChaosEngine(ev, rank=0, ledger_path=ledger)
+        eng2.on_step(3)
+        assert sleeps == [0.5]
+        # ... and a different rank never owned a rank-targeted event
+        kill = ChaosEngine(
+            parse_chaos("kill:rank1@step3"), rank=0,
+            ledger_path=str(tmp_path / "l0.json"),
+        )
+        kill.on_step(3)  # would SIGKILL us if mis-targeted
+    finally:
+        chaos_mod.time.sleep = orig_sleep
+
+
+# ---- consensus -------------------------------------------------------
+
+
+def test_consensus_agree_any_all():
+    # single process: identity, no collectives touched
+    assert agree_any(True, num_processes=1) is True
+    assert agree_any(False, num_processes=1) is False
+    assert agree_any([True, False], num_processes=1) == [True, False]
+    assert agree_all([True, False], num_processes=1) == [True, False]
+    # forced multi-process in a 1-process world: the gather runs for
+    # real and reduces over the (single-row) world axis elementwise
+    assert agree_any([True, False, True], num_processes=2) == [
+        True, False, True,
+    ]
+    assert agree_all([True, True], num_processes=2) == [True, True]
+    assert agree_any(False, num_processes=2) is False
+
+
+# ---- exit classification ---------------------------------------------
+
+
+def test_classify_exit():
+    import signal
+
+    assert "SIGKILL" in classify_exit(-signal.SIGKILL)
+    assert "SIGTERM" in classify_exit(-signal.SIGTERM)
+    assert "watchdog" in classify_exit(124)
+    assert "exit 1" in classify_exit(1)
+    assert classify_exit(None) == "unknown"
+
+
+# ---- checkpoint integrity: corruption → quarantine → fallback --------
+
+
+def _tiny_state(value: float):
+    """A minimal TrainState-shaped tree (fast orbax round-trips)."""
+    import jax.numpy as jnp
+
+    from ddp_tpu.parallel.ddp import TrainState
+
+    return TrainState(
+        step=jnp.asarray(int(value), jnp.int32),
+        params={"w": jnp.full((8, 8), value, jnp.float32)},
+        opt_state={"m": jnp.zeros((8, 8), jnp.float32)},
+        model_state={},
+    )
+
+
+def test_corrupt_latest_quarantines_and_falls_back(tmp_path):
+    """The smoke-tier fallback pin: corrupt "latest" on disk →
+    discovery quarantines it (renamed aside, NEVER deleted) and
+    restores the previous intact epoch instead of crashing."""
+    from ddp_tpu.train.checkpoint import CheckpointManager, verify_manifest
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, _tiny_state(0.0))
+    mgr.save(1, _tiny_state(1.0))
+    mgr.wait()  # manifests flush once saves are durable
+    assert verify_manifest(d, 0) == [] and verify_manifest(d, 1) == []
+
+    victim = corrupt_latest_checkpoint(d, seed=0)
+    assert victim and "epoch_1" in victim
+    problems = verify_manifest(d, 1)
+    assert problems and "size" in problems[0]
+
+    state, epoch = mgr.restore(_tiny_state(9.0))
+    assert epoch == 0
+    assert float(np.asarray(state.params["w"])[0, 0]) == 0.0
+    assert mgr.quarantined and mgr.quarantined[0]["epoch"] == 1
+    names = sorted(os.listdir(d))
+    assert any(n.startswith("quarantine.epoch-1") for n in names)
+    assert "epoch_1" not in names  # gone from discovery...
+    assert os.path.isdir(mgr.quarantined[0]["path"])  # ...but preserved
+
+    # restore_or_init: everything corrupt → recompute from scratch
+    corrupt_latest_checkpoint(d, seed=0)
+    _, start = mgr.restore_or_init(_tiny_state(9.0))
+    assert start == 0
+    mgr.close()
+
+
+def test_explicit_epoch_restore_refuses_corruption(tmp_path):
+    """An EXPLICITLY requested epoch that fails verification raises —
+    silently substituting another state would be worse than failing."""
+    from ddp_tpu.train.checkpoint import CheckpointManager
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, _tiny_state(0.0))
+    mgr.wait()
+    corrupt_latest_checkpoint(d, seed=0)
+    with pytest.raises(RuntimeError, match="integrity"):
+        mgr.restore(_tiny_state(9.0), 0)
+    mgr.close()
+
+
+def test_manifest_detects_missing_and_mutated_files(tmp_path):
+    from ddp_tpu.train.checkpoint import (
+        CheckpointManager,
+        verify_manifest,
+        write_manifest,
+    )
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, _tiny_state(0.0))
+    mgr.wait()
+    step_dir = os.path.join(d, "epoch_0")
+    files = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(step_dir)
+        for f in fs
+    ]
+    victim = max(files, key=os.path.getsize)
+    # same-size byte flip → crc mismatch, not size mismatch
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+    problems = verify_manifest(d, 0)
+    assert problems and "checksum" in problems[0]
+    # missing file
+    os.remove(victim)
+    assert any("missing" in p for p in verify_manifest(d, 0))
+    # no manifest at all → unverifiable (None), accepted for legacy
+    os.remove(os.path.join(d, "epoch_0.manifest.json"))
+    assert verify_manifest(d, 0) is None
+    # re-manifest the (broken) dir: verification goes green against
+    # the NEW contents — manifests describe, they don't resurrect
+    write_manifest(d, 0)
+    assert verify_manifest(d, 0) == []
+    mgr.close()
+
+
+def test_trainer_chaos_guards(tmp_path):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    kw = dict(
+        epochs=1, batch_size=4,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True, synthetic_size=64, eval_every=0,
+    )
+    with pytest.raises(ValueError, match="bad chaos event"):
+        Trainer(TrainConfig(chaos="kill:rank1", **kw))
+    with pytest.raises(ValueError, match="fast_epoch"):
+        Trainer(
+            TrainConfig(
+                chaos="kill:rank0@step3", fast_epoch=True, **kw
+            )
+        )
+    # epoch triggers compose with --fast_epoch (no per-step loop needed)
+    t = Trainer(TrainConfig(chaos="sigterm:rank0@epoch5", fast_epoch=True, **kw))
+    t.close()
+    # --max_restarts without --spawn is a CLI error, not a silent no-op
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import train as train_cli
+
+    with pytest.raises(ValueError, match="max_restarts"):
+        train_cli.main(["--max_restarts", "2"])
+
+
+def test_chaos_sigterm_preempts_then_resume_completes(tmp_path):
+    """Single-process drill: ``sigterm:rank0@step…`` rides the
+    trainer's graceful-preemption path (mid-epoch checkpoint + clean
+    exit), and a re-run resumes to completion WITHOUT re-firing the
+    event (the ledger). The whole kill→restart→resume loop, minus the
+    process reaping the slow-tier spawn test covers."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    def cfg():
+        return TrainConfig(
+            epochs=2, batch_size=4,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True, synthetic_size=256,  # 8 steps/epoch
+            log_interval=2, eval_every=0,
+            chaos="sigterm:rank0@step3",
+        )
+
+    t1 = Trainer(cfg())
+    summary1 = t1.train()
+    t1.close()
+    assert summary1["preempted"] is True
+    ledger = json.loads(
+        (tmp_path / "ck" / "chaos_ledger.rank0.json").read_text()
+    )
+    assert ledger["fired"] == ["sigterm:rank0@step3"]
+
+    t2 = Trainer(cfg())
+    summary2 = t2.train()
+    t2.close()
+    assert not summary2.get("preempted")
+    assert int(t2.state.step) == 16  # 2 epochs × 8 steps, none lost
+
+
+# ---- spawned-world tests (slow tier) ---------------------------------
+
+
+def _read(out_dir, n):
+    out = []
+    for rank in range(n):
+        with open(os.path.join(out_dir, f"rank{rank}.json")) as f:
+            out.append(json.load(f))
+    return out
+
+
+def _chaos_train_worker(rank, world, ckpt, data, out_dir, chaos_spec):
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=2, batch_size=4,
+        checkpoint_dir=ckpt, data_root=data,
+        # world 2 × batch 4 = global batch 8 → 8 steps/epoch
+        synthetic_data=True, synthetic_size=64,
+        log_interval=4, eval_every=0,
+        chaos=chaos_spec,
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "epochs_run": summary["epochs_run"],
+                "acc": summary["final_accuracy"],
+                "loss": summary["final_loss"],
+                "step": int(trainer.state.step),
+            },
+            f,
+        )
+
+
+@pytest.mark.multihost
+def test_spawn_kill_restart_resumes_to_completion(tmp_path):
+    """The end-to-end kill-and-recover pin: rank 1 is SIGKILLed
+    mid-epoch-1, the launcher reaps the world (rank 0 is blocked in a
+    collective) and relaunches it, the relaunch auto-resumes from the
+    epoch-0 checkpoint, the chaos ledger stops a second kill, and the
+    run completes with metrics matching an uninjected reference —
+    goodput.json showing EXACTLY one restart."""
+    # Reference: same shape, no chaos.
+    ref = tmp_path / "ref"
+    ref_out = ref / "out"
+    for p in (ref, ref_out):
+        p.mkdir()
+    spawn(
+        _chaos_train_worker, 2,
+        (str(ref / "ck"), str(tmp_path / "data"), str(ref_out), None),
+        timeout=600,
+    )
+    reference = _read(ref_out, 2)
+
+    out = tmp_path / "out"
+    out.mkdir()
+    ck = str(tmp_path / "ck")
+    # Epoch 0 = steps 0..7 (checkpointed at the boundary), kill rank 1
+    # before step 12 — mid-epoch 1, after the epoch-0 save committed.
+    restarts = spawn(
+        _chaos_train_worker, 2,
+        (ck, str(tmp_path / "data"), str(out), "kill:rank1@step12"),
+        timeout=900, grace=5.0,
+        max_restarts=2, restart_backoff=0.1,
+    )
+    assert restarts == 1  # one generation died, one finished
+    results = _read(out, 2)
+    assert all(r["step"] == 16 for r in results)  # 2 epochs × 8 steps
+    assert all(np.isfinite(r["acc"]) for r in results)
+    # Final metrics match the uninjected run (same seeds, same batch
+    # order — the replayed epoch 1 reproduces the lost work exactly).
+    assert np.isclose(results[0]["acc"], reference[0]["acc"], atol=1e-6)
+    assert np.isclose(results[0]["loss"], reference[0]["loss"], rtol=1e-5)
+    # goodput.json accumulated across the kill: exactly one restart.
+    side = json.loads((tmp_path / "ck" / "goodput.json").read_text())
+    assert side["restarts"] == 1
+    # The ledger recorded the kill so the relaunch replayed step 12
+    # without re-dying.
+    ledger = json.loads(
+        (tmp_path / "ck" / "chaos_ledger.rank1.json").read_text()
+    )
+    assert ledger["fired"] == ["kill:rank1@step12"]
+
+
+def _halt_worker(rank, world, ckpt, data, out_dir):
+    from ddp_tpu.obs.health import HealthHaltError
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=1, batch_size=4,
+        checkpoint_dir=ckpt, data_root=data,
+        # world 2 × batch 4 = global batch 8 → 8 steps/epoch
+        synthetic_data=True, synthetic_size=64,
+        log_interval=2, eval_every=0,
+        health=True, health_action="halt",
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    if rank == 1:
+        # A RANK-LOCAL anomaly (only rank 1's sentry sees it) — the
+        # real detector wiring from the deferral queue onward.
+        orig = trainer.train_step
+        count = {"n": 0}
+
+        def probed(state, images, labels):
+            out = orig(state, images, labels)
+            count["n"] += 1
+            if count["n"] == 3:
+                trainer._on_health_events(
+                    [{"detector": "straggler", "step": 3, "value": 9.9}],
+                    epoch=0, ran=3,
+                )
+            return out
+
+        trainer.train_step = probed
+    halted = False
+    dump = None
+    try:
+        trainer.train()
+    except HealthHaltError as e:
+        halted = True
+        dump = e.dump_path
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump({"halted": halted, "dump": dump}, f)
+
+
+@pytest.mark.multihost
+def test_spawn_health_halt_all_ranks_via_consensus(tmp_path):
+    """--health_action halt, multi-process (the lifted PR-4
+    restriction): an anomaly only rank 1 sees halts BOTH ranks at the
+    same agreed batch — no survivor is left blocked in a collective,
+    so every worker exits cleanly (spawn succeeds)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    spawn(
+        _halt_worker, 2,
+        (str(tmp_path / "ck"), str(tmp_path / "data"), str(out)),
+        timeout=600,
+    )
+    results = _read(out, 2)
+    assert [r["halted"] for r in results] == [True, True]
+    # every rank left a flight-recorder post-mortem
+    assert all(r["dump"] for r in results)

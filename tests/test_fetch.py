@@ -1,0 +1,135 @@
+"""Dataset-download retry (data/fetch.py): bounded, jittered,
+transient-only — and fast-failing when offline so the synthetic
+fallback path stays instant."""
+
+import http.client
+import socket
+import urllib.error
+
+import pytest
+
+from ddp_tpu.data.fetch import (
+    backoff_delays,
+    fetch_from_mirrors,
+    fetch_with_retry,
+    is_transient,
+)
+
+
+def test_transient_classification():
+    # another attempt could fix these
+    assert is_transient(urllib.error.HTTPError("u", 503, "x", {}, None))
+    assert is_transient(urllib.error.HTTPError("u", 429, "x", {}, None))
+    assert is_transient(
+        urllib.error.ContentTooShortError("truncated", None)
+    )
+    assert is_transient(http.client.IncompleteRead(b""))
+    assert is_transient(urllib.error.URLError(socket.timeout()))
+    assert is_transient(urllib.error.URLError(ConnectionResetError()))
+    # ... these it could not: config errors and being offline
+    assert not is_transient(urllib.error.HTTPError("u", 404, "x", {}, None))
+    assert not is_transient(
+        urllib.error.URLError(socket.gaierror(-2, "no DNS"))
+    )
+    refused = ConnectionRefusedError()
+    refused.errno = 111
+    assert not is_transient(urllib.error.URLError(refused))
+
+
+def test_backoff_is_bounded_exponential_and_deterministic():
+    a = backoff_delays("https://m/x.gz", 4, base_delay=0.5, max_delay=8.0)
+    b = backoff_delays("https://m/x.gz", 4, base_delay=0.5, max_delay=8.0)
+    assert a == b  # seeded per URL — reproducible
+    assert len(a) == 3
+    for i, d in enumerate(a):
+        assert 0.0 <= d <= 8.0 * 1.25  # capped + jitter bound
+        assert abs(d - 0.5 * 2**i) <= 0.25 * 0.5 * 2**i + 1e-9
+    # different URLs desynchronize (no thundering herd)...
+    assert backoff_delays("https://m/y.gz", 4) != a
+    # ...and so do different WORKERS fetching the SAME file (the salt
+    # defaults to the pid; lockstep retries would re-synchronize the
+    # herd the jitter exists to break up)
+    assert backoff_delays("https://m/x.gz", 4, salt=1) != backoff_delays(
+        "https://m/x.gz", 4, salt=2
+    )
+
+
+def test_mirror_rotation_covers_http_exceptions(tmp_path, monkeypatch):
+    """A mirror failing with IncompleteRead (an HTTPException, NOT an
+    OSError) rotates to the next mirror instead of escaping the loop;
+    all mirrors failing raises RuntimeError naming the last error."""
+    import ddp_tpu.data.fetch as fetch_mod
+
+    dest = str(tmp_path / "f.gz")
+    calls = []
+
+    def fake_retry(url, d, attempts=3):
+        calls.append(url)
+        if "bad1" in url:
+            raise http.client.IncompleteRead(b"")
+        if "bad2" in url:
+            raise urllib.error.URLError("down")
+        with open(d, "wb") as f:
+            f.write(b"ok")
+        return d
+
+    monkeypatch.setattr(fetch_mod, "fetch_with_retry", fake_retry)
+    out = fetch_from_mirrors(
+        ("https://bad1/", "https://bad2/", "https://good/"), "f.gz", dest
+    )
+    assert out == dest and len(calls) == 3
+    with pytest.raises(RuntimeError, match="any mirror"):
+        fetch_from_mirrors(("https://bad1/",), "f.gz", dest)
+
+
+def test_retries_transient_then_succeeds(tmp_path):
+    dest = str(tmp_path / "file.gz")
+    calls, sleeps = [], []
+
+    def flaky(url, tmp):
+        calls.append(url)
+        if len(calls) < 3:
+            raise urllib.error.ContentTooShortError("torn", None)
+        with open(tmp, "wb") as f:
+            f.write(b"payload")
+
+    out = fetch_with_retry(
+        "https://mirror/f.gz", dest,
+        attempts=3, retrieve=flaky, sleep=sleeps.append,
+    )
+    assert out == dest and open(dest, "rb").read() == b"payload"
+    assert len(calls) == 3 and len(sleeps) == 2
+    assert sleeps == backoff_delays("https://mirror/f.gz", 3)[:2]
+
+
+def test_nontransient_fails_fast_without_sleeping(tmp_path):
+    sleeps = []
+
+    def offline(url, tmp):
+        raise urllib.error.URLError(socket.gaierror(-2, "no DNS"))
+
+    with pytest.raises(urllib.error.URLError):
+        fetch_with_retry(
+            "https://mirror/f.gz", str(tmp_path / "f"),
+            retrieve=offline, sleep=sleeps.append,
+        )
+    assert sleeps == []  # the offline fallback must not wait
+
+
+def test_exhausted_attempts_raise_and_leave_no_partial(tmp_path):
+    dest = str(tmp_path / "f.gz")
+
+    def always_torn(url, tmp):
+        with open(tmp, "wb") as f:
+            f.write(b"half")
+        raise urllib.error.ContentTooShortError("torn", None)
+
+    with pytest.raises(urllib.error.ContentTooShortError):
+        fetch_with_retry(
+            "https://mirror/f.gz", dest,
+            attempts=2, retrieve=always_torn, sleep=lambda s: None,
+        )
+    import os
+
+    assert not os.path.exists(dest)
+    assert not os.path.exists(dest + ".part")  # torn temp removed

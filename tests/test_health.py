@@ -418,18 +418,53 @@ def test_health_rejects_bad_combinations(tmp_path):
                 num_microbatches=4,
             )
         )
-    # Rank-local events vs collective checkpointing: non-warn actions
-    # reject multi-process contexts at construction.
+
+
+def test_multiprocess_health_actions_defer_to_consensus(tmp_path):
+    """The PR-4 restriction is LIFTED: non-warn actions now construct
+    in multi-process contexts, and rank-local events queue for the
+    agreement point instead of acting immediately (one rank halting
+    alone would strand its peers in the next collective)."""
+    from ddp_tpu.train.trainer import Trainer
 
     class _FakeCtx:
         process_id = 0
         num_processes = 2
         is_main = True
 
-    with pytest.raises(ValueError, match="health_action warn"):
-        Trainer(
-            _config(tmp_path, health_action="halt"), ctx=_FakeCtx()
+    t = Trainer(_config(tmp_path, health_action="halt"), ctx=_FakeCtx())
+    try:
+        ev = {"detector": "grad_explosion", "step": 3, "value": 9.0}
+        # Immediate path would raise HealthHaltError; deferral queues.
+        t._on_health_events([ev], epoch=0, ran=3)
+        assert t._pending_halt == [ev]
+        t2 = Trainer(
+            _config(
+                tmp_path,
+                health_action="checkpoint",
+                checkpoint_dir=str(tmp_path / "ck2"),
+            ),
+            ctx=_FakeCtx(),
         )
+        try:
+            nonfinite = {"detector": "nonfinite", "step": 4}
+            t2._on_health_events([ev, nonfinite], epoch=0, ran=4)
+            # nonfinite states are never rescuable, agreed or not
+            assert t2._pending_rescue == [ev]
+        finally:
+            t2.close()
+        # The agreement gather itself: with the world size forced to 2
+        # in a 1-process jax, agree_any still reduces elementwise.
+        pre, halt, rescue = t._sync_flags(host_step=10)
+        assert (pre, halt, rescue) == (False, True, False)
+        # An agreed halt takes THIS rank down too (peers do the same).
+        with pytest.raises(HealthHaltError):
+            t._act_on_agreed(
+                True, False, epoch=0, ran=3, host_step=10
+            )
+        assert t._pending_halt == []  # consumed by the raise
+    finally:
+        t.close()
 
 
 def test_health_disabled_trainer_schema_unchanged(tmp_path):
@@ -450,6 +485,9 @@ def test_health_disabled_trainer_schema_unchanged(tmp_path):
 # ---- scripts/health_report.py ---------------------------------------
 
 _REPORT_FIXTURE = [
+    {"kind": "fallback", "time": 0.5, "epoch": 2, "resumed_epoch": 1,
+     "quarantined_path": "ck/quarantine.epoch-2",
+     "problems": ["default/d/abc: checksum mismatch"]},
     {"kind": "step", "time": 1, "epoch": 0, "batch": 0, "step": 1,
      "loss": 2.5, "lr": 0.01, "grad_norm": 4.0, "input_wait_s": 0.01,
      "dispatch_s": 0.001, "compute_s": 0.089, "recompiles": 1,

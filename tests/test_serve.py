@@ -605,3 +605,61 @@ class TestServer:
             )
             assert stats["compile_counts"] == eng.compile_counts()
             assert stats["ttft_s"]["count"] >= 1
+
+    def test_graceful_drain(self, params):
+        """The SIGTERM drain contract (scripts/serve.py): admissions
+        stop with 503 + Retry-After, running lanes finish, and the
+        drain state is visible on /healthz, /statusz and as the
+        /metricsz gauge."""
+        import urllib.error
+        import urllib.request
+
+        from ddp_tpu.serve.server import LMServer
+
+        eng = ServeEngine(SPEC, params, slots=2, prefill_len=8)
+        with LMServer(eng) as srv:
+            metrics = urllib.request.urlopen(
+                srv.url + "/metricsz", timeout=10
+            ).read().decode()
+            assert "ddp_tpu_serve_draining 0" in metrics
+
+            # a request admitted BEFORE the drain completes normally
+            status, out = srv.submit_and_wait(
+                {"prompt_tokens": [1, 2, 3], "max_new_tokens": 4}
+            )
+            assert status == 200 and out["status"] == COMPLETE
+
+            srv.begin_drain()
+            req = urllib.request.Request(
+                srv.url + "/generate",
+                data=json.dumps(
+                    {"prompt_tokens": [1, 2], "max_new_tokens": 2}
+                ).encode(),
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 503
+            assert exc.value.headers["Retry-After"] == str(
+                int(srv.drain_retry_after)
+            )
+            assert json.loads(exc.value.read())["error"] == "draining"
+
+            health = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/healthz", timeout=10
+                ).read()
+            )
+            assert health["ok"] and health["draining"] is True
+            statusz = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/statusz", timeout=10
+                ).read()
+            )
+            assert statusz["draining"] is True
+            metrics = urllib.request.urlopen(
+                srv.url + "/metricsz", timeout=10
+            ).read().decode()
+            assert "ddp_tpu_serve_draining 1" in metrics
+
+            # nothing in flight → the drain completes immediately
+            assert srv.drain(timeout=10) is True

@@ -73,6 +73,12 @@ def main(argv=None) -> int:
         print("\n".join(sorted(rows)))
         return 0
     config = TrainConfig.from_namespace(ns)
+    if config.max_restarts and config.spawn <= 1:
+        raise ValueError(
+            "--max_restarts is the --spawn launcher's restart loop "
+            "(runtime/launch.py); a single-process run restarts by "
+            "re-invoking train.py — auto-resume does the rest"
+        )
     if config.spawn > 1:
         # Reference parity: torch.multiprocessing.spawn(ddp_train,
         # nprocs=world_size) at train_ddp.py:222-224. Each rank gets
@@ -90,6 +96,11 @@ def main(argv=None) -> int:
             (args,),
             devices_per_process=config.emulate_devices or 1,
             timeout=None,  # a training run may legitimately take hours
+            # Restart-with-resume: a dead rank reaps the world and
+            # relaunches it; every rank auto-resumes from the latest
+            # checkpoint and goodput.json counts the restart.
+            max_restarts=config.max_restarts,
+            restart_backoff=config.restart_backoff,
         )
         return 0
     return _run(config)
