@@ -34,6 +34,17 @@ def _bench_trace_path(name: str) -> str:
     return os.path.abspath(os.path.join(d, f"{name}.trace.json"))
 
 
+def _lint_clean() -> bool:
+    """Self-lint verdict stamped on headline records (never raises —
+    a linter crash reads as not-clean, loudly, not as a dead bench)."""
+    try:
+        from ddp_tpu.analysis import self_lint_clean
+
+        return self_lint_clean()
+    except Exception:
+        return False
+
+
 def run_bench(
     *,
     global_batch_size: int = 16384,
@@ -176,6 +187,12 @@ def run_bench(
         # count): a nonzero value in the trajectory means the headline
         # paid restart overhead and is not comparing like with like.
         "restarts": 0,
+        # Self-lint status of the measured tree (scripts/lint.py
+        # --self, ddp_tpu.analysis): False means this number was
+        # captured on a tree with unsuppressed distributed-JAX hazard
+        # findings — a lint regression shows up in the perf-trajectory
+        # sidecars next to the throughput it might be corrupting.
+        "lint_clean": _lint_clean(),
     }
 
 
@@ -322,8 +339,13 @@ def run_vit_bench(
 
     def step(carry, key):
         params, opt_state = carry
-        images = jax.random.normal(key, (batch, 32, 32, 3), jnp.bfloat16)
-        labels = jax.random.randint(key, (batch,), 0, 100)
+        # One key per consumer (self-lint DDP005): sharing `key`
+        # between normal() and randint() draws labels CORRELATED with
+        # the images — a synthetic batch the model can partially read
+        # the answer from.
+        k_img, k_lbl = jax.random.split(key)
+        images = jax.random.normal(k_img, (batch, 32, 32, 3), jnp.bfloat16)
+        labels = jax.random.randint(k_lbl, (batch,), 0, 100)
 
         def loss_fn(p):
             pb = jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)

@@ -1,0 +1,275 @@
+"""Jit-reachability call graph for the hazard checkers.
+
+DDP002 (host-sync) only means something INSIDE code that jit traces:
+``float(x)`` in the trainer's host loop is the design (the log-cadence
+sync), the same call inside a function the train step calls is a
+silent per-step stall (or a trace error waiting for a dynamic value).
+So the checkers need "is this function reached from a jit/shard_map
+root" — which this module answers with a package-wide best-effort
+call graph:
+
+- Roots: functions decorated ``@jax.jit`` / ``@partial(jax.jit, …)``,
+  functions passed by name to ``jax.jit`` / ``pjit`` / ``shard_map`` /
+  ``grad`` / ``vmap`` / ``pmap`` / ``checkpoint`` or to the ``lax``
+  control-flow combinators (``scan``/``cond``/``while_loop``/…) —
+  plus the bodies of lambdas passed to any of those.
+- Edges: bare-name calls resolved within the module, and cross-module
+  through ``from x import y`` when ``x`` is part of the linted tree.
+- Closure: nested ``def``s of an in-graph function are in-graph (their
+  bodies are traced with the parent).
+
+Best-effort by design: method calls and higher-order plumbing are not
+chased (no type inference in a linter), so reachability UNDERapproxi-
+mates — a miss means a false negative, never a false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ddp_tpu.analysis.core import ModuleInfo
+
+# Transforms whose function-valued arguments run under trace. Matched
+# on the RESOLVED dotted name's tail so `jax.jit`, `jit` (from-import)
+# and aliased spellings all hit.
+TRACER_TAILS = (
+    "jax.jit",
+    "jax.pjit",
+    "pjit.pjit",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.shard_map",
+    "shard_map.shard_map",
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.cond",
+    "lax.cond",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.switch",
+    "lax.switch",
+    "jax.lax.map",
+    "lax.map",
+    "jax.lax.associative_scan",
+    "lax.associative_scan",
+)
+
+
+def is_tracer_name(resolved: str | None) -> bool:
+    if not resolved:
+        return False
+    return any(
+        resolved == t or resolved.endswith("." + t) for t in TRACER_TAILS
+    )
+
+
+def resolve_partial_target(mod: ModuleInfo, call: ast.Call):
+    """``partial(jax.jit, …)`` → the inner transform call, else None."""
+    fn = mod.resolve(call.func)
+    if fn and (fn == "functools.partial" or fn.endswith(".partial")
+               or fn == "partial"):
+        if call.args and is_tracer_name(mod.resolve(call.args[0])):
+            return call
+    return None
+
+
+@dataclasses.dataclass
+class FunctionRecord:
+    modname: str
+    qualname: str  # "outer.inner" for nested defs
+    node: ast.FunctionDef
+    parent: str | None  # enclosing function qualname, None at module level
+    calls: set[str]  # bare names called in the body (own nested defs excluded)
+
+
+@dataclasses.dataclass
+class Project:
+    modules: dict[str, ModuleInfo]
+    functions: dict[tuple[str, str], FunctionRecord]  # (modname, qualname)
+    ingraph: set[tuple[str, str]]
+
+    def is_ingraph(self, modname: str, qualname: str) -> bool:
+        return (modname, qualname) in self.ingraph
+
+
+def _collect_functions(mod: ModuleInfo) -> dict[str, FunctionRecord]:
+    records: dict[str, FunctionRecord] = {}
+
+    def visit(node: ast.AST, prefix: str, parent: str | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}" if prefix else child.name
+                calls: set[str] = set()
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Name
+                    ):
+                        calls.add(sub.func.id)
+                records[qual] = FunctionRecord(
+                    modname=mod.modname,
+                    qualname=qual,
+                    node=child,
+                    parent=parent,
+                    calls=calls,
+                )
+                visit(child, qual + ".", qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", parent)
+            else:
+                visit(child, prefix, parent)
+
+    visit(mod.tree, "", None)
+    return records
+
+
+def _function_args(call: ast.Call) -> list[ast.AST]:
+    args = list(call.args)
+    args.extend(kw.value for kw in call.keywords if kw.value is not None)
+    return args
+
+
+def _local_lookup(
+    mod: ModuleInfo,
+    funcs: dict[str, FunctionRecord],
+    name: str,
+    scope: str | None,
+) -> str | None:
+    """Resolve a bare name to a function qualname: innermost enclosing
+    scope first (nested helpers shadow module-level ones), then the
+    module level."""
+    if scope:
+        parts = scope.split(".")
+        for i in range(len(parts), 0, -1):
+            cand = ".".join(parts[:i] + [name])
+            if cand in funcs:
+                return cand
+    # class methods register as "Class.name" — bare-name calls can't
+    # reach them, so only true module-level functions match here.
+    return name if name in funcs else None
+
+
+def build_project(modules: list[ModuleInfo]) -> Project:
+    mod_index = {m.modname: m for m in modules}
+    functions: dict[tuple[str, str], FunctionRecord] = {}
+    per_mod_funcs: dict[str, dict[str, FunctionRecord]] = {}
+    for m in modules:
+        recs = _collect_functions(m)
+        per_mod_funcs[m.modname] = recs
+        for qual, rec in recs.items():
+            functions[(m.modname, qual)] = rec
+
+    roots: set[tuple[str, str]] = set()
+
+    def mark_name_root(mod: ModuleInfo, name: str, scope: str | None):
+        funcs = per_mod_funcs[mod.modname]
+        local = _local_lookup(mod, funcs, name, scope)
+        if local is not None:
+            roots.add((mod.modname, local))
+            return
+        # from-imported project function
+        target = mod.aliases.get(name)
+        if target and "." in target:
+            tmod, tname = target.rsplit(".", 1)
+            if tmod in per_mod_funcs and tname in per_mod_funcs[tmod]:
+                roots.add((tmod, tname))
+
+    def enclosing_scope(
+        node: ast.AST, parents: dict, by_node: dict
+    ) -> str | None:
+        cur = parents.get(node)
+        while cur is not None:
+            if cur in by_node:
+                return by_node[cur]
+            cur = parents.get(cur)
+        return None
+
+    for m in modules:
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(m.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        funcs = per_mod_funcs[m.modname]
+        by_node = {rec.node: qual for qual, rec in funcs.items()}
+        for node in ast.walk(m.tree):
+            # decorator roots
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec
+                    if isinstance(dec, ast.Call):
+                        if is_tracer_name(m.resolve(dec.func)) or (
+                            resolve_partial_target(m, dec) is not None
+                        ):
+                            roots.add((m.modname, by_node[node]))
+                        continue
+                    if is_tracer_name(m.resolve(target)):
+                        roots.add((m.modname, by_node[node]))
+            # call-site roots: jit(f) / shard_map(f, ...) / scan(f, ...)
+            if isinstance(node, ast.Call):
+                is_tracer = is_tracer_name(m.resolve(node.func))
+                if not is_tracer and resolve_partial_target(m, node):
+                    is_tracer = True
+                if not is_tracer:
+                    continue
+                scope = enclosing_scope(node, parents, by_node)
+                for arg in _function_args(node):
+                    if isinstance(arg, ast.Name):
+                        mark_name_root(m, arg.id, scope)
+                    elif isinstance(arg, ast.Lambda):
+                        # the lambda body is traced: every bare-name
+                        # call inside it seeds reachability
+                        for sub in ast.walk(arg.body):
+                            if isinstance(sub, ast.Call) and isinstance(
+                                sub.func, ast.Name
+                            ):
+                                mark_name_root(m, sub.func.id, scope)
+
+    # BFS: callees of in-graph functions + nested defs of in-graph
+    # functions are in-graph.
+    ingraph: set[tuple[str, str]] = set()
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        if key in ingraph or key not in functions:
+            continue
+        ingraph.add(key)
+        modname, qual = key
+        mod = mod_index[modname]
+        funcs = per_mod_funcs[modname]
+        rec = functions[key]
+        # nested defs trace with the parent
+        prefix = qual + "."
+        for other_qual in funcs:
+            if other_qual.startswith(prefix):
+                frontier.append((modname, other_qual))
+        # bare-name callees
+        for name in rec.calls:
+            local = _local_lookup(mod, funcs, name, qual)
+            if local is not None:
+                frontier.append((modname, local))
+                continue
+            target = mod.aliases.get(name)
+            if target and "." in target:
+                tmod, tname = target.rsplit(".", 1)
+                if tmod in per_mod_funcs and tname in per_mod_funcs[tmod]:
+                    frontier.append((tmod, tname))
+
+    return Project(
+        modules=mod_index, functions=functions, ingraph=ingraph
+    )
+
+
+def ingraph_functions(
+    project: Project, mod: ModuleInfo
+) -> list[FunctionRecord]:
+    return [
+        rec
+        for (modname, qual), rec in project.functions.items()
+        if modname == mod.modname and (modname, qual) in project.ingraph
+    ]

@@ -1,0 +1,198 @@
+"""DDP001 — collective call under rank-divergent control flow.
+
+The PR-5 deadlock class: a ``psum``/``all_gather``/checkpoint-save is
+a *collective* — every rank must reach it the same number of times in
+the same order. A call site guarded by ``process_index() == 0`` /
+``ctx.is_main`` / ``rank`` (or reachable only through an ``except``
+handler, which one rank can enter alone) lets ranks desync: the rank
+that takes the branch blocks in the collective forever while its
+peers run past it. PR 5's whole consensus layer
+(``runtime/consensus.agree_any``) exists because exactly this bit the
+health-checkpoint path.
+
+Detection: walk each function keeping a stack of divergence contexts —
+
+- an ``if``/``while`` whose test mentions a rank-identity signal
+  (``process_index``, ``process_id``, ``rank``, ``local_rank``,
+  ``is_main``, ``is_coordinator``) and does NOT itself come from an
+  agreement (``agree_any``/``agree_all``/``_sync_flags``/…);
+- an ``except`` handler body (exception paths are per-rank by nature).
+
+A collective call inside any such context is a finding. Plain data
+branches (``if halt:``) are NOT flagged — statically proving their
+uniformity is impossible, and the PR-1→5 bugs were all explicit
+rank-identity guards, so that is the class this rule pins.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ddp_tpu.analysis.core import Finding, ModuleInfo
+
+# lax/multihost collectives by terminal attribute name.
+COLLECTIVE_ATTRS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_gather_invariant",
+    "ppermute",
+    "pshuffle",
+    "all_to_all",
+    "psum_scatter",
+    "process_allgather",
+    "sync_global_devices",
+    "broadcast_one_to_all",
+}
+# host-level agreement primitives are collectives too
+COLLECTIVE_NAMES = {"agree_any", "agree_all"}
+# checkpoint manager methods that enter an Orbax (collective) save or
+# restore — matched only on receivers that look like a manager.
+CKPT_ATTRS = {"save", "restore", "restore_or_init", "wait"}
+CKPT_RECEIVERS = {"ckpt", "checkpoint", "checkpointer", "ckpt_mgr"}
+
+RANK_SIGNALS = {
+    "process_index",
+    "process_id",
+    "rank",
+    "local_rank",
+    "is_main",
+    "is_coordinator",
+}
+AGREEMENT_MARKS = ("agree", "_sync_flags", "consensus")
+
+
+def _terminal_names(expr: ast.AST):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute):
+            yield node.attr
+        elif isinstance(node, ast.Name):
+            yield node.id
+
+
+def _test_divergent(test: ast.AST) -> bool:
+    names = set(_terminal_names(test))
+    if not (names & RANK_SIGNALS):
+        return False
+    # an agreement in the same test means the branch is world-uniform
+    # by construction (e.g. `if agree_any(self.rank_flag):`)
+    for n in names:
+        if any(mark in n for mark in AGREEMENT_MARKS):
+            return False
+    return True
+
+
+def _collective_call(mod: ModuleInfo, call: ast.Call) -> str | None:
+    """Return a display name when ``call`` is a collective, else None."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id in COLLECTIVE_NAMES:
+            return fn.id
+        resolved = mod.aliases.get(fn.id, "")
+        if resolved.rsplit(".", 1)[-1] in (
+            COLLECTIVE_ATTRS | COLLECTIVE_NAMES
+        ):
+            return fn.id
+        return None
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in COLLECTIVE_ATTRS or fn.attr in COLLECTIVE_NAMES:
+            return fn.attr
+        if fn.attr in CKPT_ATTRS:
+            recv = fn.value
+            last = None
+            if isinstance(recv, ast.Attribute):
+                last = recv.attr
+            elif isinstance(recv, ast.Name):
+                last = recv.id
+            if last is not None and last.lower() in CKPT_RECEIVERS:
+                return f"{last}.{fn.attr}"
+    return None
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.context: list[str] = []
+        self.findings: list[Finding] = []
+
+    def _enter(self, reason: str | None, bodies: list) -> None:
+        if reason is not None:
+            self.context.append(reason)
+        for body in bodies:
+            for stmt in body:
+                self.visit(stmt)
+        if reason is not None:
+            self.context.pop()
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        reason = (
+            "rank-dependent branch" if _test_divergent(node.test) else None
+        )
+        self._enter(reason, [node.body])
+        # the else of a rank guard is JUST as divergent (the other
+        # ranks' side of the split)
+        self._enter(reason, [node.orelse])
+
+    def visit_While(self, node: ast.While) -> None:
+        self.visit(node.test)
+        reason = (
+            "rank-dependent loop" if _test_divergent(node.test) else None
+        )
+        self._enter(reason, [node.body, node.orelse])
+
+    def visit_Try(self, node: ast.Try) -> None:
+        self._enter(None, [node.body, node.orelse, node.finalbody])
+        self._enter("exception path", [h.body for h in node.handlers])
+
+    # TryStar (3.11+) shares Try's shape; getattr keeps 3.10 parsing.
+    visit_TryStar = visit_Try
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.context:
+            name = _collective_call(self.mod, node)
+            if name is not None:
+                self.findings.append(
+                    Finding(
+                        rule="DDP001",
+                        path=self.mod.path,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"collective `{name}` under {self.context[-1]}"
+                            " — ranks that skip this branch desync and "
+                            "deadlock the world"
+                        ),
+                        hint=(
+                            "hoist the collective out of the divergent "
+                            "branch, or agree first "
+                            "(runtime/consensus.agree_any)"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+    # nested functions get their own walk with a fresh context: a
+    # callback DEFINED under a rank guard is not itself divergent
+    # control flow around a collective call site.
+    def visit_FunctionDef(self, node) -> None:
+        saved, self.context = self.context, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.context = saved
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self.context = self.context, []
+        self.visit(node.body)
+        self.context = saved
+
+
+def check(mod: ModuleInfo, project) -> list[Finding]:
+    del project
+    w = _Walker(mod)
+    w.visit(mod.tree)
+    return w.findings

@@ -1,0 +1,324 @@
+"""Static-analysis engine: findings, suppressions, project walking.
+
+Pure-``ast`` machinery shared by every checker: no jax USE anywhere
+in the analysis package (the parent package's import-time compat
+shims are the only jax cost), and a syntax error in the tree under
+analysis surfaces as a finding, not a crash. The hazard checkers
+themselves live in sibling modules
+(collective / hostsync / donation / recompile / prng); this module
+owns what they share:
+
+- :class:`Finding` — one diagnosed hazard, renderable as the
+  golden-pinned ``path:line:col: RULE message [hint: ...]`` line and
+  as a JSON object for CI.
+- Suppressions — ``# ddp-lint: disable=DDP002 <justification>``
+  silences matching rules on that line (or the next line when the
+  comment stands alone). The justification is REQUIRED: a bare
+  disable is itself reported as DDP000 — every silenced hazard must
+  say why it is safe, in the source, where the next reader is.
+- :class:`ModuleInfo` / import-alias resolution — ``np.asarray`` and
+  ``numpy.asarray`` (or ``from jax.random import split``) resolve to
+  one canonical dotted name so checkers match semantics, not
+  spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Iterable
+
+# Rule ids are stable API: CI configs, suppression comments, and the
+# fixture corpus all pin them.
+RULE_TITLES = {
+    "DDP000": "suppression without justification",
+    "DDP001": "collective under rank-divergent control flow",
+    "DDP002": "host sync inside jit-reachable code",
+    "DDP003": "donated buffer read after donation",
+    "DDP004": "recompile hazard",
+    "DDP005": "PRNG key reuse without split/fold_in",
+}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+    justification: str | None = None
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+        if self.hint:
+            s += f" [hint: {self.hint}]"
+        return s
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.hint:
+            d["hint"] = self.hint
+        if self.suppressed:
+            d["suppressed"] = True
+            d["justification"] = self.justification
+        return d
+
+
+# ---- import-alias resolution ----------------------------------------
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed file plus the lookup tables the checkers share."""
+
+    path: str  # as reported in findings (relative to the lint root)
+    modname: str  # dotted module name ("ddp_tpu.serve.engine")
+    source: str
+    tree: ast.Module
+    aliases: dict[str, str]  # local name -> canonical dotted prefix
+    lines: list[str]
+
+    def resolve(self, node: ast.AST) -> str | None:
+        """Canonical dotted name of a Name/Attribute chain, with the
+        module's import aliases folded in — or None for anything
+        dynamic (subscripts, calls) along the chain."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.aliases.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+def _collect_aliases(tree: ast.Module) -> dict[str, str]:
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.level:  # relative import — anchor later if needed
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def load_module(path: str, modname: str, rel: str) -> ModuleInfo | Finding:
+    """Parse one file → ModuleInfo, or a Finding for a syntax error
+    (the lint must report unparseable files, not die on them)."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(
+            rule="DDP000",
+            path=rel,
+            line=e.lineno or 1,
+            col=(e.offset or 1) - 1,
+            message=f"syntax error: {e.msg}",
+            hint="fix the parse error; no rules ran on this file",
+        )
+    return ModuleInfo(
+        path=rel,
+        modname=modname,
+        source=source,
+        tree=tree,
+        aliases=_collect_aliases(tree),
+        lines=source.splitlines(),
+    )
+
+
+# ---- suppressions ---------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ddp-lint:\s*disable=([A-Za-z0-9,]+)[ \t]*(.*?)\s*$"
+)
+
+
+def parse_suppressions(
+    mod: ModuleInfo,
+) -> tuple[dict[int, tuple[set[str], str]], list[Finding]]:
+    """Line → (rule ids, justification), plus DDP000 findings for
+    suppressions missing their justification.
+
+    A trailing comment suppresses its own line; a comment-only line
+    suppresses the next line (the decorator position). The
+    justification (everything after the rule list) is mandatory — the
+    suppression still APPLIES either way (so a justification fix
+    doesn't un-silence a known-accepted hazard mid-CI), but DDP000 is
+    unsuppressable and fails the run until the why is written down.
+    """
+    supp: dict[int, tuple[set[str], str]] = {}
+    problems: list[Finding] = []
+    for i, text in enumerate(mod.lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group(1).split(",") if r.strip()}
+        justification = m.group(2).strip()
+        target = i + 1 if text.strip().startswith("#") else i
+        prev_rules, prev_just = supp.get(target, (set(), ""))
+        supp[target] = (
+            prev_rules | rules,
+            justification or prev_just,
+        )
+        if not justification:
+            problems.append(
+                Finding(
+                    rule="DDP000",
+                    path=mod.path,
+                    line=i,
+                    col=text.index("#"),
+                    message=(
+                        "suppression without justification: "
+                        f"disable={','.join(sorted(rules))} must say WHY "
+                        "the hazard is safe here"
+                    ),
+                    hint=(
+                        "write `# ddp-lint: disable=RULE <one-line "
+                        "reason>`"
+                    ),
+                )
+            )
+    return supp, problems
+
+
+def apply_suppressions(
+    findings: list[Finding],
+    supp: dict[int, tuple[set[str], str]],
+) -> None:
+    for f in findings:
+        if f.rule == "DDP000":
+            continue  # the meta-rule cannot be suppressed
+        entry = supp.get(f.line)
+        if entry and f.rule in entry[0]:
+            f.suppressed = True
+            f.justification = entry[1] or None
+
+
+# ---- project walking ------------------------------------------------
+
+
+def iter_py_files(paths: Iterable[str]) -> list[tuple[str, str, str]]:
+    """Expand files/dirs → sorted (abspath, modname, relpath) triples.
+
+    modname is dotted relative to the argument's parent (linting
+    ``ddp_tpu`` yields ``ddp_tpu.serve.engine``); relpath is what
+    findings print — relative to the CWD when inside it, absolute
+    otherwise, so report lines are stable across checkouts.
+    """
+    out: list[tuple[str, str, str]] = []
+    cwd = os.getcwd()
+
+    def rel(p: str) -> str:
+        r = os.path.relpath(p, cwd)
+        return r if not r.startswith("..") else p
+
+    for arg in paths:
+        arg = os.path.abspath(arg)
+        if os.path.isfile(arg):
+            mod = os.path.splitext(os.path.basename(arg))[0]
+            out.append((arg, mod, rel(arg)))
+            continue
+        base = os.path.dirname(arg)
+        for dirpath, dirnames, filenames in os.walk(arg):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                modparts = os.path.relpath(full, base)[: -len(".py")]
+                modname = modparts.replace(os.sep, ".")
+                if modname.endswith(".__init__"):
+                    modname = modname[: -len(".__init__")]
+                out.append((full, modname, rel(full)))
+    # de-dup (a file passed twice, or a dir plus a file inside it)
+    seen: set[str] = set()
+    uniq = []
+    for t in out:
+        if t[0] not in seen:
+            seen.add(t[0])
+            uniq.append(t)
+    return sorted(uniq, key=lambda t: t[2])
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]
+    files: int
+
+    @property
+    def unsuppressed(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def render_text(self) -> str:
+        lines = [f.render() for f in self.unsuppressed]
+        lines.append(
+            f"ddp-lint: {len(self.unsuppressed)} finding(s) "
+            f"({len(self.suppressed)} suppressed) in {self.files} "
+            "file(s)"
+        )
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        counts: dict[str, int] = {}
+        for f in self.unsuppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return json.dumps(
+            {
+                "version": 1,
+                "files": self.files,
+                "counts": dict(sorted(counts.items())),
+                "findings": [f.to_dict() for f in self.findings],
+            },
+            indent=2,
+        )
+
+
+def run_checks(
+    modules: list[ModuleInfo],
+    checks: list[Callable],
+    project,
+    pre_findings: list[Finding],
+) -> list[Finding]:
+    """Run every checker over every module, then fold in suppressions
+    and sort for stable (golden-pinnable) output."""
+    findings = list(pre_findings)
+    for mod in modules:
+        mod_findings: list[Finding] = []
+        for check in checks:
+            mod_findings.extend(check(mod, project))
+        supp, supp_problems = parse_suppressions(mod)
+        apply_suppressions(mod_findings, supp)
+        findings.extend(mod_findings)
+        findings.extend(supp_problems)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

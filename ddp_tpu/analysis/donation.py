@@ -1,0 +1,316 @@
+"""DDP003 — donated buffer read after donation.
+
+The serve-cache class: ``donate_argnums`` hands the argument's device
+buffer to XLA for in-place reuse — after the call returns, the old
+array aliases freed (or overwritten) memory. Reading it again is
+use-after-free that XLA sometimes catches (a deleted-buffer error)
+and sometimes silently serves garbage from, depending on backend and
+timing. The serve engine's ``SlotCache`` lives and dies by this
+contract: every ``self._cache`` rebind must consume the previous
+reference, never keep it.
+
+Detection (per module, best-effort by name):
+
+- bindings ``f = jax.jit(g, donate_argnums=…)`` and defs decorated
+  ``@partial(jax.jit, donate_argnums=…)`` record which positions (or
+  argnames, resolved through ``g``'s signature) donate;
+- at each call of a donating callable, a plain-Name argument in a
+  donated position is DEAD after the call — a later load of that name
+  in the same scope (before a rebind) is a finding;
+- a donating call inside a loop whose donated Name is never rebound
+  in the loop body is a finding at the call site (the next iteration
+  re-reads the donated buffer).
+
+``state = step(state, batch)`` — the idiom — is clean: the call
+statement's own targets rebind the name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ddp_tpu.analysis.core import Finding, ModuleInfo
+
+_JIT_TAILS = ("jax.jit", "jax.pjit", "pjit.pjit")
+
+
+def _is_jit(mod: ModuleInfo, fn: ast.AST) -> bool:
+    resolved = mod.resolve(fn)
+    return bool(resolved) and any(
+        resolved == t or resolved.endswith("." + t) for t in _JIT_TAILS
+    )
+
+
+def _donated_positions(
+    mod: ModuleInfo, call: ast.Call, params: list[str] | None
+) -> set[int]:
+    """Donated positional indices from a jit(...) call's keywords."""
+    out: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    out.add(v.value)
+        elif kw.arg == "donate_argnames" and params:
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                    and v.value in params
+                ):
+                    out.add(params.index(v.value))
+    return out
+
+
+def _collect_donating(mod: ModuleInfo) -> dict[str, set[int]]:
+    """Name → donated positional indices, for names visible in this
+    module (assignment bindings and decorated defs)."""
+    defs: dict[str, list[str]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = [a.arg for a in node.args.args]
+
+    donating: dict[str, set[int]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if not _is_jit(mod, call.func):
+                continue
+            params = None
+            if call.args and isinstance(call.args[0], ast.Name):
+                params = defs.get(call.args[0].id)
+            pos = _donated_positions(mod, call, params)
+            if not pos:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    donating[tgt.id] = pos
+                elif isinstance(tgt, ast.Attribute):
+                    # self._decode = jax.jit(..., donate_argnums=…):
+                    # track by attribute name (method-call sites)
+                    donating[tgt.attr] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit(mod, dec.func)
+                    or (
+                        dec.args
+                        and _is_jit(mod, dec.args[0])
+                    )
+                ):
+                    params = [a.arg for a in node.args.args]
+                    pos = _donated_positions(mod, dec, params)
+                    if pos:
+                        donating[node.name] = pos
+    return donating
+
+
+def _call_display(call: ast.Call) -> str:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return "<call>"
+
+
+def _stmt_targets(stmt: ast.stmt) -> set[str]:
+    """Names this statement (re)binds."""
+    out: set[str] = set()
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [stmt.target]
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+    return out
+
+
+def _loads_in(node: ast.AST, name: str) -> list[ast.Name]:
+    return [
+        n
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name)
+        and n.id == name
+        and isinstance(n.ctx, ast.Load)
+    ]
+
+
+def _stores_name(node: ast.AST, name: str) -> bool:
+    return any(
+        isinstance(n, ast.Name)
+        and n.id == name
+        and isinstance(n.ctx, ast.Store)
+        for n in ast.walk(node)
+    )
+
+
+def _calls_in_stmt(stmt: ast.stmt) -> list[ast.Call]:
+    """Calls within a statement, pruning nested def/lambda subtrees
+    (those scopes get their own scan — analyzing their calls against
+    THIS block's tail would be the wrong liveness context)."""
+    out: list[ast.Call] = []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return out  # its body is a separate scope, scanned on its own
+    # compound statements: only the HEADER expressions belong to this
+    # block position — their bodies are recursed into as blocks of
+    # their own (analyzing a body call against THIS block's tail
+    # would see phantom after-call loads)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots: list[ast.AST] = [stmt.iter]
+    elif isinstance(stmt, (ast.While, ast.If)):
+        roots = [stmt.test]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [item.context_expr for item in stmt.items]
+    elif isinstance(stmt, ast.Try) or stmt.__class__.__name__ == "TryStar":
+        roots = []
+    else:
+        roots = [stmt]
+    stack: list[ast.AST] = list(roots)
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _scan_block(
+    mod: ModuleInfo,
+    stmts: list[ast.stmt],
+    donating: dict[str, set[int]],
+    findings: list[Finding],
+    loop_stack: list[ast.stmt],
+) -> None:
+    for idx, stmt in enumerate(stmts):
+        # donating calls anywhere inside this statement
+        for call in _calls_in_stmt(stmt):
+            fname = None
+            if isinstance(call.func, ast.Name):
+                fname = call.func.id
+            elif isinstance(call.func, ast.Attribute):
+                fname = call.func.attr
+            pos = donating.get(fname or "")
+            if not pos:
+                continue
+            rebound = _stmt_targets(stmt)
+            for p in sorted(pos):
+                if p >= len(call.args):
+                    continue
+                arg = call.args[p]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if arg.id in rebound:
+                    # rebound by this very statement (`s = f(s, …)`,
+                    # the idiom): later loads read the NEW buffer, and
+                    # the next loop iteration does too. Clean.
+                    continue
+                # later loads in the tail of this block
+                hit = None
+                for later in stmts[idx + 1 :]:
+                    if _stores_name(later, arg.id) and not _loads_in(
+                        later, arg.id
+                    ):
+                        break  # rebound before any read
+                    loads = _loads_in(later, arg.id)
+                    if loads:
+                        # a statement that both loads and stores
+                        # (x = g(x)) still reads the dead buffer
+                        hit = loads[0]
+                        break
+                if hit is not None:
+                    findings.append(
+                        Finding(
+                            rule="DDP003",
+                            path=mod.path,
+                            line=hit.lineno,
+                            col=hit.col_offset,
+                            message=(
+                                f"`{arg.id}` was donated to "
+                                f"`{_call_display(call)}` on line "
+                                f"{call.lineno} (donate_argnums={p}) "
+                                "and is read again — its buffer is "
+                                "dead after the call"
+                            ),
+                            hint=(
+                                "use the call's RETURN value, or drop "
+                                "the donation for this argument"
+                            ),
+                        )
+                    )
+                elif loop_stack:
+                    loop = loop_stack[-1]
+                    if not _stores_name(loop, arg.id):
+                        findings.append(
+                            Finding(
+                                rule="DDP003",
+                                path=mod.path,
+                                line=call.lineno,
+                                col=call.col_offset,
+                                message=(
+                                    f"`{arg.id}` is donated to "
+                                    f"`{_call_display(call)}` inside a "
+                                    "loop without being rebound — the "
+                                    "next iteration re-reads the dead "
+                                    "buffer"
+                                ),
+                                hint=(
+                                    "rebind the donated name from the "
+                                    "call's return value each iteration"
+                                ),
+                            )
+                        )
+        # recurse into nested blocks
+        if isinstance(stmt, (ast.For, ast.While)):
+            _scan_block(
+                mod, stmt.body, donating, findings, loop_stack + [stmt]
+            )
+            _scan_block(mod, stmt.orelse, donating, findings, loop_stack)
+        elif isinstance(stmt, ast.If):
+            _scan_block(mod, stmt.body, donating, findings, loop_stack)
+            _scan_block(mod, stmt.orelse, donating, findings, loop_stack)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            _scan_block(mod, stmt.body, donating, findings, loop_stack)
+        elif isinstance(stmt, ast.Try) or stmt.__class__.__name__ == "TryStar":
+            _scan_block(mod, stmt.body, donating, findings, loop_stack)
+            for h in stmt.handlers:
+                _scan_block(mod, h.body, donating, findings, loop_stack)
+            _scan_block(mod, stmt.orelse, donating, findings, loop_stack)
+            _scan_block(mod, stmt.finalbody, donating, findings, loop_stack)
+
+
+def check(mod: ModuleInfo, project) -> list[Finding]:
+    del project
+    donating = _collect_donating(mod)
+    if not donating:
+        return []
+    findings: list[Finding] = []
+    seen: set[tuple[int, int, str]] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_block(mod, node.body, donating, findings, [])
+    # top-level statements too (scripts)
+    _scan_block(mod, mod.tree.body, donating, findings, [])
+    uniq = []
+    for f in findings:
+        key = (f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    return uniq

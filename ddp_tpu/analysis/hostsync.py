@@ -1,0 +1,154 @@
+"""DDP002 — host sync inside jit-reachable code.
+
+The PR-3 class: the serve engine's entire redesign existed to shrink
+per-step host traffic to one ``[slots]`` int32 fetch, because every
+``.item()`` / ``float(loss)`` / ``np.asarray(x)`` in the hot path is
+a device→host round trip that stalls the dispatch pipeline (and on
+multi-host, stalls *every* rank behind the slowest). Inside code that
+jit actually traces these are worse than slow — a concrete-value pull
+on a tracer is a trace error, a ``print`` runs once at trace time and
+then silently never again.
+
+Detection: the callgraph walk (``analysis/callgraph.py``) marks every
+function reached from a ``jax.jit``/``shard_map``/``lax.*`` root;
+within those, flag
+
+- ``x.item()``
+- ``np.asarray(...)`` / ``np.array(...)`` (numpy materialization)
+- ``jax.device_get(...)``
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` on dynamic expressions
+  (static shape arithmetic — ``.shape``/``.ndim``/``len()`` — is
+  exempt: those are Python ints at trace time by construction)
+- ``print(...)`` (trace-time only; ``jax.debug.print`` is the traced
+  equivalent)
+
+Host code is never flagged: the trainer's log-cadence ``float(loss)``
+is the *design* (and the ``--sanitize`` transfer guard polices the
+dynamic half of this rule at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ddp_tpu.analysis.callgraph import Project, ingraph_functions
+from ddp_tpu.analysis.core import Finding, ModuleInfo
+
+_CASTS = {"float", "int", "bool"}
+# exact canonical names: `jnp` resolves to "jax.numpy", whose asarray
+# is a DEVICE op — only host numpy materializes
+_NUMPY_EXACT = {"numpy.asarray", "numpy.array"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+_STATIC_CALLS = {"len", "range", "enumerate"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the cast argument is trace-time static by
+    construction: constants, shape/dtype attributes, len()-style
+    introspection, or arithmetic over those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS or _is_static_expr(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _STATIC_CALLS
+        ):
+            return True
+        # method calls on a static chain: mesh.shape.get("fsdp", 1)
+        return isinstance(node.func, ast.Attribute) and _is_static_expr(
+            node.func.value
+        )
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+def _sync_kind(mod: ModuleInfo, call: ast.Call) -> tuple[str, str] | None:
+    """(display, hint) when the call is a host sync, else None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "item" and not call.args:
+        return (
+            "`.item()`",
+            "return the array and read it at the host loop's cadence",
+        )
+    resolved = mod.resolve(fn)
+    if resolved:
+        if resolved in _NUMPY_EXACT:
+            tail = resolved.rsplit(".", 1)[-1]
+            return (
+                f"`np.{tail}(...)`",
+                "keep the value on device (jnp) or fetch it outside "
+                "the traced function",
+            )
+        if resolved.endswith("jax.device_get") or resolved == "device_get":
+            return (
+                "`jax.device_get(...)`",
+                "fetch outside the traced function",
+            )
+    if isinstance(fn, ast.Name):
+        if fn.id == "print":
+            return (
+                "`print(...)`",
+                "runs at TRACE time only — use jax.debug.print for "
+                "per-step output",
+            )
+        if (
+            fn.id in _CASTS
+            and fn.id not in mod.aliases
+            and len(call.args) == 1
+            and not call.keywords
+            and not _is_static_expr(call.args[0])
+        ):
+            return (
+                f"`{fn.id}(...)` on a dynamic value",
+                "a concrete-value pull on a tracer fails at trace "
+                "time; keep the computation in jnp",
+            )
+    return None
+
+
+def check(mod: ModuleInfo, project: Project) -> list[Finding]:
+    findings: list[Finding] = []
+    for rec in ingraph_functions(project, mod):
+        # nested in-graph functions are walked via their own record;
+        # skip their bodies here so a finding lands exactly once.
+        nested = {
+            child.node
+            for (mn, q), child in project.functions.items()
+            if mn == mod.modname and q.startswith(rec.qualname + ".")
+        }
+
+        def walk(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if child in nested:
+                    continue
+                if isinstance(child, ast.Call):
+                    kind = _sync_kind(mod, child)
+                    if kind is not None:
+                        display, hint = kind
+                        findings.append(
+                            Finding(
+                                rule="DDP002",
+                                path=mod.path,
+                                line=child.lineno,
+                                col=child.col_offset,
+                                message=(
+                                    f"host sync {display} inside "
+                                    "jit-reachable code (reached from a "
+                                    "jit/shard_map root via "
+                                    f"`{rec.qualname}`)"
+                                ),
+                                hint=hint,
+                            )
+                        )
+                walk(child)
+
+        for stmt in rec.node.body:
+            walk(stmt)
+    return findings

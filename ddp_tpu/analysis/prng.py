@@ -1,0 +1,266 @@
+"""DDP005 — PRNG key reuse without split/fold_in.
+
+The silent-correctness class: JAX keys are pure values, so passing
+the same key to two samplers gives two CORRELATED (often identical)
+draws — no error, no warning, just dropout masks that equal the init
+noise, or every serve lane sampling the same token stream. The serve
+sampler threads per-slot ``fold_in`` counters for exactly this
+reason.
+
+Model (per function scope, names only):
+
+- a key is born from ``PRNGKey``/``random.key``/``split``/``fold_in``
+  (or a parameter named like one: ``key``, ``rng``, ``*_key``,
+  ``*_rng``);
+- passing a key to any call CONSUMES it — including ``split`` (using
+  the parent after splitting it is the classic bug);
+- ``fold_in(key, i)`` does NOT consume: deriving per-step keys from a
+  base with distinct data is the sanctioned streaming pattern;
+- a second consumption without an intervening rebind is a finding.
+
+``if``/``else`` branches merge (one consumption on each side is one
+consumption); loop bodies are interpreted twice so a key consumed
+once per iteration without a per-iteration ``split``/``fold_in``
+rebind is caught on the second pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ddp_tpu.analysis.core import Finding, ModuleInfo
+
+_DERIVE_TAILS = (
+    "random.PRNGKey",
+    "random.key",
+    "random.split",
+    "random.fold_in",
+    "random.clone",
+    "random.wrap_key_data",
+)
+_NONCONSUMING_TAILS = (
+    "random.PRNGKey",
+    "random.key",
+    "random.fold_in",
+    "random.clone",
+    "random.wrap_key_data",
+)
+_KEY_PARAM_RE = re.compile(r"(^|_)(key|rng)$")
+
+
+def _tail_match(resolved: str | None, tails) -> bool:
+    if not resolved:
+        return False
+    return any(
+        resolved == t or resolved.endswith("." + t) or resolved == t.split(".")[-1]
+        for t in tails
+    )
+
+
+def _is_derivation(mod: ModuleInfo, call: ast.Call) -> bool:
+    return _tail_match(mod.resolve(call.func), _DERIVE_TAILS)
+
+
+def _is_nonconsuming(mod: ModuleInfo, call: ast.Call) -> bool:
+    return _tail_match(mod.resolve(call.func), _NONCONSUMING_TAILS)
+
+
+def _stmt_targets(stmt: ast.stmt) -> list[str]:
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out: list[str] = []
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                out.append(node.id)
+    return out
+
+
+def _calls_pruned(root: ast.AST) -> list[ast.Call]:
+    """Calls in source order, without descending into nested scopes."""
+    out: list[ast.Call] = []
+
+    def visit(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(child, ast.Call):
+                out.append(child)
+            visit(child)
+
+    visit(root)
+    out.sort(key=lambda c: (c.lineno, c.col_offset))
+    return out
+
+
+def _key_args(call: ast.Call) -> list[ast.Name]:
+    args = list(call.args) + [
+        kw.value for kw in call.keywords if kw.value is not None
+    ]
+    return [a for a in args if isinstance(a, ast.Name)]
+
+
+class _Scope:
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.findings: list[Finding] = []
+        self._seen: set[tuple[int, int]] = set()
+
+    def _flag(self, name_node: ast.Name) -> None:
+        key = (name_node.lineno, name_node.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(
+                rule="DDP005",
+                path=self.mod.path,
+                line=name_node.lineno,
+                col=name_node.col_offset,
+                message=(
+                    f"PRNG key `{name_node.id}` consumed again without "
+                    "split/fold_in — both consumers draw CORRELATED "
+                    "randomness"
+                ),
+                hint=(
+                    "split the key (`k1, k2 = jax.random.split(key)`) "
+                    "or fold in a distinct counter per consumer"
+                ),
+            )
+        )
+
+    def _consume_stmt(self, stmt: ast.stmt, state: dict[str, int]) -> None:
+        for call in _calls_pruned(stmt):
+            if _is_nonconsuming(self.mod, call):
+                continue
+            for name in _key_args(call):
+                if name.id in state:
+                    if state[name.id] >= 1:
+                        self._flag(name)
+                    state[name.id] += 1
+
+    def _apply_stores(self, stmt: ast.stmt, state: dict[str, int]) -> None:
+        names = _stmt_targets(stmt)
+        if not names:
+            return
+        value = getattr(stmt, "value", None)
+        is_key_value = (
+            isinstance(value, ast.Call)
+            and _is_derivation(self.mod, value)
+        ) or (
+            isinstance(value, ast.Name) and value.id in state
+        )
+        for n in names:
+            if is_key_value:
+                state[n] = (
+                    state.get(value.id, 0)
+                    if isinstance(value, ast.Name)
+                    else 0
+                )
+            else:
+                state.pop(n, None)
+
+    def run_block(
+        self, stmts: list[ast.stmt], state: dict[str, int]
+    ) -> bool:
+        """Interpret a block; True when it terminates (return/raise/
+        break/continue) — a terminated branch never merges into the
+        fall-through state, so `if flip: return normal(key)` followed
+        by `return uniform(key)` is one consumption per path."""
+        terminated = False
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.Return, ast.Raise, ast.Break, ast.Continue)
+            ):
+                self._consume_stmt(stmt, state)
+                terminated = True
+                break
+            if isinstance(stmt, ast.If):
+                self._consume_expr(stmt.test, state)
+                s1 = dict(state)
+                t1 = self.run_block(stmt.body, s1)
+                s2 = dict(state)
+                t2 = self.run_block(stmt.orelse, s2)
+                live = (
+                    [s for s, t in ((s1, t1), (s2, t2)) if not t]
+                    or [s1]  # both terminated: dead fall-through
+                )
+                # merge: a key alive in every live branch stays
+                # tracked, at the max consumption any path reached
+                merged: dict[str, int] = {}
+                for k in set().union(*live):
+                    if all(k in s for s in live):
+                        merged[k] = max(s[k] for s in live)
+                state.clear()
+                state.update(merged)
+                if t1 and t2 and stmt.orelse:
+                    terminated = True
+                    break
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, ast.While):
+                    self._consume_expr(stmt.test, state)
+                else:
+                    # the loop target rebinds: not a key anymore
+                    for node in ast.walk(stmt.target):
+                        if isinstance(node, ast.Name):
+                            state.pop(node.id, None)
+                    self._consume_expr(stmt.iter, state)
+                # two passes: reuse across iterations surfaces on the
+                # second (a per-iteration rebind resets the count and
+                # stays clean)
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.body, state)
+                self.run_block(stmt.orelse, state)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._consume_expr(item.context_expr, state)
+                self.run_block(stmt.body, state)
+            elif isinstance(stmt, ast.Try) or (
+                stmt.__class__.__name__ == "TryStar"
+            ):
+                self.run_block(stmt.body, state)
+                for h in stmt.handlers:
+                    self.run_block(h.body, state)
+                self.run_block(stmt.orelse, state)
+                self.run_block(stmt.finalbody, state)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue  # separate scope, analyzed on its own
+            else:
+                self._consume_stmt(stmt, state)
+                self._apply_stores(stmt, state)
+        return terminated
+
+    def _consume_expr(self, expr: ast.AST, state: dict[str, int]) -> None:
+        wrapper = ast.Expr(value=expr)
+        ast.copy_location(wrapper, expr)
+        self._consume_stmt(wrapper, state)
+
+
+def check(mod: ModuleInfo, project) -> list[Finding]:
+    del project
+    findings: list[Finding] = []
+    scopes: list[tuple[list[ast.stmt], dict[str, int]]] = [
+        (mod.tree.body, {})
+    ]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            state: dict[str, int] = {}
+            args = node.args
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+            ):
+                if _KEY_PARAM_RE.search(a.arg):
+                    state[a.arg] = 0
+            scopes.append((node.body, state))
+    for body, state in scopes:
+        scope = _Scope(mod)
+        scope.run_block(body, state)
+        findings.extend(scope.findings)
+    return findings

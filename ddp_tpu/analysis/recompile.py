@@ -1,0 +1,275 @@
+"""DDP004 — recompile hazards.
+
+The PR-2/PR-4 class: the obs layer grew a process-wide compile
+counter and a recompile-storm sentry because recompiles are the
+silent 100× step-time cliff — and every storm traced back to one of
+a few static patterns:
+
+- ``jax.jit(...)`` constructed inside a loop: each iteration builds a
+  NEW callable with an empty cache, so every iteration pays a full
+  XLA compile (the cache keys on function identity). Building a jit
+  once inside a *builder function* — the codebase idiom — is fine;
+  building it per loop iteration never is.
+- unhashable static args: ``static_argnums``/``static_argnames``
+  pointing at a parameter whose default (or call-site value) is a
+  ``list``/``dict``/``set`` — jit requires hashable statics, and the
+  error surfaces at the first call, far from the definition.
+- data-dependent shapes: ``jnp.zeros(int(n * frac))``-style arithmetic
+  flowing into a shape position recompiles once per distinct value
+  (shape changes are new programs, the PR-3 bucketing lesson).
+
+Each sub-pattern is one findable, fixture-pinned shape — not a
+heuristic over the whole program.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ddp_tpu.analysis.core import Finding, ModuleInfo
+from ddp_tpu.analysis.donation import _is_jit  # same jit-name matcher
+
+_SHAPE_CTORS = (
+    "numpy.zeros",
+    "numpy.ones",
+    "numpy.full",
+    "numpy.empty",
+    "jax.numpy.zeros",
+    "jax.numpy.ones",
+    "jax.numpy.full",
+    "jax.numpy.empty",
+    "jnp.zeros",
+    "jnp.ones",
+    "jnp.full",
+    "jnp.empty",
+)
+
+_MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp)
+
+
+def _jit_call_here(mod: ModuleInfo, node: ast.Call) -> bool:
+    if _is_jit(mod, node.func):
+        return True
+    # partial(jax.jit, ...) builds the same per-iteration callable
+    resolved = mod.resolve(node.func)
+    if resolved and (
+        resolved == "functools.partial" or resolved.endswith(".partial")
+        or resolved == "partial"
+    ):
+        return bool(node.args) and _is_jit(mod, node.args[0])
+    return False
+
+
+def _static_param_names(
+    call: ast.Call, params: list[str] | None
+) -> list[str]:
+    """Parameter names declared static at this jit site."""
+    out: list[str] = []
+    for kw in call.keywords:
+        vals = (
+            kw.value.elts
+            if isinstance(kw.value, (ast.Tuple, ast.List))
+            else [kw.value]
+        )
+        if kw.arg == "static_argnames":
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.append(v.value)
+        elif kw.arg == "static_argnums" and params:
+            for v in vals:
+                if (
+                    isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                    and v.value < len(params)
+                ):
+                    out.append(params[v.value])
+    return out
+
+
+def _check_jit_in_loop(mod: ModuleInfo, findings: list[Finding]) -> None:
+    loop_depth = 0
+
+    def visit(node: ast.AST):
+        nonlocal loop_depth
+        is_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+        if is_loop:
+            loop_depth += 1
+        if (
+            loop_depth > 0
+            and isinstance(node, ast.Call)
+            and _jit_call_here(mod, node)
+        ):
+            findings.append(
+                Finding(
+                    rule="DDP004",
+                    path=mod.path,
+                    line=node.lineno,
+                    col=node.col_offset,
+                    message=(
+                        "jit-compiled callable constructed inside a "
+                        "loop — each iteration builds a fresh cache "
+                        "and pays a full XLA compile"
+                    ),
+                    hint=(
+                        "hoist the jax.jit(...) above the loop (the "
+                        "compile cache keys on function identity)"
+                    ),
+                )
+            )
+        # comprehensions repeat their element expression too
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+        ):
+            loop_depth += 1
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            loop_depth -= 1
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        if is_loop:
+            loop_depth -= 1
+
+    visit(mod.tree)
+
+
+def _check_unhashable_static(
+    mod: ModuleInfo, findings: list[Finding]
+) -> None:
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    def flag_defaults(fn: ast.FunctionDef, static_names: list[str]):
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        offset = len(pos) - len(defaults)
+        for i, d in enumerate(defaults):
+            name = pos[offset + i].arg
+            if name in static_names and isinstance(d, _MUTABLE):
+                findings.append(
+                    Finding(
+                        rule="DDP004",
+                        path=mod.path,
+                        line=d.lineno,
+                        col=d.col_offset,
+                        message=(
+                            f"static argument `{name}` defaults to an "
+                            "unhashable value — jit statics must hash "
+                            "(and a mutated default silently changes "
+                            "the compile key)"
+                        ),
+                        hint="use a tuple (or a frozen dataclass)",
+                    )
+                )
+        for kwarg, d in zip(args.kwonlyargs, args.kw_defaults):
+            if (
+                d is not None
+                and kwarg.arg in static_names
+                and isinstance(d, _MUTABLE)
+            ):
+                findings.append(
+                    Finding(
+                        rule="DDP004",
+                        path=mod.path,
+                        line=d.lineno,
+                        col=d.col_offset,
+                        message=(
+                            f"static argument `{kwarg.arg}` defaults "
+                            "to an unhashable value — jit statics "
+                            "must hash"
+                        ),
+                        hint="use a tuple (or a frozen dataclass)",
+                    )
+                )
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _is_jit(mod, node.func):
+            params = None
+            target: ast.FunctionDef | None = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = defs.get(node.args[0].id)
+                if target is not None:
+                    params = [
+                        a.arg
+                        for a in target.args.posonlyargs + target.args.args
+                    ]
+            static_names = _static_param_names(node, params)
+            if static_names and target is not None:
+                flag_defaults(target, static_names)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit(mod, dec.func)
+                    or (dec.args and _is_jit(mod, dec.args[0]))
+                ):
+                    params = [
+                        a.arg
+                        for a in node.args.posonlyargs + node.args.args
+                    ]
+                    static_names = _static_param_names(dec, params)
+                    if static_names:
+                        flag_defaults(node, static_names)
+
+
+def _contains_dynamic_int(node: ast.AST) -> ast.Call | None:
+    """An ``int(<arithmetic>)`` buried in a shape expression."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "int"
+            and sub.args
+            and isinstance(sub.args[0], ast.BinOp)
+        ):
+            return sub
+    return None
+
+
+def _check_dynamic_shapes(
+    mod: ModuleInfo, findings: list[Finding]
+) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = mod.resolve(node.func)
+        if not resolved or not any(
+            resolved == t or resolved.endswith("." + t)
+            for t in _SHAPE_CTORS
+        ):
+            continue
+        if not node.args:
+            continue
+        hit = _contains_dynamic_int(node.args[0])
+        if hit is not None:
+            findings.append(
+                Finding(
+                    rule="DDP004",
+                    path=mod.path,
+                    line=hit.lineno,
+                    col=hit.col_offset,
+                    message=(
+                        "data-dependent `int(...)` arithmetic in a "
+                        "shape position — every distinct value is a "
+                        "new program (one full recompile each)"
+                    ),
+                    hint=(
+                        "round the size to a bucket (powers of two: "
+                        "the serve-engine prefill lesson) or pad to a "
+                        "static bound"
+                    ),
+                )
+            )
+
+
+def check(mod: ModuleInfo, project) -> list[Finding]:
+    del project
+    findings: list[Finding] = []
+    _check_jit_in_loop(mod, findings)
+    _check_unhashable_static(mod, findings)
+    _check_dynamic_shapes(mod, findings)
+    return findings

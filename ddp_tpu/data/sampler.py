@@ -56,8 +56,17 @@ class ShardSampler:
     def epoch_indices(self, epoch: int) -> np.ndarray:
         """Global index order for ``epoch`` (before shard slicing)."""
         if self.shuffle:
-            key = jax.random.key(self.seed + epoch)
-            perm = np.asarray(
+            # The epoch plan is a deliberate per-epoch device round
+            # trip (--sanitize found the implicit spelling): the key
+            # upload runs in an explicit allow window — device_put
+            # can't replace it, int32 canonicalization would reject
+            # the seeds >= 2**31 that key() folds 64-bit — and the
+            # readback is an explicit device_get. Bit-identical to
+            # the old spelling for every seed (pinned by
+            # test_sanitize).
+            with jax.transfer_guard("allow"):
+                key = jax.random.key(self.seed + epoch)
+            perm = jax.device_get(
                 jax.random.permutation(key, self.num_examples, independent=False)
             )
         else:

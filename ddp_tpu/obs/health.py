@@ -157,7 +157,11 @@ def health_stats(grads, params, updates) -> HealthStats:
 
     paths, gidx = group_layout(grads)
     G = len(paths)
-    seg = np.asarray(gidx, np.int32)
+    # Device-resident segment ids (self-lint DDP002): the layout is
+    # trace-time static either way, but a host-numpy constant inside
+    # the traced stats pass materializes on host first — jnp pins it
+    # directly as an on-device constant.
+    seg = jnp.asarray(gidx, jnp.int32)
 
     def seg_sqnorm(tree):
         parts = jnp.stack(

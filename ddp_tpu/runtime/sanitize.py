@@ -1,0 +1,88 @@
+"""Runtime sanitizer — the dynamic half of the hazard linter.
+
+``ddp_tpu.analysis`` finds host-sync and transfer patterns statically
+(DDP002); ``--sanitize`` proves the dynamic instances: it arms
+``jax.transfer_guard("disallow")`` around the hot loop so any
+IMPLICIT host↔device transfer — a numpy array slipping into the
+jitted step, a stray ``float(loss)`` added outside the log cadence, a
+``.item()`` in a callback — raises an ``XlaRuntimeError`` at the
+offending call instead of silently stalling every step. The loop's
+DELIBERATE syncs (the log-cadence reads, the one-step-behind health
+retire, the consensus gather, the serve engine's ``[slots]`` fetch)
+run inside explicit ``allow()`` windows: the contract in the code,
+enforced at runtime.
+
+Explicit transfers (``jax.device_put`` / ``device_get`` — how the
+loader and the drain fetch move data ON PURPOSE) stay legal under the
+guard; so does trace-time constant embedding (probed: jit compile
+under ``disallow`` is clean on this jax).
+
+The second half is the desync watchdog: with ``--sanitize`` and no
+explicit ``--watchdog_timeout``, the step watchdog arms at
+``--sanitize_timeout`` with an abort that names the likely cause — a
+rank-divergent collective (the DDP001 class) leaves every peer
+blocked mid-collective, which from one host is indistinguishable
+from a hang except by the flight-recorder tail the forensics hook
+dumps.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+logger = logging.getLogger("ddp_tpu")
+
+# Default seconds of no step progress before the desync watchdog
+# aborts (generous: must clear the first-step XLA compile).
+DESYNC_TIMEOUT_DEFAULT = 300.0
+
+
+class Sanitizer:
+    """Transfer-guard windows for a hot loop.
+
+    ``enabled=False`` (the default everywhere) makes both context
+    managers free no-ops, so call sites wire them unconditionally —
+    the same pattern as the tracer's null spans.
+    """
+
+    def __init__(self, enabled: bool):
+        self.enabled = bool(enabled)
+
+    def guard(self):
+        """Arm ``disallow`` for the enclosed hot-loop region."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.transfer_guard("disallow")
+
+    def allow(self):
+        """A deliberate-sync window inside a guarded region (log
+        cadence, health retire, consensus gather, drain fetch)."""
+        if not self.enabled:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.transfer_guard("allow")
+
+
+def desync_abort(num_processes: int):
+    """Watchdog abort for ``--sanitize``: same forensics + hard exit
+    as the default, prefixed with the desync diagnosis so the
+    post-mortem starts at the right hazard class."""
+    from ddp_tpu.utils.watchdog import _default_abort
+
+    def _abort(seconds: float) -> None:
+        logger.error(
+            "sanitize: no step progress for %.0fs across %d "
+            "process(es) — suspected collective desync (a rank left a "
+            "collective early, or entered one alone: the DDP001 "
+            "class). The flight recorder dumps next; check the last "
+            "per-rank steps for the divergence point.",
+            seconds,
+            num_processes,
+        )
+        _default_abort(seconds)
+
+    return _abort

@@ -172,6 +172,7 @@ class ServeEngine:
         metrics: Optional[MetricsWriter] = None,
         tracer: Optional[Tracer] = None,
         clock: Callable[[], float] = time.monotonic,
+        sanitize: bool = False,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -227,6 +228,17 @@ class ServeEngine:
         # block until ready so spans cover device compute — measuring
         # mode trades the pipeline overlap for span fidelity.
         self.tracer = tracer or Tracer()
+        # Runtime sanitizer (--sanitize, runtime/sanitize.py): the
+        # transfer guard arms around the steady-state DECODE dispatch
+        # in step(), proving it does zero implicit host transfer. The
+        # engine's two deliberate transfers — the chunk-argument
+        # upload and the one-step-behind [S] int32 fetch — execute
+        # OUTSIDE the guarded region (no allow() windows here, unlike
+        # the trainer). Disabled = a free nullcontext, like the
+        # tracer.
+        from ddp_tpu.runtime.sanitize import Sanitizer
+
+        self._sanitizer = Sanitizer(sanitize)
         self._started_at = clock()
         self._productive_s = 0.0
         self.scheduler = Scheduler(
@@ -555,10 +567,17 @@ class ServeEngine:
         # throw the entire output away.
         if emit_lanes:
             t0 = time.perf_counter()
-            self._toks, self._cache, self._sample_steps = self._decode(
-                self.params, self._cache, self._toks, self._seeds,
-                self._sample_steps, self._temps, self._top_ps,
-            )
+            # --sanitize: every steady-state decode input is already
+            # device-resident, so the guard proves this dispatch does
+            # ZERO implicit host transfer — the PR-3 invariant,
+            # enforced instead of assumed. (Chunk dispatch above
+            # legitimately uploads prompt content; the retire below
+            # legitimately fetches [S] int32 — both deliberate.)
+            with self._sanitizer.guard():
+                self._toks, self._cache, self._sample_steps = self._decode(
+                    self.params, self._cache, self._toks, self._seeds,
+                    self._sample_steps, self._temps, self._top_ps,
+                )
             device_work = True
             if traced:
                 jax.block_until_ready(self._toks)

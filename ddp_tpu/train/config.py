@@ -192,6 +192,18 @@ class TrainConfig:
     # Every event fires ONCE across restarts (per-rank ledger next to
     # the checkpoints) — see docs/ROBUSTNESS.md for the grammar.
     chaos: str | None = None
+    # Runtime sanitizer (runtime/sanitize.py): arm
+    # jax.transfer_guard("disallow") around the train hot loop so any
+    # IMPLICIT host<->device transfer raises at the offending call
+    # (the dynamic half of scripts/lint.py's DDP002), and arm the
+    # step watchdog at --sanitize_timeout with a desync-diagnosing
+    # abort when --watchdog_timeout is unset. A diagnosis mode, like
+    # --trace_dir.
+    sanitize: bool = False
+    # Desync-watchdog timeout under --sanitize (seconds; only applies
+    # when --watchdog_timeout is 0). Must clear the first-step
+    # compile. 0 disables the watchdog half.
+    sanitize_timeout: float = 300.0
     # Restart-with-resume under --spawn: when a rank dies, the
     # launcher reaps the whole world and relaunches it (fresh
     # coordinator, exponential backoff) up to this many times; each
@@ -361,6 +373,19 @@ class TrainConfig:
             "'kill:rank1@step20,sigterm:rank0@epoch1,"
             "stall:input@step5:2.5s,ckpt_corrupt:latest' "
             "(docs/ROBUSTNESS.md; events fire once across restarts)",
+        )
+        p.add_argument(
+            "--sanitize", action="store_true",
+            help="arm jax.transfer_guard('disallow') around the hot "
+            "loop (implicit host transfers raise) plus the desync "
+            "watchdog — the runtime half of scripts/lint.py "
+            "(docs/ANALYSIS.md)",
+        )
+        p.add_argument(
+            "--sanitize_timeout", type=float,
+            default=cls.sanitize_timeout,
+            help="desync-watchdog seconds under --sanitize (when "
+            "--watchdog_timeout is unset; 0 = guard only)",
         )
         p.add_argument(
             "--max_restarts", type=int, default=cls.max_restarts,

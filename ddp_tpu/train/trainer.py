@@ -1149,8 +1149,32 @@ class Trainer:
         self._peak_flops = (
             peak_flops_per_chip(devices[0]) * self.mesh.devices.size
         )
+        # Runtime sanitizer (--sanitize, runtime/sanitize.py): the
+        # transfer guard arms around the hot loop in _train_epoch
+        # (deliberate syncs run in allow() windows); disabled it is a
+        # nullcontext, pinned free like the tracer. The watchdog half
+        # rides the existing StepWatchdog: with no explicit
+        # --watchdog_timeout, --sanitize arms it at --sanitize_timeout
+        # with the desync-diagnosing abort. Not under --fast_epoch —
+        # one dispatch per epoch has no per-step beats (the same
+        # reason an explicit step-scale timeout is rejected there).
+        from ddp_tpu.runtime.sanitize import Sanitizer, desync_abort
+
+        self._sanitizer = Sanitizer(config.sanitize)
+        self._wd_dump_reason = "watchdog_timeout"
+        wd_timeout = config.watchdog_timeout
+        wd_kwargs = {}
+        if (
+            config.sanitize
+            and wd_timeout <= 0
+            and config.sanitize_timeout > 0
+            and not config.fast_epoch
+        ):
+            wd_timeout = config.sanitize_timeout
+            wd_kwargs["on_timeout"] = desync_abort(self.ctx.num_processes)
+            self._wd_dump_reason = "suspected_desync"
         # Constructed here, armed in train() (start/stop bracket the run).
-        self._watchdog = StepWatchdog(config.watchdog_timeout)
+        self._watchdog = StepWatchdog(wd_timeout, **wd_kwargs)
         # Deterministic fault injection (--chaos, runtime/chaos.py):
         # each rank arms its share of the plan; the per-rank ledger
         # next to the checkpoints makes every event once-only across
@@ -1778,7 +1802,7 @@ class Trainer:
             from ddp_tpu.utils.watchdog import register_forensics
 
             def wd_forensic():
-                self._recorder.dump("watchdog_timeout")
+                self._recorder.dump(self._wd_dump_reason)
                 self._export_trace()
 
             register_forensics(wd_forensic)
@@ -2014,98 +2038,120 @@ class Trainer:
         # dispatch-return from block_until_ready; disabled, batches()
         # hands back the raw iterator and on_step returns immediately.
         attr = self._attr
-        for batch_idx, batch in enumerate(
-            attr.batches(self.loader.epoch(epoch, skip_batches)),
-            start=skip_batches,
-        ):
-            # Chaos trigger point (--chaos): "step N" fires before the
-            # dispatch that would run global step N — kills/SIGTERMs
-            # land here, input stalls sleep here (the straggler sentry
-            # and goodput accounting see them like real ones).
-            self._chaos.on_step(step0 + n_batches)
-            self.state, metrics = self.train_step(
-                self.state, batch.images, batch.labels
-            )
-            timing = attr.on_step(metrics.loss)
-            host_step = step0 + n_batches  # this dispatch's in-graph step
-            self._recorder.record(
-                "step", epoch=epoch, batch=batch_idx, step=host_step
-            )
-            if self._health.enabled:
-                # Retires the PREVIOUS step's [G] health vectors (one
-                # step behind the dispatch — the only added sync) and
-                # runs the sentry; events apply --health_action.
-                events = self._health.on_step(host_step, metrics)
-                if events:
-                    self._on_health_events(
-                        events, epoch=epoch, ran=batch_idx + 1
-                    )
-            last_metrics = metrics
-            n_batches += 1
-            inflight.append(metrics.loss)
-            if len(inflight) > self.MAX_INFLIGHT_STEPS:
-                jax.block_until_ready(inflight.popleft())
-            # Progress beat AFTER the bounded sync above: a hung
-            # collective stalls that block_until_ready, beats stop,
-            # and the watchdog converts the hang into a crash.
-            self._watchdog.beat()
-            if self.ctx.num_processes == 1:
-                if self._preempt_requested:
-                    break  # caller checkpoints the mid-epoch state
-            elif batch_idx % cfg.log_interval == 0:
-                # Multi-host: breaking on the local flag alone would
-                # leave peers blocked in the next step's collective.
-                # ONE agreement gather at this deterministic cadence
-                # carries the preemption flag AND the deferred health
-                # escalations (_on_health_events), so every process
-                # halts / checkpoints / exits at the SAME batch.
-                pre, halt, rescue = self._sync_flags(host_step)
-                if halt or rescue:
-                    self._act_on_agreed(
-                        halt, rescue, epoch=epoch, ran=batch_idx + 1,
-                        host_step=host_step,
-                    )
-                if pre:
-                    break
-            if batch_idx % cfg.log_interval == 0:
-                # train_ddp.py:201-202 parity: rank-0 loss print. .item()
-                # syncs, so only at the log cadence.
-                loss = float(metrics.loss)
-                losses.append(loss)
-                step_now = int(self.state.step)
-                logger.info(
-                    "Epoch %d Batch %d Loss %.4f", epoch, batch_idx, loss
+        # --sanitize: the guard makes any IMPLICIT transfer in this
+        # loop raise at the offending call (runtime/sanitize.py — the
+        # dynamic half of lint rule DDP002). The loop's DELIBERATE
+        # syncs each run in an allow() window below: the log-cadence
+        # reads, the one-step-behind health retire, the consensus
+        # gather. Disabled, both are nullcontexts.
+        with self._sanitizer.guard():
+            for batch_idx, batch in enumerate(
+                attr.batches(self.loader.epoch(epoch, skip_batches)),
+                start=skip_batches,
+            ):
+                # Chaos trigger point (--chaos): "step N" fires before
+                # the dispatch that would run global step N — kills/
+                # SIGTERMs land here, input stalls sleep here (the
+                # straggler sentry and goodput accounting see them
+                # like real ones).
+                self._chaos.on_step(step0 + n_batches)
+                self.state, metrics = self.train_step(
+                    self.state, batch.images, batch.labels
                 )
-                gn = (
-                    {}
-                    if metrics.grad_norm is None
-                    else {"grad_norm": round(float(metrics.grad_norm), 6)}
-                )
-                lr_now = round(
-                    lr_at(self._lr_schedule, max(0, step_now - 1)), 8
-                )
-                obs_fields = self._step_obs_fields(timing)
-                self.metrics_writer.write(
-                    "step",
-                    epoch=epoch,
-                    batch=batch_idx,
-                    step=step_now,
-                    loss=loss,
-                    lr=lr_now,
-                    **gn,
-                    **obs_fields,
-                )
+                timing = attr.on_step(metrics.loss)
+                host_step = step0 + n_batches  # this dispatch's step
                 self._recorder.record(
-                    "log", step=step_now, epoch=epoch, batch=batch_idx,
-                    loss=loss, **gn,
+                    "step", epoch=epoch, batch=batch_idx, step=host_step
                 )
-                # Live exposition state (--metrics_port /metricsz).
-                self._prom_state.update(
-                    step=step_now, epoch=epoch, loss=loss, lr=lr_now,
-                    **gn,
-                )
-                if "mfu" in obs_fields:
-                    self._prom_state["mfu"] = obs_fields["mfu"]
+                if self._health.enabled:
+                    # Retires the PREVIOUS step's [G] health vectors
+                    # (one step behind the dispatch — the only added
+                    # sync, hence the allow window) and runs the
+                    # sentry; events apply --health_action.
+                    with self._sanitizer.allow():
+                        events = self._health.on_step(host_step, metrics)
+                        if events:
+                            self._on_health_events(
+                                events, epoch=epoch, ran=batch_idx + 1
+                            )
+                last_metrics = metrics
+                n_batches += 1
+                inflight.append(metrics.loss)
+                if len(inflight) > self.MAX_INFLIGHT_STEPS:
+                    jax.block_until_ready(inflight.popleft())
+                # Progress beat AFTER the bounded sync above: a hung
+                # collective stalls that block_until_ready, beats
+                # stop, and the watchdog converts the hang into a
+                # crash.
+                self._watchdog.beat()
+                if self.ctx.num_processes == 1:
+                    if self._preempt_requested:
+                        break  # caller checkpoints the mid-epoch state
+                elif batch_idx % cfg.log_interval == 0:
+                    # Multi-host: breaking on the local flag alone
+                    # would leave peers blocked in the next step's
+                    # collective. ONE agreement gather at this
+                    # deterministic cadence carries the preemption
+                    # flag AND the deferred health escalations
+                    # (_on_health_events), so every process halts /
+                    # checkpoints / exits at the SAME batch.
+                    with self._sanitizer.allow():
+                        pre, halt, rescue = self._sync_flags(host_step)
+                        if halt or rescue:
+                            self._act_on_agreed(
+                                halt, rescue, epoch=epoch,
+                                ran=batch_idx + 1, host_step=host_step,
+                            )
+                    if pre:
+                        break
+                if batch_idx % cfg.log_interval == 0:
+                    # train_ddp.py:201-202 parity: rank-0 loss print.
+                    # .item() syncs, so only at the log cadence — the
+                    # allow window marks it deliberate under
+                    # --sanitize.
+                    with self._sanitizer.allow():
+                        loss = float(metrics.loss)
+                        losses.append(loss)
+                        step_now = int(self.state.step)
+                        logger.info(
+                            "Epoch %d Batch %d Loss %.4f",
+                            epoch, batch_idx, loss,
+                        )
+                        gn = (
+                            {}
+                            if metrics.grad_norm is None
+                            else {
+                                "grad_norm": round(
+                                    float(metrics.grad_norm), 6
+                                )
+                            }
+                        )
+                        lr_now = round(
+                            lr_at(self._lr_schedule, max(0, step_now - 1)),
+                            8,
+                        )
+                        obs_fields = self._step_obs_fields(timing)
+                    self.metrics_writer.write(
+                        "step",
+                        epoch=epoch,
+                        batch=batch_idx,
+                        step=step_now,
+                        loss=loss,
+                        lr=lr_now,
+                        **gn,
+                        **obs_fields,
+                    )
+                    self._recorder.record(
+                        "log", step=step_now, epoch=epoch,
+                        batch=batch_idx, loss=loss, **gn,
+                    )
+                    # Live exposition state (--metrics_port /metricsz).
+                    self._prom_state.update(
+                        step=step_now, epoch=epoch, loss=loss, lr=lr_now,
+                        **gn,
+                    )
+                    if "mfu" in obs_fields:
+                        self._prom_state["mfu"] = obs_fields["mfu"]
         if last_metrics is not None:
             jax.block_until_ready(last_metrics.loss)
         # The monitor still owes the LAST step's ingestion (it runs
@@ -2212,13 +2258,20 @@ class Trainer:
         logger.info("Starting epoch %d (compiled fast path)", epoch)
         obs_extra = None
         t0 = time.perf_counter()
+        # --sanitize: the epoch dispatch is the whole hot loop here —
+        # the guard proves it transfer-free; the stacked per-step
+        # losses are read AFTER it (outside the guard), where host
+        # reads belong.
         if self._attr.enabled:
             # Per-EPOCH attribution — the whole epoch is one dispatch,
             # so dispatch-return vs block_until_ready is all the host
             # can observe of it (steptime.dispatch_compute_split).
-            (self.state, metrics), disp_s, comp_s, recompiles = (
-                dispatch_compute_split(self.fast_runner, self.state, epoch)
-            )
+            with self._sanitizer.guard():
+                (self.state, metrics), disp_s, comp_s, recompiles = (
+                    dispatch_compute_split(
+                        self.fast_runner, self.state, epoch
+                    )
+                )
             self.tracer.complete("epoch.dispatch", t0, disp_s)
             self.tracer.complete(
                 "epoch.compute", t0 + disp_s, comp_s,
@@ -2230,7 +2283,8 @@ class Trainer:
                 "recompiles": recompiles,
             }
         else:
-            self.state, metrics = self.fast_runner(self.state, epoch)
+            with self._sanitizer.guard():
+                self.state, metrics = self.fast_runner(self.state, epoch)
         losses_all = np.asarray(metrics.loss)
         gnorms_all = (
             None if metrics.grad_norm is None else np.asarray(metrics.grad_norm)

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Static analysis + runtime sanitizer (docs/ANALYSIS.md): catch the
+# hazard classes that cost PR-1..5 their hardest bugs — rank-divergent
+# collectives, hidden host syncs, donation misuse, recompile storms,
+# PRNG reuse — BEFORE runtime, then prove the dynamic half with the
+# transfer guard. All on a CPU dev box.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example16}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. The CI gate: lint the repo's own tree. Exit 0 = no unsuppressed
+#    findings (this exact command runs in the smoke tier).
+python scripts/lint.py --self
+
+# 2. The rule catalog, and a machine-readable report for CI tooling.
+python scripts/lint.py --list-rules
+python scripts/lint.py --self --json "$WORK/lint.json"
+python - <<PY
+import json
+doc = json.load(open("$WORK/lint.json"))
+assert doc["version"] == 1 and not doc["counts"], doc["counts"]
+print(f"lint.json: {doc['files']} files, counts={doc['counts']}")
+PY
+
+# 3. What a finding looks like: lint the true-positive fixture corpus
+#    (exit 1 — every rule fires, with file:line and a fix hint).
+python scripts/lint.py tests/lint_fixtures/ddp005_tp.py || true
+
+# 4. The runtime half: --sanitize arms jax.transfer_guard("disallow")
+#    around the hot loop (any implicit host transfer raises at the
+#    offending call) plus the desync watchdog. A clean tree trains
+#    clean — the deliberate syncs all sit in allow() windows.
+python train.py --epochs 1 --batch_size 8 \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --log_interval 4 --eval_every 0 \
+    --sanitize
+
+echo "example 16 OK"

@@ -93,6 +93,13 @@ def main() -> None:
         "seconds, then exit cleanly",
     )
     p.add_argument(
+        "--sanitize", action="store_true",
+        help="arm jax.transfer_guard('disallow') around the decode "
+        "dispatch: any implicit host transfer in the hot loop raises "
+        "instead of silently stalling (the runtime half of "
+        "scripts/lint.py; docs/ANALYSIS.md)",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -154,6 +161,7 @@ def main() -> None:
         max_queue=args.max_queue,
         metrics=metrics,
         tracer=tracer,
+        sanitize=args.sanitize,
     )
     if not args.no_warmup:
         # Compile the bounded program set (one chunk program per

@@ -135,6 +135,13 @@ _SMOKE_PATTERNS = (
     "test_config.py::test_reference_defaults",
     "test_metrics.py::test_writer_disabled_is_noop",
     "test_watchdog.py::test_fires_when_beats_stop",
+    # static analysis (ddp_tpu.analysis): the self-lint CI gate
+    # (scripts/lint.py --self, the compileall gate's sibling), one
+    # fixture-corpus representative, and the transfer-guard pin of
+    # the runtime sanitizer (--sanitize)
+    "test_lint.py::test_self_lint_clean",
+    "test_lint.py::test_rule_true_positives_pinned",
+    "test_sanitize.py::TestSanitizerUnit::test_guard_blocks_implicit_transfer",
     # observability: whole-tree syntax gate, trace-exporter schema pin,
     # and the tracing-off-is-free guarantee (ddp_tpu.obs)
     "test_obs.py::test_compileall_package_and_scripts",
@@ -159,6 +166,9 @@ _SMOKE_PATTERNS = (
 # ~20 s each and environment-sensitive). The full unfiltered suite
 # remains the round gate and still runs everything here.
 _SLOW_PATTERNS = (
+    # sanitize: the engine builds + warms two engines (~11 s); the
+    # trainer-level violation pin stays in tier-1
+    "test_sanitize.py::test_engine_sanitized_decode_and_seeded_violation",
     # second measured cut: with the first cut applied, compile
     # costs shift onto surviving module-mates — these re-crossed
     # the 9 s line in a tier-1-only timing run (802 s wall, too

@@ -1,0 +1,52 @@
+"""DDP001 true negatives: uniform collectives, agreed branches, and
+rank-guarded HOST-ONLY work. Zero findings expected."""
+
+import jax
+from jax import lax
+
+from ddp_tpu.runtime.consensus import agree_any
+
+
+def uniform_reduce(x):
+    # every rank reaches it unconditionally
+    return lax.psum(x, "data")
+
+
+def agreed_save(ckpt, state, local_flag):
+    # the branch test IS the agreement: world-uniform by construction
+    if agree_any(local_flag):
+        ckpt.save(0, state)
+
+
+def main_only_logging(metrics, loss, ctx):
+    # rank-guarded HOST work (no collective) is the design
+    if ctx.is_main:
+        metrics.write("step", loss=loss)
+
+
+def data_branch(x, halt):
+    # plain data branches are not flagged (uniformity is the caller's
+    # contract; only explicit rank-identity guards pin the bug class)
+    if halt:
+        return lax.pmean(x, "data")
+    return x
+
+
+def collective_in_finally(x, log):
+    try:
+        log.append("enter")
+    finally:
+        # finally runs on every rank, raised or not
+        x = lax.psum(x, "data")
+    return x
+
+
+def callback_defined_under_rank_guard(ctx):
+    # DEFINING a function under a rank guard is fine — only calling a
+    # collective there diverges
+    if ctx.is_main:
+        def report(x):
+            return lax.psum(x, "data")
+
+        return report
+    return None

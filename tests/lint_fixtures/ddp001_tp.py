@@ -1,0 +1,36 @@
+"""DDP001 true positives: collectives under rank-divergent control
+flow — the PR-5 deadlock class. Parsed by the linter, never imported.
+``# ddp-expect: RULE`` marks each line the linter must flag."""
+
+import jax
+from jax import lax
+
+from ddp_tpu.runtime.consensus import agree_any
+
+
+def save_on_main_only(ckpt, state):
+    # rank-guarded collective save: peers block in the NEXT collective
+    if jax.process_index() == 0:
+        ckpt.save(0, state)  # ddp-expect: DDP001
+
+
+def reduce_under_rank_branch(x, ctx):
+    if ctx.is_main:
+        return lax.psum(x, "data")  # ddp-expect: DDP001
+    return x
+
+
+def gather_in_except(flags):
+    try:
+        value = flags[0]
+    except IndexError:
+        value = agree_any(False)  # ddp-expect: DDP001
+    return value
+
+
+def psum_in_else_of_rank_guard(x, rank):
+    if rank == 0:
+        y = x
+    else:
+        y = lax.pmean(x, "data")  # ddp-expect: DDP001
+    return y

@@ -1,0 +1,33 @@
+"""DDP002 true negatives: host-loop syncs (the design), static shape
+arithmetic inside traced code, and device-side jnp ops. Zero
+findings expected."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_step(state, batch):
+    # static introspection is trace-time Python — not a sync
+    dim = int(batch.shape[0])
+    cols = float(batch.shape[-1] * 2)
+    # jnp.asarray is a DEVICE op (only host numpy materializes)
+    scale = jnp.asarray(1.0 / max(dim, 1), jnp.float32)
+    return state["w"] @ batch * scale + cols
+
+
+def host_loop(step, state, batches, metrics):
+    # the host loop is allowed to sync — log-cadence float() IS the
+    # trainer's design; DDP002 only fires inside jit-reachable code
+    for i, batch in enumerate(batches):
+        state, loss = step(state, batch)
+        if i % 10 == 0:
+            metrics.write("step", loss=float(loss))
+            print("step", i, np.asarray(loss))
+    return state
+
+
+def untraced_helper(arr):
+    # never reached from a jit root → host rules
+    return arr.sum().item()

@@ -1,0 +1,41 @@
+"""DDP002 true positives: host syncs inside jit-reachable code.
+Roots are discovered through jit/shard_map/lax call sites and the
+call graph walks into plain helpers from there."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log_softmax_stats(logits):
+    # reached from traced_step below → every sync here is in-graph
+    peak = logits.max()
+    print("peak:", peak)  # ddp-expect: DDP002
+    host = np.asarray(logits)  # ddp-expect: DDP002
+    return host.shape[0]
+
+
+@jax.jit
+def traced_step(state, batch):
+    logits = state["w"] @ batch
+    log_softmax_stats(logits)
+    loss = jnp.square(logits).mean()
+    scale = float(loss)  # ddp-expect: DDP002
+    return loss * scale
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def traced_partial(x, n):
+    value = x.sum().item()  # ddp-expect: DDP002
+    return x * value + n
+
+
+def scan_body(carry, x):
+    fetched = jax.device_get(x)  # ddp-expect: DDP002
+    return carry, fetched
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)

@@ -1,0 +1,34 @@
+"""DDP003 true negatives: the rebind idiom (`state = step(state, …)`)
+and donation-free jits. Zero findings expected."""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, batch):
+    return state + batch.sum()
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+plain = jax.jit(_step)
+
+
+def rebind_idiom(batches):
+    state = jnp.zeros((4,))
+    for b in batches:
+        state = step(state, b)  # donated AND rebound: clean
+    return state
+
+
+def rebound_before_read(batch):
+    state = jnp.zeros((4,))
+    state = step(state, batch)
+    return state + 1.0  # reads the NEW buffer
+
+
+def no_donation(batches):
+    state = jnp.zeros((4,))
+    out = []
+    for b in batches:
+        out.append(plain(state, b))  # no donation: state stays live
+    return out, state

@@ -1,0 +1,40 @@
+"""DDP003 true positives: donated buffers read after donation — the
+serve-cache use-after-free class."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, batch):
+    return state + batch.sum()
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def read_after_donate(batch):
+    state = jnp.zeros((4,))
+    new_state = step(state, batch)
+    stale = state + 1.0  # ddp-expect: DDP003
+    return new_state, stale
+
+
+def donate_in_loop(batches):
+    state = jnp.zeros((4,))
+    out = None
+    for b in batches:
+        out = step(state, b)  # ddp-expect: DDP003
+    return out
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def write_cache(cache, update):
+    return cache.at[0].set(update)
+
+
+def argnames_read_after_donate(update):
+    cache = jnp.zeros((8,))
+    fresh = write_cache(cache, update)
+    return fresh, cache.sum()  # ddp-expect: DDP003

@@ -1,0 +1,35 @@
+"""DDP004 true negatives: the builder idiom (jit constructed once per
+builder call), hashable statics, static shapes. Zero findings."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step(model, lr):
+    # the codebase idiom: build the jit ONCE inside a builder —
+    # function identity is stable across the training run
+    def step(state, batch):
+        return state - lr * model(state, batch)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def _kernel(x, layout=(4, 4)):  # tuple static: hashable
+    return x.reshape(layout)
+
+
+kernel = jax.jit(_kernel, static_argnames=("layout",))
+
+
+def fixed_buffers(batch_size):
+    # shapes from config/shape arithmetic, no data-dependent int()
+    pad = jnp.zeros((batch_size, 16))
+    ring = jnp.ones(batch_size * 2)
+    return pad, ring
+
+
+def loop_calls_prebuilt(step, state, batches):
+    # CALLING a prebuilt jit in a loop is the whole point
+    for b in batches:
+        state = step(state, b)
+    return state

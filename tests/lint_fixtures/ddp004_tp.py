@@ -1,0 +1,36 @@
+"""DDP004 true positives: recompile hazards — jit-in-loop, unhashable
+statics, data-dependent shapes."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def jit_per_batch(batches, w):
+    total = 0.0
+    for b in batches:
+        f = jax.jit(lambda x: x @ w)  # ddp-expect: DDP004
+        total += f(b)
+    return total
+
+
+def partial_jit_per_item(items):
+    outs = []
+    while items:
+        x = items.pop()
+        g = functools.partial(jax.jit, static_argnums=0)(lambda n: n)  # ddp-expect: DDP004
+        outs.append(g(x))
+    return outs
+
+
+def _kernel(x, layout=[4, 4]):  # ddp-expect: DDP004
+    return x.reshape(layout)
+
+
+kernel = jax.jit(_kernel, static_argnames=("layout",))
+
+
+def ragged_buffer(n, frac):
+    # every distinct int(n * frac) is a new program
+    return jnp.zeros(int(n * frac))  # ddp-expect: DDP004

@@ -1,0 +1,36 @@
+"""DDP005 true negatives: split-per-consumer, fold_in streaming, and
+per-branch single use. Zero findings expected."""
+
+import jax
+
+
+def split_per_consumer(batch):
+    key = jax.random.PRNGKey(0)
+    k_img, k_lbl = jax.random.split(key)
+    images = jax.random.normal(k_img, (batch, 8))
+    labels = jax.random.randint(k_lbl, (batch,), 0, 10)
+    return images, labels
+
+
+def fold_in_streaming(base_key, steps):
+    # the sanctioned per-step pattern: fold_in derives, never consumes
+    total = 0.0
+    for i in range(steps):
+        k = jax.random.fold_in(base_key, i)
+        total += jax.random.uniform(k)
+    return total
+
+
+def split_each_iteration(key, steps):
+    samples = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        samples.append(jax.random.normal(sub, (2,)))
+    return samples
+
+
+def one_use_per_branch(key, flip):
+    # either path consumes the key exactly once
+    if flip:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))

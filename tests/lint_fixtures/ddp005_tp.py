@@ -1,0 +1,25 @@
+"""DDP005 true positives: PRNG key reuse — correlated randomness."""
+
+import jax
+import jax.numpy as jnp
+
+
+def correlated_batch(batch):
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (batch, 32, 32, 3))
+    labels = jax.random.randint(key, (batch,), 0, 10)  # ddp-expect: DDP005
+    return images, labels
+
+
+def parent_used_after_split(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.normal(key, (4,))  # ddp-expect: DDP005
+    return a, b, k2
+
+
+def reuse_across_iterations(steps, rng):
+    total = 0.0
+    for _ in range(steps):
+        total += jax.random.uniform(rng)  # ddp-expect: DDP005
+    return total

@@ -1,0 +1,26 @@
+"""Suppression syntax fixtures: a justified disable silences the
+finding; a bare disable is DDP000 (and cannot itself be disabled)."""
+
+import jax
+from jax import lax
+
+
+def justified_trailing(x, ctx):
+    if ctx.is_main:
+        # suppressed (justified): NOT expected in unsuppressed output
+        return lax.psum(x, "data")  # ddp-lint: disable=DDP001 single-process tool path, guarded by caller
+    return x
+
+
+def justified_standalone(batch):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (batch,))
+    # ddp-lint: disable=DDP005 deliberate twin draw: testing correlation itself
+    b = jax.random.normal(key, (batch,))
+    return a, b
+
+
+def bare_disable(x, rank):
+    if rank == 0:
+        return lax.psum(x, "data")  # ddp-lint: disable=DDP001
+    return x

@@ -1,0 +1,214 @@
+"""--sanitize: the runtime half of the hazard linter (ISSUE 6).
+
+The transfer guard must (a) be free and invisible on a clean hot
+loop — trainer and serve engine complete identically with it armed —
+and (b) make a SEEDED implicit host transfer raise at the offending
+call instead of silently syncing every step. Plus the desync-watchdog
+arming rules.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddp_tpu.runtime.sanitize import DESYNC_TIMEOUT_DEFAULT, Sanitizer
+
+
+def _implicit_transfer_error():
+    # jaxlib's XlaRuntimeError lives in different spots across
+    # versions; Exception + message match is the stable contract
+    return "Disallowed host-to-device transfer"
+
+
+# ---- unit: the guard itself -----------------------------------------
+
+
+class TestSanitizerUnit:
+    def test_guard_blocks_implicit_transfer(self):
+        s = Sanitizer(True)
+        f = jax.jit(lambda x: x * 2)
+        with pytest.raises(Exception, match=_implicit_transfer_error()):
+            with s.guard():
+                f(np.ones((4,), np.float32))  # numpy → implicit h2d
+
+    def test_explicit_device_put_stays_legal(self):
+        s = Sanitizer(True)
+        f = jax.jit(lambda x: x * 2)
+        with s.guard():
+            y = f(jax.device_put(np.ones((4,), np.float32)))
+        assert float(np.asarray(y)[0]) == 2.0
+
+    def test_allow_window_inside_guard(self):
+        s = Sanitizer(True)
+        with s.guard():
+            with s.allow():
+                v = jnp.int32(5)  # scalar upload: deliberate window
+        assert int(np.asarray(v)) == 5
+
+    def test_disabled_is_nullcontext(self):
+        import contextlib
+
+        s = Sanitizer(False)
+        assert isinstance(s.guard(), contextlib.nullcontext)
+        assert isinstance(s.allow(), contextlib.nullcontext)
+        with s.guard():
+            jnp.int32(5)  # no guard, no raise
+
+
+def test_sampler_explicit_transfers_bit_identical():
+    """The sanitizer's first real catch: the epoch-shuffle plan did an
+    IMPLICIT scalar upload + numpy readback per epoch. The explicit
+    device_put/device_get spelling must produce the identical
+    permutation (data order is a resume contract) and stay legal
+    under the guard."""
+    from ddp_tpu.data.sampler import ShardSampler
+
+    # seeds past int32 too: jax.random.key folds 64-bit seeds, so the
+    # guard-legal spelling must not route them through an int32
+    # canonicalization (device_put would overflow)
+    for seed in (7, 2**31 + 5):
+        s = ShardSampler(
+            num_examples=100, num_shards=4, shard_id=1, shuffle=True,
+            seed=seed,
+        )
+        baseline = np.asarray(
+            jax.random.permutation(
+                jax.random.key(seed + 3), 100, independent=False
+            )
+        )[1::4]
+        with Sanitizer(True).guard():
+            idx = s.shard_indices(epoch=3)
+        assert np.array_equal(idx, baseline)
+
+
+# ---- trainer wiring -------------------------------------------------
+
+
+def _config(tmpdir, **kw):
+    from ddp_tpu.train.config import TrainConfig
+
+    return TrainConfig(
+        epochs=1,
+        batch_size=8,
+        synthetic_data=True,
+        synthetic_size=64,
+        checkpoint_dir=str(tmpdir / "ck"),
+        data_root=str(tmpdir / "data"),
+        log_interval=2,
+        eval_every=0,
+        num_workers=0,
+        **kw,
+    )
+
+
+def test_cli_flag_parses():
+    from ddp_tpu.train.config import TrainConfig
+
+    cfg = TrainConfig.from_args(
+        ["--sanitize", "--sanitize_timeout", "120", "--synthetic_data"]
+    )
+    assert cfg.sanitize is True
+    assert cfg.sanitize_timeout == 120.0
+    assert TrainConfig().sanitize is False
+    assert TrainConfig().sanitize_timeout == DESYNC_TIMEOUT_DEFAULT
+
+
+def test_trainer_sanitized_run_and_seeded_violation(tmp_path):
+    """One Trainer, two proofs: the guarded hot loop completes clean
+    (the deliberate syncs all sit in allow() windows), then a seeded
+    violation — the loader handing the step RAW numpy instead of
+    device arrays, exactly the hidden per-step upload DDP002 hunts —
+    raises under the guard instead of silently syncing."""
+    from ddp_tpu.train.trainer import Trainer
+
+    tr = Trainer(_config(tmp_path, sanitize=True))
+    try:
+        # desync watchdog armed at the default (no explicit timeout)
+        assert tr._watchdog.timeout == DESYNC_TIMEOUT_DEFAULT
+        result = tr.train()
+        assert result["epochs_run"] == 1
+        assert np.isfinite(result["final_loss"])
+
+        # seeded violation: strip the loader's explicit device_put
+        orig_epoch = tr.loader.epoch
+
+        def numpy_epoch(epoch, skip_batches=0):
+            for b in orig_epoch(epoch, skip_batches):
+                yield type(b)(
+                    images=np.asarray(b.images),
+                    labels=np.asarray(b.labels),
+                )
+
+        tr.loader.epoch = numpy_epoch
+        tr.config.epochs = 2  # one more epoch through the bad loader
+        with pytest.raises(Exception, match=_implicit_transfer_error()):
+            tr.train()
+    finally:
+        tr.close()
+
+
+def test_trainer_watchdog_precedence(tmp_path):
+    """An explicit --watchdog_timeout wins over the sanitize default,
+    and --fast_epoch never arms the desync watchdog (no per-step
+    beats — one dispatch per epoch)."""
+    from ddp_tpu.train.trainer import Trainer
+
+    tr = Trainer(
+        _config(tmp_path, sanitize=True, watchdog_timeout=17.0)
+    )
+    try:
+        assert tr._watchdog.timeout == 17.0
+        assert tr._wd_dump_reason == "watchdog_timeout"
+    finally:
+        tr.close()
+    tr2 = Trainer(
+        _config(tmp_path, sanitize=True, fast_epoch=True)
+    )
+    try:
+        assert tr2._watchdog.timeout == 0.0
+        assert tr2._sanitizer.enabled
+    finally:
+        tr2.close()
+
+
+# ---- serve engine wiring --------------------------------------------
+
+
+def test_engine_sanitized_decode_and_seeded_violation():
+    """The sanitized engine serves greedy traffic token-identically
+    (the decode dispatch is provably transfer-free), and a seeded
+    violation — a numpy token vector slipping into the decode program
+    — raises under the guard."""
+    from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.serve.engine import ServeEngine
+
+    spec = LMSpec(vocab_size=37, total_len=32, d_model=32, depth=2,
+                  num_heads=4)
+    params = init_lm(spec, seed=0)
+
+    def run(sanitize):
+        eng = ServeEngine(
+            spec, params, slots=2, prefill_len=8, sanitize=sanitize
+        )
+        eng.submit([3, 1, 4], 6)
+        done = eng.run(max_steps=64)
+        assert len(done) == 1 and done[0].status == "complete"
+        return eng, done[0].tokens
+
+    eng_plain, toks_plain = run(False)
+    eng_san, toks_san = run(True)
+    assert toks_san == toks_plain  # the guard is non-semantic
+
+    # seeded violation: a host round-trip on the device-resident
+    # token vector feeds the decode program numpy
+    orig = eng_san._decode
+
+    def leaky_decode(params, cache, toks, *rest):
+        return orig(params, cache, np.asarray(toks), *rest)
+
+    eng_san._decode = leaky_decode
+    eng_san.submit([5, 2], 4)
+    with pytest.raises(Exception, match=_implicit_transfer_error()):
+        eng_san.run(max_steps=64)
