@@ -12,7 +12,16 @@ call graph:
   functions passed by name to ``jax.jit`` / ``pjit`` / ``shard_map`` /
   ``grad`` / ``vmap`` / ``pmap`` / ``checkpoint`` or to the ``lax``
   control-flow combinators (``scan``/``cond``/``while_loop``/…) —
-  plus the bodies of lambdas passed to any of those.
+  plus the bodies of lambdas passed to any of those. A function whose
+  body calls a DEVICE collective (``lax.psum``/``psum_scatter``/
+  ``reduce_scatter``/``all_gather``/``ppermute``/``all_to_all``/…) is
+  a root too: device collectives are only meaningful under trace, so
+  the enclosing function is in-graph by construction — this is what
+  puts the zero strategy's scatter/gather helpers under DDP002 even
+  when they reach the step through method plumbing the bare-name
+  edges cannot chase. (Host-level agreement/multihost utils —
+  ``agree_*``, ``process_allgather`` — deliberately do NOT root:
+  their callers are host loops where a ``float(loss)`` is the design.)
 - Edges: bare-name calls resolved within the module, and cross-module
   through ``from x import y`` when ``x`` is part of the linted tree.
 - Closure: nested ``def``s of an in-graph function are in-graph (their
@@ -60,6 +69,26 @@ TRACER_TAILS = (
     "jax.lax.associative_scan",
     "lax.associative_scan",
 )
+
+
+# Device-side collectives: a call to one marks the ENCLOSING function
+# in-graph (they trace or they crash — there is no host spelling).
+# Strictly the device subset of collective.COLLECTIVE_ATTRS: the
+# host-level agreement/multihost names must not root, or every host
+# loop that agrees-then-logs would start flagging its deliberate syncs.
+DEVICE_COLLECTIVE_ATTRS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "all_gather_invariant",
+    "ppermute",
+    "pshuffle",
+    "all_to_all",
+    "psum_scatter",
+    "reduce_scatter",
+}
 
 
 def is_tracer_name(resolved: str | None) -> bool:
@@ -212,6 +241,19 @@ def build_project(modules: list[ModuleInfo]) -> Project:
                         roots.add((m.modname, by_node[node]))
             # call-site roots: jit(f) / shard_map(f, ...) / scan(f, ...)
             if isinstance(node, ast.Call):
+                # device-collective roots: the enclosing function of a
+                # psum/psum_scatter/all_gather/... call is traced code.
+                attr = None
+                if isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    resolved = m.aliases.get(node.func.id, "")
+                    if resolved:
+                        attr = resolved.rsplit(".", 1)[-1]
+                if attr in DEVICE_COLLECTIVE_ATTRS:
+                    scope = enclosing_scope(node, parents, by_node)
+                    if scope is not None:
+                        roots.add((m.modname, scope))
                 is_tracer = is_tracer_name(m.resolve(node.func))
                 if not is_tracer and resolve_partial_target(m, node):
                     is_tracer = True

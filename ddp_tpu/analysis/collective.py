@@ -31,6 +31,9 @@ import ast
 from ddp_tpu.analysis.core import Finding, ModuleInfo
 
 # lax/multihost collectives by terminal attribute name.
+# ``psum_scatter``/``reduce_scatter`` and the param ``all_gather`` are
+# the ZeRO strategy's pair (parallel/zero.py): the same rank-uniformity
+# contract as the all-reduce they replace, guarded by the same rule.
 COLLECTIVE_ATTRS = {
     "psum",
     "pmean",
@@ -42,6 +45,7 @@ COLLECTIVE_ATTRS = {
     "pshuffle",
     "all_to_all",
     "psum_scatter",
+    "reduce_scatter",
     "process_allgather",
     "sync_global_devices",
     "broadcast_one_to_all",

@@ -301,12 +301,34 @@ def create_lm_train_state(
     mesh: Mesh,
     *,
     seed: int = 0,
+    zero_layout=None,
 ) -> LMTrainState:
     """Replicated state, or fsdp-sharded at rest when the mesh has an
     ``fsdp`` axis > 1 (parallel/seq_fsdp.py — moments shard with the
-    params, so optimizer memory drops by the axis size too)."""
+    params, so optimizer memory drops by the axis size too).
+
+    ``zero_layout`` (parallel/zero.py BucketLayout) is the ZeRO
+    weight-update sharding variant: params replicate as usual but the
+    optimizer state rests as flat fp32 buckets sharded 1/N over
+    ``data`` — the layout ``make_lm_train_step(..., zero_layout=)``
+    updates in place.
+    """
     from ddp_tpu.models.seq_transformer import sharded_or_replicated_state
 
+    if zero_layout is not None:
+        from ddp_tpu.parallel.zero import create_zero_opt_state
+
+        rep = NamedSharding(mesh, P())
+        params = jax.tree.map(
+            lambda x: jax.device_put(x, rep), init_lm(spec, seed=seed)
+        )
+        return LMTrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+            params=params,
+            opt_state=create_zero_opt_state(
+                params, optimizer, mesh, zero_layout
+            ),
+        )
     return sharded_or_replicated_state(
         init_lm(spec, seed=seed), optimizer, mesh
     )
@@ -555,8 +577,16 @@ def make_lm_train_step(
     jit: bool = True,
     health: bool = False,
     health_inject: tuple[str, int] | None = None,
+    zero_layout=None,
 ):
     """dp×sp[×fsdp] causal-LM step: ``step(state, tokens)``.
+
+    ``zero_layout`` swaps the replicated weight update for the ZeRO
+    in-graph GSPMD expression (parallel/zero.py ``zero_gspmd_update``):
+    gradients constrain into data-sharded flat buckets, the optimizer
+    runs on 1/N shards with the moments resting sharded, and the SPMD
+    partitioner derives the parameter all-gather. Loss/metrics math is
+    untouched — trajectories pin against the plain step.
 
     ``jit=False`` returns the raw (untraced) step for callers that
     embed it in a larger program — the compiled-epoch runner
@@ -574,6 +604,14 @@ def make_lm_train_step(
     is the mean next-token cross-entropy, accuracy the next-token
     top-1.
     """
+    if zero_layout is not None and health:
+        # The health stats pass reads the UPDATE tree, which the zero
+        # expression only materializes as 1/N flat shards — same wall
+        # the Trainer enforces at the flag level.
+        raise ValueError(
+            "health stats need the full update tree; the zero sharded "
+            "update never materializes it — drop health or zero_layout"
+        )
     sharded_forward, xspec = _make_sharded_forward(spec, mesh, compute_dtype)
     token_metrics = _make_sharded_token_metrics(
         spec, mesh, label_smoothing=label_smoothing
@@ -625,10 +663,18 @@ def make_lm_train_step(
             from ddp_tpu.obs.health import inject_nan
 
             grads = inject_nan(grads, state.step, health_inject)
-        updates, opt_state = optimizer.update(
-            grads, state.opt_state, state.params
-        )
-        params = optax.apply_updates(state.params, updates)
+        if zero_layout is not None:
+            from ddp_tpu.parallel.zero import zero_gspmd_update
+
+            params, opt_state = zero_gspmd_update(
+                optimizer, zero_layout, mesh, grads,
+                state.opt_state, state.params,
+            )
+        else:
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
         accuracy = correct / (tokens.shape[0] * (tokens.shape[1] - 1))
         if health:
             from ddp_tpu.obs.health import health_stats

@@ -64,7 +64,7 @@ class MoEMLP(nn.Module):
         n = B * T
         tokens = x.reshape(n, d)
         # Per-expert slot count; static (derived from traced shapes).
-        capacity = max(1, int(round(self.capacity_factor * n * self.top_k / E)))
+        capacity = max(1, int(round(self.capacity_factor * n * self.top_k / E)))  # ddp-lint: disable=DDP002 n/E are Python ints from x.shape — static at trace time
 
         # Router in fp32 for numerically stable softmax under bf16.
         gate_logits = nn.Dense(E, dtype=jnp.float32, name="router")(

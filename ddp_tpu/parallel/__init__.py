@@ -14,6 +14,11 @@ SPMD step (``ddp.py``), and builds the rest of the axis vocabulary
                  attention via ``ppermute`` and Ulysses all-to-all.
 - ``pipeline`` — GPipe microbatch pipelining (``pipe`` axis) via
                  ``ppermute`` ring shifts, differentiable schedule.
+- ``zero``     — ZeRO-style weight-update sharding on the pure-DP
+                 path: bucketed ``psum_scatter`` of gradients, the
+                 optimizer on 1/N flat shards (moments rest sharded),
+                 ``all_gather`` of params — with an in-graph GSPMD
+                 twin for the causal LM's jit-level step.
 """
 
 from ddp_tpu.parallel.ddp import (  # noqa: F401
@@ -40,4 +45,11 @@ from ddp_tpu.parallel.spmd import (  # noqa: F401
     make_spmd_eval_step,
     make_spmd_train_step,
     param_specs,
+)
+from ddp_tpu.parallel.zero import (  # noqa: F401
+    BucketLayout,
+    build_layout,
+    create_zero_state,
+    make_zero_train_step,
+    zero_gspmd_update,
 )

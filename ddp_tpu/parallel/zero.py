@@ -1,0 +1,605 @@
+"""ZeRO-style weight-update sharding on the data-parallel path.
+
+The reference (and our own ``parallel/ddp.py``) ends every step the
+same way DDP always has: all-reduce the FULL gradient tree, then let
+every replica redundantly run the identical optimizer update over the
+identical replicated moments — N copies of the same math and N copies
+of the Adam moments. "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (PAPERS.md #3) removes exactly that
+redundancy without touching the model math:
+
+    reduce-scatter(grads)  →  each replica owns 1/N of every bucket
+    sharded optimizer update  →  moments + update math are 1/N
+    all-gather(params)     →  replicas re-converge, bit-for-bit
+
+Params stay replicated at rest (this is ZeRO stage 1, not FSDP — the
+``fsdp`` axis already covers stage 3 by annotation); only the
+optimizer state and the update compute shard. Total collective payload
+is unchanged — a ring all-reduce IS a reduce-scatter + all-gather —
+but the *all-reduce* disappears, the moments memory divides by N, and
+the two half-collectives become independently schedulable per bucket.
+
+Gradients are packed into size-targeted **buckets** (``--zero_bucket_mb``,
+the knob DDP's C++ reducer calls ``bucket_cap_mb``): each bucket is a
+flat fp32 vector padded to a multiple of the replica count, so a
+parameter count not divisible by the axis size costs padding, never a
+wrong answer. Bucketing is what buys comm/compute overlap: each
+bucket's reduce-scatter depends only on ITS leaves' gradients, so the
+scheduler may dispatch bucket k's scatter while backward compute for
+bucket k+1's layers is still in flight — and the all-gathers pipeline
+against the sharded updates the same way. ``overlap=False`` builds the
+control: an ``optimization_barrier`` fence after the full backward
+plus a serial chain through the collectives, which is what bench.py
+measures the overlapped step against (prove it, don't assume it).
+
+Two expressions of the same decomposition, per the paper's framing:
+
+- ``make_zero_train_step`` — the explicit-collective ``shard_map``
+  step (the DDP image family): ``lax.psum_scatter`` / sharded optax
+  update / ``lax.all_gather``, every collective visible.
+- ``zero_gspmd_update`` — the in-graph GSPMD expression (the causal
+  LM's jit-level step): the same bucket layout pinned with
+  ``with_sharding_constraint`` so the SPMD partitioner shards the
+  update math and the moments, and derives the parameter all-gather.
+
+The optimizer contract: the update rule must be *elementwise* (sgd,
+momentum, adam, adamw, weight decay, schedules) because it runs on
+1/N flat shards — transforms that couple elements across the tree
+(global-norm clipping, full-shape parameter EMA) are rejected at
+construction (train/optim.py ``check_zero_compatible``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddp_tpu.parallel.common import (
+    check_accum_divisible,
+    make_loss_fn,
+)
+from ddp_tpu.parallel.ddp import StepMetrics, TrainState
+from ddp_tpu.runtime.mesh import data_axes
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One flat fp32 reduce-scatter unit: a contiguous run of leaves.
+
+    ``padded`` rounds ``total`` up to a multiple of the replica count
+    so the scatter tiles evenly; the pad region carries zeros end to
+    end (zero grads → zero moments → zero update), so indivisible
+    parameter counts are correct by construction.
+    """
+
+    leaf_ids: tuple[int, ...]
+    sizes: tuple[int, ...]
+    total: int
+    padded: int
+    shard: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLayout:
+    """Assignment of every param leaf (flatten order) to a bucket."""
+
+    buckets: tuple[Bucket, ...]
+    num_leaves: int
+    world: int
+
+    @property
+    def padded_total(self) -> int:
+        return sum(b.padded for b in self.buckets)
+
+
+def _opt_key(i: int) -> str:
+    return f"b{i:03d}"
+
+
+def opt_keys(layout: BucketLayout) -> list[str]:
+    return [_opt_key(i) for i in range(len(layout.buckets))]
+
+
+def build_layout(
+    params, world: int, *, bucket_mb: float = 4.0
+) -> BucketLayout:
+    """Greedy size-targeted bucketing over the param leaves.
+
+    ``params`` may be arrays or ``ShapeDtypeStruct``s — only shapes
+    matter. Leaves pack in flatten order until a bucket crosses the
+    byte target (fp32 accounting — the reduction dtype); a leaf larger
+    than the target gets its own bucket rather than being split.
+    """
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if bucket_mb <= 0:
+        raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        raise ValueError("empty parameter tree — nothing to shard")
+    target_elems = max(1, int(bucket_mb * 2**20) // 4)
+    buckets: list[Bucket] = []
+    ids: list[int] = []
+    sizes: list[int] = []
+    total = 0
+
+    def close():
+        nonlocal ids, sizes, total
+        if not ids:
+            return
+        padded = -(-total // world) * world
+        buckets.append(
+            Bucket(
+                leaf_ids=tuple(ids),
+                sizes=tuple(sizes),
+                total=total,
+                padded=padded,
+                shard=padded // world,
+            )
+        )
+        ids, sizes, total = [], [], 0
+
+    for i, leaf in enumerate(leaves):
+        n = 1
+        for s in leaf.shape:
+            n *= int(s)
+        if n >= target_elems:
+            # An oversized leaf gets its OWN bucket: trapping the
+            # accumulated small leaves behind it would serialize their
+            # scatter on the big transfer.
+            close()
+            ids, sizes, total = [i], [n], n
+            close()
+            continue
+        ids.append(i)
+        sizes.append(n)
+        total += n
+        if total >= target_elems:
+            close()
+    close()
+    return BucketLayout(
+        buckets=tuple(buckets), num_leaves=len(leaves), world=world
+    )
+
+
+def check_zero_mesh(mesh: Mesh) -> None:
+    """The sharded update scatters over the DATA axis alone: any other
+    populated axis already owns its own optimizer-state story (fsdp IS
+    ZeRO-3; tp/expert/seq/pipe shard state by their rule layouts)."""
+    bad = {
+        a: int(mesh.shape[a])
+        for a in ("model", "fsdp", "expert", "seq", "pipe")
+        if mesh.shape.get(a, 1) > 1
+    }
+    if bad:
+        raise ValueError(
+            f"--parallel zero shards the weight update over the data "
+            f"axis only; {bad} already shard optimizer state their own "
+            "way — drop the axes or the flag"
+        )
+
+
+def _flatten_buckets(layout: BucketLayout, leaves) -> list[jax.Array]:
+    """Leaf list → one flat fp32 ``[padded]`` vector per bucket."""
+    flats = []
+    for b in layout.buckets:
+        parts = [
+            leaves[i].astype(jnp.float32).reshape(-1) for i in b.leaf_ids
+        ]
+        pad = b.padded - b.total
+        if pad:
+            parts.append(jnp.zeros((pad,), jnp.float32))
+        flats.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    return flats
+
+
+def _unflatten_buckets(layout: BucketLayout, flats, like_leaves):
+    """Flat ``[padded]`` vectors → leaf list shaped/typed like
+    ``like_leaves`` (static slices; the pad tail is dropped)."""
+    out: list[Any] = [None] * layout.num_leaves
+    for b, flat in zip(layout.buckets, flats):
+        off = 0
+        for i, n in zip(b.leaf_ids, b.sizes):
+            like = like_leaves[i]
+            out[i] = (
+                flat[off : off + n].reshape(like.shape).astype(like.dtype)
+            )
+            off += n
+    return out
+
+
+def _opt_template(optimizer, layout: BucketLayout):
+    """abstract optimizer state over the flat buckets + the elementwise
+    contract check: every state leaf must be a scalar (schedule/Adam
+    counts) or shaped exactly like its bucket — anything else means
+    the update couples elements across the tree and cannot run on
+    1/N shards. Shape-based, so it catches full-shape STATE (a param
+    EMA of the original tree) but not STATELESS cross-element
+    transforms (global-norm clipping carries EmptyState) — those are
+    rejected at the flag level (train/optim.check_zero_compatible);
+    direct-API callers composing their own optax chains own the
+    elementwise contract for stateless members."""
+    flats = {
+        _opt_key(i): jax.ShapeDtypeStruct((b.padded,), jnp.float32)
+        for i, b in enumerate(layout.buckets)
+    }
+    tpl = jax.eval_shape(optimizer.init, flats)
+    allowed = {v.shape for v in flats.values()}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tpl)[0]:
+        if len(leaf.shape) and leaf.shape not in allowed:
+            name = jax.tree_util.keystr(path)
+            raise ValueError(
+                f"optimizer state leaf {name} has shape {leaf.shape}, "
+                "not the flat bucket shape — the zero update runs "
+                "elementwise on 1/N shards (sgd/momentum/adam/adamw "
+                "compose; global-norm clipping and parameter EMA do "
+                "not — train/optim.check_zero_compatible)"
+            )
+    return tpl
+
+
+def opt_state_specs(optimizer, layout: BucketLayout):
+    """PartitionSpec tree for the resting optimizer state: flat bucket
+    leaves shard dim 0 over ``data``, scalars replicate."""
+    tpl = _opt_template(optimizer, layout)
+    return jax.tree.map(
+        lambda x: P("data") if len(x.shape) else P(), tpl
+    )
+
+
+def create_zero_opt_state(params, optimizer, mesh: Mesh, layout: BucketLayout):
+    """Initialize the optimizer state directly into the sharded layout.
+
+    State leaves are GLOBAL ``[padded]`` arrays resting sharded over
+    ``data`` (1/N per device — the memory win is at rest, not just in
+    the step); scalars replicate. Works multi-process: every process
+    computes the same init under one jit with explicit out_shardings.
+    """
+    leaves = jax.tree_util.tree_leaves(params)
+    flats = dict(zip(opt_keys(layout), _flatten_buckets(layout, leaves)))
+    specs = opt_state_specs(optimizer, layout)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.jit(optimizer.init, out_shardings=shardings)(flats)
+
+
+def create_zero_state(
+    model,
+    optimizer: optax.GradientTransformation,
+    sample_input,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+    bucket_mb: float = 4.0,
+) -> tuple[TrainState, BucketLayout]:
+    """Replicated params + step + model_state, data-sharded flat
+    optimizer state. The placements ARE the contract (checkpoint
+    restores template on them, like the fsdp family)."""
+    from ddp_tpu.parallel.common import _train_kwarg
+
+    check_zero_mesh(mesh)
+    variables = model.init(
+        jax.random.key(seed), sample_input, **_train_kwarg(model, False)
+    )
+    params = variables["params"]
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    layout = build_layout(
+        params, int(mesh.shape["data"]), bucket_mb=bucket_mb
+    )
+    rep = NamedSharding(mesh, P())
+    put = lambda t: jax.tree.map(lambda x: jax.device_put(x, rep), t)
+    params = put(params)
+    state = TrainState(
+        step=jax.device_put(jnp.zeros((), jnp.int32), rep),
+        params=params,
+        opt_state=create_zero_opt_state(params, optimizer, mesh, layout),
+        model_state=put(model_state),
+    )
+    return state, layout
+
+
+def _scatter_buckets(flats, *, sequential: bool = False):
+    """Reduce-scatter each bucket over ``data`` (raw SUMS — callers
+    divide by the axis size). ``sequential=True`` is the no-overlap
+    control: a barrier fences the collectives behind the ENTIRE
+    backward, and each scatter chains on its predecessor, so nothing
+    can hide under compute."""
+    if sequential and len(flats) > 1:
+        flats = list(lax.optimization_barrier(tuple(flats)))
+    out = []
+    prev = None
+    for f in flats:
+        if sequential and prev is not None:
+            f, _ = lax.optimization_barrier((f, prev))
+        s = lax.psum_scatter(f, "data", scatter_dimension=0, tiled=True)
+        out.append(s)
+        prev = s
+    return out
+
+
+def _gather_buckets(shards, *, sequential: bool = False):
+    """All-gather each bucket's updated param shard back to ``[padded]``
+    (tiled — member i contributes block i, the psum_scatter order)."""
+    out = []
+    prev = None
+    for s in shards:
+        if sequential and prev is not None:
+            s, _ = lax.optimization_barrier((s, prev))
+        g = lax.all_gather(s, "data", axis=0, tiled=True)
+        out.append(g)
+        prev = g
+    return out
+
+
+def make_zero_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    layout: BucketLayout,
+    *,
+    compute_dtype=jnp.float32,
+    donate: bool = True,
+    seed: int = 0,
+    aux_loss_weight: float = 0.01,
+    grad_accum_steps: int = 1,
+    augment_fn=None,
+    label_smoothing: float = 0.0,
+    overlap: bool = True,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, StepMetrics]]:
+    """The explicit-collective (shard_map) zero step — ``parallel/ddp.py``
+    ``make_train_step``'s contract with the update stage swapped:
+    ``pmean(grads) → update`` becomes ``psum_scatter → 1/N update →
+    all_gather``. Loss/accuracy semantics are identical (pinned by
+    tests/test_zero.py and the 2-process gloo spawn pins).
+
+    ``grad_accum_steps=k`` accumulates into the SCATTERED shards — one
+    reduce-scatter per microbatch, accumulator buffers 1/N — so the
+    memory win survives accumulation (a full-tree accumulator would
+    undo it).
+    """
+    check_zero_mesh(mesh)
+    axes = data_axes(mesh)
+    world = int(mesh.shape["data"])
+    if world != layout.world:
+        raise ValueError(
+            f"layout built for world {layout.world}, mesh data axis is "
+            f"{world}"
+        )
+    keys = opt_keys(layout)
+    loss_fn = make_loss_fn(
+        model, compute_dtype, aux_loss_weight, augment_fn=augment_fn,
+        label_smoothing=label_smoothing,
+    )
+
+    def per_shard_step(state: TrainState, images, labels):
+        mutable = list(state.model_state.keys())
+        rng = jax.random.fold_in(jax.random.key(seed), state.step)
+        for a in axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(a))
+
+        if grad_accum_steps == 1:
+            (loss, (logits, new_ms)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params, state.model_state, images, labels, rng, mutable)
+            correct = (jnp.argmax(logits.astype(jnp.float32), -1) == labels).sum()
+            n_labels = labels.shape[0]
+            # THE rework: where ddp.py all-reduces the full tree, each
+            # bucket reduce-scatters independently — free to dispatch
+            # while backward compute for later buckets is in flight.
+            gshards = _scatter_buckets(
+                _flatten_buckets(layout, jax.tree_util.tree_leaves(grads)),
+                sequential=not overlap,
+            )
+            scale = 1.0 / world
+        else:
+            mb = check_accum_divisible(images.shape[0], grad_accum_steps)
+            imgs = images.reshape(grad_accum_steps, mb, *images.shape[1:])
+            lbls = labels.reshape(grad_accum_steps, mb)
+
+            def micro(carry, xy):
+                sh_acc, ms, loss_acc, correct_acc, i = carry
+                x, y = xy
+                (mloss, (mlogits, mms)), g = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, ms, x, y, jax.random.fold_in(rng, i), mutable)
+                # Accumulate the SCATTERED shard, not the full tree:
+                # the accumulator is 1/N per replica by construction.
+                sh = _scatter_buckets(
+                    _flatten_buckets(layout, jax.tree_util.tree_leaves(g)),
+                    sequential=not overlap,
+                )
+                c = (jnp.argmax(mlogits.astype(jnp.float32), -1) == y).sum()
+                return (
+                    [a + s for a, s in zip(sh_acc, sh)],
+                    mms,
+                    loss_acc + mloss,
+                    correct_acc + c.astype(jnp.float32),
+                    i + 1,
+                ), None
+
+            zero_sh = [
+                jnp.zeros((b.shard,), jnp.float32) for b in layout.buckets
+            ]
+            (gshards, new_ms, loss_sum, correct, _), _ = lax.scan(
+                micro,
+                (
+                    zero_sh,
+                    state.model_state,
+                    jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.int32),
+                ),
+                (imgs, lbls),
+            )
+            loss = loss_sum / grad_accum_steps
+            n_labels = images.shape[0]
+            scale = 1.0 / (world * grad_accum_steps)
+
+        g_tree = {k: s * scale for k, s in zip(keys, gshards)}
+        # Global grad norm from disjoint shards: one scalar psum.
+        local_sq = sum(jnp.sum(jnp.square(g)) for g in g_tree.values())
+        grad_norm = jnp.sqrt(lax.psum(local_sq, axes))
+        # This replica's own param block, sliced locally (params are
+        # replicated — no comm; block order is psum_scatter's).
+        idx = lax.axis_index("data")
+        p_leaves = jax.tree_util.tree_leaves(state.params)
+        p_flats = _flatten_buckets(layout, p_leaves)
+        p_tree = {
+            k: lax.dynamic_slice_in_dim(f, idx * b.shard, b.shard)
+            for k, f, b in zip(keys, p_flats, layout.buckets)
+        }
+        # The 1/N update: same elementwise math as the replicated step,
+        # restricted to the shard this replica owns.
+        updates, opt_state = optimizer.update(g_tree, state.opt_state, p_tree)
+        new_p = optax.apply_updates(p_tree, updates)
+        gathered = _gather_buckets(
+            [new_p[k] for k in keys], sequential=not overlap
+        )
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(state.params),
+            _unflatten_buckets(layout, gathered, p_leaves),
+        )
+        # SyncBN-style non-gradient stats averaging, exactly as ddp.py.
+        new_ms = jax.tree.map(
+            lambda v: lax.pmean(v.astype(jnp.float32), axes), new_ms
+        )
+        metrics = StepMetrics(
+            loss=lax.pmean(loss, axes),
+            accuracy=lax.psum(correct, axes) / (n_labels * world),
+            grad_norm=grad_norm,
+        )
+        return TrainState(state.step + 1, params, opt_state, new_ms), metrics
+
+    ospecs = opt_state_specs(optimizer, layout)
+    state_specs = TrainState(
+        step=P(), params=P(), opt_state=ospecs, model_state=P()
+    )
+    bspec = P(axes)
+    sharded = jax.shard_map(
+        per_shard_step,
+        mesh=mesh,
+        in_specs=(state_specs, bspec, bspec),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def zero_gspmd_update(
+    optimizer, layout: BucketLayout, mesh: Mesh, grads, opt_state, params
+):
+    """The in-graph GSPMD expression of the sharded update (used by the
+    causal LM's jit-level step, models/lm.py).
+
+    Gradients arrive already reduced (the shard_map transpose psums
+    them); constraining the flat buckets to ``P('data')`` is a free
+    replicated→sharded reshard, after which the SPMD partitioner runs
+    the update math and lays the moments out 1/N per device. The final
+    replicated constraint on the new params is the derived all-gather.
+    Returns ``(new_params, new_opt_state)``.
+    """
+    shard = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+    keys = opt_keys(layout)
+    g_leaves, tdef = jax.tree_util.tree_flatten(grads)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    g_tree = {
+        k: lax.with_sharding_constraint(f, shard)
+        for k, f in zip(keys, _flatten_buckets(layout, g_leaves))
+    }
+    p_tree = {
+        k: lax.with_sharding_constraint(f, shard)
+        for k, f in zip(keys, _flatten_buckets(layout, p_leaves))
+    }
+    updates, new_opt = optimizer.update(g_tree, opt_state, p_tree)
+    # Moments REST sharded between steps — without the constraint the
+    # partitioner may replicate them on output and the memory win
+    # silently evaporates.
+    new_opt = jax.tree.map(
+        lambda x: lax.with_sharding_constraint(x, shard)
+        if getattr(x, "ndim", 0)
+        else x,
+        new_opt,
+    )
+    new_flats = optax.apply_updates(p_tree, updates)
+    new_flats = [
+        lax.with_sharding_constraint(new_flats[k], rep) for k in keys
+    ]
+    new_params = jax.tree_util.tree_unflatten(
+        tdef, _unflatten_buckets(layout, new_flats, p_leaves)
+    )
+    return new_params, new_opt
+
+
+# ---- accounting: what the strategy moves and what it holds ----------
+
+
+def ddp_comm_bytes(params, world: int) -> dict[str, int]:
+    """Per-step per-replica collective payload of the ddp baseline,
+    ring model: all-reduce = 2·(N−1)/N of the fp32 gradient bytes."""
+    n = sum(
+        int(jnp.size(leaf)) for leaf in jax.tree_util.tree_leaves(params)
+    )
+    ar = int(2 * (world - 1) / max(1, world) * n * 4)
+    return {
+        "all_reduce": ar, "reduce_scatter": 0, "all_gather": 0,
+        "total": ar,
+    }
+
+
+def zero_comm_bytes(
+    layout: BucketLayout,
+    world: int,
+    *,
+    grad_accum_steps: int = 1,
+    gspmd: bool = False,
+) -> dict[str, int]:
+    """Per-step per-replica collective payload of the zero strategy.
+
+    Explicit (shard_map) path: the all-reduce is GONE — replaced by a
+    reduce-scatter per bucket per microbatch ((N−1)/N of the padded
+    bytes each) plus one parameter all-gather. Ring-model total equals
+    the ddp all-reduce at ``grad_accum_steps=1`` (RS + AG *is* an AR);
+    the wins are the vanished redundant update compute, the 1/N
+    moments, and the per-bucket scheduling freedom. The in-graph GSPMD
+    path keeps the transpose's gradient all-reduce — ONE PER
+    MICROBATCH under accumulation, exactly like the explicit path's
+    scatters (models/lm.py backs through the shard_map forward inside
+    each scan iteration) — and adds the parameter all-gather:
+    memory-only win, priced honestly here.
+    """
+    b4 = layout.padded_total * 4
+    frac = (world - 1) / max(1, world)
+    if gspmd:
+        ar = int(2 * frac * b4) * max(1, grad_accum_steps)
+        rs = 0
+    else:
+        ar = 0
+        rs = int(frac * b4) * max(1, grad_accum_steps)
+    ag = int(frac * b4)
+    return {
+        "all_reduce": ar, "reduce_scatter": rs, "all_gather": ag,
+        "total": ar + rs + ag,
+    }
+
+
+def opt_bytes_per_device(opt_state) -> int:
+    """Optimizer-state memory high-water: max over devices of the
+    bytes the state's live buffers actually hold there (per-shard
+    accounting over the arrays' real shardings — replicated leaves
+    count in full on every device, data-sharded flats count 1/N)."""
+    per: dict[Any, int] = {}
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for s in leaf.addressable_shards:
+            n = 1
+            for d in s.data.shape:
+                n *= int(d)
+            per[s.device] = per.get(s.device, 0) + n * leaf.dtype.itemsize
+    return max(per.values(), default=0)

@@ -111,6 +111,20 @@ class TrainConfig:
     # chunked into seq_len sequences (data/text.py). No tokenizer dep.
     text_file: str | None = None
     zero1: bool = False  # shard optimizer state over data (ZeRO stage 1)
+    # Weight-update strategy on the data-parallel path. "auto" keeps
+    # the mesh-derived choice (shard_map DDP / GSPMD). "zero" is the
+    # ZeRO-style sharded update (parallel/zero.py): reduce-scatter
+    # grads in size-targeted buckets, run the optimizer on 1/N flat
+    # shards (moments REST sharded — Adam memory divides by the
+    # replica count), all-gather params. Covers the DDP image family
+    # (explicit shard_map collectives) and the causal LM (in-graph
+    # GSPMD expression); parity-pinned against the replicated update.
+    parallel: str = "auto"  # auto | zero
+    # Bucket size target for the zero reduce-scatters (the knob DDP's
+    # C++ reducer calls bucket_cap_mb): smaller buckets give the
+    # scheduler more collectives to overlap with backward compute,
+    # larger ones amortize per-collective latency.
+    zero_bucket_mb: float = 4.0
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
     # (resnet*, vit*, vit_moe*); simple_cnn has no block stack to remat.
@@ -307,6 +321,17 @@ class TrainConfig:
             help="byte-level corpus for --dataset text (causal_lm)",
         )
         p.add_argument("--zero1", action="store_true")
+        p.add_argument(
+            "--parallel", default=cls.parallel, choices=("auto", "zero"),
+            help="weight-update strategy: zero = ZeRO-style sharded "
+            "update (reduce-scatter grads, 1/N optimizer shards, "
+            "all-gather params — parallel/zero.py)",
+        )
+        p.add_argument(
+            "--zero_bucket_mb", type=float, default=cls.zero_bucket_mb,
+            help="gradient bucket size target for --parallel zero "
+            "(MB; smaller = more overlap-schedulable collectives)",
+        )
         p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)
         p.add_argument(

@@ -126,6 +126,50 @@ def lr_at(schedule, step: int) -> float:
     return float(schedule)
 
 
+def check_zero_compatible(
+    name: str,
+    *,
+    grad_clip_norm: float = 0.0,
+    ema_decay: float = 0.0,
+) -> None:
+    """Reject optimizer configs the ZeRO sharded update cannot run.
+
+    ``--parallel zero`` (parallel/zero.py) executes the update rule on
+    1/N flat parameter SHARDS, so every transform in the chain must be
+    *elementwise* — sgd, momentum, adam, adamw, weight decay and the
+    schedules all are (their ``init``/``update`` accept the sharded
+    moment trees unchanged). Two config knobs are not, and composing
+    them is out of scope rather than silently wrong:
+
+    - global-norm clipping reads a norm over the WHOLE gradient tree
+      before scaling (clipping per shard is a different algorithm);
+    - the parameter EMA keeps a full-shape parameter average inside
+      ``opt_state`` and ``evaluate()`` reads it back as a param tree —
+      flat 1/N shards cannot serve either end.
+
+    A structural backstop at layout time (parallel/zero.py
+    ``_opt_template``: every state leaf scalar or bucket-shaped)
+    additionally catches hand-built optimizers whose STATE has the
+    wrong shape — but it is shape-based, so a STATELESS cross-element
+    transform (``clip_by_global_norm`` carries EmptyState) slips it;
+    direct-API callers composing their own optax chains own the
+    elementwise contract themselves.
+    """
+    del name  # all registered families pass once the knobs are clear
+    if grad_clip_norm:
+        raise ValueError(
+            "--grad_clip_norm computes a GLOBAL gradient norm, which "
+            "couples elements across the sharded update — not "
+            "composable with --parallel zero; drop one"
+        )
+    if ema_decay:
+        raise ValueError(
+            "--ema_decay keeps a full-shape parameter average inside "
+            "opt_state, which --parallel zero shards flat — "
+            "evaluate-with-EMA could never see whole params; drop one"
+        )
+
+
 def make_optimizer(
     name: str = "sgd",
     *,

@@ -388,6 +388,61 @@ class Trainer:
             or config.mesh_expert > 1
             or config.zero1  # opt-state sharding rides the GSPMD step
         )
+        # ZeRO-style weight-update sharding (--parallel zero,
+        # parallel/zero.py): reduce-scatter grads, 1/N sharded
+        # optimizer update, all-gather params. Validated here, before
+        # any device or dataset work, so a bad combination fails with
+        # the flags named.
+        self.zero_mode = config.parallel == "zero"
+        if self.zero_mode:
+            from ddp_tpu.train.optim import check_zero_compatible
+
+            if self.use_spmd:
+                raise ValueError(
+                    "--parallel zero shards the update over the data "
+                    "axis; model/fsdp/expert meshes (and --zero1) "
+                    "already shard optimizer state their own way — "
+                    "fsdp IS ZeRO-3 — drop the axes/flag or --parallel"
+                )
+            if config.mesh_seq > 1 or config.mesh_pipe > 1:
+                raise ValueError(
+                    "--parallel zero composes with the data axis only "
+                    "(the sharded update scatters over it); drop "
+                    "--mesh_seq/--mesh_pipe or --parallel"
+                )
+            if self.pipe_mode or (self.seq_mode and not self.lm_mode):
+                raise ValueError(
+                    f"--parallel zero covers the DDP image family and "
+                    f"--model causal_lm; {config.model!r} keeps its "
+                    "own update path"
+                )
+            if config.fast_epoch:
+                raise ValueError(
+                    "--fast_epoch scans the plain DDP step; the zero "
+                    "strategy has its own hot loop — drop one"
+                )
+            if config.health:
+                raise ValueError(
+                    "--health groups gradient stats by layer path, but "
+                    "--parallel zero only materializes 1/N FLAT "
+                    "gradient shards (the reduced full-gradient tree "
+                    "never exists) — drop one"
+                )
+            check_zero_compatible(
+                config.optimizer,
+                grad_clip_norm=config.grad_clip_norm,
+                ema_decay=config.ema_decay,
+            )
+            if config.zero_bucket_mb <= 0:
+                raise ValueError(
+                    f"--zero_bucket_mb must be > 0, got "
+                    f"{config.zero_bucket_mb}"
+                )
+        self._zero_layout = None
+        # Per-step collective-payload estimate (parallel/zero.py): set
+        # on the strategies whose comm story the bench compares (plain
+        # DDP and zero); None elsewhere omits the metrics field.
+        self._comm_bytes: int | None = None
         from ddp_tpu.data.augment import get_augmentation
 
         self.dataset = config.dataset
@@ -664,15 +719,43 @@ class Trainer:
             if self.lm_mode:
                 from ddp_tpu.models.lm import (
                     create_lm_train_state,
+                    init_lm,
                     make_lm_eval_step,
                     make_lm_train_step,
                 )
 
+                if self.zero_mode:
+                    # The causal LM rides the IN-GRAPH GSPMD zero
+                    # expression (parallel/zero.py zero_gspmd_update):
+                    # the bucket layout is built from abstract shapes
+                    # so no replicated moment tree ever materializes.
+                    from ddp_tpu.parallel.zero import (
+                        build_layout,
+                        check_zero_mesh,
+                        zero_comm_bytes,
+                    )
+
+                    check_zero_mesh(self.mesh)
+                    seq_spec = self.seq_spec
+                    self._zero_layout = build_layout(
+                        jax.eval_shape(
+                            lambda: init_lm(seq_spec, seed=config.seed)
+                        ),
+                        int(self.mesh.shape["data"]),
+                        bucket_mb=config.zero_bucket_mb,
+                    )
+                    self._comm_bytes = zero_comm_bytes(
+                        self._zero_layout,
+                        int(self.mesh.shape["data"]),
+                        grad_accum_steps=config.grad_accum_steps,
+                        gspmd=True,
+                    )["total"]
                 lm_step = make_lm_train_step(
                     self.seq_spec, self.optimizer, self.mesh,
                     compute_dtype=compute_dtype,
                     grad_accum_steps=config.grad_accum_steps,
                     label_smoothing=config.label_smoothing,
+                    zero_layout=self._zero_layout,
                     **hkw,
                 )
                 # labels ride the loader but the LM has no use for
@@ -684,6 +767,7 @@ class Trainer:
                 st = create_lm_train_state(
                     self.seq_spec, self.optimizer, self.mesh,
                     seed=config.seed,
+                    zero_layout=self._zero_layout,
                 )
             else:
                 from ddp_tpu.models.seq_transformer import (
@@ -721,6 +805,9 @@ class Trainer:
                 if config.mesh_fsdp > 1
                 or config.mesh_model > 1
                 or config.mesh_expert > 1
+                # zero: the data-sharded flat moments ARE the contract
+                # — a blanket replicate would silently undo the win.
+                or self.zero_mode
                 else replicate_state(st_tr, self.mesh)
             )
         elif self.pipe_lm_mode:
@@ -1004,6 +1091,35 @@ class Trainer:
                 seed=config.seed,
                 zero1=config.zero1,
             )
+        elif self.zero_mode:
+            # The explicit-collective (shard_map) zero step: bucketed
+            # psum_scatter / 1/N update / all_gather in place of the
+            # DDP pmean — parity-pinned against make_train_step.
+            from ddp_tpu.parallel.zero import (
+                create_zero_state,
+                make_zero_train_step,
+                zero_comm_bytes,
+            )
+
+            self.state, self._zero_layout = create_zero_state(
+                self.model, self.optimizer, sample, self.mesh,
+                seed=config.seed, bucket_mb=config.zero_bucket_mb,
+            )
+            self.train_step = make_zero_train_step(
+                self.model, self.optimizer, self.mesh, self._zero_layout,
+                compute_dtype=compute_dtype, seed=config.seed,
+                grad_accum_steps=config.grad_accum_steps,
+                augment_fn=augment_fn,
+                label_smoothing=config.label_smoothing,
+            )
+            self.eval_step = make_eval_step(
+                self.model, self.mesh, compute_dtype=compute_dtype
+            )
+            self._comm_bytes = zero_comm_bytes(
+                self._zero_layout,
+                int(self.mesh.shape["data"]),
+                grad_accum_steps=config.grad_accum_steps,
+            )["total"]
         else:
             self.train_step = make_train_step(
                 self.model, self.optimizer, self.mesh,
@@ -1020,6 +1136,13 @@ class Trainer:
                 self.model, self.optimizer, sample, seed=config.seed
             )
             self.state = replicate_state(state, self.mesh)
+            # The comm story the zero bench compares against: the full
+            # fp32 gradient ring all-reduce, every step.
+            from ddp_tpu.parallel.zero import ddp_comm_bytes
+
+            self._comm_bytes = ddp_comm_bytes(
+                self.state.params, self.data_shards
+            )["total"]
         self.fast_runner = None
         if config.fast_epoch:
             if not (self.lm_mode or self.pipe_mode) and (
@@ -1558,6 +1681,19 @@ class Trainer:
                 "epoch %d at batch %d (step %d)", epoch, ran, host_step,
             )
 
+    def _fresh_opt_state(self, params):
+        """A from-scratch optimizer state in the LIVE layout: the zero
+        strategy's flat data-sharded buckets, or plain ``init`` —
+        ``--reset_opt_state`` under ``--parallel zero`` must not graft
+        a tree-shaped state onto a bucket-sharded step."""
+        if self.zero_mode:
+            from ddp_tpu.parallel.zero import create_zero_opt_state
+
+            return create_zero_opt_state(
+                params, self.optimizer, self.mesh, self._zero_layout
+            )
+        return self.optimizer.init(params)
+
     def _restore_or_init(self):
         """Auto-resume, tolerant of --ema_decay being turned ON since
         the checkpoint was written (or a torch-imported checkpoint):
@@ -1652,7 +1788,7 @@ class Trainer:
                 self.state._replace(
                     params=params,
                     model_state=model_state,
-                    opt_state=self.optimizer.init(params),
+                    opt_state=self._fresh_opt_state(params),
                 ),
                 epoch + 1,
             )
@@ -2140,6 +2276,16 @@ class Trainer:
                         lr=lr_now,
                         **gn,
                         **obs_fields,
+                        # Analytic per-step collective payload
+                        # (parallel/zero.py estimates — static per
+                        # strategy, no sync): present on the ddp/zero
+                        # paths so the sharded update's comm story is
+                        # auditable next to the step times.
+                        **(
+                            {"comm_bytes": self._comm_bytes}
+                            if self._comm_bytes is not None
+                            else {}
+                        ),
                     )
                     self._recorder.record(
                         "log", step=step_now, epoch=epoch,
@@ -2224,6 +2370,8 @@ class Trainer:
             extra["health_events"] = int(
                 sum(self._health.events_total.values())
             )
+        if self._comm_bytes is not None:
+            extra["comm_bytes"] = self._comm_bytes
         self.metrics_writer.write(
             "epoch",
             epoch=epoch,

@@ -163,6 +163,17 @@ def build_report(records: list[dict]) -> str:
     if any("recompiles" in e for e in epochs):
         lines.append(f"recompiles    : {recompiles}")
 
+    # Collective-payload estimate (the ddp/zero update strategies
+    # stamp it — parallel/zero.py): only printed when present, so
+    # pre-zero streams keep their golden output byte-identical.
+    comm = [
+        r["comm_bytes"]
+        for r in steps + epochs
+        if r.get("comm_bytes") is not None
+    ]
+    if comm:
+        lines.append(f"comm/step     : {comm[-1]:,} bytes (estimate)")
+
     sentry = [h for h in health if h.get("detector") != "nonfinite"]
     if sentry:
         by_det: dict[str, int] = {}

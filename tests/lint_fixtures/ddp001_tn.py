@@ -50,3 +50,11 @@ def callback_defined_under_rank_guard(ctx):
 
         return report
     return None
+
+
+def uniform_zero_update(flat_grads, param_shard, world):
+    # the ZeRO pair under uniform control flow — every rank scatters
+    # and gathers unconditionally (parallel/zero.py's shape)
+    shard = lax.psum_scatter(flat_grads, "data", tiled=True) / world
+    new_shard = param_shard - 0.01 * shard
+    return lax.all_gather(new_shard, "data", tiled=True)

@@ -34,3 +34,25 @@ def psum_in_else_of_rank_guard(x, rank):
     else:
         y = lax.pmean(x, "data")  # ddp-expect: DDP001
     return y
+
+
+# --- the ZeRO pair (parallel/zero.py): reduce-scatter / all-gather
+# carry the same every-rank contract as the all-reduce they replace
+
+
+def scatter_on_main_only(flat_grads, ctx):
+    if ctx.is_main:
+        return lax.psum_scatter(flat_grads, "data", tiled=True)  # ddp-expect: DDP001
+    return flat_grads
+
+
+def reduce_scatter_in_rank_loop(dist, bucket, rank):
+    while rank == 0:
+        bucket = dist.reduce_scatter(bucket)  # ddp-expect: DDP001
+    return bucket
+
+
+def gather_params_on_main(param_shard, process_id):
+    if process_id == 0:
+        return lax.all_gather(param_shard, "data", tiled=True)  # ddp-expect: DDP001
+    return param_shard

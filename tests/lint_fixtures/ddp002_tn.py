@@ -31,3 +31,12 @@ def host_loop(step, state, batches, metrics):
 def untraced_helper(arr):
     # never reached from a jit root → host rules
     return arr.sum().item()
+
+
+def zero_update_shard(flat_grads, param_shard, lr):
+    # in-graph via its collectives (the zero strategy's shape) — but
+    # shape arithmetic stays static and every op stays on device
+    shard = jax.lax.psum_scatter(flat_grads, "data", tiled=True)
+    world = int(flat_grads.shape[0] // param_shard.shape[0])
+    new_shard = param_shard - lr * shard / world
+    return jax.lax.all_gather(new_shard, "data", tiled=True)

@@ -39,3 +39,21 @@ def scan_body(carry, x):
 
 def run_scan(xs):
     return jax.lax.scan(scan_body, 0.0, xs)
+
+
+# --- device-collective roots: a function whose body reduce-scatters /
+# all-gathers is traced code by construction, even when no jit/shard_map
+# call site names it (the zero strategy's helper shape)
+
+
+def bucket_scatter_update(flat_grads, world):
+    shard = jax.lax.psum_scatter(flat_grads, "data", tiled=True)
+    mean = shard / world
+    print("bucket mean", mean)  # ddp-expect: DDP002
+    return mean
+
+
+def gather_params_and_log(param_shard, stats):
+    full = jax.lax.all_gather(param_shard, "data", tiled=True)
+    stats["norm"] = float(full.sum())  # ddp-expect: DDP002
+    return full

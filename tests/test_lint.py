@@ -126,7 +126,7 @@ def test_text_report_golden():
         "(runtime/consensus.agree_any)]"
     )
     assert lines[-1] == (
-        "ddp-lint: 4 finding(s) (0 suppressed) in 1 file(s)"
+        "ddp-lint: 7 finding(s) (0 suppressed) in 1 file(s)"
     )
 
 
@@ -196,6 +196,10 @@ def test_callgraph_reaches_through_helpers():
     assert project.is_ingraph("ddp002_tp", "log_softmax_stats")
     # lax.scan body counts as a root
     assert project.is_ingraph("ddp002_tp", "scan_body")
+    # a body containing a device collective roots itself (the zero
+    # strategy's scatter/gather helpers)
+    assert project.is_ingraph("ddp002_tp", "bucket_scatter_update")
+    assert project.is_ingraph("ddp002_tn", "zero_update_shard")
     # host code stays out
     assert not project.is_ingraph("ddp002_tn", "host_loop")
     assert not project.is_ingraph("ddp002_tn", "untraced_helper")

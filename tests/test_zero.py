@@ -1,0 +1,520 @@
+"""ZeRO-style weight-update sharding (``--parallel zero``,
+parallel/zero.py): reduce-scatter grads in buckets, run the optimizer
+on 1/N flat shards (moments REST data-sharded), all-gather params.
+
+Parity strategy mirrors test_zero1.py: multi-step trajectories pin
+under SGD+momentum (linear in the gradients — layout noise cannot
+amplify), single-step under Adam (whose rsqrt near v≈0 chaotically
+magnifies 1e-8 reduction-order differences over steps). The tiny MLP
+used throughout has 13-/7-wide layers, so every leaf count is
+indivisible by the 8-way replica axis — the padding path is exercised
+by construction, and an explicit per-leaf-bucket test pins it.
+
+The 2-process gloo parity pins (MNIST CNN + causal LM across REAL
+process boundaries) live in tests/test_multihost.py like the other
+spawn tests.
+"""
+
+import json
+import os
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddp_tpu.parallel import zero as z
+from ddp_tpu.parallel.ddp import (
+    create_train_state,
+    make_train_step,
+    replicate_state,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, data_axes, make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TinyMLP(nn.Module):
+    """Every layer width coprime with the 8-way axis — padding on."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(13)(x))
+        return nn.Dense(7)(x)
+
+
+def _mesh(devices):
+    return make_mesh(MeshSpec(data=8), devices=devices)
+
+
+def _batch(mesh, n=16, seed=0, d=6, classes=7):
+    rng = np.random.default_rng(seed)
+    sh = NamedSharding(mesh, P(data_axes(mesh)))
+    return (
+        jax.device_put(rng.normal(size=(n, d)).astype(np.float32), sh),
+        jax.device_put(rng.integers(0, classes, (n,)).astype(np.int32), sh),
+    )
+
+
+def _setup(devices, *, parallel_zero, tx=None, bucket_mb=0.0001, **step_kw):
+    mesh = _mesh(devices)
+    model = TinyMLP()
+    tx = tx or optax.adam(1e-3)
+    sample = jnp.zeros((1, 6), jnp.float32)
+    if parallel_zero:
+        state, layout = z.create_zero_state(
+            model, tx, sample, mesh, seed=0, bucket_mb=bucket_mb
+        )
+        step = z.make_zero_train_step(
+            model, tx, mesh, layout, donate=False, **step_kw
+        )
+        return mesh, state, step, layout
+    state = replicate_state(
+        create_train_state(model, tx, sample, seed=0), mesh
+    )
+    step = make_train_step(model, tx, mesh, donate=False, **step_kw)
+    return mesh, state, step, None
+
+
+def _assert_params_close(s_zero, s_ddp, rtol=1e-5, atol=1e-6):
+    for a, b in zip(
+        jax.tree.leaves(s_zero.params), jax.tree.leaves(s_ddp.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol
+        )
+
+
+# ---- layout: bucketing + padding arithmetic (pure host) -------------
+
+
+def test_layout_buckets_and_padding():
+    params = {
+        "a": jax.ShapeDtypeStruct((3, 5), jnp.float32),   # 15
+        "b": jax.ShapeDtypeStruct((7,), jnp.float32),      # 7
+        "c": jax.ShapeDtypeStruct((2, 2, 2), jnp.float32),  # 8
+    }
+    # tiny target → one bucket per leaf; world 8 forces padding on all
+    layout = z.build_layout(params, 8, bucket_mb=1e-9)
+    assert len(layout.buckets) == 3
+    covered = sorted(i for b in layout.buckets for i in b.leaf_ids)
+    assert covered == [0, 1, 2]
+    for b in layout.buckets:
+        assert b.padded % 8 == 0 and b.padded >= b.total
+        assert b.shard * 8 == b.padded
+    assert layout.padded_total == 16 + 8 + 8
+    # big target → everything in ONE bucket, padded once
+    one = z.build_layout(params, 8, bucket_mb=4.0)
+    assert len(one.buckets) == 1
+    assert one.buckets[0].total == 30 and one.buckets[0].padded == 32
+    # an oversized leaf gets its OWN bucket — accumulated small leaves
+    # must not serialize behind it
+    big = {  # dict flatten order is alphabetical: a, b, c
+        "a_small": jax.ShapeDtypeStruct((4,), jnp.float32),
+        "b_huge": jax.ShapeDtypeStruct((100,), jnp.float32),
+        "c_tail": jax.ShapeDtypeStruct((3,), jnp.float32),
+    }
+    # target 40 elems: b_huge (100) crosses it alone
+    lay = z.build_layout(big, 8, bucket_mb=40 * 4 / 2**20)
+    by_leaves = [b.leaf_ids for b in lay.buckets]
+    assert by_leaves == [(0,), (1,), (2,)], by_leaves  # huge rides alone
+    with pytest.raises(ValueError, match="bucket_mb"):
+        z.build_layout(params, 8, bucket_mb=0)
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.default_rng(0)
+    leaves = [
+        jnp.asarray(rng.normal(size=(3, 5)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(7,)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(2, 2, 2)), jnp.bfloat16),
+    ]
+    layout = z.build_layout(leaves, 8, bucket_mb=1e-9)
+    flats = z._flatten_buckets(layout, leaves)
+    for b, f in zip(layout.buckets, flats):
+        assert f.shape == (b.padded,) and f.dtype == jnp.float32
+        assert not np.any(np.asarray(f[b.total:]))  # pad region zeros
+    back = z._unflatten_buckets(layout, flats, leaves)
+    for got, want in zip(back, leaves):
+        assert got.dtype == want.dtype and got.shape == want.shape
+        np.testing.assert_array_equal(
+            np.asarray(got, np.float32), np.asarray(want, np.float32)
+        )
+
+
+def test_scatter_slice_gather_convention(devices):
+    """psum_scatter block ↔ axis_index slice ↔ tiled all_gather must
+    agree on block ordering — the zero step slices this replica's
+    param block locally and trusts the convention."""
+    from jax import lax
+
+    mesh = _mesh(devices)
+
+    def body(x):
+        s = lax.psum_scatter(x, "data", scatter_dimension=0, tiled=True)
+        idx = lax.axis_index("data")
+        mine = lax.dynamic_slice_in_dim(x, idx * 2, 2)
+        g = lax.all_gather(s, "data", axis=0, tiled=True)
+        return s, mine, g
+
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(),),
+            out_specs=(P("data"), P("data"), P()), check_vma=False,
+        )
+    )
+    x = jnp.arange(16.0)
+    s, mine, g = f(x)
+    np.testing.assert_array_equal(np.asarray(s), 8 * np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(s) / 8, np.asarray(mine))
+    np.testing.assert_array_equal(np.asarray(g), 8 * np.asarray(x))
+
+
+# ---- comm accounting ------------------------------------------------
+
+
+def test_comm_bytes_estimates():
+    params = {"w": jax.ShapeDtypeStruct((100,), jnp.float32)}
+    layout = z.build_layout(params, 8, bucket_mb=4.0)  # padded 104
+    ddp = z.ddp_comm_bytes(jnp.zeros((100,), jnp.float32), 8)
+    zr = z.zero_comm_bytes(layout, 8)
+    # the headline: the all-reduce term vanishes on the explicit path
+    assert ddp["all_reduce"] > 0 and zr["all_reduce"] == 0
+    assert zr["reduce_scatter"] > 0 and zr["all_gather"] > 0
+    # ring-model totals agree up to padding (RS + AG is an AR)
+    assert abs(zr["total"] - ddp["total"]) <= 2 * (104 - 100) * 4
+    # accumulation scatters per microbatch
+    zr4 = z.zero_comm_bytes(layout, 8, grad_accum_steps=4)
+    assert zr4["reduce_scatter"] == 4 * zr["reduce_scatter"]
+    assert zr4["all_gather"] == zr["all_gather"]
+    # the gspmd expression keeps the transpose's all-reduce — one per
+    # microbatch under accumulation, like the explicit path's scatters
+    zg = z.zero_comm_bytes(layout, 8, gspmd=True)
+    assert zg["all_reduce"] > 0 and zg["reduce_scatter"] == 0
+    zg4 = z.zero_comm_bytes(layout, 8, gspmd=True, grad_accum_steps=4)
+    assert zg4["all_reduce"] == 4 * zg["all_reduce"]
+    assert zg4["all_gather"] == zg["all_gather"]
+
+
+# ---- parity against the ddp step ------------------------------------
+
+
+def test_zero_adam_single_step_matches_ddp(devices):
+    """One Adam step: only layout/fusion noise, no chaotic
+    amplification yet — the sharded math is the same math. Every leaf
+    width (13/7/…) is indivisible by 8, so this is also the
+    padding-edge pin at per-leaf bucket granularity."""
+    mesh, s1, step1, layout = _setup(devices, parallel_zero=True)
+    _, s0, step0, _ = _setup(devices, parallel_zero=False)
+    assert all(b.padded > b.total for b in layout.buckets), (
+        "padding edge not exercised — change the MLP widths"
+    )
+    images, labels = _batch(mesh)
+    s1, m1 = step1(s1, images, labels)
+    s0, m0 = step0(s0, images, labels)
+    assert abs(float(m1.loss) - float(m0.loss)) < 1e-6
+    assert abs(float(m1.accuracy) - float(m0.accuracy)) < 1e-6
+    assert abs(float(m1.grad_norm) - float(m0.grad_norm)) < 1e-5
+    _assert_params_close(s1, s0)
+
+
+def test_zero_sgd_momentum_trajectory_matches_ddp(devices):
+    """Multi-step trajectory under SGD+momentum (linear in the grads):
+    loss and params track the replicated step to float tolerance
+    across steps — reduction order is the only difference."""
+    tx = optax.sgd(0.05, momentum=0.9)
+    mesh, s1, step1, _ = _setup(devices, parallel_zero=True, tx=tx)
+    _, s0, step0, _ = _setup(devices, parallel_zero=False, tx=tx)
+    images, labels = _batch(mesh)
+    for _ in range(4):
+        s1, m1 = step1(s1, images, labels)
+        s0, m0 = step0(s0, images, labels)
+        assert abs(float(m1.loss) - float(m0.loss)) < 1e-6
+    _assert_params_close(s1, s0)
+
+
+def test_zero_overlap_control_matches(devices):
+    """The no-overlap control (barrier fence + serial collective
+    chain) is the SAME math — only the schedule differs."""
+    mesh, s1, step1, layout = _setup(devices, parallel_zero=True)
+    step_serial = z.make_zero_train_step(
+        TinyMLP(), optax.adam(1e-3), mesh, layout, donate=False,
+        overlap=False,
+    )
+    images, labels = _batch(mesh)
+    s2 = s1
+    s1, m1 = step1(s1, images, labels)
+    s2, m2 = step_serial(s2, images, labels)
+    assert float(m1.loss) == float(m2.loss)
+    _assert_params_close(s1, s2, rtol=0, atol=0)
+
+
+def test_zero_grad_accum_matches_ddp_accum(devices):
+    """--grad_accum composes: accumulation happens in the SCATTERED
+    shards (1/N accumulators), and the result matches the ddp
+    accumulation step over the same stacked batch."""
+    tx = optax.sgd(0.05, momentum=0.9)
+    mesh, s1, step1, _ = _setup(
+        devices, parallel_zero=True, tx=tx, grad_accum_steps=2
+    )
+    _, s0, step0, _ = _setup(
+        devices, parallel_zero=False, tx=tx, grad_accum_steps=2
+    )
+    images, labels = _batch(mesh, n=32)
+    for _ in range(2):
+        s1, m1 = step1(s1, images, labels)
+        s0, m0 = step0(s0, images, labels)
+        assert abs(float(m1.loss) - float(m0.loss)) < 1e-6
+    _assert_params_close(s1, s0)
+
+
+# ---- resting state: sharded moments, replicated params --------------
+
+
+def test_zero_opt_state_rests_sharded_and_smaller(devices):
+    mesh, s1, _, _ = _setup(devices, parallel_zero=True)
+    _, s0, _, _ = _setup(devices, parallel_zero=False)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(s1.opt_state)[0]:
+        if getattr(leaf, "ndim", 0):
+            assert "data" in jax.tree.leaves(tuple(leaf.sharding.spec)), (
+                path, leaf.sharding,
+            )
+    for p in jax.tree.leaves(s1.params):
+        assert all(s is None for s in p.sharding.spec), p.sharding.spec
+    z_bytes = z.opt_bytes_per_device(s1.opt_state)
+    full_bytes = z.opt_bytes_per_device(s0.opt_state)
+    # Adam moments divide by the axis size; scalars stay replicated.
+    assert z_bytes < full_bytes / 4
+
+
+# ---- the causal LM's in-graph GSPMD expression ----------------------
+
+
+def test_zero_lm_gspmd_matches_plain_lm(devices):
+    from ddp_tpu.models.lm import (
+        LMSpec,
+        create_lm_train_state,
+        init_lm,
+        make_lm_train_step,
+    )
+    from ddp_tpu.models.seq_transformer import _batch_axes
+
+    mesh = _mesh(devices)
+    spec = LMSpec(
+        vocab_size=32, total_len=16, d_model=32, depth=1, num_heads=4
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    layout = z.build_layout(
+        jax.eval_shape(lambda: init_lm(spec, seed=0)), 8, bucket_mb=0.01
+    )
+    assert len(layout.buckets) > 1  # multi-bucket path
+    s0 = create_lm_train_state(spec, tx, mesh, seed=0)
+    s1 = create_lm_train_state(spec, tx, mesh, seed=0, zero_layout=layout)
+    step0 = make_lm_train_step(spec, tx, mesh, donate=False)
+    step1 = make_lm_train_step(
+        spec, tx, mesh, donate=False, zero_layout=layout
+    )
+    toks = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(3).integers(0, 32, (8, 16)),
+            jnp.int32,
+        ),
+        NamedSharding(mesh, P(_batch_axes(mesh), "seq")),
+    )
+    for _ in range(3):
+        s0, m0 = step0(s0, toks)
+        s1, m1 = step1(s1, toks)
+        assert abs(float(m0.loss) - float(m1.loss)) < 1e-6
+    _assert_params_close(s1, s0, atol=1e-5)
+    # moments rest data-sharded on the LM path too
+    for path, leaf in jax.tree_util.tree_flatten_with_path(s1.opt_state)[0]:
+        if getattr(leaf, "ndim", 0):
+            assert "data" in jax.tree.leaves(tuple(leaf.sharding.spec)), (
+                path, leaf.sharding,
+            )
+    assert z.opt_bytes_per_device(s1.opt_state) < z.opt_bytes_per_device(
+        s0.opt_state
+    )
+
+
+# ---- optimizer contract + flag guards -------------------------------
+
+
+def test_optimizer_contract_rejections():
+    from ddp_tpu.train.optim import check_zero_compatible
+
+    with pytest.raises(ValueError, match="GLOBAL gradient norm"):
+        check_zero_compatible("sgd", grad_clip_norm=1.0)
+    with pytest.raises(ValueError, match="full-shape parameter average"):
+        check_zero_compatible("adamw", ema_decay=0.999)
+    check_zero_compatible("adam")  # clean knobs pass
+
+    # the structural backstop: a state leaf that is neither scalar nor
+    # bucket-shaped names the elementwise contract
+    def bad_init(params):
+        del params
+        return jnp.zeros((3, 3))
+
+    bad = optax.GradientTransformation(bad_init, lambda u, s, p=None: (u, s))
+    params = {"w": jax.ShapeDtypeStruct((64,), jnp.float32)}
+    layout = z.build_layout(params, 8, bucket_mb=4.0)
+    with pytest.raises(ValueError, match="elementwise"):
+        z.opt_state_specs(bad, layout)
+
+
+def test_trainer_rejects_incompatible_combos(tmp_path):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    base = dict(
+        parallel="zero",
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        batch_size=4,
+    )
+    cases = [
+        (dict(zero1=True), "shard optimizer state"),
+        (dict(mesh_fsdp=2), "shard optimizer state"),
+        (dict(mesh_model=2), "shard optimizer state"),
+        (dict(model="long_context"), "causal_lm"),
+        (dict(model="pipe_vit", mesh_pipe=2), "data axis only"),
+        (dict(fast_epoch=True), "own hot loop"),
+        (dict(health=True), "FLAT"),
+        (dict(grad_clip_norm=1.0), "GLOBAL gradient norm"),
+        (dict(ema_decay=0.99, optimizer="adamw"), "parameter average"),
+        (dict(zero_bucket_mb=0.0), "zero_bucket_mb"),
+    ]
+    for overrides, match in cases:
+        with pytest.raises(ValueError, match=match):
+            Trainer(TrainConfig(**{**base, **overrides}))
+
+
+def test_zero_rejects_sharded_mesh(devices):
+    mesh = make_mesh(MeshSpec(data=4, fsdp=2), devices=devices)
+    with pytest.raises(ValueError, match="data axis only"):
+        z.check_zero_mesh(mesh)
+
+
+# ---- the trainer end to end (slow tier) -----------------------------
+
+
+def test_trainer_zero_e2e_sanitized_resume(tmp_path):
+    """--parallel zero through the Trainer with --sanitize armed (the
+    transfer guard proves the new hot loop implicit-transfer-free),
+    checkpointing data-sharded flat moments through Orbax, resuming,
+    and stamping comm_bytes on the step/epoch metrics records."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    metrics = str(tmp_path / "m.jsonl")
+
+    def cfg(epochs):
+        return TrainConfig(
+            epochs=epochs,
+            batch_size=4,
+            parallel="zero",
+            optimizer="adam",
+            lr=1e-3,
+            checkpoint_dir=str(tmp_path / "ck"),
+            data_root=str(tmp_path / "data"),
+            synthetic_data=True,
+            synthetic_size=128,
+            log_interval=4,
+            eval_every=0,
+            metrics_file=metrics,
+            sanitize=True,
+            sanitize_timeout=0,
+        )
+
+    t = Trainer(cfg(1))
+    assert t.zero_mode and t._zero_layout is not None
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
+    recs = [json.loads(line) for line in open(metrics)]
+    steps = [r for r in recs if r.get("kind") == "step"]
+    assert steps and all(r.get("comm_bytes", 0) > 0 for r in steps)
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert epochs and epochs[0]["comm_bytes"] == steps[0]["comm_bytes"]
+
+    t2 = Trainer(cfg(2))
+    summary2 = t2.train()
+    t2.close()
+    assert summary2["epochs_run"] == 1
+    assert summary2["history"][0]["epoch"] == 1
+
+
+def test_trainer_zero_lm_trains(tmp_path):
+    """--parallel zero --model causal_lm: the in-graph GSPMD path end
+    to end — sharded flat moments through checkpoint save and eval."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=8,
+        model="causal_lm",
+        parallel="zero",
+        optimizer="adam",
+        lr=1e-3,
+        seq_len=16,
+        vocab_size=32,
+        model_dim=32,
+        model_depth=1,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_size=64,
+        log_interval=4,
+        eval_every=0,
+    )
+    t = Trainer(cfg)
+    assert t.zero_mode and t._zero_layout is not None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+        t.state.opt_state
+    )[0]:
+        if getattr(leaf, "ndim", 0):
+            assert "data" in jax.tree.leaves(tuple(leaf.sharding.spec)), (
+                path, leaf.sharding,
+            )
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1
+    assert np.isfinite(summary["final_loss"])
+
+
+# ---- triage surfacing ----------------------------------------------
+
+
+def test_health_report_comm_line(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import health_report
+
+    path = tmp_path / "m.jsonl"
+    path.write_text(
+        json.dumps(
+            {"kind": "step", "step": 1, "loss": 1.0, "comm_bytes": 4096}
+        )
+        + "\n"
+        + json.dumps({"kind": "epoch", "epoch": 0, "batches": 2,
+                      "seconds": 1.0, "comm_bytes": 4096})
+        + "\n"
+    )
+    report = health_report.build_report(
+        health_report.load_records(str(path))
+    )
+    assert "comm/step     : 4,096 bytes" in report
+    # absent field → absent line (the golden pin stays byte-identical)
+    path.write_text(
+        json.dumps({"kind": "step", "step": 1, "loss": 1.0}) + "\n"
+    )
+    report2 = health_report.build_report(
+        health_report.load_records(str(path))
+    )
+    assert "comm/step" not in report2
