@@ -29,6 +29,43 @@ import jax
 import numpy as np
 
 
+def rescale_per_shard_batch(
+    global_batch: int, num_shards: int, *, grad_accum_steps: int = 1
+) -> int:
+    """Per-shard batch that preserves ``global_batch`` at the LIVE
+    shard count — the elastic-resize half of the shard math.
+
+    The global batch is the optimizer's contract: it defines what one
+    step *means* (and therefore what the step counter, the LR schedule
+    and steps-per-epoch mean). When an elastic restart changes the
+    shard count, the per-shard batch must absorb the change so the
+    global batch — and every checkpointed step-counter semantic —
+    survives. The shard slicing above makes the rescale exact: shard
+    ``r`` of N takes ``indices[r::N]``, so one step's union of
+    per-shard slices is the same contiguous window of the global
+    permutation at ANY divisor world size — a world-2 step and a
+    world-1 step consume identical sample sets in identical order.
+
+    Raises when the preserved global batch cannot tile the new
+    topology (indivisible, or below one example per shard) — silently
+    changing the global batch would corrupt the run's semantics.
+    """
+    denom = num_shards * max(1, grad_accum_steps)
+    per = global_batch // denom
+    if per < 1 or per * denom != global_batch:
+        raise ValueError(
+            f"elastic resize: global batch {global_batch} cannot be "
+            f"preserved over {num_shards} shard(s)"
+            + (
+                f" x {grad_accum_steps} accumulation steps"
+                if grad_accum_steps > 1
+                else ""
+            )
+            + " — it must divide evenly with >= 1 example per shard"
+        )
+    return per
+
+
 @dataclasses.dataclass(frozen=True)
 class ShardSampler:
     """Index plan for one shard of a dataset across an epoch."""

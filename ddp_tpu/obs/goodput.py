@@ -358,13 +358,29 @@ class GoodputAccountant:
     tokenizer and lm_spec sidecars) so auto-resume accumulates across
     process restarts::
 
-        {"first_launch_unix": ..., "productive_s": ..., "restarts": N}
+        {"first_launch_unix": ..., "productive_s": ..., "restarts": N,
+         "world_size": W, "last_flush_unix": ...,
+         "restart_downtime_s": ..., "resize_downtime_s": ...,
+         "resizes": M}
 
     ``start_run()`` loads-or-initializes (counting a restart when a
     previous run's sidecar exists), ``add_productive()`` accrues step/
     epoch seconds, ``flush()`` writes atomically — called per epoch so
     a kill between epochs loses at most one epoch of accounting.
     ``enabled=False`` (non-main ranks) makes everything a no-op.
+
+    Restart vs RESIZE downtime: each relaunch's downtime — the wall
+    time between the dead generation's last flush and this
+    generation's ``start_run()``, i.e. the unproductive tail of the
+    killed epoch plus reap/backoff/re-init — is attributed by whether
+    the world CHANGED SIZE across the boundary. Same size: ordinary
+    restart downtime (a crash loop). Different size: resize downtime
+    (an elastic scale-down/up, runtime/launch.py ``elastic=True``).
+    The split is what lets capacity planning separate "our jobs crash"
+    from "our fleet gets preempted and reshapes" — accounted downtime,
+    not a mystery gap. Callers pass the live ``world_size`` to
+    ``start_run``; ``prev_world`` then holds the size the previous
+    generation recorded (None on first launch / legacy sidecars).
     """
 
     def __init__(
@@ -380,8 +396,14 @@ class GoodputAccountant:
         self.first_launch: float | None = None
         self.productive_s = 0.0
         self.restarts = 0
+        self.world_size: int | None = None
+        self.prev_world: int | None = None
+        self.restart_downtime_s = 0.0
+        self.resize_downtime_s = 0.0
+        self.resizes = 0
 
-    def start_run(self) -> None:
+    def start_run(self, world_size: int | None = None) -> None:
+        self.world_size = world_size
         if not self.enabled:
             return
         state = None
@@ -394,10 +416,36 @@ class GoodputAccountant:
             self.first_launch = float(state["first_launch_unix"])
             self.productive_s = float(state.get("productive_s", 0.0))
             self.restarts = int(state.get("restarts", 0)) + 1
+            self.restart_downtime_s = float(
+                state.get("restart_downtime_s", 0.0)
+            )
+            self.resize_downtime_s = float(
+                state.get("resize_downtime_s", 0.0)
+            )
+            self.resizes = int(state.get("resizes", 0))
+            prev = state.get("world_size")
+            self.prev_world = int(prev) if prev else None
+            # Downtime of the boundary just crossed: last durable
+            # flush of the dead generation → now. Legacy sidecars
+            # without the flush stamp contribute 0 (unknowable, not
+            # invented).
+            down = max(
+                0.0, self.clock() - float(state.get("last_flush_unix", self.clock()))
+            )
+            if (
+                world_size is not None
+                and self.prev_world is not None
+                and world_size != self.prev_world
+            ):
+                self.resizes += 1
+                self.resize_downtime_s += down
+            else:
+                self.restart_downtime_s += down
         else:
             self.first_launch = self.clock()
             self.productive_s = 0.0
             self.restarts = 0
+            self.prev_world = None
 
     def add_productive(self, seconds: float) -> None:
         if self.enabled and math.isfinite(seconds) and seconds > 0:
@@ -407,13 +455,18 @@ class GoodputAccountant:
         if not self.enabled or self.first_launch is None:
             return {}
         wall = max(1e-9, self.clock() - self.first_launch)
-        return {
+        out = {
             "goodput": round(self.productive_s / wall, 6),
             "productive_s": round(self.productive_s, 3),
             "wall_s": round(wall, 3),
             "restarts": self.restarts,
             "first_launch_unix": round(self.first_launch, 3),
         }
+        if self.restarts or self.resizes:
+            out["restart_downtime_s"] = round(self.restart_downtime_s, 3)
+            out["resize_downtime_s"] = round(self.resize_downtime_s, 3)
+            out["resizes"] = self.resizes
+        return out
 
     def flush(self) -> None:
         if not self.enabled or self.first_launch is None:
@@ -426,6 +479,11 @@ class GoodputAccountant:
                     "first_launch_unix": self.first_launch,
                     "productive_s": self.productive_s,
                     "restarts": self.restarts,
+                    "world_size": self.world_size,
+                    "last_flush_unix": self.clock(),
+                    "restart_downtime_s": self.restart_downtime_s,
+                    "resize_downtime_s": self.resize_downtime_s,
+                    "resizes": self.resizes,
                 },
                 f,
             )

@@ -52,10 +52,12 @@ construction (train/optim.py ``check_zero_compatible``).
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -534,6 +536,153 @@ def zero_gspmd_update(
         tdef, _unflatten_buckets(layout, new_flats, p_leaves)
     )
     return new_params, new_opt
+
+
+# ---- elastic restore: re-bucket when the world size changed ---------
+
+_BUCKET_KEY_RE = re.compile(r"^b\d{3}$")
+
+
+def _path_bucket_key(path) -> str | None:
+    """The ``b000``-style bucket key on a tree path, if any (the flat
+    state leaves live under dict keys named by ``_opt_key``)."""
+    for k in path:
+        key = str(getattr(k, "key", k))
+        if _BUCKET_KEY_RE.fullmatch(key):
+            return key
+    return None
+
+
+@dataclasses.dataclass
+class ZeroElasticReshaper:
+    """Restore-time RE-BUCKETING for world-shape-agnostic checkpoints.
+
+    Everything else in a zero run reshards on load (params and scalars
+    are replicated; Orbax templates them onto the live mesh — the
+    tests/test_elastic_shard.py mechanism). The flat optimizer buckets
+    cannot: their GLOBAL shapes are world-dependent (``padded`` rounds
+    each bucket's ``total`` up to a multiple of the replica count), so
+    a checkpoint saved at world 2 literally has different array shapes
+    than world 1's layout and no resharding can bridge them. Bucket
+    *assignment* (which leaves, in what order, with what totals) is
+    world-independent — ``build_layout`` never consults the world for
+    it — so the bridge is pure padding arithmetic:
+
+        saved ``[padded_old]`` → strip to ``[total]`` (the pad region
+        is zeros end to end, the ``Bucket`` contract) → re-pad to
+        ``[padded_new]`` → place 1/N over the live ``data`` axis.
+
+    ``plan`` inspects the checkpoint's opt_state *metadata* (no array
+    reads) and returns an abstract restore tree in the SAVED shapes on
+    single-device placements — or None when shapes already match (the
+    common, non-resized restore pays nothing). ``apply`` then performs
+    the re-bucket on the host-restored values. A bucket-STRUCTURE
+    mismatch (``--zero_bucket_mb`` or the model changed, not the
+    world) is rejected — that state genuinely cannot be reinterpreted.
+
+    Bit-identity contract (pinned by tests/test_elastic.py): the
+    re-bucketed state equals a fresh sharding of the merged state —
+    zeros in, zeros out, values untouched.
+    """
+
+    optimizer: Any
+    layout: BucketLayout
+    mesh: Mesh
+
+    def _live_padded(self) -> dict[str, int]:
+        return {
+            _opt_key(i): b.padded
+            for i, b in enumerate(self.layout.buckets)
+        }
+
+    def plan(self, meta_opt) -> Any | None:
+        """Checkpoint opt_state metadata → abstract restore tree in the
+        saved bucket shapes, or None when no re-bucket is needed."""
+        saved: dict[str, int] = {}
+
+        def visit(path, leaf):
+            k = _path_bucket_key(path)
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            if k is not None and len(shape) == 1:
+                saved[k] = int(shape[0])
+
+        jax.tree_util.tree_map_with_path(visit, meta_opt)
+        if not saved:
+            return None  # not a bucketed opt_state — nothing to plan
+        new = self._live_padded()
+        if set(saved) != set(new):
+            raise ValueError(
+                f"checkpoint opt_state has buckets {sorted(saved)} but "
+                f"the live layout has {sorted(new)} — the bucket "
+                "STRUCTURE changed (--zero_bucket_mb or the model), "
+                "not just the world size; elastic re-bucketing only "
+                "absorbs world changes. --reset_opt_state keeps the "
+                "weights and drops the moments."
+            )
+        totals = {
+            _opt_key(i): b.total
+            for i, b in enumerate(self.layout.buckets)
+        }
+        short = {k: p for k, p in saved.items() if p < totals[k]}
+        if short:
+            raise ValueError(
+                f"checkpoint buckets {sorted(short)} are smaller than "
+                "their live totals — the parameter tree changed since "
+                "the save; this is not a world resize"
+            )
+        if all(saved[k] == new[k] for k in new):
+            return None  # same world shape — restore templated as usual
+        # REPLICATED placements over the live mesh, not a per-process
+        # local device: every rank must hand Orbax the SAME global
+        # shardings or a multi-process restore desyncs — and a fully-
+        # replicated array is host-readable on every process, which is
+        # exactly what ``apply`` needs for the re-pad arithmetic.
+        rep = NamedSharding(self.mesh, P())
+        tpl = _opt_template(self.optimizer, self.layout)
+
+        def override(path, leaf):
+            k = _path_bucket_key(path)
+            shape = (
+                (saved[k],)
+                if k is not None and len(leaf.shape) == 1
+                else leaf.shape
+            )
+            return jax.ShapeDtypeStruct(shape, leaf.dtype, sharding=rep)
+
+        return jax.tree_util.tree_map_with_path(override, tpl)
+
+    def apply(self, restored_opt):
+        """Host-restored old-world state → live data-sharded state."""
+        shard = NamedSharding(self.mesh, P("data"))
+        rep = NamedSharding(self.mesh, P())
+        totals = {
+            _opt_key(i): b.total
+            for i, b in enumerate(self.layout.buckets)
+        }
+        padded = self._live_padded()
+
+        def fix(path, leaf):
+            arr = np.asarray(leaf)
+            k = _path_bucket_key(path)
+            if k is not None and arr.ndim == 1:
+                arr = arr[: totals[k]]
+                pad = padded[k] - totals[k]
+                if pad:
+                    arr = np.concatenate(
+                        [arr, np.zeros((pad,), arr.dtype)]
+                    )
+                sharding = shard
+            else:
+                sharding = rep
+            # make_array_from_callback assembles the global array from
+            # addressable shards only, so the same spelling is correct
+            # single- and multi-process (each process holds the full
+            # host copy — the restore placed it single-device locally).
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx, a=arr: a[idx]
+            )
+
+        return jax.tree_util.tree_map_with_path(fix, restored_opt)
 
 
 # ---- accounting: what the strategy moves and what it holds ----------

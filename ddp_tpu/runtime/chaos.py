@@ -17,6 +17,15 @@ Spec grammar (comma-separated events; see docs/ROBUSTNESS.md)::
     kill:rank<R>@epoch<N>         ... at the top of epoch N
     sigterm:rank<R>@step<N>       graceful-preemption signal instead
     sigterm:rank<R>@epoch<N>
+    shrink:rank<R>@step<N>        rank R is PERMANENTLY lost (exits
+    shrink:rank<R>@epoch<N>       with launch.SHRINK_EXIT_CODE): an
+                                  elastic supervisor relaunches the
+                                  world one worker smaller — the
+                                  scale-down drill
+    grow:+1@step<N>               a lost host is restored (rank 0
+    grow:+1@epoch<N>              exits launch.GROW_EXIT_CODE): the
+                                  elastic supervisor relaunches one
+                                  worker larger — the scale-up drill
     stall:input@step<N>:<S>s      sleep S seconds before step N's
                                   dispatch, on every rank (an input-
                                   pipeline stall the straggler sentry
@@ -47,12 +56,15 @@ from typing import Iterable, Sequence
 
 logger = logging.getLogger("ddp_tpu")
 
-KINDS = ("kill", "sigterm", "stall", "ckpt_corrupt")
+KINDS = ("kill", "sigterm", "shrink", "grow", "stall", "ckpt_corrupt")
 
 _EVENT_RE = re.compile(
-    r"^(?P<kind>kill|sigterm)"
+    r"^(?P<kind>kill|sigterm|shrink)"
     r":rank(?P<rank>\d+)"
     r"@(?P<unit>step|epoch)(?P<at>\d+)$"
+)
+_GROW_RE = re.compile(
+    r"^grow:\+1@(?P<unit>step|epoch)(?P<at>\d+)$"
 )
 _STALL_RE = re.compile(
     r"^stall:input@(?P<unit>step|epoch)(?P<at>\d+)"
@@ -86,6 +98,8 @@ class ChaosEvent:
         )
         if self.kind == "stall":
             return f"stall:input@{at}:{self.seconds:g}s"
+        if self.kind == "grow":
+            return f"grow:+1@{at}"
         return f"{self.kind}:rank{self.rank}@{at}"
 
 
@@ -106,6 +120,17 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
                 ChaosEvent(
                     kind=m.group("kind"),
                     rank=int(m.group("rank")),
+                    step=at if m.group("unit") == "step" else None,
+                    epoch=at if m.group("unit") == "epoch" else None,
+                )
+            )
+            continue
+        m = _GROW_RE.match(token)
+        if m:
+            at = int(m.group("at"))
+            events.append(
+                ChaosEvent(
+                    kind="grow",
                     step=at if m.group("unit") == "step" else None,
                     epoch=at if m.group("unit") == "epoch" else None,
                 )
@@ -135,6 +160,7 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
             f"bad chaos event {token!r}; grammar: "
             "kill:rank<R>@step<N>|epoch<N>, "
             "sigterm:rank<R>@step<N>|epoch<N>, "
+            "shrink:rank<R>@step<N>|epoch<N>, grow:+1@step<N>|epoch<N>, "
             "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest"
         )
     return tuple(events)
@@ -267,8 +293,11 @@ class ChaosEngine:
     # ---- trigger points ----------------------------------------------
 
     def _mine(self, ev: ChaosEvent) -> bool:
-        if ev.kind == "ckpt_corrupt":
-            return self.rank == 0  # one filesystem, one corruptor
+        if ev.kind in ("ckpt_corrupt", "grow"):
+            # one filesystem, one corruptor; one world, one grow
+            # requester (any single rank works — rank 0 is the
+            # convention every other singleton here uses)
+            return self.rank == 0
         return ev.rank is None or ev.rank == self.rank
 
     def _fire(self, ev: ChaosEvent, checkpoint_dir: str | None = None) -> None:
@@ -278,6 +307,19 @@ class ChaosEngine:
             os.kill(os.getpid(), signal.SIGKILL)
         elif ev.kind == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)
+        elif ev.kind in ("shrink", "grow"):
+            # The elastic resize contract rides the exit code
+            # (runtime/launch.py). os._exit, not sys.exit: a reclaimed
+            # host runs no cleanup — and a SystemExit would be caught
+            # by the trainer's post-mortem machinery as an exception.
+            from ddp_tpu.runtime.launch import (
+                GROW_EXIT_CODE,
+                SHRINK_EXIT_CODE,
+            )
+
+            os._exit(
+                SHRINK_EXIT_CODE if ev.kind == "shrink" else GROW_EXIT_CODE
+            )
         elif ev.kind == "stall":
             time.sleep(ev.seconds)
         elif ev.kind == "ckpt_corrupt" and checkpoint_dir:

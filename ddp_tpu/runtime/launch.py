@@ -24,6 +24,24 @@ exponential backoff. Recovery itself is the workers' job: the trainer
 auto-resumes from the latest checkpoint, and its ``goodput.json``
 sidecar counts the relaunch as a restart, so goodput accounting
 reflects the crash loop's true cost (obs/goodput.py).
+
+Elastic supervision (``elastic=True``): restart-with-resume assumes
+the world that restarts is the world that died; preemptible fleets
+don't. A rank that exits with ``SHRINK_EXIT_CODE`` declares itself
+PERMANENTLY gone (a reclaimed host), and instead of burning the
+restart budget re-spawning a rank that will never come back, the
+supervisor relaunches the next generation one worker smaller — down
+to ``min_world``. ``GROW_EXIT_CODE`` is the inverse signal (a lost
+host restored): the next generation is one worker larger, capped at
+the original ``nprocs``. Resize generations do NOT consume
+``max_restarts`` — they are accounted separately (``events_out``)
+and the workers' goodput sidecar attributes their downtime as
+*resize* downtime, distinct from restart downtime. Workers re-derive
+everything world-shaped on relaunch: the mesh from the live device
+count (runtime/mesh.live_world_spec), the per-process batch from the
+``elastic.json`` global-batch contract (data/sampler.py shard math),
+and sharded checkpoint state by resharding — or, for ZeRO's padded
+flat buckets, re-bucketing — on restore (train/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -45,6 +63,18 @@ logger = logging.getLogger("ddp_tpu")
 # (utils/watchdog.py) — a hang converted into a classifiable crash.
 WATCHDOG_EXIT_CODE = 124
 
+# Elastic resize contract between workers and the supervisor, carried
+# in the only channel a dead process has — its exit code. A rank
+# exiting SHRINK declares itself permanently lost (the supervisor
+# relaunches the world one smaller); GROW requests the inverse (one
+# larger, capped at the original size). runtime/chaos.py's
+# ``shrink:rankN@step``/``grow:+1@epoch`` faults exit with these, so
+# the whole scale-down/scale-up path is drillable like every other
+# recovery path. Chosen clear of the meaningful small codes (1
+# exception, 124 watchdog) and of 128+signal.
+SHRINK_EXIT_CODE = 86
+GROW_EXIT_CODE = 87
+
 
 def classify_exit(exitcode: int | None) -> str:
     """Human-readable failure class for a dead worker's exit code.
@@ -63,6 +93,10 @@ def classify_exit(exitcode: int | None) -> str:
         return f"killed by {name}"
     if exitcode == WATCHDOG_EXIT_CODE:
         return "watchdog timeout (hang converted to exit 124)"
+    if exitcode == SHRINK_EXIT_CODE:
+        return "permanently lost (elastic shrink, exit 86)"
+    if exitcode == GROW_EXIT_CODE:
+        return "scale-up requested (elastic grow, exit 87)"
     return f"exception (exit {exitcode})"
 
 
@@ -160,6 +194,9 @@ def spawn(
     grace: float = 15.0,
     max_restarts: int = 0,
     restart_backoff: float = 1.0,
+    elastic: bool = False,
+    min_world: int = 1,
+    events_out: list | None = None,
 ) -> int:
     """Run ``fn(rank, world_size, *args)`` in ``nprocs`` processes.
 
@@ -181,13 +218,39 @@ def spawn(
     state; the trainer's latest-checkpoint auto-resume makes the
     combination an automatic kill-and-recover loop. Returns the number
     of restarts consumed. The overall ``timeout`` is never restarted.
+
+    ``elastic=True`` adds world RESIZE on top: a generation whose only
+    failures are ``SHRINK_EXIT_CODE``/``GROW_EXIT_CODE`` exits is not a
+    crash — it is a topology change. The next generation launches with
+    ``world - shrinks + grows`` workers (never above ``nprocs``, never
+    below ``min_world`` — below raises), on a fresh coordinator port
+    after a single ``restart_backoff`` beat (no exponential growth: a
+    resize is not a crash loop). Resizes do NOT consume
+    ``max_restarts``; mixed generations (a shrink plus an unrelated
+    crash) count as a resize — the crash is usually the reaped
+    survivor's collateral. ``events_out`` (a caller-provided list)
+    receives one dict per restart/resize so tests and bench can audit
+    the world-size trajectory without parsing worker logs.
     """
     import inspect
 
+    if min_world < 1:
+        raise ValueError(f"min_world must be >= 1, got {min_world}")
+    if min_world > nprocs:
+        raise ValueError(
+            f"min_world {min_world} exceeds the launched world {nprocs}"
+        )
     src_file = os.path.abspath(inspect.getfile(fn))
     ctx = multiprocessing.get_context("spawn")
     deadline = None if timeout is None else time.monotonic() + timeout
     restarts = 0
+    resizes = 0
+    world = nprocs
+    # Runaway-resize backstop: chaos events fire once (their ledgers),
+    # but a worker that UNCONDITIONALLY exits SHRINK/GROW would
+    # otherwise bounce the supervisor forever without ever touching
+    # the restart budget.
+    max_resizes = 2 * nprocs + 8
     while True:
         # An explicit coordinator_port only pins generation 0: the
         # dead coordinator's socket may linger (TIME_WAIT) and a
@@ -195,7 +258,7 @@ def spawn(
         # the rendezvous instead of the workload.
         port = (
             coordinator_port
-            if coordinator_port and restarts == 0
+            if coordinator_port and restarts == 0 and resizes == 0
             else free_port()
         )
         procs = [
@@ -206,14 +269,14 @@ def spawn(
                     fn.__module__,
                     fn.__qualname__,
                     rank,
-                    nprocs,
+                    world,
                     port,
                     devices_per_process,
                     tuple(args),
                 ),
                 daemon=False,
             )
-            for rank in range(nprocs)
+            for rank in range(world)
         ]
         for p in procs:
             p.start()
@@ -233,8 +296,21 @@ def spawn(
                     grace_end = time.monotonic() + grace
                     for p in procs:
                         p.join(max(0.0, grace_end - time.monotonic()))
+                    # Re-collect AFTER the grace joins: a staggered
+                    # SHRINK/GROW exit landing during the window must
+                    # be classified (an elastic shrink misread as a
+                    # plain crash would burn the restart budget — or
+                    # fail the run — for a rank that is simply gone).
+                    # Still-alive survivors are reaped in the finally
+                    # below, AFTER this snapshot, so their kill codes
+                    # never pollute the classification.
+                    bad = {
+                        r: p.exitcode
+                        for r, p in enumerate(procs)
+                        if not p.is_alive() and p.exitcode != 0
+                    }
                     break
-                if len(exited) == nprocs:
+                if len(exited) == world:
                     return restarts
                 if deadline is not None and time.monotonic() > deadline:
                     alive = [r for r, p in enumerate(procs) if p.is_alive()]
@@ -248,27 +324,94 @@ def spawn(
         classified = {
             r: classify_exit(c) for r, c in sorted(bad.items())
         }
-        if restarts >= max_restarts:
-            raise RuntimeError(
-                f"worker failures (rank: exitcode): {bad} — "
-                + "; ".join(
+        shrinks = sorted(r for r, c in bad.items() if c == SHRINK_EXIT_CODE)
+        grows = sorted(r for r, c in bad.items() if c == GROW_EXIT_CODE)
+        resize = elastic and (shrinks or grows)
+        if resize:
+            new_world = min(nprocs, world - len(shrinks) + len(grows))
+            if new_world < min_world:
+                raise RuntimeError(
+                    f"elastic resize would shrink the world to "
+                    f"{new_world} (< min_world {min_world}): "
+                    + "; ".join(
+                        f"rank {r}: {why}"
+                        for r, why in classified.items()
+                    )
+                )
+            resizes += 1
+            if resizes > max_resizes:
+                raise RuntimeError(
+                    f"{resizes} elastic resizes without completing — a "
+                    "worker is exiting SHRINK/GROW unconditionally "
+                    f"(last: {bad})"
+                )
+            backoff = max(0.0, min(30.0, restart_backoff))
+            # A grow capped at the original size (or shrink+grow
+            # cancelling out) still reaped the world — the relaunch is
+            # mandatory — but topologically it is a SAME-SIZE restart:
+            # report it as one so the supervisor's event stream and the
+            # workers' goodput attribution (keyed on the world delta)
+            # agree about the boundary. It stays in the elastic branch
+            # (no restart budget: the workers asked for this exit).
+            logger.warning(
+                "launch: elastic %s %d -> %d workers (%s) — "
+                "relaunching in %.1fs",
+                "resize" if new_world != world else "grow capped",
+                world,
+                new_world,
+                "; ".join(
                     f"rank {r}: {why}" for r, why in classified.items()
-                )
-                + (
-                    f"; {restarts}/{max_restarts} restarts exhausted"
-                    if max_restarts
-                    else ""
-                )
+                ),
+                backoff,
             )
-        backoff = min(30.0, restart_backoff * (2.0 ** restarts))
-        restarts += 1
-        logger.warning(
-            "launch: generation failed (%s) — restart %d/%d in %.1fs",
-            "; ".join(f"rank {r}: {why}" for r, why in classified.items()),
-            restarts,
-            max_restarts,
-            backoff,
-        )
+            if events_out is not None:
+                events_out.append(
+                    {
+                        "kind": (
+                            "resize" if new_world != world else "restart"
+                        ),
+                        "old_world": world,
+                        "new_world": new_world,
+                        "shrunk_ranks": shrinks,
+                        "grew": len(grows),
+                        "time": time.time(),
+                    }
+                )
+            world = new_world
+        else:
+            if restarts >= max_restarts:
+                raise RuntimeError(
+                    f"worker failures (rank: exitcode): {bad} — "
+                    + "; ".join(
+                        f"rank {r}: {why}" for r, why in classified.items()
+                    )
+                    + (
+                        f"; {restarts}/{max_restarts} restarts exhausted"
+                        if max_restarts
+                        else ""
+                    )
+                )
+            backoff = min(30.0, restart_backoff * (2.0 ** restarts))
+            restarts += 1
+            logger.warning(
+                "launch: generation failed (%s) — restart %d/%d in %.1fs",
+                "; ".join(
+                    f"rank {r}: {why}" for r, why in classified.items()
+                ),
+                restarts,
+                max_restarts,
+                backoff,
+            )
+            if events_out is not None:
+                events_out.append(
+                    {
+                        "kind": "restart",
+                        "old_world": world,
+                        "new_world": world,
+                        "failures": dict(bad),
+                        "time": time.time(),
+                    }
+                )
         if deadline is not None and time.monotonic() + backoff > deadline:
             raise RuntimeError(
                 f"worker failures (rank: exitcode): {bad}; no budget "

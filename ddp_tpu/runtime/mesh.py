@@ -107,6 +107,47 @@ def make_mesh(
     return Mesh(np.asarray(devices).reshape(shape), AXIS_ORDER)
 
 
+def live_world_spec(
+    spec: MeshSpec | Mapping[str, int], num_devices: int
+) -> MeshSpec:
+    """Re-derive the data axis from the LIVE world (elastic restart).
+
+    An elastic relaunch (runtime/launch.py ``elastic=True``) brings up
+    however many workers survived — the mesh cannot be the config's
+    mesh, it must be *this* world's. The fixed (non-data) axes are the
+    model's sharding contract and survive the resize unchanged; the
+    data axis absorbs whatever device count is actually present. Raises
+    with the resize named when the fixed axes no longer tile the shrunk
+    world (e.g. ``--mesh_model 4`` after dropping to 2 devices) — that
+    topology genuinely cannot run, and the supervisor's ``min_world``
+    is the knob that prevents reaching it.
+    """
+    if isinstance(spec, Mapping):
+        spec = MeshSpec(**dict(spec))
+    fixed = {
+        a: getattr(spec, a) for a in AXIS_ORDER if a != "data"
+    }
+    bad = {a: s for a, s in fixed.items() if s < 1}
+    if bad:
+        raise ValueError(
+            f"elastic resize: fixed mesh axes must be explicit (>= 1), "
+            f"got {bad} — only the data axis may be world-derived"
+        )
+    # One owner for the tiling arithmetic: resolve() already absorbs
+    # the -1 axis and rejects indivisible device counts — this wrapper
+    # only adds the resize framing to the failure.
+    try:
+        sizes = dataclasses.replace(spec, data=-1).resolve(num_devices)
+    except ValueError as e:
+        raise ValueError(
+            f"elastic resize: {num_devices} live device(s) cannot carry "
+            f"the fixed mesh axes {fixed}; the data axis must absorb an "
+            "integer multiple — scale --min_world (or the fixed axes) "
+            f"so every reachable world tiles ({e})"
+        ) from e
+    return dataclasses.replace(spec, data=sizes["data"])
+
+
 def data_axes(mesh) -> tuple[str, ...]:
     """Axes over which the batch is sharded and grads are averaged.
 

@@ -291,6 +291,57 @@ def derive_spec_with_sidecar(
     )
 
 
+# --- elastic world-resize contract ------------------------------------
+#
+# The one fact an elastic relaunch cannot re-derive from its own flags:
+# the ORIGINAL global batch size. Config flags are per-shard
+# (``--batch_size`` × live shards), so a shrunk world would silently
+# halve the global batch — changing what a step means, desynchronizing
+# the checkpointed step counter from the LR schedule and the
+# steps-per-epoch the mid-epoch resume markers were written under. The
+# first generation records the contract once; every later generation
+# rescales its per-shard batch to honor it
+# (data/sampler.rescale_per_shard_batch). Write-once on purpose: the
+# contract is the run's invariant, not the latest generation's shape.
+
+ELASTIC_FILENAME = "elastic.json"
+
+
+def save_elastic_contract(
+    directory: str, *, global_batch_size: int, world_size: int
+) -> str | None:
+    """Record the run's global-batch contract (first generation only —
+    an existing contract is never overwritten). Returns the path, or
+    None when a contract already existed."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, ELASTIC_FILENAME)
+    if os.path.exists(path):
+        return None
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "global_batch_size": int(global_batch_size),
+                "world_size": int(world_size),
+            },
+            f,
+        )
+    os.replace(tmp, path)
+    return path
+
+
+def load_elastic_contract(directory: str) -> dict:
+    """The recorded contract, or {} (first generation / non-elastic
+    run / unreadable sidecar — all mean "no rescale to honor")."""
+    path = os.path.join(directory, ELASTIC_FILENAME)
+    try:
+        with open(path) as f:
+            contract = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return contract if isinstance(contract, dict) else {}
+
+
 class CheckpointManager:
     """Per-epoch checkpoints with latest-epoch auto-resume.
 
@@ -640,7 +691,13 @@ class CheckpointManager:
             if s not in keep:
                 self._delete_epoch(s)
 
-    def restore(self, state_like: TrainState, epoch: int | None = None) -> tuple[TrainState, int]:
+    def restore(
+        self,
+        state_like: TrainState,
+        epoch: int | None = None,
+        *,
+        opt_reshape=None,
+    ) -> tuple[TrainState, int]:
         """Restore → (state, epoch). ``state_like`` supplies the tree
         structure/shardings (its values are discarded).
 
@@ -650,6 +707,18 @@ class CheckpointManager:
         epoch that fails verification raises instead — the caller
         named that state on purpose; silently substituting another
         would be worse than failing.
+
+        ``opt_reshape`` makes the restore world-shape-agnostic for
+        optimizer states whose GLOBAL shapes depend on the world size
+        (the zero strategy's padded flat buckets,
+        parallel/zero.ZeroElasticReshaper). Protocol: ``plan(meta)``
+        receives the checkpoint's opt_state shape metadata and returns
+        either None (shapes match the live template — the ordinary
+        templated restore runs, resharding on load) or an abstract
+        tree in the SAVED shapes; ``apply(restored)`` then converts the
+        old-world values into the live layout. Params/step/model_state
+        always restore templated on the live shardings — that half is
+        reshard-on-load by construction (tests/test_elastic_shard.py).
         """
         if epoch is None:
             epoch = self.latest_intact_epoch()
@@ -668,6 +737,25 @@ class CheckpointManager:
         abstract["spe"] = jax.ShapeDtypeStruct((), np.int32)
         abstract["mid_batch"] = jax.ShapeDtypeStruct((), np.int32)
         abstract["fmt"] = jax.ShapeDtypeStruct((), np.int32)
+        reshape_apply = None
+        if opt_reshape is not None:
+            try:
+                meta_opt = dict(self._mgr.item_metadata(epoch)).get(
+                    "opt_state"
+                )
+            except (OSError, ValueError, KeyError, TypeError):
+                meta_opt = None  # legacy/partial checkpoint: restore as-is
+            if meta_opt is not None:
+                override = opt_reshape.plan(meta_opt)
+                if override is not None:
+                    abstract["opt_state"] = override
+                    reshape_apply = opt_reshape.apply
+                    logger.warning(
+                        "Checkpoint epoch %d holds optimizer state "
+                        "bucketed for a different world size — "
+                        "re-bucketing on restore (elastic resize)",
+                        epoch,
+                    )
         # Migration ladder: older checkpoints lack "fmt" (and before
         # that "mid_batch", "spe", "model_state"); retry dropping the
         # optional keys oldest-format-last.
@@ -690,6 +778,8 @@ class CheckpointManager:
             except (ValueError, KeyError):
                 if drop == ladder[-1]:
                     raise
+        if reshape_apply is not None and "opt_state" in restored:
+            restored["opt_state"] = reshape_apply(restored["opt_state"])
         restored.setdefault("model_state", state_like.model_state)
         fmt = int(restored.pop("fmt", 1))
         _check_qkv_format(
@@ -801,12 +891,14 @@ class CheckpointManager:
         return restored["params"], restored.get("model_state", {}), epoch
 
     def restore_or_init(
-        self, state: TrainState
+        self, state: TrainState, *, opt_reshape=None
     ) -> tuple[TrainState, int]:
         """The auto-resume entry: (state, start_epoch).
 
         Mirrors train_ddp.py:49-89's flag dance — resume from latest
         epoch + 1 when a checkpoint exists, else epoch 0 fresh.
+        ``opt_reshape`` passes through to ``restore`` (the elastic
+        world-resize hook).
         """
         # Single-process only: multi-process ranks may reach this
         # pre-check at different times relative to process 0's
@@ -820,7 +912,9 @@ class CheckpointManager:
             return state, 0
         try:
             # epoch=None → verified discovery with quarantine fallback.
-            restored, epoch = self.restore(state, None)
+            restored, epoch = self.restore(
+                state, None, opt_reshape=opt_reshape
+            )
         except FileNotFoundError:
             # Nothing to restore — either the directory is empty, or
             # EVERY checkpoint failed verification and was quarantined

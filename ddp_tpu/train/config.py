@@ -227,6 +227,20 @@ class TrainConfig:
     # Base seconds for the launcher's exponential restart backoff
     # (backoff = restart_backoff * 2^i, capped at 30 s).
     restart_backoff: float = 1.0
+    # Elastic world resize (docs/ROBUSTNESS.md "Elastic world resize").
+    # Supervisor side (--spawn): a rank that exits with the SHRINK code
+    # is permanently gone — relaunch the world one smaller (down to
+    # --min_world) instead of failing; GROW relaunches one larger.
+    # Worker side (any launch mode): re-derive the mesh from the LIVE
+    # device count, preserve the recorded global batch by rescaling the
+    # per-shard batch (elastic.json contract), and restore checkpoints
+    # world-shape-agnostically (reshard on load; zero re-buckets).
+    # Pipeline models are excluded for now (stage placement is
+    # per-device; MPMD is its own roadmap item).
+    elastic: bool = False
+    # Smallest world an elastic supervisor may shrink to; shrinking
+    # below raises instead of silently degrading further.
+    min_world: int = 1
 
     # Multi-process / multi-host (reference: spawn at train_ddp.py:222-224
     # + env:// rendezvous at utils.py:7-11)
@@ -421,6 +435,20 @@ class TrainConfig:
             "--restart_backoff", type=float,
             default=cls.restart_backoff,
             help="base seconds for the exponential restart backoff",
+        )
+        p.add_argument(
+            "--elastic", action="store_true",
+            help="survive world RESIZE, not just restart: with --spawn "
+            "the supervisor relaunches with however many workers "
+            "remain (scale-down) or are restored (scale-up); workers "
+            "re-derive the mesh from the live world, preserve the "
+            "recorded global batch, and reshard/re-bucket checkpoints "
+            "on restore (docs/ROBUSTNESS.md)",
+        )
+        p.add_argument(
+            "--min_world", type=int, default=cls.min_world,
+            help="with --elastic: smallest world the supervisor may "
+            "shrink to (shrinking below fails the run)",
         )
         # Discovery: print the registries and exit (handled in train.py
         # before config construction).

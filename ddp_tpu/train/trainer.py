@@ -497,25 +497,71 @@ class Trainer:
             # (experts shard over --mesh_expert — EP owns the MoE
             # sharding story).
             _check_tp_dims(config)
-        self.mesh = make_mesh(
-            MeshSpec(
-                data=-1,
-                pipe=config.mesh_pipe,
-                model=config.mesh_model,
-                fsdp=config.mesh_fsdp,
-                expert=config.mesh_expert,
-                seq=config.mesh_seq,
-            ),
-            devices=devices,
+        mesh_spec = MeshSpec(
+            data=-1,
+            pipe=config.mesh_pipe,
+            model=config.mesh_model,
+            fsdp=config.mesh_fsdp,
+            expert=config.mesh_expert,
+            seq=config.mesh_seq,
         )
+        if config.elastic:
+            # Elastic world resize (docs/ROBUSTNESS.md): this process
+            # may be a relaunch of a differently-sized world. The mesh
+            # is re-derived from the LIVE device count (the fixed axes
+            # are the sharding contract and must still tile it), and
+            # the per-shard batch below absorbs the change so the
+            # recorded global batch — what a step MEANS — survives.
+            if self.pipe_mode:
+                raise ValueError(
+                    "--elastic excludes the pipeline family for now: "
+                    "stage params rest per-device, so a resize would "
+                    "need stage re-placement, not a reshard (the MPMD "
+                    "runtime is the upgrade path) — drop --elastic or "
+                    "use a non-pipe model"
+                )
+            from ddp_tpu.runtime.mesh import live_world_spec
+
+            mesh_spec = live_world_spec(mesh_spec, len(devices))
+        self.mesh = make_mesh(mesh_spec, devices=devices)
         self.data_shards = int(
             np.prod([self.mesh.shape[a] for a in data_axes(self.mesh)])
         )
         # With accumulation the loader delivers k microbatches' worth at
         # once; the step splits them and applies one update.
+        self.per_shard_batch = config.batch_size
         self.global_batch_size = (
-            config.batch_size * self.data_shards * config.grad_accum_steps
+            self.per_shard_batch * self.data_shards * config.grad_accum_steps
         )
+        if config.elastic:
+            # Honor the run's recorded global-batch contract: flags are
+            # per-shard, so at a resized world the natural product above
+            # would change the global batch — and with it the meaning
+            # of the checkpointed step counter, the LR schedule, and
+            # the mid-epoch resume markers. The sampler's shard math
+            # makes the rescale exact (same sample windows per step at
+            # any divisor world — data/sampler.py).
+            from ddp_tpu.data.sampler import rescale_per_shard_batch
+            from ddp_tpu.train.checkpoint import load_elastic_contract
+
+            contract = load_elastic_contract(config.checkpoint_dir)
+            recorded = int(contract.get("global_batch_size") or 0)
+            if recorded and recorded != self.global_batch_size:
+                self.per_shard_batch = rescale_per_shard_batch(
+                    recorded,
+                    self.data_shards,
+                    grad_accum_steps=config.grad_accum_steps,
+                )
+                logger.warning(
+                    "Elastic resize: preserving recorded global batch "
+                    "%d over %d data shard(s) — per-shard batch %d -> "
+                    "%d",
+                    recorded,
+                    self.data_shards,
+                    config.batch_size,
+                    self.per_shard_batch,
+                )
+                self.global_batch_size = recorded
 
         from ddp_tpu.data.registry import NUM_CLASSES
         from ddp_tpu.train.optim import make_optimizer
@@ -1251,6 +1297,18 @@ class Trainer:
                 "without --max_checkpoints it would keep everything — "
                 "set --max_checkpoints N (or drop --keep_best)"
             )
+        # World-shape-agnostic restore hook: the zero strategy's flat
+        # bucket shapes are world-dependent (padded to the replica
+        # count), so an elastic resize must RE-BUCKET them on restore —
+        # everything else reshards by Orbax templating. None for every
+        # other strategy (restore behaves exactly as before).
+        self._opt_reshape = None
+        if self.zero_mode:
+            from ddp_tpu.parallel.zero import ZeroElasticReshaper
+
+            self._opt_reshape = ZeroElasticReshaper(
+                self.optimizer, self._zero_layout, self.mesh
+            )
         self.ckpt = CheckpointManager(
             config.checkpoint_dir,
             max_to_keep=config.max_checkpoints,
@@ -1718,12 +1776,15 @@ class Trainer:
         def do_restore(state):
             if self.config.resume_epoch is not None:
                 restored, epoch = self.ckpt.restore(
-                    state, self.config.resume_epoch
+                    state, self.config.resume_epoch,
+                    opt_reshape=self._opt_reshape,
                 )
                 prune_rewound_branch(epoch)
                 logger.info("Resumed from requested epoch %d", epoch)
                 return restored, epoch + 1
-            return self.ckpt.restore_or_init(state)
+            return self.ckpt.restore_or_init(
+                state, opt_reshape=self._opt_reshape
+            )
 
         if self.config.reset_opt_state:
             # Weights only; the optimizer (schedules, moments, step
@@ -1847,6 +1908,17 @@ class Trainer:
             from ddp_tpu.train.checkpoint import save_lm_spec
 
             save_lm_spec(cfg.checkpoint_dir, self.seq_spec)
+        if cfg.elastic and self.ctx.is_main:
+            # Record the run's global-batch contract ONCE (first
+            # generation); relaunched generations read it in __init__
+            # and rescale their per-shard batch to honor it.
+            from ddp_tpu.train.checkpoint import save_elastic_contract
+
+            save_elastic_contract(
+                cfg.checkpoint_dir,
+                global_batch_size=self.global_batch_size,
+                world_size=self.ctx.num_processes,
+            )
         # Process-start chaos (ckpt_corrupt) fires BEFORE discovery so
         # the integrity/quarantine fallback below is what it drills.
         self._chaos.on_start(cfg.checkpoint_dir)
@@ -1873,8 +1945,20 @@ class Trainer:
             )
         # Restart-aware goodput: the sidecar (if any) carries the
         # first launch's clock and prior productive seconds, so a
-        # preempt/resume cycle accumulates instead of resetting.
-        self._goodput.start_run()
+        # preempt/resume cycle accumulates instead of resetting — and
+        # the live world size, so a relaunch whose world CHANGED is
+        # attributed as resize downtime, not restart downtime. The
+        # "world" here is the DATA-PARALLEL world (device shards, not
+        # process count): it is what the shard math, the zero bucket
+        # layout and the batch rescale actually key on, and it moves
+        # for both resize kinds — lost hosts (spawn workers) and lost
+        # local devices (--emulate_devices drills).
+        self._goodput.start_run(world_size=self.data_shards)
+        # Durable immediately: a generation killed before its first
+        # epoch boundary must still leave its world size (and launch
+        # clock) on disk, or the NEXT generation's restart/resize
+        # downtime attribution would skip a boundary.
+        self._goodput.flush()
         # Flight-recorder context: what a post-mortem needs but no
         # step record carries — config, env, mesh, rank.
         self._recorder.set_context(
@@ -1884,9 +1968,30 @@ class Trainer:
             rank=self.ctx.process_id,
             num_processes=self.ctx.num_processes,
         )
+        # Old-world → new-world transition, from the goodput sidecar's
+        # recorded world (None on the first generation). Rides both the
+        # flight recorder AND the metrics stream: the run_start metrics
+        # record is the triage anchor (scripts/health_report.py world
+        # trajectory; the elastic drill pins). Written from straight-
+        # line code exactly once per train() call — one generation, one
+        # record carrying the restart count (pinned by test_metrics and
+        # the elastic drills).
+        world_fields = {
+            "world_size": self.ctx.num_processes,
+            "data_shards": self.data_shards,
+        }
+        if self._goodput.prev_world is not None:
+            world_fields["prev_data_shards"] = self._goodput.prev_world
         self._recorder.record(
             "run_start", start_epoch=start_epoch,
+            restarts=self._goodput.restarts, **world_fields,
+        )
+        self.metrics_writer.write(
+            "run_start",
+            start_epoch=start_epoch,
             restarts=self._goodput.restarts,
+            global_batch_size=self.global_batch_size,
+            **world_fields,
         )
         # Mid-epoch preemption saves are tagged with their (incomplete)
         # epoch and record how many batches ran as an explicit
@@ -2505,8 +2610,9 @@ class Trainer:
         images, labels = self.test_split
         # Accumulation exists to keep the per-forward footprint at
         # batch_size×shards — eval must not undo that by running one
-        # k×-sized forward.
-        bs = self.config.batch_size * self.data_shards
+        # k×-sized forward. per_shard_batch (not config.batch_size):
+        # an elastic resize rescaled it to preserve the global batch.
+        bs = self.per_shard_batch * self.data_shards
         n = len(images)
         if n == 0:
             return float("nan"), float("nan")

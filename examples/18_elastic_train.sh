@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Elastic world resize (docs/ROBUSTNESS.md "Elastic world resize"):
+# survive scale-DOWN and scale-UP restarts, not just same-size ones.
+# A permanently lost rank (a reclaimed preemptible host) shrinks the
+# next generation instead of failing the run; checkpoints restore
+# world-shape-agnostically and the per-shard batch rescales so the
+# global batch — what a step MEANS — is preserved across the resize.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example18}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+# 1. Scale-down drill: rank 1 is PERMANENTLY lost mid-epoch-1 (the
+#    shrink chaos fault exits with the launcher's SHRINK code). The
+#    elastic supervisor reaps the world and relaunches it at world 1
+#    — without burning the restart budget — and the survivor resumes
+#    from the epoch-0 checkpoint with its per-shard batch doubled so
+#    the global batch (and steps-per-epoch) are unchanged.
+python train.py --spawn 2 --elastic --min_world 1 \
+    --epochs 2 --batch_size 4 \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics.jsonl" \
+    --log_interval 4 --eval_every 0 \
+    --chaos "shrink:rank1@step12" --restart_backoff 0.5
+
+# goodput.json attributes the resize downtime SEPARATELY from restart
+# downtime, and each generation's run_start record carries the
+# old-world -> new-world transition.
+python - <<PY
+import json
+side = json.load(open("$WORK/ck/goodput.json"))
+print("resizes:", side["resizes"],
+      " resize_downtime_s:", round(side["resize_downtime_s"], 2),
+      " restart_downtime_s:", round(side["restart_downtime_s"], 2))
+assert side["resizes"] == 1 and side["resize_downtime_s"] > 0
+starts = [json.loads(l) for l in open("$WORK/metrics.jsonl")
+          if '"run_start"' in l]
+print("world trajectory:", [s["data_shards"] for s in starts])
+assert [s["data_shards"] for s in starts] == [2, 1]
+PY
+
+# 2. The same drill survives ZeRO (--parallel zero): the flat
+#    optimizer buckets are padded to the replica count, so the world-2
+#    checkpoint literally has different shapes than world 1's layout —
+#    restore RE-BUCKETS them (strip old padding, re-pad, place 1/N)
+#    bit-identically to a fresh shard of the merged state.
+python train.py --spawn 2 --elastic --min_world 1 \
+    --epochs 2 --batch_size 4 --parallel zero --optimizer adam \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck_zero" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics_zero.jsonl" \
+    --log_interval 4 --eval_every 0 \
+    --chaos "shrink:rank1@step12" --restart_backoff 0.5
+
+# 3. Scale-UP drill, single-process spelling: train on 2 emulated
+#    devices, then resume the same run on 1 (the device-count analogue
+#    of losing a host — same reshard/rescale machinery, no spawn).
+python train.py --elastic --epochs 1 --batch_size 4 \
+    --emulate_devices 2 \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck_dev" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics_dev.jsonl" --eval_every 0
+python train.py --elastic --epochs 2 --batch_size 4 \
+    --emulate_devices 1 \
+    --synthetic_data --synthetic_size 64 \
+    --checkpoint_dir "$WORK/ck_dev" --data_root "$WORK/data" \
+    --metrics_file "$WORK/metrics_dev.jsonl" --eval_every 0
+
+# 4. The triage line: generations, world trajectory, downtime split.
+python scripts/health_report.py "$WORK/metrics.jsonl"

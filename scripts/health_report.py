@@ -159,6 +159,41 @@ def build_report(records: list[dict]) -> str:
                 f"epoch {_fmt(fb.get('resumed_epoch'))}"
             )
 
+    # Elastic triage (world resize): one run_start record per
+    # generation carries the live world; the trajectory plus the
+    # goodput sidecar's downtime split answers "did we shrink, and
+    # what did the reshapes cost vs the plain crashes". Absent on
+    # pre-elastic streams (no run_start records), so their reports
+    # stay byte-identical.
+    run_starts = [r for r in records if r.get("kind") == "run_start"]
+    if run_starts:
+        worlds = [
+            r.get("data_shards") or r.get("world_size")
+            for r in run_starts
+        ]
+        worlds = [int(w) for w in worlds if w]
+        traj = " -> ".join(str(w) for w in worlds) if worlds else "?"
+        n_resize = sum(
+            1 for a, b in zip(worlds, worlds[1:]) if a != b
+        )
+        line = (
+            f"elastic       : {len(run_starts)} generation(s), "
+            f"world {traj}"
+        )
+        if n_resize:
+            line += f" ({n_resize} resize(s))"
+        if isinstance(final_gp, dict) and (
+            "resize_downtime_s" in final_gp
+            or "restart_downtime_s" in final_gp
+        ):
+            line += (
+                f"; downtime resize "
+                f"{_fmt(final_gp.get('resize_downtime_s', 0.0), 1)}s / "
+                f"restart "
+                f"{_fmt(final_gp.get('restart_downtime_s', 0.0), 1)}s"
+            )
+        lines.append(line)
+
     recompiles = sum(e.get("recompiles", 0) for e in epochs)
     if any("recompiles" in e for e in epochs):
         lines.append(f"recompiles    : {recompiles}")

@@ -1,0 +1,711 @@
+"""Elastic world resize (ISSUE 8): survive scale-down/scale-up
+restarts with reshard-on-load checkpoints.
+
+Acceptance pins:
+
+1. **Shrink drill e2e** — a 2-process gloo spawn with
+   ``shrink:rank1@step12`` under ``elastic=True, min_world=1``
+   completes training at world 1; final metrics match an uninjected
+   run at the surviving world size (same preserved global batch);
+   ``goodput.json`` attributes the resize downtime separately from
+   restart downtime (slow tier — real spawned worlds). Same drill
+   green for ``--parallel zero`` (flat buckets re-bucket on restore).
+2. **ZeRO elastic restore unit pin** — a zero checkpoint saved at
+   world 2 re-buckets and restores at world 1 bit-identically to a
+   fresh shard of the merged state (and the reverse, with zero pad);
+   zero1 moments saved data=2 restore data=1 (template resharding).
+3. **Exactly one run_start metrics record per generation** carries the
+   restart count and the old/new world sizes.
+4. The shard math preserves the global batch exactly: one step's
+   sample window is identical at any divisor world size.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddp_tpu.runtime.chaos import ChaosEvent, format_chaos, parse_chaos
+from ddp_tpu.runtime.launch import (
+    GROW_EXIT_CODE,
+    SHRINK_EXIT_CODE,
+    classify_exit,
+    spawn,
+)
+from ddp_tpu.runtime.mesh import MeshSpec, live_world_spec, make_mesh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- chaos grammar ---------------------------------------------------
+
+
+def test_shrink_grow_grammar_roundtrip():
+    spec = "shrink:rank1@step12,grow:+1@epoch2,shrink:rank0@epoch1"
+    ev = parse_chaos(spec)
+    assert [e.kind for e in ev] == ["shrink", "grow", "shrink"]
+    assert ev[0] == ChaosEvent(kind="shrink", rank=1, step=12)
+    assert ev[1] == ChaosEvent(kind="grow", epoch=2)
+    assert format_chaos(ev) == spec
+    for bad in (
+        "shrink:rank1",     # no trigger point
+        "shrink@step3",     # no rank
+        "grow:+2@epoch1",   # only +1 exists
+        "grow:rank1@step3",  # grow takes no rank
+    ):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+
+
+def test_classify_exit_elastic_codes():
+    assert "shrink" in classify_exit(SHRINK_EXIT_CODE)
+    assert "grow" in classify_exit(GROW_EXIT_CODE)
+
+
+# ---- mesh re-derivation ----------------------------------------------
+
+
+def test_live_world_spec_rederives_data_axis():
+    spec = live_world_spec(MeshSpec(), 3)
+    assert spec.data == 3
+    spec = live_world_spec(MeshSpec(model=2), 6)
+    assert spec.data == 3 and spec.model == 2
+    # mapping form works too (the MeshSpec(**dict) path)
+    spec = live_world_spec({"model": 2}, 4)
+    assert spec.data == 2
+    with pytest.raises(ValueError, match="elastic resize"):
+        live_world_spec(MeshSpec(model=4), 2)  # fixed axes don't fit
+    with pytest.raises(ValueError, match="elastic resize"):
+        live_world_spec(MeshSpec(model=2), 3)  # indivisible
+    with pytest.raises(ValueError, match="data axis may be"):
+        live_world_spec(MeshSpec(model=-1), 4)  # only data is derived
+
+
+# ---- shard math: global batch preserved exactly ----------------------
+
+
+def test_rescale_per_shard_batch_math():
+    from ddp_tpu.data.sampler import rescale_per_shard_batch
+
+    assert rescale_per_shard_batch(8, 1) == 8
+    assert rescale_per_shard_batch(8, 2) == 4
+    assert rescale_per_shard_batch(8, 2, grad_accum_steps=2) == 2
+    with pytest.raises(ValueError, match="global batch 8"):
+        rescale_per_shard_batch(8, 3)
+    with pytest.raises(ValueError, match="global batch"):
+        rescale_per_shard_batch(2, 2, grad_accum_steps=2)  # < 1/shard
+
+
+def test_step_sample_windows_identical_across_worlds():
+    """The claim the batch rescale rests on: shard r of N takes
+    ``indices[r::N]``, so one step's union of per-shard slices is the
+    SAME window of the global permutation at any divisor world."""
+    from ddp_tpu.data.sampler import ShardSampler
+
+    n, G = 64, 8
+    for epoch in (0, 1, 5):
+        one = ShardSampler(
+            num_examples=n, num_shards=1, shard_id=0, seed=3
+        ).shard_indices(epoch)
+        for world in (2, 4):
+            b = G // world
+            shards = [
+                ShardSampler(
+                    num_examples=n, num_shards=world, shard_id=r, seed=3
+                ).shard_indices(epoch)
+                for r in range(world)
+            ]
+            for k in range(n // G):
+                window = set(one[k * G : (k + 1) * G].tolist())
+                union = set()
+                for s in shards:
+                    union |= set(s[k * b : (k + 1) * b].tolist())
+                assert union == window
+
+
+# ---- goodput: resize vs restart downtime attribution -----------------
+
+
+def test_goodput_resize_vs_restart_attribution(tmp_path):
+    from ddp_tpu.obs.goodput import GoodputAccountant
+
+    path = str(tmp_path / "goodput.json")
+    t = {"now": 1000.0}
+
+    def clock():
+        return t["now"]
+
+    a = GoodputAccountant(path, clock=clock)
+    a.start_run(world_size=2)
+    assert a.restarts == 0 and a.prev_world is None
+    a.add_productive(5.0)
+    t["now"] = 1010.0
+    a.flush()
+
+    # same-world relaunch 3 s later → restart downtime
+    t["now"] = 1013.0
+    b = GoodputAccountant(path, clock=clock)
+    b.start_run(world_size=2)
+    assert b.restarts == 1 and b.resizes == 0 and b.prev_world == 2
+    assert b.restart_downtime_s == pytest.approx(3.0)
+    t["now"] = 1014.0
+    b.flush()
+
+    # RESIZED relaunch 6 s later → resize downtime, separately
+    t["now"] = 1020.0
+    c = GoodputAccountant(path, clock=clock)
+    c.start_run(world_size=1)
+    assert c.restarts == 2 and c.resizes == 1 and c.prev_world == 2
+    assert c.resize_downtime_s == pytest.approx(6.0)
+    assert c.restart_downtime_s == pytest.approx(3.0)
+    snap = c.snapshot()
+    assert snap["resizes"] == 1
+    assert snap["resize_downtime_s"] == pytest.approx(6.0)
+    c.flush()
+    side = json.loads((tmp_path / "goodput.json").read_text())
+    assert side["world_size"] == 1 and side["resizes"] == 1
+
+
+def test_goodput_legacy_sidecar_still_loads(tmp_path):
+    """Pre-elastic sidecars (no world/flush fields) resume without
+    inventing downtime."""
+    from ddp_tpu.obs.goodput import GoodputAccountant
+
+    path = tmp_path / "goodput.json"
+    path.write_text(
+        json.dumps(
+            {"first_launch_unix": 100.0, "productive_s": 7.0, "restarts": 2}
+        )
+    )
+    a = GoodputAccountant(str(path))
+    a.start_run(world_size=4)
+    assert a.restarts == 3 and a.prev_world is None
+    assert a.restart_downtime_s == 0.0 and a.resize_downtime_s == 0.0
+
+
+# ---- elastic contract sidecar ----------------------------------------
+
+
+def test_elastic_contract_write_once(tmp_path):
+    from ddp_tpu.train.checkpoint import (
+        load_elastic_contract,
+        save_elastic_contract,
+    )
+
+    d = str(tmp_path / "ck")
+    assert load_elastic_contract(d) == {}
+    p = save_elastic_contract(d, global_batch_size=8, world_size=2)
+    assert p is not None
+    assert load_elastic_contract(d)["global_batch_size"] == 8
+    # write-once: a later (resized) generation must not overwrite the
+    # run's contract
+    assert save_elastic_contract(d, global_batch_size=4, world_size=1) is None
+    assert load_elastic_contract(d)["global_batch_size"] == 8
+    assert load_elastic_contract(d)["world_size"] == 2
+
+
+# ---- supervisor validation (no processes spawned) --------------------
+
+
+def test_spawn_validates_min_world():
+    def worker(rank, world):  # pragma: no cover — never launched
+        pass
+
+    with pytest.raises(ValueError, match="min_world"):
+        spawn(worker, 2, min_world=0)
+    with pytest.raises(ValueError, match="min_world"):
+        spawn(worker, 2, min_world=3)
+
+
+def test_cli_elastic_guards(tmp_path):
+    sys.path.insert(0, REPO)
+    import train as train_cli
+
+    with pytest.raises(ValueError, match="min_world"):
+        train_cli.main(["--min_world", "2"])
+    with pytest.raises(ValueError, match="min_world"):
+        train_cli.main(
+            ["--spawn", "2", "--elastic", "--min_world", "3"]
+        )
+
+
+def test_trainer_elastic_rejects_pipe(tmp_path):
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    with pytest.raises(ValueError, match="elastic"):
+        Trainer(
+            TrainConfig(
+                model="pipe_vit", mesh_pipe=2, elastic=True,
+                epochs=1, batch_size=8,
+                checkpoint_dir=str(tmp_path / "ck"),
+                data_root=str(tmp_path / "data"),
+                synthetic_data=True, synthetic_size=64,
+            )
+        )
+
+
+# ---- ZeRO elastic restore: re-bucket on world change -----------------
+
+
+def _odd_params():
+    """Leaves totalling 17 elements: padded is 18 at world 2 but 17 at
+    world 1 — the shape mismatch resharding cannot bridge."""
+    import jax.numpy as jnp
+
+    return {
+        "a": jnp.arange(7, dtype=jnp.float32),
+        "b": jnp.arange(10, dtype=jnp.float32) * 0.5,
+    }
+
+
+def _zero_fixture(mesh, world, params, tx, *, moment_bias=0.0):
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ddp_tpu.parallel.zero import build_layout, create_zero_opt_state
+
+    rep = NamedSharding(mesh, P())
+    p = jax.tree.map(lambda x: jax.device_put(x, rep), params)
+    layout = build_layout(params, world, bucket_mb=4.0)
+    opt = create_zero_opt_state(p, tx, mesh, layout)
+    if moment_bias:
+        opt = jax.tree.map(
+            lambda x: x + moment_bias if getattr(x, "ndim", 0) else x,
+            opt,
+        )
+    return p, layout, opt
+
+
+def test_zero_rebucket_world2_to_world1_bit_identical(tmp_path, devices):
+    """The satellite pin: a zero checkpoint saved at world 2 re-buckets
+    and restores at world 1 bit-identically to a fresh shard of the
+    merged state (values untouched, old pad stripped)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.parallel.zero import ZeroElasticReshaper
+    from ddp_tpu.train.checkpoint import CheckpointManager
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = _odd_params()
+    tx = optax.adam(1e-3)
+    mesh2 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    mesh1 = make_mesh(MeshSpec(data=1), devices=devices[:1])
+    p2, lay2, opt2 = _zero_fixture(mesh2, 2, params, tx, moment_bias=0.25)
+    assert [b.padded for b in lay2.buckets] == [18]
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(0, TrainState(jnp.zeros((), jnp.int32), p2, opt2, {}))
+    mgr.wait()
+
+    p1, lay1, opt1 = _zero_fixture(mesh1, 1, params, tx)
+    assert [b.padded for b in lay1.buckets] == [17]
+    rep1 = NamedSharding(mesh1, P())
+    tpl = TrainState(
+        jax.device_put(jnp.zeros((), jnp.int32), rep1), p1, opt1, {}
+    )
+    restored, epoch = mgr.restore(
+        tpl, opt_reshape=ZeroElasticReshaper(tx, lay1, mesh1)
+    )
+    mgr.close()
+    assert epoch == 0
+
+    def leaves(t):
+        return jax.tree_util.tree_flatten_with_path(t)[0]
+
+    tot = lay1.buckets[0].total
+    for (_, got), (_, want) in zip(
+        leaves(restored.opt_state), leaves(opt2)
+    ):
+        got, want = np.asarray(got), np.asarray(want)
+        if got.ndim:
+            assert got.shape == (17,)
+            np.testing.assert_array_equal(got[:tot], want[:tot])
+        else:
+            np.testing.assert_array_equal(got, want)
+    # restored flats actually rest sharded over the live data axis
+    flat = next(
+        l for _, l in leaves(restored.opt_state) if getattr(l, "ndim", 0)
+    )
+    from jax.sharding import PartitionSpec
+
+    assert flat.sharding.spec == PartitionSpec("data")
+    # ... and the params resharded onto the 1-device mesh by templating
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert set(leaf.sharding.device_set) <= set(devices[:1])
+
+
+def test_zero_rebucket_world1_to_world2_pads_zeros(tmp_path, devices):
+    """Scale-UP: the re-pad region is zeros (zero grads → zero moments
+    — the Bucket contract the update math relies on)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.parallel.zero import ZeroElasticReshaper
+    from ddp_tpu.train.checkpoint import CheckpointManager
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = _odd_params()
+    tx = optax.sgd(1e-2, momentum=0.9)  # trace state: one flat per bucket
+    mesh1 = make_mesh(MeshSpec(data=1), devices=devices[:1])
+    mesh2 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    p1, lay1, opt1 = _zero_fixture(mesh1, 1, params, tx, moment_bias=0.5)
+
+    d = str(tmp_path / "ck")
+    mgr = CheckpointManager(d, async_save=False)
+    mgr.save(2, TrainState(jnp.zeros((), jnp.int32), p1, opt1, {}))
+    mgr.wait()
+
+    p2, lay2, opt2 = _zero_fixture(mesh2, 2, params, tx)
+    rep2 = NamedSharding(mesh2, P())
+    tpl = TrainState(
+        jax.device_put(jnp.zeros((), jnp.int32), rep2), p2, opt2, {}
+    )
+    restored, epoch = mgr.restore(
+        tpl, opt_reshape=ZeroElasticReshaper(tx, lay2, mesh2)
+    )
+    mgr.close()
+    assert epoch == 2
+
+    def leaves(t):
+        return jax.tree_util.tree_flatten_with_path(t)[0]
+
+    tot = lay2.buckets[0].total
+    for (_, got), (_, want) in zip(
+        leaves(restored.opt_state), leaves(opt1)
+    ):
+        got, want = np.asarray(got), np.asarray(want)
+        if got.ndim:
+            assert got.shape == (18,)
+            np.testing.assert_array_equal(got[:tot], want[:tot])
+            np.testing.assert_array_equal(got[tot:], np.zeros(18 - tot))
+
+
+def test_zero_rebucket_rejects_structure_change(devices):
+    """A bucket-STRUCTURE mismatch (bucket_mb changed, not the world)
+    is a recipe change — refuse instead of reinterpreting."""
+    import optax
+
+    from ddp_tpu.parallel.zero import (
+        ZeroElasticReshaper,
+        _opt_template,
+        build_layout,
+    )
+
+    params = {
+        "a": np.zeros((40,), np.float32),
+        "b": np.zeros((40,), np.float32),
+    }
+    tx = optax.adam(1e-3)
+    one_bucket = build_layout(params, 2, bucket_mb=4.0)
+    # tiny target → one bucket per leaf (leaf >= target gets its own)
+    two_buckets = build_layout(params, 2, bucket_mb=1e-4)
+    assert len(one_bucket.buckets) != len(two_buckets.buckets)
+    mesh2 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    reshaper = ZeroElasticReshaper(tx, one_bucket, mesh2)
+    with pytest.raises(ValueError, match="STRUCTURE"):
+        reshaper.plan(_opt_template(tx, two_buckets))
+
+
+def test_zero_rebucket_plan_noop_when_shapes_match(devices):
+    import optax
+
+    from ddp_tpu.parallel.zero import (
+        ZeroElasticReshaper,
+        _opt_template,
+        build_layout,
+    )
+
+    params = _odd_params()
+    tx = optax.adam(1e-3)
+    lay = build_layout(params, 2, bucket_mb=4.0)
+    mesh2 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    reshaper = ZeroElasticReshaper(tx, lay, mesh2)
+    assert reshaper.plan(_opt_template(tx, lay)) is None
+    # non-bucketed metadata (a plain tree-shaped opt state) is a no-op
+    # too: nothing to re-bucket, the templated restore handles it
+    assert reshaper.plan({"mu": np.zeros((3, 3), np.float32)}) is None
+
+
+def test_zero1_moments_reshard_data2_to_data1(tmp_path, devices):
+    """The other half of the satellite pin: zero1 (tree-shaped,
+    data-sharded moments) needs NO re-bucketing — Orbax reshards on
+    load via the live template (the test_elastic_shard mechanism),
+    data=2 → data=1."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.models import get_model
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.parallel.spmd import create_spmd_state, make_spmd_train_step
+    from ddp_tpu.train.checkpoint import CheckpointManager
+
+    model = get_model("simple_cnn")
+    tx = optax.adam(1e-3)
+    sample = jnp.zeros((1, 28, 28, 1))
+    mesh2 = make_mesh(MeshSpec(data=2), devices=devices[:2])
+    st2 = create_spmd_state(model, tx, sample, mesh2, seed=0, zero1=True)
+    step = make_spmd_train_step(model, tx, mesh2, zero1=True, donate=False)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.integers(0, 256, size=(8, 28, 28, 1), dtype=np.uint8)
+    )
+    labels = jnp.asarray(rng.integers(0, 10, size=(8,)), jnp.int32)
+    st2, _ = step(st2, images, labels)
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(0, TrainState(st2.step, st2.params, st2.opt_state, {}))
+    mgr.wait()
+
+    mesh1 = make_mesh(MeshSpec(data=1), devices=devices[:1])
+    st1 = create_spmd_state(model, tx, sample, mesh1, seed=7, zero1=True)
+    restored, epoch = mgr.restore(
+        TrainState(st1.step, st1.params, st1.opt_state, {})
+    )
+    mgr.close()
+    assert epoch == 0
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        restored.opt_state,
+        st2.opt_state,
+    )
+    leaf = jax.tree_util.tree_leaves(restored.opt_state)[0]
+    assert set(leaf.sharding.device_set) <= set(devices[:1])
+
+
+# ---- single-process device-count resize (subprocess: own device
+# ---- count) + the run_start exactly-once pin -------------------------
+
+
+def _run_cli(args, cwd=REPO, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "train.py"), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=cwd,
+    )
+
+
+@pytest.mark.slow
+def test_device_resize_preserves_global_batch_and_run_start(tmp_path):
+    """Single-process elastic resize (2 emulated devices → 1): the
+    recorded global batch is preserved (same steps/epoch), downtime is
+    attributed as RESIZE, and each generation writes EXACTLY ONE
+    run_start metrics record carrying the restart count and the
+    old/new world shapes."""
+    ck = str(tmp_path / "ck")
+    metrics = str(tmp_path / "m.jsonl")
+    base = [
+        "--batch_size", "4", "--synthetic_data", "--synthetic_size",
+        "64", "--eval_every", "0", "--log_interval", "4",
+        "--checkpoint_dir", ck, "--data_root", str(tmp_path / "data"),
+        "--metrics_file", metrics, "--elastic",
+    ]
+    p1 = _run_cli(["--epochs", "1", "--emulate_devices", "2", *base])
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    p2 = _run_cli(["--epochs", "2", "--emulate_devices", "1", *base])
+    assert p2.returncode == 0, p2.stderr[-2000:]
+
+    records = [json.loads(l) for l in open(metrics) if l.strip()]
+    epochs = [r for r in records if r["kind"] == "epoch"]
+    # global batch preserved → SAME steps/epoch at both worlds
+    assert [e["batches"] for e in epochs] == [8, 8]
+    starts = [r for r in records if r["kind"] == "run_start"]
+    assert len(starts) == 2  # exactly one per generation
+    assert [s["restarts"] for s in starts] == [0, 1]
+    assert [s["data_shards"] for s in starts] == [2, 1]
+    assert starts[1]["prev_data_shards"] == 2
+    assert all(s["global_batch_size"] == 8 for s in starts)
+    contract = json.loads(
+        open(os.path.join(ck, "elastic.json")).read()
+    )
+    assert contract["global_batch_size"] == 8
+    side = json.loads(open(os.path.join(ck, "goodput.json")).read())
+    assert side["resizes"] == 1
+    assert side["resize_downtime_s"] > 0
+    assert side["restart_downtime_s"] == 0.0
+
+
+# ---- spawned-world drills (slow tier) --------------------------------
+
+
+def _read(out_dir, ranks):
+    out = []
+    for rank in ranks:
+        with open(os.path.join(out_dir, f"rank{rank}.json")) as f:
+            out.append(json.load(f))
+    return out
+
+
+def _elastic_train_worker(
+    rank, world, ckpt, data, out_dir, chaos_spec, parallel, epochs
+):
+    from ddp_tpu.runtime import dist
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    # batch_size stays 4 in EVERY generation (same argv on relaunch);
+    # the elastic contract is what rescales the per-shard batch.
+    config = TrainConfig(
+        epochs=epochs, batch_size=4,
+        checkpoint_dir=ckpt, data_root=data,
+        synthetic_data=True, synthetic_size=64,
+        log_interval=4, eval_every=0,
+        chaos=chaos_spec, elastic=True,
+        parallel=parallel,
+        optimizer="adam" if parallel == "zero" else "sgd",
+        metrics_file=os.path.join(out_dir, "metrics.jsonl"),
+    )
+    trainer = Trainer(config, ctx=dist.current())
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "world": world,
+                "epochs_run": summary["epochs_run"],
+                "acc": summary["final_accuracy"],
+                "loss": summary["final_loss"],
+                "step": int(trainer.state.step),
+                "global_batch": trainer.global_batch_size,
+                "per_shard": trainer.per_shard_batch,
+            },
+            f,
+        )
+
+
+def _reference_world1(tmp_path, parallel):
+    """Uninjected run at the SURVIVING world size (1), same preserved
+    global batch (8) — in-process, single data shard."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    config = TrainConfig(
+        epochs=2, batch_size=8, num_devices=1,
+        checkpoint_dir=str(tmp_path / "ref_ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True, synthetic_size=64,
+        log_interval=4, eval_every=0,
+        parallel=parallel,
+        optimizer="adam" if parallel == "zero" else "sgd",
+    )
+    trainer = Trainer(config)
+    try:
+        summary = trainer.train()
+    finally:
+        trainer.close()
+    return summary
+
+
+@pytest.mark.multihost
+@pytest.mark.parametrize("parallel", ["auto", "zero"])
+def test_spawn_shrink_drill_completes_at_world1(tmp_path, parallel):
+    """THE acceptance drill: 2-process gloo spawn, rank 1 permanently
+    lost mid-epoch-1 (``shrink:rank1@step12``), ``elastic`` +
+    ``min_world=1``. The supervisor resizes (consuming NO restart
+    budget), the survivor resumes from the epoch-0 checkpoint at the
+    preserved global batch, training completes at world 1 with final
+    metrics matching an uninjected world-1 run, and goodput.json
+    attributes the resize downtime separately from restart downtime.
+    ``parallel='zero'`` additionally exercises the bucket re-bucket on
+    the restore path (world-2 padded flats → world-1 layout)."""
+    out = tmp_path / "out"
+    out.mkdir()
+    ck = str(tmp_path / "ck")
+    events = []
+    restarts = spawn(
+        _elastic_train_worker, 2,
+        (ck, str(tmp_path / "data"), str(out), "shrink:rank1@step12",
+         parallel, 2),
+        timeout=900, grace=5.0,
+        max_restarts=0,  # resizes must not need a restart budget
+        restart_backoff=0.1,
+        elastic=True, min_world=1, events_out=events,
+    )
+    assert restarts == 0
+    assert [e["kind"] for e in events] == ["resize"]
+    assert events[0]["old_world"] == 2 and events[0]["new_world"] == 1
+    assert events[0]["shrunk_ranks"] == [1]
+
+    # only the surviving world's rank 0 completes
+    results = _read(str(out), [0])
+    assert results[0]["world"] == 1
+    assert results[0]["step"] == 16  # 2 epochs × 8 steps, none lost
+    assert results[0]["global_batch"] == 8  # preserved
+    assert results[0]["per_shard"] == 8  # rescaled 4 → 8
+    assert np.isfinite(results[0]["loss"])
+
+    # parity with an uninjected run at the surviving world size: the
+    # replayed epoch-1 reproduces the lost work at world 1, and epoch 0
+    # differed only in gradient summation structure (mean-of-shard-
+    # means vs full-batch mean) — tight float tolerance, not bitwise.
+    ref = _reference_world1(tmp_path, parallel)
+    assert np.isclose(results[0]["acc"], ref["final_accuracy"], atol=1e-3)
+    assert np.isclose(results[0]["loss"], ref["final_loss"], rtol=1e-3)
+
+    side = json.loads((tmp_path / "ck" / "goodput.json").read_text())
+    assert side["restarts"] == 1  # one relaunch happened...
+    assert side["resizes"] == 1  # ...and it was a resize
+    assert side["resize_downtime_s"] > 0
+    assert side["restart_downtime_s"] == 0.0
+
+    # one run_start metrics record per generation, old/new worlds on it
+    starts = [
+        json.loads(l)
+        for l in open(os.path.join(str(out), "metrics.jsonl"))
+        if '"run_start"' in l
+    ]
+    assert len(starts) == 2
+    assert [s["data_shards"] for s in starts] == [2, 1]
+    assert starts[1]["prev_data_shards"] == 2
+    assert [s["restarts"] for s in starts] == [0, 1]
+
+    # the ledger stopped a second shrink
+    ledger = json.loads(
+        (tmp_path / "ck" / "chaos_ledger.rank1.json").read_text()
+    )
+    assert ledger["fired"] == ["shrink:rank1@step12"]
+
+
+@pytest.mark.multihost
+def test_spawn_shrink_then_grow_restores_world(tmp_path):
+    """Scale-up drill: shrink to 1 mid-epoch-0, then ``grow:+1`` at the
+    top of epoch 1 restores world 2 — the run finishes with BOTH ranks
+    live, per-shard batch back at 4, and the goodput sidecar counting
+    two resizes."""
+    out = tmp_path / "out"
+    out.mkdir()
+    ck = str(tmp_path / "ck")
+    events = []
+    restarts = spawn(
+        _elastic_train_worker, 2,
+        (ck, str(tmp_path / "data"), str(out),
+         "shrink:rank1@step4,grow:+1@epoch1", "auto", 2),
+        timeout=900, grace=5.0,
+        max_restarts=0, restart_backoff=0.1,
+        elastic=True, min_world=1, events_out=events,
+    )
+    assert restarts == 0
+    assert [(e["old_world"], e["new_world"]) for e in events] == [
+        (2, 1), (1, 2),
+    ]
+    results = _read(str(out), [0, 1])
+    assert all(r["world"] == 2 for r in results)
+    assert all(r["step"] == 16 for r in results)
+    assert all(r["per_shard"] == 4 for r in results)  # grown back
+    side = json.loads((tmp_path / "ck" / "goodput.json").read_text())
+    assert side["resizes"] == 2

@@ -485,6 +485,11 @@ def test_health_disabled_trainer_schema_unchanged(tmp_path):
 # ---- scripts/health_report.py ---------------------------------------
 
 _REPORT_FIXTURE = [
+    {"kind": "run_start", "time": 0.1, "start_epoch": 0, "restarts": 0,
+     "world_size": 2, "data_shards": 2, "global_batch_size": 8},
+    {"kind": "run_start", "time": 0.4, "start_epoch": 2, "restarts": 1,
+     "world_size": 1, "data_shards": 1, "prev_data_shards": 2,
+     "global_batch_size": 8},
     {"kind": "fallback", "time": 0.5, "epoch": 2, "resumed_epoch": 1,
      "quarantined_path": "ck/quarantine.epoch-2",
      "problems": ["default/d/abc: checksum mismatch"]},
@@ -510,7 +515,8 @@ _REPORT_FIXTURE = [
     {"kind": "final", "time": 5, "accuracy": 0.5, "loss": None,
      "epochs_run": 1,
      "goodput": {"productive_s": 0.6, "wall_s": 1.0, "goodput": 0.6,
-                 "restarts": 1}},
+                 "restarts": 1, "restart_downtime_s": 0.0,
+                 "resize_downtime_s": 0.25, "resizes": 1}},
 ]
 
 

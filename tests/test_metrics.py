@@ -83,7 +83,14 @@ def test_trainer_emits_step_epoch_final_records(tmp_path):
 
     records = [json.loads(l) for l in metrics_path.read_text().splitlines()]
     kinds = {r["kind"] for r in records}
-    assert kinds == {"step", "epoch", "final"}
+    assert kinds == {"run_start", "step", "epoch", "final"}
+    # exactly ONE run_start per generation, carrying the restart count
+    # and the world shape (the elastic-resize triage anchor)
+    starts = [r for r in records if r["kind"] == "run_start"]
+    assert len(starts) == 1
+    assert starts[0]["restarts"] == 0
+    assert starts[0]["world_size"] == 1
+    assert starts[0]["data_shards"] >= 1
     steps = [r for r in records if r["kind"] == "step"]
     assert all(np.isfinite(r["loss"]) for r in steps)
     # observability: every step row carries the grad norm and the lr

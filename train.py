@@ -79,6 +79,18 @@ def main(argv=None) -> int:
             "(runtime/launch.py); a single-process run restarts by "
             "re-invoking train.py — auto-resume does the rest"
         )
+    if config.min_world != TrainConfig.min_world and not config.elastic:
+        raise ValueError(
+            "--min_world bounds --elastic's scale-down; add --elastic "
+            "(or drop --min_world)"
+        )
+    if config.elastic and config.spawn > 1 and not (
+        1 <= config.min_world <= config.spawn
+    ):
+        raise ValueError(
+            f"--min_world {config.min_world} must be in "
+            f"[1, --spawn {config.spawn}]"
+        )
     if config.spawn > 1:
         # Reference parity: torch.multiprocessing.spawn(ddp_train,
         # nprocs=world_size) at train_ddp.py:222-224. Each rank gets
@@ -101,6 +113,11 @@ def main(argv=None) -> int:
             # checkpoint and goodput.json counts the restart.
             max_restarts=config.max_restarts,
             restart_backoff=config.restart_backoff,
+            # Elastic: a rank that exits SHRINK is permanently gone —
+            # relaunch smaller (down to --min_world) instead of failing;
+            # GROW relaunches larger. Workers reshard on resume.
+            elastic=config.elastic,
+            min_world=config.min_world,
         )
         return 0
     return _run(config)
