@@ -45,6 +45,26 @@ def _lint_clean() -> bool:
         return False
 
 
+def _env_fields() -> dict:
+    """Capture provenance every record carries: platform, backend, and
+    an explicit ``cpu_fallback`` flag.
+
+    The r02-r05 captures fell back to CPU when the TPU tunnel was
+    unreachable (ROADMAP perf-trajectory note) and their records were
+    only distinguishable by correlating ``platform`` — the flag makes
+    "never compare an on-chip trajectory point against a CPU one"
+    greppable in one field, in every entry, not just the headline.
+    """
+    import jax
+
+    platform = jax.devices()[0].platform
+    return {
+        "platform": platform,
+        "backend": jax.default_backend(),
+        "cpu_fallback": platform == "cpu",
+    }
+
+
 def run_bench(
     *,
     global_batch_size: int = 16384,
@@ -98,16 +118,29 @@ def run_bench(
     state = replicate_state(
         create_train_state(model, tx, jnp.zeros((1, 28, 28, 1)), seed=0), mesh
     )
+    # Compiled-program introspection for the headline (obs/xprof.py):
+    # the CPU path instruments the raw jit step (full AOT ledger —
+    # real compile seconds, XLA FLOPs, memory); the TPU fast path is
+    # an epoch-runner closure, so its ledger entry is observe-only
+    # (first-dispatch wall time, flagged ``fallback``). Either way the
+    # record carries compile_time_s and the HBM high-water.
+    from ddp_tpu.obs.xprof import DeviceMemorySampler, Xprof
+
+    xprof = Xprof(enabled=True)
+    hbm = DeviceMemorySampler(enabled=True)
     if platform == "tpu":
-        runner = make_epoch_runner(
-            model,
-            tx,
-            mesh,
-            images,
-            labels,
-            global_batch_size,
-            compute_dtype=compute_dtype,
-            seed=0,
+        runner = xprof.instrument(
+            make_epoch_runner(
+                model,
+                tx,
+                mesh,
+                images,
+                labels,
+                global_batch_size,
+                compute_dtype=compute_dtype,
+                seed=0,
+            ),
+            "bench_epoch",
         )
     else:
         # XLA:CPU compiles the conv step ~200× slower INSIDE lax.scan
@@ -118,9 +151,12 @@ def run_bench(
         # scanned fast path stays the TPU measurement.
         from ddp_tpu.parallel.ddp import make_train_step
 
-        step_fn = make_train_step(
-            model, tx, mesh, donate=False, compute_dtype=compute_dtype,
-            seed=0,
+        step_fn = xprof.instrument(
+            make_train_step(
+                model, tx, mesh, donate=False,
+                compute_dtype=compute_dtype, seed=0,
+            ),
+            "bench_step",
         )
         n_imgs = images.shape[0]
         steps = n_imgs // global_batch_size
@@ -151,6 +187,7 @@ def run_bench(
         with tracer.span("bench.warmup_epoch", {"epoch": e}):
             state, metrics = runner(state, e)
             jax.block_until_ready(metrics.loss)
+    hbm.sample()  # post-compile steady state
 
     t0 = time.perf_counter()
     for e in range(warmup_epochs, warmup_epochs + timed_epochs):
@@ -158,6 +195,7 @@ def run_bench(
             state, metrics = runner(state, e)
     jax.block_until_ready(metrics.loss)
     seconds = time.perf_counter() - t0
+    hbm.sample()
 
     total_images = images_per_epoch * timed_epochs
     per_chip = total_images / seconds / len(devices)
@@ -174,7 +212,7 @@ def run_bench(
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-        "platform": platform,
+        **_env_fields(),
         "num_chips": len(devices),
         "global_batch_size": global_batch_size,
         "timed_epochs": timed_epochs,
@@ -182,6 +220,23 @@ def run_bench(
         "seconds": round(seconds, 3),
         "mfu": round(mfu, 6),
         "trace": trace,
+        # Compiled-program ledger (obs/xprof.py): what this number
+        # paid in XLA builds, and the device-memory high-water of the
+        # measured loop (memory_stats on TPU, live-buffer accounting
+        # on CPU — never null either way). compile_measured says what
+        # compile_time_s IS: "aot" = real lower().compile() seconds
+        # (the CPU per-step path); "first_call" = the observe-only
+        # fallback's whole first dispatch (compile + one epoch of
+        # steps — the TPU epoch-runner closure can't lower), which
+        # must never be compared against an aot number.
+        "compile_time_s": round(xprof.total_compile_s, 3),
+        "compile_measured": (
+            "first_call"
+            if any(r.get("fallback") for r in xprof.ledger_records())
+            else "aot"
+        ),
+        "compiled_programs": xprof.program_count,
+        "hbm_high_water_bytes": hbm.high_water_bytes,
         # How many times this measurement was respawned before a
         # record landed (the supervisor overwrites with the real
         # count): a nonzero value in the trajectory means the headline
@@ -396,6 +451,7 @@ def run_vit_bench(
         "metric": "vit_tiny_bf16_train_throughput",
         "value": round(images_per_sec, 1),
         "unit": "images/sec/chip",
+        **_env_fields(),
         "tokens": T,
         "use_cls_token": use_cls_token,
         "batch": batch,
@@ -480,6 +536,7 @@ def run_lm_bench(
         "metric": "causal_lm_train_throughput",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
+        **_env_fields(),
         "batch": batch,
         "seq_len": seq_len,
         "nsteps": nsteps,
@@ -585,6 +642,7 @@ def run_decode_bench(
     return {
         "metric": "kv_cache_decode_throughput",
         "value": round(toks / best, 1),
+        **_env_fields(),
         "mfu": round(
             (toks / best) * fwd_per_token / peak_flops_per_chip(device), 6
         ),
@@ -766,6 +824,7 @@ def run_serve_bench(
     return {
         "metric": "serve_decode_throughput",
         "value": round(total_tokens / wall, 1),
+        **_env_fields(),
         "mfu": round(
             (total_tokens / wall) * fwd_per_token
             / peak_flops_per_chip(device),
@@ -862,6 +921,7 @@ def run_loader_bench(
     pool_engaged = ShardedLoader.pool_would_engage(batch_bytes)
     result = {
         "metric": "loader_batch_assembly",
+        **_env_fields(),
         "shape": [batch, side, side, 3],
         "python_batches_per_sec": round(python_gather(), 1),
         "native_available": native.available(),
@@ -1060,15 +1120,35 @@ def _zero_bench_impl(
     zero_state, layout = create_zero_state(
         model, tx, sample, mesh, seed=0, bucket_mb=bucket_mb
     )
+    # Each variant dispatches through the xprof compile ledger
+    # (obs/xprof.py): the record then carries real compile seconds per
+    # variant, the HBM high-water of the measured loops, and — the
+    # cross-check this bench exists to keep honest — the HLO-derived
+    # collective bytes next to the analytic comm_bytes estimates.
+    from ddp_tpu.obs.xprof import DeviceMemorySampler, Xprof
+
+    xprof = Xprof(enabled=True)
+    hbm = DeviceMemorySampler(enabled=True)
     variants = {
-        "ddp": (make_train_step(model, tx, mesh, donate=False), ddp_state),
+        "ddp": (
+            xprof.instrument(
+                make_train_step(model, tx, mesh, donate=False), "ddp"
+            ),
+            ddp_state,
+        ),
         "zero": (
-            make_zero_train_step(model, tx, mesh, layout, donate=False),
+            xprof.instrument(
+                make_zero_train_step(model, tx, mesh, layout, donate=False),
+                "zero",
+            ),
             zero_state,
         ),
         "zero_serialized": (
-            make_zero_train_step(
-                model, tx, mesh, layout, donate=False, overlap=False
+            xprof.instrument(
+                make_zero_train_step(
+                    model, tx, mesh, layout, donate=False, overlap=False
+                ),
+                "zero_serialized",
             ),
             zero_state,
         ),
@@ -1102,9 +1182,26 @@ def _zero_bench_impl(
         "ddp": opt_bytes_per_device(ddp_state.opt_state),
         "zero": opt_bytes_per_device(zero_state.opt_state),
     }
+    hbm.sample()
+    comm_est = {
+        "ddp": ddp_comm_bytes(ddp_state.params, world),
+        "zero": zero_comm_bytes(layout, world),
+    }
+    # Hand ledger vs compiled program: ring-model traffic from the
+    # optimized HLO's collective payloads, checked against the
+    # analytic estimate each strategy publishes (parallel/zero.py).
+    comm_check = {
+        name: xprof.comm_check(name, comm_est[name]["total"], world)
+        for name in ("ddp", "zero")
+    }
+    compile_s = {}
+    for rec in xprof.ledger_records():
+        compile_s[rec["label"]] = round(
+            compile_s.get(rec["label"], 0.0) + rec["compile_time_s"], 3
+        )
     return {
         "metric": "zero_weight_update_sharding",
-        "platform": devices[0].platform,
+        **_env_fields(),
         "world_size": world,
         "bucket_mb": bucket_mb,
         "buckets": len(layout.buckets),
@@ -1113,10 +1210,10 @@ def _zero_bench_impl(
         "step_time_p50_s": p50,
         "dispatch_compute": split,
         "overlap_fraction": round(overlap_fraction, 4),
-        "comm_bytes": {
-            "ddp": ddp_comm_bytes(ddp_state.params, world),
-            "zero": zero_comm_bytes(layout, world),
-        },
+        "comm_bytes": comm_est,
+        "hlo_comm_check": comm_check,
+        "compile_time_s": compile_s,
+        "hbm_high_water_bytes": hbm.high_water_bytes,
         "opt_state_bytes_per_device": opt_mem,
         "opt_memory_ratio": round(
             opt_mem["zero"] / max(1, opt_mem["ddp"]), 4
@@ -1179,11 +1276,17 @@ def run_elastic_bench(*, timeout: float = 600.0) -> dict:
     except subprocess.TimeoutExpired:
         return {
             "metric": "elastic_world_resize",
+            "platform": "cpu",
+            "backend": "cpu",
+            "cpu_fallback": True,
             "error": f"drill timed out after {timeout:.0f}s",
         }
     if proc.returncode != 0:
         return {
             "metric": "elastic_world_resize",
+            "platform": "cpu",
+            "backend": "cpu",
+            "cpu_fallback": True,
             "error": f"drill rc={proc.returncode}: {proc.stderr[-800:]}",
         }
     records = []
@@ -1235,7 +1338,12 @@ def run_elastic_bench(*, timeout: float = 600.0) -> dict:
     steps = [r for r in records if r.get("kind") == "step"]
     return {
         "metric": "elastic_world_resize",
-        "platform": "cpu",  # --spawn emulates hosts on CPU by design
+        # --spawn emulates hosts on CPU by design: the drill is a
+        # recovery-path latency on emulated hosts, never an on-chip
+        # throughput claim — flagged like every other CPU capture.
+        "platform": "cpu",
+        "backend": "cpu",
+        "cpu_fallback": True,
         "world_trajectory": worlds,
         "generations": len(rs_idx),
         "resizes": int(side.get("resizes", 0)),
@@ -1407,6 +1515,7 @@ def run_accuracy_bench() -> dict:
 
     return {
         "real_data": True,
+        **_env_fields(),
         "dataset": "uci_digits (sklearn load_digits scans, vendored "
                    "as IDX by scripts/vendor_uci_digits.py; real MNIST "
                    "unreachable — zero network egress)",
@@ -1709,6 +1818,8 @@ def _error_record(error: str, attempts: list[str]) -> dict:
         "unit": "images/sec/chip",
         "vs_baseline": 0.0,
         "platform": "none",
+        "backend": "none",
+        "cpu_fallback": True,
         "error": error,
         "capture_attempts": attempts,
     }

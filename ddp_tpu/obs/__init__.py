@@ -22,7 +22,13 @@ jit compilation and no growing per-step allocations):
   dumped crash-safely on exception, SIGTERM, and watchdog kill;
 - ``promtext`` — Prometheus text exposition of the live counters,
   served at ``/metricsz`` (serve frontend + trainer metrics port),
-  with a matching lint.
+  with a matching lint;
+- ``xprof`` — compiled-program introspection: per-executable compile
+  ledger (label, arg-shape signature, compile wall-time, XLA-measured
+  FLOPs/bytes, memory breakdown, HLO collective payloads) plus the
+  device-memory high-water/headroom sampler, cross-checking the
+  analytic estimators and the zero strategy's hand-priced
+  ``comm_bytes`` against what XLA actually built.
 
 Wiring: ``--trace_dir`` / ``--health`` / ``--metrics_port`` on
 train.py (train/trainer.py), the serve engine/server (spans +
@@ -59,10 +65,17 @@ from ddp_tpu.obs.tracer import (
     install_from_env,
     validate_trace_file,
 )
+from ddp_tpu.obs.xprof import (
+    DeviceMemorySampler,
+    Xprof,
+    parse_hlo_collectives,
+    ring_collective_traffic,
+)
 
 __all__ = [
     "AnomalySentry",
     "CompileCounter",
+    "DeviceMemorySampler",
     "FlightRecorder",
     "GoodputAccountant",
     "HealthMonitor",
@@ -73,13 +86,16 @@ __all__ = [
     "StepAttributor",
     "StepTiming",
     "Tracer",
+    "Xprof",
     "get_tracer",
     "group_layout",
     "health_stats",
     "install_from_env",
+    "parse_hlo_collectives",
     "peak_flops_per_chip",
     "render_serve",
     "render_train",
+    "ring_collective_traffic",
     "train_flops_per_example",
     "validate_promtext",
     "validate_trace_file",

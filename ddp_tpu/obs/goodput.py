@@ -85,6 +85,14 @@ def mfu(
 # forward = the matmul/conv terms only. Elementwise/norm/softmax work
 # is excluded by convention — MFU compares against the MXU peak, which
 # only the contractions can use.
+#
+# Cross-checked against the compiler, not just golden-pinned: the
+# xprof layer (obs/xprof.py) reads XLA's own op count off the compiled
+# train step, and tests/test_xprof.py pins measured/analytic within a
+# per-family tolerance band (near 1 for the conv nets, above 1 for
+# tiny transformers where the excluded elementwise work is a visible
+# share). An estimator edit that drifts from the real program now
+# fails there, not in a quiet MFU skew.
 
 
 def conv_flops(h_out: int, w_out: int, k: int, c_in: int, c_out: int) -> float:

@@ -305,6 +305,29 @@ def render_serve(
     b.add("ddp_tpu_serve_productive_seconds_total", gp.get("productive_s"),
           metric_type="counter")
     b.add("ddp_tpu_serve_goodput", gp.get("goodput"))
+    # Compiled-program introspection (obs/xprof.py, engine xprof=...):
+    # absent keys render nothing, so an xprof-less engine's exposition
+    # stays byte-identical.
+    xp = stats.get("xprof") or {}
+    b.add(
+        "ddp_tpu_serve_compiled_executables", xp.get("programs"),
+        help="xprof compile-ledger entries",
+    )
+    b.add(
+        "ddp_tpu_serve_compile_seconds_total", xp.get("compile_s_total"),
+        metric_type="counter", help="XLA compile wall time paid",
+    )
+    mem = xp.get("hbm") or {}
+    b.add("ddp_tpu_serve_hbm_used_bytes", mem.get("hbm_used_bytes"))
+    b.add(
+        "ddp_tpu_serve_hbm_high_water_bytes",
+        mem.get("hbm_high_water_bytes"),
+        help="peak device memory observed",
+    )
+    b.add(
+        "ddp_tpu_serve_hbm_headroom_frac", mem.get("hbm_headroom_frac"),
+        help="1 - high_water/limit (absent off-TPU: no honest limit)",
+    )
     return b.render()
 
 
@@ -352,6 +375,29 @@ def render_train(snap: dict) -> str:
             },
             help="first non-finite gradient/loss observation",
         )
+    # Compiled-program introspection (--xprof, obs/xprof.py): compile
+    # ledger totals and the device-memory sampler's view. Absent keys
+    # render no series — an xprof-off trainer's exposition is
+    # byte-identical to the pre-xprof one.
+    b.add(
+        "ddp_tpu_train_compiled_executables", snap.get("compile_programs"),
+        help="xprof compile-ledger entries",
+    )
+    b.add(
+        "ddp_tpu_train_compile_seconds_total",
+        snap.get("compile_seconds_total"),
+        metric_type="counter", help="XLA compile wall time paid",
+    )
+    b.add("ddp_tpu_train_hbm_used_bytes", snap.get("hbm_used_bytes"))
+    b.add(
+        "ddp_tpu_train_hbm_high_water_bytes",
+        snap.get("hbm_high_water_bytes"),
+        help="peak device memory observed",
+    )
+    b.add(
+        "ddp_tpu_train_hbm_headroom_frac", snap.get("hbm_headroom_frac"),
+        help="1 - high_water/limit (absent off-TPU: no honest limit)",
+    )
     b.summary("ddp_tpu_train_step_seconds", snap.get("step_time"))
     return b.render()
 

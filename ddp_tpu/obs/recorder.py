@@ -95,6 +95,7 @@ class FlightRecorder:
         self.clock = clock
         self._ring: deque = deque(maxlen=max(1, self.capacity))
         self._context: dict[str, Any] = {}
+        self._providers: dict[str, Any] = {}
         self._dumps = 0
 
     @property
@@ -110,6 +111,20 @@ class FlightRecorder:
         if not self.enabled:
             return
         self._context.update(ctx)
+
+    def set_provider(self, name: str, fn) -> None:
+        """Register a live-state provider collected AT DUMP TIME.
+
+        Unlike ``set_context`` (a snapshot frozen when set), a
+        provider is called when the dump happens — the xprof compile
+        ledger and the last device-memory sample belong here: an OOM
+        post-mortem needs the state at death, not at construction.
+        Each provider runs inside its own guard; a raising provider
+        contributes an error marker, never kills the dump.
+        """
+        if not self.enabled:
+            return
+        self._providers[name] = fn
 
     def record(self, kind: str, **fields) -> None:
         """Append one record to the ring (host dict append — cheap
@@ -127,6 +142,12 @@ class FlightRecorder:
         path = self.path
         try:
             os.makedirs(self.directory, exist_ok=True)
+            extras: dict[str, Any] = {}
+            for name, fn in self._providers.items():
+                try:
+                    extras[name] = fn()
+                except Exception as e:  # noqa: BLE001 — never kill a dump
+                    extras[name] = {"provider_error": type(e).__name__}
             doc = _sanitize(
                 {
                     "reason": reason,
@@ -134,6 +155,7 @@ class FlightRecorder:
                     "dumped_at": round(self.clock(), 3),
                     "dumps": self._dumps + 1,
                     "context": self._context,
+                    **({"extras": extras} if extras else {}),
                     "records": list(self._ring),
                 }
             )

@@ -75,12 +75,21 @@ class CompileCounter:
 
 @dataclass
 class StepTiming:
-    """One step's attribution (seconds; recompiles is a count)."""
+    """One step's attribution (seconds; recompiles is a count).
+
+    ``compiles`` names the culprits when the step paid for one and an
+    :class:`~ddp_tpu.obs.xprof.Xprof` instruments the hot path: one
+    dict per compile with the responsible label, arg-shape signature,
+    shape-diff vs the label's previous compile, and compile seconds —
+    the recompile-storm sentry's count, upgraded to an attribution.
+    None when nothing compiled (or nothing was instrumented).
+    """
 
     input_wait_s: float
     dispatch_s: float
     compute_s: float
     recompiles: int
+    compiles: Optional[list] = None
 
     @property
     def wall_s(self) -> float:
@@ -122,14 +131,24 @@ class StepAttributor:
     """
 
     def __init__(
-        self, *, enabled: bool = False, tracer: Optional[Tracer] = None
+        self,
+        *,
+        enabled: bool = False,
+        tracer: Optional[Tracer] = None,
+        xprof=None,
     ):
         self.enabled = bool(enabled)
         self.tracer = tracer if tracer is not None else Tracer()
+        # Compile attribution (obs/xprof.py): when the hot path is
+        # instrumented, a step whose compile counter moved also gets
+        # the ledger events that landed during it — label, shape-diff,
+        # compile seconds. None/disabled adds nothing.
+        self.xprof = xprof if (xprof is not None and xprof.enabled) else None
         self.epoch_totals = EpochAttribution()
         self._input_wait = 0.0
         self._fetch_end = 0.0
         self._compiles_at_fetch = 0
+        self._xprof_seq_at_fetch = 0
         if self.enabled:
             CompileCounter.install()
 
@@ -154,6 +173,8 @@ class StepAttributor:
             self._fetch_end = time.perf_counter()
             self._input_wait = self._fetch_end - t0
             self._compiles_at_fetch = CompileCounter.count()
+            if self.xprof is not None:
+                self._xprof_seq_at_fetch = self.xprof.event_seq
             yield batch
 
     def on_step(self, sync_ref: Any) -> Optional[StepTiming]:
@@ -172,6 +193,14 @@ class StepAttributor:
             compute_s=done - dispatched,
             recompiles=CompileCounter.count() - self._compiles_at_fetch,
         )
+        if timing.recompiles and self.xprof is not None:
+            # The ledger events that landed during this step ARE the
+            # culprits — the process counter says a compile happened,
+            # the ledger says which label and what shape changed.
+            self._xprof_seq_at_fetch, events = self.xprof.events_after(
+                self._xprof_seq_at_fetch
+            )
+            timing.compiles = events or None
         self.epoch_totals.add(timing)
         tr = self.tracer
         if tr.enabled:
@@ -182,17 +211,24 @@ class StepAttributor:
                 timing.input_wait_s,
             )
             tr.complete("step.dispatch", self._fetch_end, timing.dispatch_s)
+            compute_args = None
+            if timing.recompiles:
+                compute_args = {"recompiles": timing.recompiles}
+                if timing.compiles:
+                    compute_args["compiled"] = [
+                        f"{e.get('label')} ({e.get('compile_time_s')}s)"
+                        for e in timing.compiles
+                    ]
             tr.complete(
-                "step.compute", dispatched, timing.compute_s,
-                {"recompiles": timing.recompiles}
-                if timing.recompiles
-                else None,
+                "step.compute", dispatched, timing.compute_s, compute_args,
             )
         # Prime for a loop body that never re-enters the iterator
         # (last batch): keep fetch_end monotone.
         self._fetch_end = done
         self._input_wait = 0.0
         self._compiles_at_fetch = CompileCounter.count()
+        if self.xprof is not None:
+            self._xprof_seq_at_fetch = self.xprof.event_seq
         return timing
 
     def finish_epoch(self) -> EpochAttribution:

@@ -132,6 +132,15 @@ class Tracer:
         now = time.perf_counter()
         self._record("i", name, now, 0.0, args)
 
+    def counter(self, name: str, values: dict) -> None:
+        """A Perfetto counter sample (``ph: "C"``): ``values`` maps
+        series name → number and renders as a counter track (the HBM
+        used/high-water track rides this). Free when disabled, like
+        every recording path."""
+        if not self.enabled:
+            return
+        self._record("C", name, time.perf_counter(), 0.0, values)
+
     def complete(
         self,
         name: str,

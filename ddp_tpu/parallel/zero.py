@@ -741,14 +741,10 @@ def opt_bytes_per_device(opt_state) -> int:
     """Optimizer-state memory high-water: max over devices of the
     bytes the state's live buffers actually hold there (per-shard
     accounting over the arrays' real shardings — replicated leaves
-    count in full on every device, data-sharded flats count 1/N)."""
-    per: dict[Any, int] = {}
-    for leaf in jax.tree_util.tree_leaves(opt_state):
-        if not hasattr(leaf, "addressable_shards"):
-            continue
-        for s in leaf.addressable_shards:
-            n = 1
-            for d in s.data.shape:
-                n *= int(d)
-            per[s.device] = per.get(s.device, 0) + n * leaf.dtype.itemsize
-    return max(per.values(), default=0)
+    count in full on every device, data-sharded flats count 1/N).
+    One convention, one definition: the accounting itself lives in
+    ``obs/xprof.max_device_buffer_bytes`` (shared with the device-
+    memory sampler's live-buffer fallback)."""
+    from ddp_tpu.obs.xprof import max_device_buffer_bytes
+
+    return max_device_buffer_bytes(jax.tree_util.tree_leaves(opt_state))

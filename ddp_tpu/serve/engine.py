@@ -173,6 +173,7 @@ class ServeEngine:
         tracer: Optional[Tracer] = None,
         clock: Callable[[], float] = time.monotonic,
         sanitize: bool = False,
+        xprof=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -304,13 +305,32 @@ class ServeEngine:
                 donate_argnums=(1,),
             )
 
-        self._chunk_first = _chunk_fn(False)
-        self._chunk_cont = _chunk_fn(True)
-        self._decode = jax.jit(
-            lambda p, c, t, sd, st, tm, tp: _decode_sample(
-                spec, p, c, t, sd, st, tm, tp
+        # Compiled-program introspection (obs/xprof.py): when a live
+        # Xprof is passed, the engine's whole program set dispatches
+        # through its compile ledger — warmup() then enumerates every
+        # program WITH its compile time, XLA FLOPs, and memory
+        # breakdown, and /metricsz gains compile + HBM gauges. The
+        # wrapper preserves _cache_size(), so the static-shape pins
+        # (compile_counts frozen after warmup) hold either way. None =
+        # uninstrumented, byte-identical to the pre-xprof engine.
+        from ddp_tpu.obs.xprof import DeviceMemorySampler, Xprof
+
+        self._xprof = xprof if xprof is not None else Xprof(enabled=False)
+        self._hbm = DeviceMemorySampler(enabled=self._xprof.enabled)
+        self._chunk_first = self._xprof.instrument(
+            _chunk_fn(False), "serve.prefill_first"
+        )
+        self._chunk_cont = self._xprof.instrument(
+            _chunk_fn(True), "serve.prefill_chunk"
+        )
+        self._decode = self._xprof.instrument(
+            jax.jit(
+                lambda p, c, t, sd, st, tm, tp: _decode_sample(
+                    spec, p, c, t, sd, st, tm, tp
+                ),
+                donate_argnums=(1,),
             ),
-            donate_argnums=(1,),
+            "serve.decode",
         )
 
     # ---- frontend surface ------------------------------------------
@@ -415,8 +435,15 @@ class ServeEngine:
             ),
         }
 
-    def stats(self) -> dict:
-        """JSON-ready operational snapshot (the /stats endpoint)."""
+    def stats(self, *, include_ledger: bool = False) -> dict:
+        """JSON-ready operational snapshot (the /stats endpoint).
+
+        ``include_ledger`` embeds the full per-executable compile
+        ledger; the default keeps the snapshot scalar-cheap — the
+        /metricsz renderer only reads the gauge fields, and a
+        Prometheus scrape must not pay a per-profile dict copy (which
+        grows with the ledger) for three gauges.
+        """
         return {
             "slots": self.num_slots,
             "active": self.active,
@@ -436,6 +463,27 @@ class ServeEngine:
                 "step_token_budget": self.step_token_budget,
             },
             "goodput": self.goodput(),
+            # Compiled-program introspection, only when instrumented:
+            # an xprof-less engine's stats (and its /metricsz
+            # rendering) stay byte-identical.
+            **(
+                {
+                    "xprof": {
+                        "programs": self._xprof.program_count,
+                        "compile_s_total": round(
+                            self._xprof.total_compile_s, 4
+                        ),
+                        **(
+                            {"ledger": self._xprof.ledger_records()}
+                            if include_ledger
+                            else {}
+                        ),
+                        "hbm": self._hbm.sample(),
+                    }
+                }
+                if self._xprof.enabled
+                else {}
+            ),
         }
 
     # ---- engine loop ------------------------------------------------

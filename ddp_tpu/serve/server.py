@@ -248,7 +248,7 @@ class LMServer:
                 }
         if route == "/stats":
             with self._lock:
-                return self.engine.stats()
+                return self.engine.stats(include_ledger=True)
         if route == "/metricsz":
             # Prometheus text, not JSON: rendered under the engine
             # lock from the same stats() snapshot /stats serves.

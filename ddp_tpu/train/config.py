@@ -167,6 +167,14 @@ class TrainConfig:
     trace_dir: str | None = None
     # Bounded trace memory: the ring keeps the LAST this-many events.
     trace_ring_events: int = 65536
+    # Compiled-program introspection (ddp_tpu.obs.xprof): instrument
+    # the hot-path jit programs so every compile is ledgered (label,
+    # arg-shape signature, compile wall-time, XLA-measured FLOPs/
+    # bytes, memory breakdown, HLO collective payloads), recompiles
+    # get culprits instead of counts, and step/epoch records carry
+    # the device-memory high-water/headroom. A diagnosis mode like
+    # --trace_dir; off (default) is pinned free.
+    xprof: bool = False
     # Abort the process when no step completes for this many seconds
     # (0 = off). Converts a hung collective into a crash the launcher
     # detects, so restart+resume can recover. Set generously above the
@@ -372,6 +380,13 @@ class TrainConfig:
         )
         p.add_argument(
             "--trace_ring_events", type=int, default=cls.trace_ring_events,
+        )
+        p.add_argument(
+            "--xprof", action="store_true",
+            help="compiled-program introspection: per-executable "
+            "compile ledger (XLA FLOPs/memory/collectives), recompile "
+            "culprits, HBM high-water in step/epoch records "
+            "(ddp_tpu.obs.xprof; see docs/OBSERVABILITY.md)",
         )
         p.add_argument(
             "--watchdog_timeout", type=float, default=cls.watchdog_timeout

@@ -45,6 +45,7 @@ from ddp_tpu.obs.recorder import FlightRecorder, snapshot_env
 from ddp_tpu.obs.sentry import AnomalySentry, SentryConfig
 from ddp_tpu.obs.steptime import StepAttributor, dispatch_compute_split
 from ddp_tpu.obs.tracer import Tracer
+from ddp_tpu.obs.xprof import DeviceMemorySampler, Xprof
 from ddp_tpu.parallel.ddp import (
     create_train_state,
     make_eval_step,
@@ -150,8 +151,28 @@ class Trainer:
             ring_events=config.trace_ring_events,
             process_id=self.ctx.process_id,
         )
+        # Compiled-program introspection (--xprof, obs/xprof.py): the
+        # hot-path jit programs are instrumented below (per family, at
+        # the site where the raw jit object is in hand) so every
+        # compile lands in a ledger with XLA-measured FLOPs/memory/
+        # collectives, recompiles carry culprits, and the step/epoch
+        # records gain the device-memory high-water. Disabled,
+        # instrument() is the identity and the sampler returns {} —
+        # pinned free like the tracer.
+        if config.xprof and config.fast_epoch:
+            raise ValueError(
+                "--xprof instruments the per-step hot path, but "
+                "--fast_epoch runs a whole epoch as ONE dispatch "
+                "(dispatch_compute_split already reports its compile "
+                "count) — drop one of the two"
+            )
+        self._xprof = Xprof(enabled=config.xprof)
+        self._hbm = DeviceMemorySampler(enabled=config.xprof)
+        self._xprof_cursor = 0
+        self._comm_checked = False
         self._attr = StepAttributor(
-            enabled=bool(config.trace_dir), tracer=self.tracer
+            enabled=bool(config.trace_dir), tracer=self.tracer,
+            xprof=self._xprof,
         )
         # Run health (obs/health.py): the in-graph stats pass rides the
         # step builders; the monitor/sentry are constructed after the
@@ -796,13 +817,19 @@ class Trainer:
                         grad_accum_steps=config.grad_accum_steps,
                         gspmd=True,
                     )["total"]
-                lm_step = make_lm_train_step(
-                    self.seq_spec, self.optimizer, self.mesh,
-                    compute_dtype=compute_dtype,
-                    grad_accum_steps=config.grad_accum_steps,
-                    label_smoothing=config.label_smoothing,
-                    zero_layout=self._zero_layout,
-                    **hkw,
+                # Instrumented HERE (not on the label-dropping lambda
+                # below): only the raw jit object can lower for the
+                # xprof compile ledger.
+                lm_step = self._xprof.instrument(
+                    make_lm_train_step(
+                        self.seq_spec, self.optimizer, self.mesh,
+                        compute_dtype=compute_dtype,
+                        grad_accum_steps=config.grad_accum_steps,
+                        label_smoothing=config.label_smoothing,
+                        zero_layout=self._zero_layout,
+                        **hkw,
+                    ),
+                    "train_step",
                 )
                 # labels ride the loader but the LM has no use for
                 # them — targets are the shifted tokens.
@@ -945,10 +972,15 @@ class Trainer:
                 "1f1b": make_pipe_lm_1f1b_train_step,
                 "interleaved": make_pipe_lm_interleaved_train_step,
             }.get(config.pipe_schedule, make_pipe_lm_train_step)
-            pipe_step = make_step(
-                self.pipe_cfg, self.optimizer, self.mesh,
-                compute_dtype=compute_dtype,
-                **hkw,
+            # Instrumented on the raw jit object (the state-converting
+            # wrapper below cannot lower).
+            pipe_step = self._xprof.instrument(
+                make_step(
+                    self.pipe_cfg, self.optimizer, self.mesh,
+                    compute_dtype=compute_dtype,
+                    **hkw,
+                ),
+                "train_step",
             )
 
             def step(ts, tokens, labels):
@@ -1052,11 +1084,16 @@ class Trainer:
                 "1f1b": make_pipe_vit_1f1b_train_step,
                 "interleaved": make_pipe_vit_interleaved_train_step,
             }.get(config.pipe_schedule, make_pipe_vit_train_step)
-            pipe_step = make_step(
-                self.pipe_cfg, self.optimizer, self.mesh,
-                compute_dtype=compute_dtype,
-                label_smoothing=config.label_smoothing,
-                augment_fn=augment_fn, seed=config.seed,
+            # Instrumented on the raw jit object (the state-converting
+            # wrapper below cannot lower).
+            pipe_step = self._xprof.instrument(
+                make_step(
+                    self.pipe_cfg, self.optimizer, self.mesh,
+                    compute_dtype=compute_dtype,
+                    label_smoothing=config.label_smoothing,
+                    augment_fn=augment_fn, seed=config.seed,
+                ),
+                "train_step",
             )
 
             def step(ts, images, labels):
@@ -1189,6 +1226,20 @@ class Trainer:
             self._comm_bytes = ddp_comm_bytes(
                 self.state.params, self.data_shards
             )["total"]
+        # Families whose train/eval steps are the raw jit objects get
+        # instrumented here in one place (the seq classifier, GSPMD,
+        # zero, and plain-DDP steps; the lm/pipe branches wrapped
+        # their inner jits above — their outer state adapters cannot
+        # lower). Identity when --xprof is off.
+        if self._xprof.enabled:
+            if hasattr(self.train_step, "lower"):
+                self.train_step = self._xprof.instrument(
+                    self.train_step, "train_step"
+                )
+            if hasattr(self.eval_step, "lower"):
+                self.eval_step = self._xprof.instrument(
+                    self.eval_step, "eval_step"
+                )
         self.fast_runner = None
         if config.fast_epoch:
             if not (self.lm_mode or self.pipe_mode) and (
@@ -1384,6 +1435,18 @@ class Trainer:
             rank=self.ctx.process_id,
             capacity=config.flight_records,
         )
+        if self._xprof.enabled:
+            # OOM forensics: the dump collects the compile ledger and
+            # a FRESH memory sample at dump time (a provider, not a
+            # snapshot) — what was compiled, how big, and how full the
+            # device was when the run died.
+            self._recorder.set_provider(
+                "xprof",
+                lambda: {
+                    "compile_ledger": self._xprof.ledger_records(),
+                    "memory": self._hbm.sample(),
+                },
+            )
         # Anomaly sentry + one-step-behind health monitor. The group-
         # path layout comes from the SAME group_layout the in-graph
         # pass uses, so the [G] vectors decode without drift.
@@ -1553,6 +1616,87 @@ class Trainer:
         )
         if m is not None:
             fields["mfu"] = round(m, 6)
+        return fields
+
+    def _xprof_step_fields(self) -> dict:
+        """Log-cadence xprof work: sample device memory (step-record
+        fields + Perfetto counter track), drain fresh compile events
+        into the metrics stream/flight recorder, and run the one-time
+        comm-bytes cross-check. {} when --xprof is off — the step
+        record's schema only widens under the flag (the disabled-mode
+        byte-identity pin).
+        """
+        if not self._xprof.enabled:
+            return {}
+        mem = self._hbm.sample()
+        fields = {
+            k: mem[k]
+            for k in (
+                "hbm_used_bytes", "hbm_high_water_bytes",
+                "hbm_headroom_frac",
+            )
+            if k in mem
+        }
+        if self.tracer.enabled and mem:
+            self.tracer.counter(
+                "hbm",
+                {
+                    "used_bytes": mem["hbm_used_bytes"],
+                    "high_water_bytes": mem["hbm_high_water_bytes"],
+                },
+            )
+        self._xprof_cursor, events = self._xprof.events_after(
+            self._xprof_cursor
+        )
+        for ev in events:
+            rec = {
+                k: ev[k]
+                for k in (
+                    "label", "signature", "shape_diff",
+                    "compile_time_s", "flops",
+                )
+                if ev.get(k) is not None
+            }
+            self.metrics_writer.write("compile", **rec)
+            self._recorder.record("compile", **rec)
+        # Hand-ledger vs compiled-program collectives, once per run:
+        # the ddp/zero strategies price their per-step payload
+        # analytically (parallel/zero.py); the first compiled
+        # train_step says what XLA actually emits. World 1 has no
+        # collectives to check.
+        if (
+            self._comm_bytes is not None
+            and not self._comm_checked
+            and self.data_shards >= 2
+        ):
+            check = self._xprof.comm_check(
+                "train_step", self._comm_bytes, self.data_shards
+            )
+            if check is not None:
+                self._comm_checked = True
+                self.metrics_writer.write("xprof_check", **check)
+                if check["within_tolerance"]:
+                    logger.info(
+                        "xprof comm check: analytic %d bytes vs HLO %d "
+                        "(ratio %s) — within tolerance",
+                        check["expected_comm_bytes"],
+                        check["measured_comm_bytes"],
+                        check["ratio"],
+                    )
+                else:
+                    logger.warning(
+                        "xprof comm check FAILED: analytic %d bytes vs "
+                        "HLO-derived %d (ratio %s) — the comm_bytes "
+                        "estimate drifted from the compiled program",
+                        check["expected_comm_bytes"],
+                        check["measured_comm_bytes"],
+                        check["ratio"],
+                    )
+        self._prom_state["compile_programs"] = self._xprof.program_count
+        self._prom_state["compile_seconds_total"] = round(
+            self._xprof.total_compile_s, 4
+        )
+        self._prom_state.update(fields)
         return fields
 
     def _prom_snapshot(self) -> dict:
@@ -2372,6 +2516,12 @@ class Trainer:
                             8,
                         )
                         obs_fields = self._step_obs_fields(timing)
+                        # Device-memory sample + compile-event drain
+                        # (host-side reads, no device sync — inside
+                        # the window only because metrics/recorder
+                        # writes belong with the other log-cadence
+                        # bookkeeping). {} when --xprof is off.
+                        xprof_fields = self._xprof_step_fields()
                     self.metrics_writer.write(
                         "step",
                         epoch=epoch,
@@ -2381,6 +2531,7 @@ class Trainer:
                         lr=lr_now,
                         **gn,
                         **obs_fields,
+                        **xprof_fields,
                         # Analytic per-step collective payload
                         # (parallel/zero.py estimates — static per
                         # strategy, no sync): present on the ddp/zero
@@ -2477,6 +2628,16 @@ class Trainer:
             )
         if self._comm_bytes is not None:
             extra["comm_bytes"] = self._comm_bytes
+        if self._xprof.enabled:
+            # Epoch-boundary memory sample + compile totals (the drain
+            # inside also flushes compiles paid outside the log
+            # cadence — eval, restore — to the metrics stream).
+            xf = self._xprof_step_fields()
+            for k in ("hbm_high_water_bytes", "hbm_headroom_frac"):
+                if k in xf:
+                    extra[k] = xf[k]
+            extra["compile_s"] = round(self._xprof.total_compile_s, 4)
+            extra["compiled_programs"] = self._xprof.program_count
         self.metrics_writer.write(
             "epoch",
             epoch=epoch,

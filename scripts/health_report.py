@@ -198,6 +198,57 @@ def build_report(records: list[dict]) -> str:
     if any("recompiles" in e for e in epochs):
         lines.append(f"recompiles    : {recompiles}")
 
+    # Compiled-program triage (--xprof streams): what the run paid in
+    # XLA builds — and, when a compile was a RE-compile, the culprit
+    # label and shape-diff. Only printed when the stream carries
+    # "compile" records, so pre-xprof reports stay byte-identical.
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    if compiles:
+        total = sum(r.get("compile_time_s") or 0.0 for r in compiles)
+        by_label: dict[str, int] = {}
+        for c in compiles:
+            lbl = c.get("label") or "?"
+            by_label[lbl] = by_label.get(lbl, 0) + 1
+        detail = ", ".join(
+            f"{k}: {v}" for k, v in sorted(by_label.items())
+        )
+        lines.append(
+            f"compiles      : {len(compiles)} ({detail}), "
+            f"{_fmt(total, 2)}s total"
+        )
+        recompiled = [c for c in compiles if c.get("shape_diff")]
+        if recompiled:
+            last = recompiled[-1]
+            lines.append(
+                f"                last recompile: {last.get('label')} "
+                f"[{last.get('shape_diff')}] "
+                f"{_fmt(last.get('compile_time_s'), 2)}s"
+            )
+
+    # Device-memory triage (--xprof): the high-water across the run
+    # and the latest headroom (absent off-TPU — no honest limit).
+    # STREAM order, not steps-then-epochs concatenation: a run that
+    # finished epoch N and then OOM'd mid-epoch N+1 has its freshest
+    # (lowest) headroom in step records written AFTER the epoch-N
+    # record, and the "latest" value must be the last one written.
+    hbm = [
+        r
+        for r in records
+        if r.get("kind") in ("step", "epoch")
+        and r.get("hbm_high_water_bytes") is not None
+    ]
+    if hbm:
+        high = max(int(r["hbm_high_water_bytes"]) for r in hbm)
+        line = f"hbm           : high-water {high:,} bytes"
+        fracs = [
+            r["hbm_headroom_frac"]
+            for r in hbm
+            if r.get("hbm_headroom_frac") is not None
+        ]
+        if fracs:
+            line += f" (headroom {_fmt(100.0 * fracs[-1], 1)}%)"
+        lines.append(line)
+
     # Collective-payload estimate (the ddp/zero update strategies
     # stamp it — parallel/zero.py): only printed when present, so
     # pre-zero streams keep their golden output byte-identical.

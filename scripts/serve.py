@@ -100,6 +100,13 @@ def main() -> None:
         "scripts/lint.py; docs/ANALYSIS.md)",
     )
     p.add_argument(
+        "--xprof", action="store_true",
+        help="compiled-program introspection (ddp_tpu.obs.xprof): the "
+        "engine's program set dispatches through a compile ledger "
+        "(XLA FLOPs/memory per executable), /metricsz gains compile "
+        "and HBM gauges, and /stats carries the full ledger",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -115,6 +122,7 @@ def main() -> None:
 
     from ddp_tpu.models.lm import LMSpec, init_lm
     from ddp_tpu.obs.tracer import Tracer
+    from ddp_tpu.obs.xprof import Xprof
     from ddp_tpu.serve.engine import ServeEngine
     from ddp_tpu.serve.server import LMServer
     from ddp_tpu.utils.metrics import MetricsWriter
@@ -162,6 +170,7 @@ def main() -> None:
         metrics=metrics,
         tracer=tracer,
         sanitize=args.sanitize,
+        xprof=Xprof(enabled=args.xprof),
     )
     if not args.no_warmup:
         # Compile the bounded program set (one chunk program per

@@ -80,6 +80,22 @@ def merge_traces(paths: list[str]) -> dict:
     # Stable cross-rank ordering for humans scrolling raw JSON;
     # Perfetto orders by ts itself, metadata events lead.
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    # Counter tracks (ph "C" — the HBM used/high-water track from
+    # --xprof rides these): Perfetto renders the per-rank tracks from
+    # the events themselves; the sidecar summarizes each series'
+    # sample count and max so a merged trace answers "how high did
+    # memory get on any rank" without opening the UI.
+    counters: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "C":
+            continue
+        for series, value in (ev.get("args") or {}).items():
+            if not isinstance(value, (int, float)):
+                continue
+            key = f"{ev.get('name')}:{series}"
+            ent = counters.setdefault(key, {"samples": 0, "max": value})
+            ent["samples"] += 1
+            ent["max"] = max(ent["max"], value)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -87,6 +103,7 @@ def merge_traces(paths: list[str]) -> dict:
             "merged_from": [os.path.basename(p) for p in paths],
             "ranks": ranks,
             "dropped_events": dropped,
+            **({"counters": counters} if counters else {}),
             "span_summaries": {
                 n: s.to_state() for n, s in merged_summaries.items()
             },
@@ -123,6 +140,11 @@ def main(argv=None) -> None:
                 "events": len(merged["traceEvents"]),
                 "span_names": sorted(
                     merged["ddp_tpu"]["span_summaries"]
+                ),
+                **(
+                    {"counters": merged["ddp_tpu"]["counters"]}
+                    if "counters" in merged["ddp_tpu"]
+                    else {}
                 ),
             }
         )

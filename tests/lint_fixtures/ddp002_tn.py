@@ -40,3 +40,36 @@ def zero_update_shard(flat_grads, param_shard, lr):
     world = int(flat_grads.shape[0] // param_shard.shape[0])
     new_shard = param_shard - lr * shard / world
     return jax.lax.all_gather(new_shard, "data", tiled=True)
+
+
+def xprof_memory_hook(devices, live_arrays, metrics):
+    # the xprof hook pattern (obs/xprof.py DeviceMemorySampler): host
+    # code sampling device.memory_stats() and accounting live-buffer
+    # bytes with host numpy — outside any jit root, so host rules
+    # apply even though a jit-owning module defines it
+    per = {}
+    for d in devices:
+        stats = d.memory_stats()
+        if stats:
+            per[d] = int(stats.get("bytes_in_use", 0))
+    for arr in live_arrays:
+        for s in arr.addressable_shards:
+            per[s.device] = per.get(s.device, 0) + int(
+                np.asarray(s.data.shape).prod()
+            )
+    metrics.write("hbm", used=max(per.values(), default=0))
+    return per
+
+
+def xprof_instrumented_dispatch(fn, args, ledger):
+    # the AOT-wrapper pattern: lower/compile on the host, ledger the
+    # introspection, dispatch the compiled program — no host sync of
+    # any traced value
+    compiled = fn.lower(*args).compile()
+    ledger.append(
+        {
+            "flops": compiled.cost_analysis(),
+            "memory": compiled.memory_analysis(),
+        }
+    )
+    return compiled(*args)

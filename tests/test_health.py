@@ -493,10 +493,19 @@ _REPORT_FIXTURE = [
     {"kind": "fallback", "time": 0.5, "epoch": 2, "resumed_epoch": 1,
      "quarantined_path": "ck/quarantine.epoch-2",
      "problems": ["default/d/abc: checksum mismatch"]},
+    {"kind": "compile", "time": 0.8, "label": "train_step",
+     "signature": "tree(7 leaves, 520587 elems)|u8[8,28,28,1]|i32[8]",
+     "compile_time_s": 0.52, "flops": 698609600.0},
     {"kind": "step", "time": 1, "epoch": 0, "batch": 0, "step": 1,
      "loss": 2.5, "lr": 0.01, "grad_norm": 4.0, "input_wait_s": 0.01,
      "dispatch_s": 0.001, "compute_s": 0.089, "recompiles": 1,
-     "mfu": 0.02},
+     "mfu": 0.02, "hbm_used_bytes": 2094980,
+     "hbm_high_water_bytes": 2094980},
+    {"kind": "compile", "time": 1.6, "label": "train_step",
+     "signature": "tree(7 leaves, 520587 elems)|u8[4,28,28,1]|i32[4]",
+     "shape_diff": "arg1: u8[8,28,28,1]->u8[4,28,28,1]; "
+     "arg2: i32[8]->i32[4]",
+     "compile_time_s": 0.31, "flops": 349304800.0},
     {"kind": "step", "time": 2, "epoch": 0, "batch": 2, "step": 3,
      "loss": 2.0, "lr": 0.01, "grad_norm": 5.5, "input_wait_s": 0.02,
      "dispatch_s": 0.001, "compute_s": 0.079, "recompiles": 0,
@@ -511,7 +520,9 @@ _REPORT_FIXTURE = [
      "mfu": 0.02},
     {"kind": "epoch", "time": 4, "epoch": 0, "batches": 6,
      "seconds": 0.6, "images_per_sec": 320.0, "mean_loss": 2.25,
-     "mfu": 0.02, "goodput": 0.9, "recompiles": 1, "health_events": 2},
+     "mfu": 0.02, "goodput": 0.9, "recompiles": 1, "health_events": 2,
+     "hbm_high_water_bytes": 2095072, "hbm_headroom_frac": 0.85,
+     "compile_s": 0.83, "compiled_programs": 2},
     {"kind": "final", "time": 5, "accuracy": 0.5, "loss": None,
      "epochs_run": 1,
      "goodput": {"productive_s": 0.6, "wall_s": 1.0, "goodput": 0.6,
