@@ -65,6 +65,26 @@ def _env_fields() -> dict:
     }
 
 
+def _assert_provenance(fields: dict) -> None:
+    """Pin a record's published provenance to the LIVE backend.
+
+    ``_env_fields`` output asserted against a fresh read of jax at
+    publish time: a stale dict captured before a backend flip, copied
+    from another record, or mutated downstream fails loudly here
+    instead of poisoning the perf trajectory (the r02-r05 stale-capture
+    lesson — a CPU record that claims otherwise is worse than no
+    record).
+    """
+    import jax
+
+    live = jax.devices()[0].platform
+    assert (
+        fields["platform"] == live
+        and fields["backend"] == jax.default_backend()
+        and fields["cpu_fallback"] == (live == "cpu")
+    ), (fields, live)
+
+
 def run_bench(
     *,
     global_batch_size: int = 16384,
@@ -207,12 +227,19 @@ def run_bench(
         trace = tracer.export(_bench_trace_path("mnist_ddp"))
     except OSError:
         trace = None  # read-only checkout: the record survives
+    env = _env_fields()
+    # Stale-trajectory guard (ISSUE 10 satellite): the headline's
+    # provenance fields are what makes the next TPU-reachable capture
+    # comparable against BENCH_LKG.json — assert they are present and
+    # self-consistent before the record is published (_finalize embeds
+    # the last on-chip record whenever cpu_fallback is True).
+    _assert_provenance(env)
     return {
         "metric": "mnist_ddp_train_throughput",
         "value": round(per_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
-        **_env_fields(),
+        **env,
         "num_chips": len(devices),
         "global_batch_size": global_batch_size,
         "timed_epochs": timed_epochs,
@@ -739,7 +766,7 @@ def run_serve_bench(
     # (per-length prefill, per-config decode) fails the bench before
     # it pollutes a published record.
     compile_counts = engine.warmup()
-    compile_budget = 2 * len(engine.buckets) + 1
+    compile_budget = engine.compile_budget()
     assert sum(compile_counts.values()) <= compile_budget, (
         f"engine program set {compile_counts} exceeds its budget of "
         f"2 x {len(engine.buckets)} chunk buckets + 1 decode program"
@@ -821,10 +848,139 @@ def run_serve_bench(
         trace = tracer.export(_bench_trace_path("serve_decode"))
     except OSError:
         trace = None
+
+    # ---- decode-path variants (ISSUE 10) ----------------------------
+    # Same model, same traffic, four engine configs: the PR-3 baseline
+    # (jnp reference attention, fp32 cache), flash-decode (the engine's
+    # auto selection: Pallas kernel on TPU, the bit-identical reference
+    # off-TPU — forcing the interpreter here would measure the
+    # interpreter, not the kernel), +speculative (γ=4 greedy drafts
+    # from a truncated-depth draft sharing the target's weights — the
+    # zero-training draft; --draft_checkpoint_dir wires a real one),
+    # and +int8 KV (quantize-on-write cache). Each sub-record carries
+    # steady-state step-latency p50/p99, tokens/s, acceptance, cache
+    # bytes/slot, and the PR-9 provenance fields so a CPU-fallback
+    # capture can never be compared against an on-chip one.
+    from ddp_tpu.utils.metrics import StatSummary as _SS
+
+    def _variant(name: str, **ekw) -> dict:
+        v_eng = ServeEngine(
+            spec, params, slots=slots, prefill_len=prefill_len,
+            max_queue=4 * slots, **ekw,
+        )
+        counts = v_eng.warmup()
+        assert sum(counts.values()) <= v_eng.compile_budget(), (
+            f"variant {name} program set {counts} exceeds its budget "
+            f"{v_eng.compile_budget()}"
+        )
+        v_rng = np.random.default_rng(seed + 1)  # same traffic per variant
+        for _ in range(2 * slots):
+            plen = int(v_rng.integers(8, max(9, prefill_len // 2 + 1)))
+            v_eng.submit(
+                v_rng.integers(0, vocab, plen).tolist(), new_tokens
+            )
+        v_eng.step()  # settle admission/prefill before timing
+        v_eng.step_latency = _SS()
+        v0 = time.perf_counter()
+        while v_eng.pending:
+            v_eng.step()
+        v_wall = time.perf_counter() - v0
+        v_tokens = sum(
+            len(c.tokens) for c in v_eng._completed.values()
+        )
+        lat = v_eng.step_latency
+        assert v_eng.compile_counts() == counts, (
+            f"variant {name} recompiled after warmup"
+        )
+        return {
+            "attn_impl": v_eng.decode_attn,
+            "kv_dtype": v_eng.kv_dtype,
+            "spec_tokens": v_eng.spec_tokens,
+            "step_latency_s": {
+                "count": lat.count,
+                "p50": round(lat.percentile(50), 6) if lat.count else None,
+                "p99": round(lat.percentile(99), 6) if lat.count else None,
+            },
+            "tokens_per_s": round(v_tokens / v_wall, 1),
+            "total_tokens": v_tokens,
+            "acceptance_rate": v_eng.spec_acceptance_rate(),
+            "cache_bytes_per_slot": v_eng.cache_bytes_per_slot(),
+            "compile_programs": sum(counts.values()),
+            "compile_budget": v_eng.compile_budget(),
+            **_env_fields(),
+        }
+
+    # Truncated-depth draft sharing the target's weights: the cheapest
+    # "small draft LM from models/lm.py" that exists without a second
+    # training run. On random init its proposals barely correlate with
+    # the target (acceptance is reported, not assumed); a trained
+    # draft checkpoint slots into the same machinery via
+    # scripts/serve.py --draft_checkpoint_dir.
+    draft_spec = spec._replace(depth=max(1, depth // 2))
+    draft_params = {
+        k: params[k]
+        for k in ["embed", "pos_embed", "ln_final"]
+        + [f"block{i + 1}" for i in range(draft_spec.depth)]
+    }
+    variants = {
+        "baseline": _variant("baseline", decode_attn="reference"),
+        "flash_decode": _variant("flash_decode", decode_attn="auto"),
+        "spec": _variant(
+            "spec", decode_attn="auto",
+            draft_spec=draft_spec, draft_params=draft_params,
+            spec_tokens=4,
+        ),
+        # Perfectly-aligned draft (the target itself): acceptance-1.0
+        # ceiling — measures the verify-round mechanics (γ tokens per
+        # target step) with the draft-quality variable removed.
+        "spec_selfdraft": _variant(
+            "spec_selfdraft", decode_attn="auto",
+            draft_spec=spec, draft_params=params, spec_tokens=4,
+        ),
+        "int8_kv": _variant("int8_kv", decode_attn="auto",
+                            kv_dtype="int8"),
+    }
+    base_bytes = variants["baseline"]["cache_bytes_per_slot"]
+    int8_bytes = variants["int8_kv"]["cache_bytes_per_slot"]
+    assert int8_bytes <= 0.55 * base_bytes, (
+        f"int8 KV cache bytes/slot {int8_bytes} did not halve the "
+        f"fp32 layout {base_bytes}"
+    )
+
+    env = _env_fields()
+    # Satellite 6 (stale on-chip trajectory): the provenance fields
+    # are load-bearing for the next TPU-reachable capture — assert
+    # they exist and agree before publishing, and say loudly when
+    # this record is a CPU fallback.
+    _assert_provenance(env)
     return {
         "metric": "serve_decode_throughput",
         "value": round(total_tokens / wall, 1),
-        **_env_fields(),
+        **env,
+        **(
+            {
+                "note": "CPU-fallback capture: decode-path variant "
+                "latencies are CPU-bound (flash-decode auto-selects "
+                "the reference path off-TPU); compare on-chip records "
+                "only against BENCH_LKG.json"
+            }
+            if env["cpu_fallback"]
+            else {}
+        ),
+        "variants": variants,
+        "flash_p50_vs_baseline": (
+            round(
+                variants["flash_decode"]["step_latency_s"]["p50"]
+                / variants["baseline"]["step_latency_s"]["p50"],
+                3,
+            )
+            if variants["baseline"]["step_latency_s"]["p50"]
+            else None
+        ),
+        "int8_cache_bytes_ratio": round(int8_bytes / base_bytes, 3),
+        # How many int8 lanes fit in the HBM one fp32 lane occupies —
+        # the slots-per-chip capacity story.
+        "int8_slots_capacity_gain": round(base_bytes / int8_bytes, 2),
         "mfu": round(
             (total_tokens / wall) * fwd_per_token
             / peak_flops_per_chip(device),

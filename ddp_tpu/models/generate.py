@@ -29,6 +29,11 @@ from jax import lax
 
 from ddp_tpu.models.lm import LMSpec
 from ddp_tpu.ops.attention import best_attention, dot_product_attention
+from ddp_tpu.ops.decode import (
+    decode_attention,
+    dequantize_kv,
+    quantize_kv,
+)
 
 
 class DecodeCache(NamedTuple):
@@ -460,27 +465,101 @@ class SlotCache(NamedTuple):
     slot is a lane of the batch dim), but ``pos`` is [S] int32: every
     slot decodes at its own position, so a mixed-age batch (one
     request 5 tokens in, another 200) advances in one step.
+
+    ``k_scale``/``v_scale`` ([depth, S, total_len, H_kv] fp32) exist
+    only for int8-quantized caches (``init_slot_cache(...,
+    dtype=jnp.int8)`` — ops/decode.quantize_kv per-head scales,
+    written alongside every K/V row); fp32/bf16 caches carry empty
+    tuples there, so the plain cache's pytree (and every donation
+    path over it) is unchanged. ``quantized()`` is a trace-time
+    dispatch: dtype is static under jit.
     """
 
     k: jax.Array
     v: jax.Array
     pos: jax.Array
+    k_scale: Any = ()
+    v_scale: Any = ()
+
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
 
 
 def init_slot_cache(
     spec: LMSpec, slots: int, dtype=jnp.float32
 ) -> SlotCache:
+    """``dtype=jnp.int8`` allocates the quantized variant: int8 K/V
+    plus per-(position, head) fp32 scales — cache HBM per slot drops
+    to ~(1 + 4/Dh)/8 of the fp32 layout, the ``slots``-per-chip
+    capacity win `bench.py serve_decode` measures."""
     head_dim = spec.d_model // spec.num_heads
     shape = (spec.depth, slots, spec.total_len, _kv_heads(spec), head_dim)
+    # Two DISTINCT buffers: the cache is donated through every engine
+    # program, and aliased leaves ((x,) * 2) make XLA reject the
+    # donation ("same buffer twice").
+    scales = (
+        (jnp.zeros(shape[:-1], jnp.float32),
+         jnp.zeros(shape[:-1], jnp.float32))
+        if dtype == jnp.int8
+        else ((), ())
+    )
     return SlotCache(
         k=jnp.zeros(shape, dtype),
         v=jnp.zeros(shape, dtype),
         pos=jnp.zeros((slots,), jnp.int32),
+        k_scale=scales[0],
+        v_scale=scales[1],
     )
 
 
+def _write_kv_rows(cache: SlotCache, layer: int, k, v, pos):
+    """Write per-lane K/V rows at each lane's position, in place.
+
+    ``k``/``v``: [S, T, H_kv, Dh] float rows for positions
+    ``pos[s]..pos[s]+T-1``. On a quantized cache the rows quantize on
+    write (ops/decode.quantize_kv — int8 rows + per-head scales), so
+    the cache never holds full-precision lines. Returns the updated
+    cache. The vmapped ``dynamic_update_slice`` clamps per lane, so
+    callers must pre-clamp ``pos`` when T > 1 (a clamp-shift would
+    silently move the write over live lines).
+    """
+    write = jax.vmap(
+        lambda lane, row, p: lax.dynamic_update_slice(
+            lane, row, (p, 0, 0)
+        )
+    )  # ([S, L, H_kv, Dh], [S, T, H_kv, Dh], [S]) → written lanes
+    ck, cv, ksc, vsc = cache.k, cache.v, cache.k_scale, cache.v_scale
+    if cache.quantized():
+        write_sc = jax.vmap(
+            lambda lane, row, p: lax.dynamic_update_slice(
+                lane, row, (p, 0)
+            )
+        )  # ([S, L, H_kv], [S, T, H_kv], [S])
+        qk, k_s = quantize_kv(k)
+        qv, v_s = quantize_kv(v)
+        ck = ck.at[layer].set(write(ck[layer], qk, pos))
+        cv = cv.at[layer].set(write(cv[layer], qv, pos))
+        ksc = ksc.at[layer].set(write_sc(ksc[layer], k_s, pos))
+        vsc = vsc.at[layer].set(write_sc(vsc[layer], v_s, pos))
+    else:
+        ck = ck.at[layer].set(write(ck[layer], k.astype(ck.dtype), pos))
+        cv = cv.at[layer].set(write(cv[layer], v.astype(cv.dtype), pos))
+    return cache._replace(k=ck, v=cv, k_scale=ksc, v_scale=vsc)
+
+
+def _lane_scales(cache: SlotCache, layer: int):
+    if cache.quantized():
+        return cache.k_scale[layer], cache.v_scale[layer]
+    return None, None
+
+
 def slot_decode_step(
-    spec: LMSpec, params: Any, cache: SlotCache, tokens: jax.Array
+    spec: LMSpec,
+    params: Any,
+    cache: SlotCache,
+    tokens: jax.Array,
+    *,
+    attn_impl: str = "reference",
 ) -> tuple[jax.Array, SlotCache]:
     """decode_step with per-slot positions → (logits [S, V], cache).
 
@@ -496,13 +575,19 @@ def slot_decode_step(
     clamped at ``total_len`` so an idle slot can sit in the batch
     indefinitely without indexing past the cache (writes at the clamp
     land on the last line, which a refill overwrites).
+
+    ``attn_impl`` (Python-static — the engine compiles its choice in)
+    picks the banded single-query attention: ``reference`` is the
+    ops/decode jnp path, bit-identical to the math that used to live
+    inline here; ``flash``/``auto`` route through the Pallas
+    flash-decode kernel (ops/decode.py). On an int8 cache both paths
+    dequantize at the compute site.
     """
     embed = params["embed"]
     S = tokens.shape[0]
     H = spec.num_heads
     Dh = spec.d_model // H
     H_kv = _kv_heads(spec)
-    G = H // H_kv
     pos = cache.pos  # [S]
     x = embed[tokens][:, None, :]  # [S, 1, d]
     # Per-slot position embedding: row s reads pos_embed[pos[s]].
@@ -510,38 +595,21 @@ def slot_decode_step(
     x = x + pe[jnp.minimum(pos, spec.total_len - 1)][:, None, :].astype(
         x.dtype
     )
-    live = (
-        jnp.arange(spec.total_len)[None, :] <= pos[:, None]
-    )[:, None, None, :]  # [S, 1, 1, L]
-    write = jax.vmap(
-        lambda lane, row, p: lax.dynamic_update_slice(
-            lane, row, (p, 0, 0)
-        )
-    )  # ([S, L, H_kv, Dh], [S, 1, H_kv, Dh], [S]) → written lanes
-    ck, cv = cache.k, cache.v
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
-        ck = ck.at[i].set(write(ck[i], k, pos))
-        cv = cv.at[i].set(write(cv[i], v, pos))
-        qg = q[:, 0].reshape(S, H_kv, G, Dh)
-        logits = (
-            jnp.einsum(
-                "bkgd,blkd->bkgl",
-                qg.astype(jnp.float32),
-                ck[i].astype(jnp.float32),
-            )
-            * Dh**-0.5
-        )  # [S, H_kv, G, L]
-        logits = jnp.where(live, logits, -jnp.inf)
-        w = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bkgl,blkd->bkgd", w, cv[i].astype(jnp.float32))
+        cache = _write_kv_rows(cache, i, k, v, pos)
+        ksc, vsc = _lane_scales(cache, i)
+        attn = decode_attention(
+            q[:, 0], cache.k[i], cache.v[i], pos, ksc, vsc,
+            impl=attn_impl,
+        )  # [S, H, Dh] fp32
         attn = attn.reshape(S, 1, spec.d_model).astype(x.dtype)
         x = _block_finish(spec, p, x, attn)
     x = _layer_norm(x, params["ln_final"])
     out_logits = (x[:, 0] @ embed.T.astype(jnp.float32)).astype(jnp.float32)
-    return out_logits, SlotCache(
-        k=ck, v=cv, pos=jnp.minimum(pos + 1, spec.total_len)
+    return out_logits, cache._replace(
+        pos=jnp.minimum(pos + 1, spec.total_len)
     )
 
 
@@ -638,45 +706,16 @@ def sample_slot_tokens(
     only runs when some lane actually sets top_p < 1. Mostly-greedy
     serving traffic therefore pays (almost) nothing for the fused
     sampling path — the reason the old engine kept sampling on host.
+
+    Exactly the K=1 specialization of ``sample_slot_tokens_block``
+    (offset 0 folds in ``steps + 0``), and implemented as such: the
+    speculative path's seeded-acceptance guarantee depends on the two
+    key-derivation/gating paths staying bit-identical, so there is
+    only one.
     """
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    sampling = temps > 0.0
-
-    def drawn(_):
-        keys = jax.vmap(
-            lambda s, st: jax.random.fold_in(jax.random.key(s), st)
-        )(seeds, steps)
-        safe_t = jnp.where(sampling, temps, jnp.float32(1.0))
-        scaled = logits.astype(jnp.float32) / safe_t[:, None]
-
-        def filtered(s):
-            # Per-lane blend (a vmapped cond would lower to select
-            # anyway): the lanes at top_p == 1.0 keep their unfiltered
-            # row bit-identical to generate.
-            return jax.vmap(
-                lambda row, p: jnp.where(
-                    p < 1.0, nucleus_filter(row, p), row
-                )
-            )(s, top_ps)
-
-        # Hoisted gates — these conds sit OUTSIDE the vmap, so the
-        # branch skip is real: the vocab sort only runs when some lane
-        # actually set top_p < 1.
-        cand = lax.cond(
-            jnp.any(sampling & (top_ps < 1.0)),
-            filtered,
-            lambda s: s,
-            scaled,
-        )
-        return jax.vmap(
-            lambda k, c: jax.random.categorical(k, c, axis=-1)
-        )(keys, cand).astype(jnp.int32)
-
-    # ...and a pure-greedy batch never derives a key at all.
-    toks = lax.cond(
-        jnp.any(sampling), drawn, lambda _: greedy, operand=None
-    )
-    return jnp.where(sampling, toks, greedy)
+    return sample_slot_tokens_block(
+        logits[:, None, :], seeds, steps, temps, top_ps
+    )[:, 0]
 
 
 def slot_decode_sample_step(
@@ -688,6 +727,8 @@ def slot_decode_sample_step(
     steps: jax.Array,
     temps: jax.Array,
     top_ps: jax.Array,
+    *,
+    attn_impl: str = "reference",
 ) -> tuple[jax.Array, SlotCache, jax.Array]:
     """``slot_decode_step`` with sampling fused → ([S] int32, cache,
     advanced step counters).
@@ -705,9 +746,183 @@ def slot_decode_sample_step(
     garbage the engine ignores — their logits are finite (position 0
     is always live), so no NaN can propagate.
     """
-    logits, cache = slot_decode_step(spec, params, cache, tokens)
+    logits, cache = slot_decode_step(
+        spec, params, cache, tokens, attn_impl=attn_impl
+    )
     toks = sample_slot_tokens(logits, seeds, steps, temps, top_ps)
     return toks, cache, steps + 1
+
+
+def sample_slot_tokens_block(
+    logits: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    temps: jax.Array,
+    top_ps: jax.Array,
+) -> jax.Array:
+    """Per-(slot, offset) sampling over [S, K, V] logits → [S, K] int32.
+
+    The verify-step sibling of ``sample_slot_tokens``: offset j of
+    lane s samples under ``fold_in(key(seeds[s]), steps[s] + j)`` —
+    the EXACT key the non-speculative loop would use for that lane's
+    (steps[s] + j)-th emitted token, which is what makes speculative
+    acceptance exact for seeded sampling (the target's tokens are the
+    same stream, just computed K at a time). Same runtime gating: a
+    pure-greedy batch runs one argmax, the nucleus sort only runs
+    when some lane set top_p < 1.
+    """
+    S, K, _V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    sampling = temps > 0.0
+
+    def drawn(_):
+        def lane_keys(s, st):
+            return jax.vmap(
+                lambda j: jax.random.fold_in(jax.random.key(s), st + j)
+            )(jnp.arange(K))
+
+        keys = jax.vmap(lane_keys)(seeds, steps)  # [S, K] keys
+        safe_t = jnp.where(sampling, temps, jnp.float32(1.0))
+        scaled = logits.astype(jnp.float32) / safe_t[:, None, None]
+
+        def filtered(sc):
+            return jax.vmap(
+                lambda rows, p: jax.vmap(
+                    lambda row: jnp.where(
+                        p < 1.0, nucleus_filter(row, p), row
+                    )
+                )(rows)
+            )(sc, top_ps)
+
+        cand = lax.cond(
+            jnp.any(sampling & (top_ps < 1.0)),
+            filtered,
+            lambda sc: sc,
+            scaled,
+        )
+        return jax.vmap(
+            jax.vmap(lambda k, c: jax.random.categorical(k, c, axis=-1))
+        )(keys, cand).astype(jnp.int32)
+
+    toks = lax.cond(
+        jnp.any(sampling), drawn, lambda _: greedy, operand=None
+    )
+    return jnp.where(sampling[:, None], toks, greedy)
+
+
+def slot_verify_step(
+    spec: LMSpec,
+    params: Any,
+    cache: SlotCache,
+    tokens: jax.Array,
+    drafts: jax.Array,
+    seeds: jax.Array,
+    steps: jax.Array,
+    temps: jax.Array,
+    top_ps: jax.Array,
+) -> tuple[jax.Array, SlotCache, jax.Array, jax.Array, jax.Array]:
+    """Speculative-decoding verify: score K draft tokens per lane in
+    ONE target-model step → ``(next_toks [S], cache, steps,
+    target_toks [S, K], matched [S])``.
+
+    ``tokens``: [S] — each lane's last accepted token (the decode
+    loop's ``_toks``); ``drafts``: [S, K] — the draft model's K
+    greedy proposals d_1..d_K. The target runs the K inputs
+    ``[token, d_1..d_{K-1}]`` at positions ``pos[s]..pos[s]+K-1``
+    under the banded per-lane mask (query j attends keys ``<=
+    pos[s]+j``) — a K-wide chunked forward over the SAME cache lanes
+    the decode step uses, K/V written (and on an int8 cache,
+    quantized) before attending. Each of the K positions then samples
+    the target's token with that position's own fold_in counter
+    (``sample_slot_tokens_block``), so ``target_toks[s]`` is exactly
+    the token stream the non-speculative loop would emit.
+
+    Acceptance is prefix-exact: ``matched[s]`` = leading positions
+    where draft == target. The lane emits ``n = min(matched + 1, K)``
+    tokens — the matched drafts plus the target's correction token
+    (or, on a full match, the K targets with no bonus: the K+1-th
+    logit was never computed) — and ``next_toks``/``pos``/``steps``
+    advance by exactly n per lane, so rejected positions' K/V rows
+    sit above ``pos`` (never attendable) until the next round
+    overwrites them — the engine's write-before-attend invariant.
+    Output equivalence to the non-speculative stream is exact for
+    greedy AND seeded sampling (tests/test_spec_decode.py).
+
+    The write start is pre-clamped at ``total_len - K`` (the vmapped
+    ``dynamic_update_slice`` would clamp-shift over live lines
+    otherwise): the engine reserves K-1 positions at admission so a
+    LIVE lane never triggers the clamp — it only guards idle lanes
+    parked at the position ceiling.
+    """
+    embed = params["embed"]
+    S, K = drafts.shape
+    H = spec.num_heads
+    Dh = spec.d_model // H
+    H_kv = _kv_heads(spec)
+    G = H // H_kv
+    pos = cache.pos  # [S]
+    inputs = jnp.concatenate([tokens[:, None], drafts[:, :-1]], axis=1)
+    x = embed[inputs]  # [S, K, d]
+    pe = params["pos_embed"][0]  # [L, d]
+    offsets = jnp.arange(K, dtype=jnp.int32)
+    q_pos = jnp.minimum(
+        pos[:, None] + offsets[None, :], spec.total_len - 1
+    )  # [S, K]
+    x = x + pe[q_pos].astype(x.dtype)
+    wstart = jnp.minimum(pos, spec.total_len - K)
+    live = (
+        jnp.arange(spec.total_len)[None, None, :]
+        <= (pos[:, None] + offsets[None, :])[:, :, None]
+    )[:, None, None, :, :]  # [S, 1, 1, K, L]
+    for i in range(spec.depth):
+        p = params[f"block{i + 1}"]
+        q, k, v = _block_qkv(p, x, H, Dh, H_kv)
+        cache = _write_kv_rows(cache, i, k, v, wstart)
+        ksc, vsc = _lane_scales(cache, i)
+        kf = cache.k[i]
+        vf = cache.v[i]
+        if cache.quantized():
+            kf = dequantize_kv(kf, ksc)
+            vf = dequantize_kv(vf, vsc)
+        qg = q.reshape(S, K, H_kv, G, Dh)
+        logits = (
+            jnp.einsum(
+                "bqkgd,blkd->bkgql",
+                qg.astype(jnp.float32),
+                kf.astype(jnp.float32),
+            )
+            * Dh**-0.5
+        )  # [S, H_kv, G, K, L]
+        logits = jnp.where(live, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum(
+            "bkgql,blkd->bqkgd", w, vf.astype(jnp.float32)
+        )
+        attn = attn.reshape(S, K, spec.d_model).astype(x.dtype)
+        x = _block_finish(spec, p, x, attn)
+    x = _layer_norm(x, params["ln_final"])
+    out_logits = (x @ embed.T.astype(jnp.float32)).astype(jnp.float32)
+    target = sample_slot_tokens_block(
+        out_logits, seeds, steps, temps, top_ps
+    )  # [S, K]
+    # Leading exact matches: cumprod turns the first mismatch into a
+    # permanent zero, so the sum is the accepted-prefix length.
+    matched = (
+        jnp.cumprod((target == drafts).astype(jnp.int32), axis=1)
+        .sum(axis=1)
+        .astype(jnp.int32)
+    )  # [S], 0..K
+    n_emit = jnp.minimum(matched + 1, K)
+    next_toks = jnp.take_along_axis(
+        target, jnp.minimum(matched, K - 1)[:, None], axis=1
+    )[:, 0]
+    return (
+        next_toks,
+        cache._replace(pos=jnp.minimum(pos + n_emit, spec.total_len)),
+        steps + n_emit,
+        target,
+        matched,
+    )
 
 
 def prefill_chunk(
@@ -786,15 +1001,32 @@ def prefill_chunk(
         params["pos_embed"], start, C, axis=1
     )
     x = x + pe.astype(x.dtype)
+    quantized = cache.quantized()
     ck, cv = cache.k, cache.v
+    ksc, vsc = cache.k_scale, cache.v_scale
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
+        if quantized:
+            # Quantize-on-write (ops/decode.quantize_kv): the cache
+            # only ever holds int8 rows + per-head scales — chunked
+            # prefill is the bulk write path, so this is where the
+            # cache-bytes halving is actually earned.
+            wk, k_s = quantize_kv(k)
+            wv, v_s = quantize_kv(v)
+            ksc = lax.dynamic_update_slice(
+                ksc, k_s[:, None], (i, slot, start, 0)
+            )
+            vsc = lax.dynamic_update_slice(
+                vsc, v_s[:, None], (i, slot, start, 0)
+            )
+        else:
+            wk, wv = k.astype(ck.dtype), v.astype(cv.dtype)
         ck = lax.dynamic_update_slice(
-            ck, k.astype(ck.dtype)[:, None], (i, slot, start, 0, 0)
+            ck, wk[:, None], (i, slot, start, 0, 0)
         )
         cv = lax.dynamic_update_slice(
-            cv, v.astype(cv.dtype)[:, None], (i, slot, start, 0, 0)
+            cv, wv[:, None], (i, slot, start, 0, 0)
         )
         if lane_attend:
             lane_k = lax.dynamic_index_in_dim(
@@ -803,6 +1035,19 @@ def prefill_chunk(
             lane_v = lax.dynamic_index_in_dim(
                 cv[i], slot, axis=0, keepdims=False
             )
+            if quantized:
+                lane_k = dequantize_kv(
+                    lane_k,
+                    lax.dynamic_index_in_dim(
+                        ksc[i], slot, axis=0, keepdims=False
+                    ),
+                )
+                lane_v = dequantize_kv(
+                    lane_v,
+                    lax.dynamic_index_in_dim(
+                        vsc[i], slot, axis=0, keepdims=False
+                    ),
+                )
             attn = dot_product_attention(
                 q.astype(jnp.float32),
                 jnp.repeat(lane_k, G, axis=1)[None].astype(jnp.float32),
@@ -851,7 +1096,7 @@ def prefill_chunk(
     temps = put(temps, temperature[None].astype(temps.dtype), (slot,))
     top_ps = put(top_ps, top_p[None].astype(top_ps.dtype), (slot,))
     return (
-        SlotCache(k=ck, v=cv, pos=new_pos),
+        SlotCache(k=ck, v=cv, pos=new_pos, k_scale=ksc, v_scale=vsc),
         new_toks, seeds, steps, temps, top_ps, first,
     )
 

@@ -301,6 +301,28 @@ def render_serve(
             labels={"program": prog},
             help="jit cache entries (static-shape pin observable)",
         )
+    # Decode hot path (ISSUE 10): cache footprint + speculative
+    # acceptance. Absent keys (pre-decode-path engines, spec off)
+    # render nothing — absent and zero are different facts.
+    dp = stats.get("decode_path") or {}
+    b.add(
+        "ddp_tpu_serve_cache_bytes_per_slot",
+        dp.get("cache_bytes_per_slot"),
+        help="KV-cache HBM per decode lane, int8 scales included",
+    )
+    b.add(
+        "ddp_tpu_serve_spec_drafted_total", dp.get("spec_drafted_total"),
+        metric_type="counter", help="draft tokens proposed",
+    )
+    b.add(
+        "ddp_tpu_serve_spec_accepted_total",
+        dp.get("spec_accepted_total"),
+        metric_type="counter", help="draft tokens the target accepted",
+    )
+    b.add(
+        "ddp_tpu_serve_spec_acceptance", dp.get("spec_acceptance"),
+        help="lifetime accepted/drafted fraction",
+    )
     gp = stats.get("goodput") or {}
     b.add("ddp_tpu_serve_productive_seconds_total", gp.get("productive_s"),
           metric_type="counter")

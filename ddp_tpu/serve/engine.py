@@ -68,6 +68,8 @@ from ddp_tpu.models.generate import prefill_chunk as _prefill_chunk
 from ddp_tpu.models.generate import (
     slot_decode_sample_step as _decode_sample,
 )
+from ddp_tpu.models.generate import slot_decode_step as _decode_step
+from ddp_tpu.models.generate import slot_verify_step as _verify_step
 from ddp_tpu.models.lm import LMSpec
 from ddp_tpu.obs.tracer import Tracer
 from ddp_tpu.serve.scheduler import (
@@ -103,6 +105,10 @@ class Completion:
     decode_seconds: float  # first token → finish
     submitted: float
     finished: float
+    # Speculative decoding only: fraction of draft proposals the
+    # target accepted over this request's verify rounds (None on the
+    # non-speculative path, or before any round ran).
+    spec_acceptance: Optional[float] = None
 
     @property
     def decode_tokens_per_s(self) -> float:
@@ -123,6 +129,10 @@ class _Slot:
     emitted: int = 0
     prefill_pos: int = 0  # prompt tokens ingested so far
     first_token_at: Optional[float] = None  # None = no token observed
+    # Speculative-decoding tallies for this occupancy (host-side —
+    # the verify round's matched counts are fetched anyway).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def free(self) -> bool:
@@ -174,6 +184,11 @@ class ServeEngine:
         clock: Callable[[], float] = time.monotonic,
         sanitize: bool = False,
         xprof=None,
+        decode_attn: str = "auto",
+        kv_dtype: str = "fp32",
+        draft_spec: Optional[LMSpec] = None,
+        draft_params: Any = None,
+        spec_tokens: int = 0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -183,6 +198,72 @@ class ServeEngine:
                 f"prefill_len {prefill_len} must leave room to decode "
                 f"inside total_len {spec.total_len}"
             )
+        # Decode-attention impl (ops/decode.py): resolved ONCE, like
+        # best_attention — the flash-decode Pallas kernel on TPU, the
+        # bit-identical jnp reference elsewhere; "flash" forces the
+        # kernel (interpret mode off-TPU: how CPU tests pin token
+        # identity).
+        if decode_attn not in ("auto", "flash", "reference"):
+            raise ValueError(
+                f"decode_attn must be auto|flash|reference, got "
+                f"{decode_attn!r}"
+            )
+        if decode_attn == "auto":
+            decode_attn = (
+                "flash"
+                if jax.devices()[0].platform == "tpu"
+                else "reference"
+            )
+        self.decode_attn = decode_attn
+        if kv_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"kv_dtype must be fp32|int8, got {kv_dtype!r}"
+            )
+        self.kv_dtype = kv_dtype
+        # Speculative decoding: a draft LM proposes spec_tokens greedy
+        # continuations per lane; the target verifies them in ONE
+        # batched step (models/generate.slot_verify_step). The verify
+        # round writes K = spec_tokens rows per lane, so admission
+        # reserves K-1 cache lines (a lane one round short of budget
+        # may overshoot its context by up to K-2 positions — reserved
+        # rather than clamp-shifted over live lines).
+        if spec_tokens:
+            if spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got {spec_tokens}"
+                )
+            if draft_spec is None or draft_params is None:
+                raise ValueError(
+                    "speculative decoding needs draft_spec AND "
+                    "draft_params alongside spec_tokens"
+                )
+            if draft_spec.vocab_size != spec.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_spec.vocab_size} != target "
+                    f"vocab {spec.vocab_size}"
+                )
+            if draft_spec.total_len != spec.total_len:
+                raise ValueError(
+                    f"draft total_len {draft_spec.total_len} != target "
+                    f"total_len {spec.total_len} (the caches track the "
+                    "same positions)"
+                )
+            if spec_tokens >= spec.total_len - prefill_len:
+                raise ValueError(
+                    f"spec_tokens {spec_tokens} leaves no decode room "
+                    f"past prefill_len {prefill_len} in total_len "
+                    f"{spec.total_len}"
+                )
+        self.spec_tokens = int(spec_tokens)
+        self.draft_spec = draft_spec
+        self.draft_params = draft_params
+        # Admission context ceiling: the verify round's K-1 reserve
+        # comes off the budget check, never the cache geometry.
+        ctx_len = spec.total_len - max(0, self.spec_tokens - 1)
+        # Decode-path tokens dispatched per running lane per step: 1
+        # plain, K under speculation (the verify round processes K
+        # positions per lane — plan_chunks accounts them all).
+        tokens_per_decode = max(1, self.spec_tokens)
         chunk = next_pow2(
             prefill_chunk
             if prefill_chunk
@@ -207,19 +288,22 @@ class ServeEngine:
         self.prefill_len = prefill_len
         self.prefill_chunk = chunk
         self.min_bucket = min_bucket
+        self._ctx_len = ctx_len
+        self._tokens_per_decode = tokens_per_decode
         self.step_token_budget = (
             step_token_budget
             if step_token_budget
-            else chunk + slots
+            else chunk + slots * tokens_per_decode
         )
-        if self.step_token_budget < min_bucket + slots:
+        if self.step_token_budget < min_bucket + slots * tokens_per_decode:
             # Below this floor the prefill head can starve forever
             # while lanes decode (the budget never fits even the
             # smallest bucket after decode tokens are accounted).
             raise ValueError(
                 f"step_token_budget {self.step_token_budget} cannot "
                 f"sustain prefill progress: needs >= min_bucket "
-                f"({min_bucket}) + slots ({slots})"
+                f"({min_bucket}) + slots ({slots}) x decode tokens "
+                f"per lane ({tokens_per_decode})"
             )
         self.clock = clock
         self.metrics = metrics or MetricsWriter(None)
@@ -245,7 +329,7 @@ class ServeEngine:
         self.scheduler = Scheduler(
             max_queue=max_queue,
             prefill_len=prefill_len,
-            total_len=spec.total_len,
+            total_len=ctx_len,
             vocab_size=spec.vocab_size,
             chunk=chunk,
             min_bucket=min_bucket,
@@ -255,7 +339,10 @@ class ServeEngine:
         # {min_bucket · 2^i} ∪ {chunk}: the whole compiled-width set.
         self.buckets = self.scheduler.bucket_list()
         self._slots = [_Slot() for _ in range(slots)]
-        self._cache = init_slot_cache(spec, slots)
+        self._cache = init_slot_cache(
+            spec, slots,
+            dtype=jnp.int8 if kv_dtype == "int8" else jnp.float32,
+        )
         # Device-resident token vector: output of the last decode (or
         # chunk splice), input to the next — the decode loop never
         # routes tokens through the host. NOT donated anywhere: the
@@ -295,12 +382,12 @@ class ServeEngine:
         # bare function objects): jit tracing caches are shared per
         # function object, and the static-shape pin must be
         # per-engine.
-        def _chunk_fn(lane_attend):
+        def _chunk_fn(lane_attend, chunk_spec):
             return jax.jit(
                 lambda p, c, t, se, sp, tm, tp, s, ch, st, ln, fi, sd,
                 rtm, rtp: _prefill_chunk(
-                    spec, p, c, t, se, sp, tm, tp, s, ch, st, ln, fi,
-                    sd, rtm, rtp, lane_attend=lane_attend,
+                    chunk_spec, p, c, t, se, sp, tm, tp, s, ch, st, ln,
+                    fi, sd, rtm, rtp, lane_attend=lane_attend,
                 ),
                 donate_argnums=(1,),
             )
@@ -318,20 +405,75 @@ class ServeEngine:
         self._xprof = xprof if xprof is not None else Xprof(enabled=False)
         self._hbm = DeviceMemorySampler(enabled=self._xprof.enabled)
         self._chunk_first = self._xprof.instrument(
-            _chunk_fn(False), "serve.prefill_first"
+            _chunk_fn(False, spec), "serve.prefill_first"
         )
         self._chunk_cont = self._xprof.instrument(
-            _chunk_fn(True), "serve.prefill_chunk"
+            _chunk_fn(True, spec), "serve.prefill_chunk"
         )
+        impl = self.decode_attn
         self._decode = self._xprof.instrument(
             jax.jit(
                 lambda p, c, t, sd, st, tm, tp: _decode_sample(
-                    spec, p, c, t, sd, st, tm, tp
+                    spec, p, c, t, sd, st, tm, tp, attn_impl=impl
                 ),
                 donate_argnums=(1,),
             ),
-            "serve.decode",
+            # The label names the program actually built: recompile
+            # culprits and /metricsz compile gauges distinguish the
+            # kernel path from the jnp path.
+            "serve.flash_decode" if impl == "flash" else "serve.decode",
         )
+        if self.spec_tokens:
+            dspec = draft_spec
+            # Draft-side machinery: its OWN cache (the draft tracks
+            # the same token history at its own width) plus dummy
+            # per-slot sampling state for the chunk signature — the
+            # draft always proposes greedily, so none of it is read.
+            self._draft_cache = init_slot_cache(dspec, slots)
+            self._d_toks = jnp.zeros((slots,), jnp.int32)
+            self._d_seeds = jnp.zeros((slots,), jnp.int32)
+            self._d_steps = jnp.zeros((slots,), jnp.int32)
+            self._d_temps = jnp.zeros((slots,), jnp.float32)
+            self._d_top_ps = jnp.ones((slots,), jnp.float32)
+            # Device-resident sync flags: the first draft step of a
+            # round adopts the TARGET cache's per-lane positions (the
+            # draft advanced spec_tokens last round, the target only
+            # as far as acceptance went). Prebuilt so the sanitized
+            # hot loop uploads nothing.
+            self._sync_pos = jnp.asarray(True)
+            self._keep_pos = jnp.asarray(False)
+
+            def _draft_propose(p, c, t, pos, sync):
+                c = c._replace(pos=jnp.where(sync, pos, c.pos))
+                logits, c = _decode_step(
+                    dspec, p, c, t, attn_impl=impl
+                )
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+
+            self._draft_chunk_first = self._xprof.instrument(
+                _chunk_fn(False, dspec), "serve.draft_prefill_first"
+            )
+            self._draft_chunk_cont = self._xprof.instrument(
+                _chunk_fn(True, dspec), "serve.draft_prefill_chunk"
+            )
+            self._draft_decode = self._xprof.instrument(
+                jax.jit(_draft_propose, donate_argnums=(1,)),
+                "serve.draft_decode",
+            )
+            self._verify = self._xprof.instrument(
+                jax.jit(
+                    lambda p, c, t, dr, sd, st, tm, tp: _verify_step(
+                        spec, p, c, t, dr, sd, st, tm, tp
+                    ),
+                    donate_argnums=(1,),
+                ),
+                "serve.spec_verify",
+            )
+        # Engine-lifetime speculative tallies (the /stats + bench
+        # acceptance-rate source); zero-cost when speculation is off.
+        self.spec_drafted_total = 0
+        self.spec_accepted_total = 0
+        self.accept_rate = StatSummary()
 
     # ---- frontend surface ------------------------------------------
 
@@ -384,12 +526,32 @@ class ServeEngine:
         """Compiled-program count per engine function (the static-
         shape pin: after ``warmup()`` these must never grow;
         prefill_first and prefill_chunk are each bounded by
-        ``len(self.buckets)``)."""
-        return {
+        ``len(self.buckets)``). Speculative engines add the draft
+        chunk programs (same bucket bound), one draft-decode and one
+        verify program."""
+        counts = {
             "prefill_first": self._chunk_first._cache_size(),
             "prefill_chunk": self._chunk_cont._cache_size(),
             "decode": self._decode._cache_size(),
         }
+        if self.spec_tokens:
+            counts.update(
+                draft_prefill_first=self._draft_chunk_first._cache_size(),
+                draft_prefill_chunk=self._draft_chunk_cont._cache_size(),
+                draft_decode=self._draft_decode._cache_size(),
+                spec_verify=self._verify._cache_size(),
+            )
+        return counts
+
+    def compile_budget(self) -> int:
+        """The engine's whole-program-set ceiling: 2 chunk programs
+        per bucket + 1 decode, doubled-chunks + draft-decode + verify
+        when speculating — asserted by ``bench.py serve_decode`` and
+        the static-shape tests."""
+        base = 2 * len(self.buckets) + 1
+        if self.spec_tokens:
+            base += 2 * len(self.buckets) + 2
+        return base
 
     def warmup(self) -> dict[str, int]:
         """Eagerly compile the engine's whole program set → counts.
@@ -421,8 +583,49 @@ class ServeEngine:
             self.params, self._cache, self._toks, self._seeds,
             self._sample_steps, self._temps, self._top_ps,
         )
+        if self.spec_tokens:
+            for fn in (self._draft_chunk_first, self._draft_chunk_cont):
+                for w in self.buckets:
+                    (self._draft_cache, self._d_toks, self._d_seeds,
+                     self._d_steps, self._d_temps, self._d_top_ps,
+                     _) = fn(
+                        self.draft_params, self._draft_cache,
+                        self._d_toks, self._d_seeds, self._d_steps,
+                        self._d_temps, self._d_top_ps,
+                        zero, jnp.zeros((w,), jnp.int32), zero,
+                        jnp.int32(w), jnp.asarray(False), zero,
+                        jnp.float32(0.0), jnp.float32(1.0),
+                    )
+            _, self._draft_cache = self._draft_decode(
+                self.draft_params, self._draft_cache, self._toks,
+                self._cache.pos, self._sync_pos,
+            )
+            (self._toks, self._cache, self._sample_steps, _t, _m
+             ) = self._verify(
+                self.params, self._cache, self._toks,
+                jnp.zeros((self.num_slots, self.spec_tokens), jnp.int32),
+                self._seeds, self._sample_steps, self._temps,
+                self._top_ps,
+            )
         jax.block_until_ready(self._toks)
         return self.compile_counts()
+
+    def cache_bytes_per_slot(self) -> int:
+        """KV-cache HBM per decode lane, scales included — the number
+        int8 quantization halves (better: int8 rows + one fp32 scale
+        per head per position vs fp32 rows), and the per-chip ``slots``
+        capacity story in ``bench.py serve_decode``."""
+        leaves = [self._cache.k, self._cache.v]
+        if self._cache.quantized():
+            leaves += [self._cache.k_scale, self._cache.v_scale]
+        return sum(int(x.nbytes) for x in leaves) // self.num_slots
+
+    def spec_acceptance_rate(self) -> Optional[float]:
+        """Lifetime draft-acceptance fraction, None before any verify
+        round (or when speculation is off)."""
+        if not self.spec_drafted_total:
+            return None
+        return self.spec_accepted_total / self.spec_drafted_total
 
     def goodput(self) -> dict:
         """Device-busy seconds over wall seconds since engine start."""
@@ -461,6 +664,26 @@ class ServeEngine:
                 "min_bucket": self.min_bucket,
                 "buckets": list(self.buckets),
                 "step_token_budget": self.step_token_budget,
+            },
+            "decode_path": {
+                "attn_impl": self.decode_attn,
+                "kv_dtype": self.kv_dtype,
+                "cache_bytes_per_slot": self.cache_bytes_per_slot(),
+                "spec_tokens": self.spec_tokens,
+                **(
+                    {
+                        "spec_acceptance": self.spec_acceptance_rate(),
+                        "spec_drafted_total": self.spec_drafted_total,
+                        "spec_accepted_total": self.spec_accepted_total,
+                        # Per-request distribution (the lifetime ratio
+                        # above hides stragglers: one cold request in
+                        # a warm fleet shows up here).
+                        "spec_acceptance_per_request":
+                            self.accept_rate.snapshot(),
+                    }
+                    if self.spec_tokens
+                    else {}
+                ),
             },
             "goodput": self.goodput(),
             # Compiled-program introspection, only when instrumented:
@@ -540,6 +763,7 @@ class ServeEngine:
         # and has been computing since.
         prev_pending = self._pending
         self._pending = []
+        self._step_spec = (0, 0)  # (drafted, accepted) this step
         produced = 0
         w0 = self.clock()
         t_dispatch = time.perf_counter()
@@ -557,8 +781,11 @@ class ServeEngine:
         prefilling.sort(key=lambda t: self._slots[t[0]].request.rid)
         decode_lanes = [i for i, s in enumerate(self._slots) if s.decoding]
         chunk_tokens = 0
+        # Budget accounting: a decoding lane costs tokens_per_decode
+        # budget tokens this step — 1 on the plain path, γ under
+        # speculation (the verify program runs γ positions per lane).
         for i, width in self.scheduler.plan_chunks(
-            prefilling, len(decode_lanes)
+            prefilling, len(decode_lanes) * self._tokens_per_decode
         ):
             slot = self._slots[i]
             req = slot.request
@@ -573,18 +800,40 @@ class ServeEngine:
             # attend the full lane under the banded q_offset mask.
             fn = self._chunk_first if start == 0 else self._chunk_cont
             t0 = time.perf_counter()
+            slot_i, tok_buf = jnp.int32(i), jnp.asarray(buf)
+            start_t, live_t = jnp.int32(start), jnp.int32(live)
+            final_t = jnp.asarray(final)
             (self._cache, self._toks, self._seeds, self._sample_steps,
              self._temps, self._top_ps, first) = fn(
                 self.params, self._cache, self._toks, self._seeds,
                 self._sample_steps, self._temps, self._top_ps,
-                jnp.int32(i), jnp.asarray(buf), jnp.int32(start),
-                jnp.int32(live), jnp.asarray(final),
+                slot_i, tok_buf, start_t, live_t, final_t,
                 # Exact int32 seed (admission range-checks it): any
                 # masking here would break token-identity with
                 # generate(seed=...) for negative seeds.
                 jnp.int32(req.seed),
                 jnp.float32(req.temperature), jnp.float32(req.top_p),
             )
+            if self.spec_tokens:
+                # The draft cache tracks the same token history: the
+                # same chunk ingests into its lane (never final — the
+                # request's first token is the TARGET's draw; the
+                # draft's dummy sampling state is never read).
+                dfn = (
+                    self._draft_chunk_first
+                    if start == 0
+                    else self._draft_chunk_cont
+                )
+                (self._draft_cache, self._d_toks, self._d_seeds,
+                 self._d_steps, self._d_temps, self._d_top_ps, _) = dfn(
+                    self.draft_params, self._draft_cache, self._d_toks,
+                    self._d_seeds, self._d_steps, self._d_temps,
+                    self._d_top_ps,
+                    slot_i, tok_buf, start_t, live_t, self._keep_pos,
+                    jnp.int32(req.seed),
+                    jnp.float32(req.temperature),
+                    jnp.float32(req.top_p),
+                )
             device_work = True
             slot.prefill_pos = start + live
             chunk_tokens += live
@@ -613,7 +862,10 @@ class ServeEngine:
         # every decoding lane already filled its budget (all retiring
         # next step) would compute a full [S, total_len] decode and
         # throw the entire output away.
-        if emit_lanes:
+        if emit_lanes and self.spec_tokens:
+            produced += self._spec_round(emit_lanes, traced)
+            device_work = True
+        elif emit_lanes:
             t0 = time.perf_counter()
             # --sanitize: every steady-state decode input is already
             # device-resident, so the guard proves this dispatch does
@@ -647,6 +899,19 @@ class ServeEngine:
 
         self._steps += 1
         self.step_latency.add(time.perf_counter() - t_step)
+        # Speculative rounds report their per-step acceptance in the
+        # serve_step stream (the ISSUE-10 contract); non-speculative
+        # engines keep the record schema byte-identical.
+        spec_fields = {}
+        if self.spec_tokens:
+            drafted, accepted = self._step_spec
+            spec_fields = dict(
+                spec_drafted=drafted,
+                spec_accepted=accepted,
+                spec_acceptance=(
+                    round(accepted / drafted, 4) if drafted else None
+                ),
+            )
         self.metrics.write(
             "serve_step",
             step=self._steps,
@@ -658,6 +923,7 @@ class ServeEngine:
             prefill_chunk_tokens=chunk_tokens,
             dispatch_s=round(dispatch_s, 6),
             retire_s=round(retire_s, 6),
+            **spec_fields,
         )
         return produced
 
@@ -678,6 +944,75 @@ class ServeEngine:
         )
 
     # ---- internals --------------------------------------------------
+
+    def _spec_round(self, emit_lanes: list[int], traced: bool) -> int:
+        """One speculative round: γ draft proposals + one batched
+        verify → tokens emitted (1..γ per lane).
+
+        The draft model proposes γ greedy tokens per lane (its first
+        step adopts the TARGET's per-lane positions — acceptance may
+        have advanced the target less than the draft last round);
+        ``slot_verify_step`` scores all of them in ONE target-model
+        step and emits each lane's accepted prefix plus the target's
+        correction token. The verify outputs' emit counts are
+        data-dependent, so the host reads them at the END of the same
+        step (two small int32 arrays — [S, γ] target tokens and [S]
+        match counts, still never logits): spec mode trades the
+        one-step retirement lag for up to γ tokens per big-model
+        step. Runs fully under the --sanitize transfer guard up to
+        that deliberate fetch.
+        """
+        gamma = self.spec_tokens
+        # First-token scalars from THIS step's final chunks must land
+        # before the verify tokens (slot.tokens is in stream order).
+        self._drain()
+        t0 = time.perf_counter()
+        with self._sanitizer.guard():
+            t = self._toks
+            pos0 = self._cache.pos
+            drafts = []
+            for j in range(gamma):
+                t, self._draft_cache = self._draft_decode(
+                    self.draft_params, self._draft_cache, t, pos0,
+                    self._sync_pos if j == 0 else self._keep_pos,
+                )
+                drafts.append(t)
+            (self._toks, self._cache, self._sample_steps, target,
+             matched) = self._verify(
+                self.params, self._cache, self._toks,
+                jnp.stack(drafts, axis=1),
+                self._seeds, self._sample_steps, self._temps,
+                self._top_ps,
+            )
+        t_np = np.asarray(target)  # [S, γ] int32
+        m_np = np.asarray(matched)  # [S] int32
+        produced = 0
+        drafted = accepted = 0
+        for i in emit_lanes:
+            slot = self._slots[i]
+            m = int(m_np[i])
+            n = min(
+                m + 1, gamma,
+                slot.request.max_new_tokens - slot.emitted,
+            )
+            slot.tokens.extend(int(x) for x in t_np[i, :n])
+            slot.emitted += n
+            produced += n
+            drafted += gamma
+            accepted += m
+            slot.spec_drafted += gamma
+            slot.spec_accepted += m
+        self.spec_drafted_total += drafted
+        self.spec_accepted_total += accepted
+        self._step_spec = (drafted, accepted)
+        self.tracer.complete(
+            "serve.spec_verify", t0, time.perf_counter() - t0,
+            {"lanes": len(emit_lanes), "drafted": drafted,
+             "accepted": accepted}
+            if traced
+            else None,
+        )
+        return produced
 
     def _admit_to_slot(self, slot: _Slot, req: Request) -> bool:
         """Bind a popped request to a lane; False = rejected instead.
@@ -703,6 +1038,8 @@ class ServeEngine:
         slot.emitted = 0
         slot.prefill_pos = 0
         slot.first_token_at = None
+        slot.spec_drafted = 0
+        slot.spec_accepted = 0
         # Sampling config reaches the device with the request's first
         # chunk (prefill_chunk installs it at the lane) — nothing to
         # upload here.
@@ -760,16 +1097,29 @@ class ServeEngine:
             decode_seconds=(now - first) if first is not None else 0.0,
             submitted=req.submitted,
             finished=now,
+            # Per-completion acceptance (ISSUE-10): fraction of this
+            # request's draft proposals the target accepted. None when
+            # no verify round ran for it (spec off, or the request
+            # finished on its prefill token alone).
+            spec_acceptance=(
+                round(slot.spec_accepted / slot.spec_drafted, 4)
+                if slot.spec_drafted
+                else None
+            ),
         )
         self._completed[req.rid] = c
         if len(c.tokens) > 1:
             self.decode_rate.add(c.decode_tokens_per_s)
+        if c.spec_acceptance is not None:
+            self.accept_rate.add(c.spec_acceptance)
         self._record_request(c)
         slot.request = None
         slot.tokens = []
         slot.emitted = 0
         slot.prefill_pos = 0
         slot.first_token_at = None
+        slot.spec_drafted = 0
+        slot.spec_accepted = 0
 
     def _record_request(self, c: Completion) -> None:
         self.status_counts[c.status] = self.status_counts.get(c.status, 0) + 1
@@ -785,4 +1135,8 @@ class ServeEngine:
         # latencies, not queue-timeout wait times.
         if c.ttft is not None:
             fields["ttft_s"] = round(c.ttft, 4)
+        # Same absent-vs-null contract for acceptance: only requests
+        # that actually ran verify rounds carry the field.
+        if c.spec_acceptance is not None:
+            fields["spec_acceptance"] = c.spec_acceptance
         self.metrics.write("serve_request", **fields)

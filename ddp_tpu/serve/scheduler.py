@@ -228,10 +228,12 @@ class Scheduler:
         """Which mid-prefill slots advance this step → [(slot, width)].
 
         ``prefilling``: (slot, start, remaining-prompt-tokens) in
-        refill order; ``decoding``: running lanes decoding this step
-        (one token each). Sarathi-style accounting: every planned
-        chunk's width plus the decode tokens must fit
-        ``token_budget``, so a long prompt is ingested across steps
+        refill order; ``decoding``: decode TOKENS dispatched this step
+        — one per running lane on the plain path, lanes × γ under
+        speculative verify (the engine multiplies; the verify program
+        really does run γ positions per lane). Sarathi-style
+        accounting: every planned chunk's width plus the decode tokens
+        must fit ``token_budget``, so a long prompt is ingested across steps
         while running lanes keep decoding — never a full-prompt
         stall. Order is preserved (no short prompt overtakes within a
         step); a tight budget shrinks the head's chunk rather than

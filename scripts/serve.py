@@ -107,6 +107,33 @@ def main() -> None:
         "and HBM gauges, and /stats carries the full ledger",
     )
     p.add_argument(
+        "--decode_attn", default="auto",
+        choices=["auto", "flash", "reference"],
+        help="single-query decode attention (ops/decode.py): 'flash' "
+        "is the Pallas flash-decode kernel (compiled Mosaic on TPU, "
+        "interpreter elsewhere), 'reference' the bit-identical jnp "
+        "path; 'auto' picks flash on TPU only",
+    )
+    p.add_argument(
+        "--kv_dtype", default="fp32", choices=["fp32", "int8"],
+        help="KV-cache storage: 'int8' quantizes on write (per-head "
+        "scales, dequantize at the compute site) — cache HBM per "
+        "slot drops ~2.7x, so a chip fits more --slots",
+    )
+    p.add_argument(
+        "--spec_tokens", type=int, default=0,
+        help="speculative decoding: draft-propose this many greedy "
+        "tokens per lane per round, verified in ONE target step "
+        "(0 = off; needs --draft_checkpoint_dir, or --init_demo "
+        "which synthesizes a smaller draft)",
+    )
+    p.add_argument(
+        "--draft_checkpoint_dir", default=None,
+        help="checkpoint of the DRAFT LM for --spec_tokens (its own "
+        "lm_spec.json sidecar; must share vocab and total_len with "
+        "the target)",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -153,6 +180,43 @@ def main() -> None:
                 f"checkpoint in {args.checkpoint_dir}: {e}"
             )
 
+    # Speculative decoding's draft model: a real (smaller) checkpoint
+    # with its own lm_spec.json, or — under --init_demo — a freshly
+    # initialized half-width sibling so the demo/CI path exercises
+    # the draft/verify machinery with no training run at all.
+    draft_spec = draft_params = None
+    if args.spec_tokens:
+        if args.draft_checkpoint_dir:
+            from ddp_tpu.train.checkpoint import (
+                CheckpointManager,
+                derive_spec_with_sidecar,
+            )
+
+            dmgr = CheckpointManager(args.draft_checkpoint_dir)
+            draft_params, _, _ = dmgr.restore_for_inference(None)
+            dmgr.close()
+            try:
+                draft_spec = derive_spec_with_sidecar(
+                    args.draft_checkpoint_dir, draft_params,
+                    num_heads_fallback=args.num_heads,
+                )
+            except ValueError as e:
+                raise SystemExit(
+                    f"draft checkpoint in {args.draft_checkpoint_dir}: "
+                    f"{e}"
+                )
+        elif args.init_demo:
+            draft_spec = spec._replace(
+                d_model=max(16, spec.d_model // 2),
+                depth=max(1, spec.depth // 2),
+            )
+            draft_params = init_lm(draft_spec, seed=1)
+        else:
+            raise SystemExit(
+                "--spec_tokens needs --draft_checkpoint_dir (or "
+                "--init_demo, which synthesizes a draft)"
+            )
+
     metrics = MetricsWriter(args.metrics_file)
     tracer = Tracer(
         enabled=bool(args.trace_dir),
@@ -171,6 +235,11 @@ def main() -> None:
         tracer=tracer,
         sanitize=args.sanitize,
         xprof=Xprof(enabled=args.xprof),
+        decode_attn=args.decode_attn,
+        kv_dtype=args.kv_dtype,
+        draft_spec=draft_spec,
+        draft_params=draft_params,
+        spec_tokens=args.spec_tokens,
     )
     if not args.no_warmup:
         # Compile the bounded program set (one chunk program per
@@ -206,6 +275,11 @@ def main() -> None:
                         "total_len": spec.total_len,
                         "vocab_size": spec.vocab_size,
                         "compile_counts": engine.compile_counts(),
+                        "decode_attn": engine.decode_attn,
+                        "kv_dtype": engine.kv_dtype,
+                        "cache_bytes_per_slot":
+                            engine.cache_bytes_per_slot(),
+                        "spec_tokens": engine.spec_tokens,
                     }
                 ),
                 flush=True,

@@ -302,6 +302,18 @@ _SLOW_PATTERNS = (
     "test_zero.py::test_trainer_zero_e2e_sanitized_resume",
     "test_zero.py::test_trainer_zero_lm_trains",
     "test_zero.py::test_zero_lm_gspmd_matches_plain_lm",
+    # ISSUE-10 decode path: the engine-level bucket sweeps compile
+    # 7-15 programs each (~10-15 s); the kernel/op pins, the seeded
+    # token-identity runs, and the transfer/validation pins stay in
+    # tier-1.
+    "test_flash_decode.py::TestFlashEngine::test_bucket_edges_greedy_token_identity",
+    "test_flash_decode.py::TestFlashEngine::test_seeded_sampling_token_identity",
+    "test_flash_decode.py::TestFlashEngine::test_compile_counts_stable_and_labeled",
+    "test_flash_decode.py::TestInt8KV::test_engine_int8_bounded_divergence_pin",
+    "test_spec_decode.py::TestSpecEngine::test_greedy_equivalent_across_bucket_edges",
+    "test_spec_decode.py::TestSpecEngine::test_compile_counts_stable_and_labeled",
+    "test_spec_decode.py::TestSpecEngine::test_selfdraft_acceptance_is_one",
+    "test_spec_decode.py::TestVerifyStep::test_full_match_advances_gamma",
 )
 
 

@@ -23,3 +23,15 @@ def reuse_across_iterations(steps, rng):
     for _ in range(steps):
         total += jax.random.uniform(rng)  # ddp-expect: DDP005
     return total
+
+
+def draft_verify_shared_key(seed, step, draft_logits, target_logits):
+    # speculative decoding hazard (serve/engine.py draft/verify
+    # sampling): the draft proposal and the target's verify draw must
+    # consume DISTINCT fold_in counters — reusing the lane key makes
+    # the "independent" verify draw perfectly correlated with the
+    # draft it is supposed to check, silently inflating acceptance
+    key = jax.random.fold_in(jax.random.key(seed), step)
+    draft = jax.random.categorical(key, draft_logits)
+    target = jax.random.categorical(key, target_logits)  # ddp-expect: DDP005
+    return draft, target

@@ -136,7 +136,7 @@ def test_json_report_schema():
     doc = json.loads(proc.stdout)
     assert doc["version"] == 1
     assert doc["files"] == 1
-    assert doc["counts"] == {"DDP005": 3}
+    assert doc["counts"] == {"DDP005": 4}
     for f in doc["findings"]:
         assert set(f) >= {"rule", "path", "line", "col", "message"}
 
