@@ -28,7 +28,19 @@ jit compilation and no growing per-step allocations):
   FLOPs/bytes, memory breakdown, HLO collective payloads) plus the
   device-memory high-water/headroom sampler, cross-checking the
   analytic estimators and the zero strategy's hand-priced
-  ``comm_bytes`` against what XLA actually built.
+  ``comm_bytes`` against what XLA actually built;
+- ``reqtrace`` — per-request distributed tracing for the serve path:
+  a 64-bit trace id at admission, lifecycle events (admit → queue →
+  prefill chunks → spec rounds → decode → retire) hung off the
+  engine's existing slot bookkeeping, exported as Perfetto async
+  spans and reconstructable/causally-validated from merged traces;
+- ``slo`` — declarative serving objectives
+  (``ttft_p99<0.5s,availability>0.999``) evaluated over rolling
+  windows with multi-window (5 m / 1 h) burn-rate alerting;
+- ``aggregate`` — the multi-process telemetry aggregator: scrape N
+  ``/statusz`` + ``/metricsz`` endpoints (or read per-rank metrics
+  files offline), merge StatSummaries exactly, render one fleet view
+  — the interface the multi-replica router will consume.
 
 Wiring: ``--trace_dir`` / ``--health`` / ``--metrics_port`` on
 train.py (train/trainer.py), the serve engine/server (spans +
@@ -56,7 +68,22 @@ from ddp_tpu.obs.promtext import (
     render_train,
     validate_promtext,
 )
-from ddp_tpu.obs.recorder import FlightRecorder
+from ddp_tpu.obs.aggregate import (
+    load_metrics_file,
+    merge_fleet,
+    render_fleet,
+    scrape_endpoint,
+)
+from ddp_tpu.obs.recorder import FlightRecorder, build_info
+from ddp_tpu.obs.reqtrace import (
+    RequestTrace,
+    RequestTracer,
+    derive_trace_id,
+    format_trace_id,
+    reconstruct_requests,
+    validate_request_timeline,
+)
+from ddp_tpu.obs.slo import Objective, SLOEngine, parse_slo
 from ddp_tpu.obs.sentry import AnomalySentry, SentryConfig
 from ddp_tpu.obs.steptime import CompileCounter, StepAttributor, StepTiming
 from ddp_tpu.obs.tracer import (
@@ -81,22 +108,36 @@ __all__ = [
     "HealthMonitor",
     "HealthStats",
     "NonFiniteLossError",
+    "Objective",
     "PromBuilder",
+    "RequestTrace",
+    "RequestTracer",
+    "SLOEngine",
     "SentryConfig",
     "StepAttributor",
     "StepTiming",
     "Tracer",
     "Xprof",
+    "build_info",
+    "derive_trace_id",
+    "format_trace_id",
     "get_tracer",
     "group_layout",
     "health_stats",
     "install_from_env",
+    "load_metrics_file",
+    "merge_fleet",
     "parse_hlo_collectives",
+    "parse_slo",
     "peak_flops_per_chip",
+    "reconstruct_requests",
+    "render_fleet",
     "render_serve",
     "render_train",
     "ring_collective_traffic",
+    "scrape_endpoint",
     "train_flops_per_example",
     "validate_promtext",
+    "validate_request_timeline",
     "validate_trace_file",
 ]

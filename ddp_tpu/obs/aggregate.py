@@ -1,0 +1,307 @@
+"""Multi-process telemetry aggregation: N serve endpoints, one view.
+
+ROADMAP item 1's multi-replica router needs exactly one input this
+repo did not have: a single fleet-level view of per-process serving
+telemetry — aggregate tokens/s, per-endpoint health, and which
+endpoint is burning its SLO budget fastest. This module builds that
+view two ways:
+
+- **live**: scrape each endpoint's ``/statusz`` (JSON: engine stats,
+  SLO state, build info) and ``/metricsz`` (Prometheus text, linted
+  on the way in) over plain ``urllib`` — the exact interface a
+  least-loaded dispatcher will poll;
+- **offline**: read per-rank metrics JSONL streams
+  (``serve_request``/``serve_step`` records from ``--metrics_file``)
+  and reconstruct the same per-endpoint shape — post-hoc fleet
+  analysis from artifacts alone, no live processes needed.
+
+Latency summaries merge **exactly** through the existing
+``StatSummary.merge`` (count/mean/min/max exact across the fold,
+property-tested since PR 2): ``/statusz`` carries each summary's full
+mergeable state (``summary_states``), not just the lossy snapshot, so
+the fleet p50/p95 rides a combined reservoir instead of an average of
+percentiles (which is not a percentile).
+
+CLI: ``scripts/obs_aggregate.py``. Pure host-side stdlib — no jax.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ddp_tpu.utils.metrics import StatSummary
+
+# The summaries /statusz exports as mergeable states and the fleet
+# view folds (engine.stats(include_states=True)).
+MERGED_SUMMARIES = ("ttft_s", "tpot_s", "queue_s", "decode_tokens_per_s")
+
+
+def scrape_endpoint(url: str, *, timeout: float = 5.0) -> dict:
+    """One endpoint's live view: /statusz JSON + linted /metricsz.
+
+    Never raises on a dead endpoint — the fleet view must render with
+    a hole where the sick replica is, not crash: failures come back
+    as ``{"ok": False, "error": ...}`` rows.
+    """
+    import urllib.error
+    import urllib.request
+
+    from ddp_tpu.obs.promtext import validate_promtext
+
+    url = url.rstrip("/")
+    view: dict[str, Any] = {"endpoint": url, "ok": False}
+    try:
+        with urllib.request.urlopen(url + "/statusz", timeout=timeout) as r:
+            view["statusz"] = json.loads(r.read().decode())
+        with urllib.request.urlopen(url + "/metricsz", timeout=timeout) as r:
+            text = r.read().decode()
+        view["metricsz_samples"] = validate_promtext(text)
+        view["ok"] = bool(view["statusz"].get("ok", False))
+    except (OSError, ValueError) as e:
+        view["error"] = f"{type(e).__name__}: {e}"
+    return view
+
+
+def load_metrics_file(path: str) -> dict:
+    """One per-rank metrics JSONL stream → the same endpoint shape.
+
+    Rebuilds the latency summaries from ``serve_request`` records (so
+    the offline fleet view merges through the identical
+    ``StatSummary`` fold) and the token/step totals from
+    ``serve_step`` records; torn tail lines are skipped, the
+    health_report discipline.
+    """
+    # serve_request records carry the summaries under their exact
+    # names — one source of truth, no field-mapping layer.
+    summaries = {name: StatSummary() for name in MERGED_SUMMARIES}
+    status_counts: dict[str, int] = {}
+    tokens_total = 0
+    steps = 0
+    breaches: list[dict] = []
+    t_first = t_last = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a live/killed run
+            kind = rec.get("kind")
+            if kind not in ("serve_request", "serve_step", "slo_breach"):
+                continue
+            t = rec.get("time")
+            if isinstance(t, (int, float)):
+                t_first = t if t_first is None else min(t_first, t)
+                t_last = t if t_last is None else max(t_last, t)
+            if kind == "serve_request":
+                status = rec.get("status", "?")
+                status_counts[status] = status_counts.get(status, 0) + 1
+                for name in MERGED_SUMMARIES:
+                    v = rec.get(name)
+                    if v is not None:
+                        summaries[name].add(float(v))
+            elif kind == "serve_step":
+                steps += 1
+                tokens_total += int(rec.get("tokens") or 0)
+            else:
+                breaches.append(rec)
+    wall_s = (
+        (t_last - t_first) if t_first is not None and t_last > t_first
+        else None
+    )
+    stats: dict[str, Any] = {
+        "requests_by_status": status_counts,
+        "tokens_total": tokens_total,
+        "steps": steps,
+        "summary_states": {
+            n: s.to_state() for n, s in summaries.items() if s.count
+        },
+        **(
+            {"goodput": {"wall_s": round(wall_s, 3)}}
+            if wall_s
+            else {}
+        ),
+    }
+    view: dict[str, Any] = {
+        "endpoint": path,
+        "ok": True,
+        "offline": True,
+        "statusz": {"ok": True, "stats": stats},
+    }
+    if breaches:
+        last = breaches[-1]
+        view["statusz"]["slo_breaches"] = {
+            "count": len(breaches),
+            "last_objective": last.get("objective"),
+            "last_burn_rate_fast": last.get("burn_rate_fast"),
+        }
+    return view
+
+
+def _endpoint_row(view: dict) -> dict:
+    """Flatten one scraped/offline view into a fleet-table row."""
+    row: dict[str, Any] = {
+        "endpoint": view.get("endpoint"),
+        "ok": bool(view.get("ok")),
+    }
+    if "error" in view:
+        row["error"] = view["error"]
+        return row
+    statusz = view.get("statusz") or {}
+    stats = statusz.get("stats") or {}
+    for key in ("active", "slots", "queue_depth", "tokens_total"):
+        if key in stats:
+            row[key] = stats[key]
+    if "draining" in statusz:
+        row["draining"] = statusz["draining"]
+    bi = stats.get("build_info") or statusz.get("build_info")
+    if bi:
+        row["build"] = f"{bi.get('version')}/{bi.get('backend')}"
+    wall = (stats.get("goodput") or {}).get("wall_s")
+    if wall and stats.get("tokens_total") is not None:
+        row["tokens_per_s"] = round(stats["tokens_total"] / wall, 2)
+    by_status = stats.get("requests_by_status") or {}
+    if by_status:
+        row["requests"] = sum(by_status.values())
+    slo = stats.get("slo")
+    if slo:
+        worst = max(
+            slo.get("objectives", []),
+            key=lambda o: o.get("burn_rate_fast", 0.0),
+            default=None,
+        )
+        if worst is not None:
+            row["slo_worst"] = {
+                "objective": worst.get("name"),
+                "burn_rate_fast": worst.get("burn_rate_fast"),
+                "breached": worst.get("breached"),
+            }
+        row["slo_breached"] = bool(slo.get("breached"))
+    elif "slo_breaches" in statusz:  # offline streams: breach records
+        sb = statusz["slo_breaches"]
+        row["slo_worst"] = {
+            "objective": sb.get("last_objective"),
+            "burn_rate_fast": sb.get("last_burn_rate_fast"),
+            "breached": True,
+        }
+        row["slo_breached"] = True
+    return row
+
+
+def merge_fleet(views: list[dict]) -> dict:
+    """N endpoint views → one fleet view (the router's input).
+
+    Aggregate tokens/s is the sum of per-endpoint rates; request
+    counts sum by status; latency summaries fold EXACTLY via
+    ``StatSummary.merge`` over the states each view carries; the
+    worst-SLO pointer names the endpoint to shed load from (or roll)
+    first.
+    """
+    rows = [_endpoint_row(v) for v in views]
+    merged = {name: None for name in MERGED_SUMMARIES}
+    status_totals: dict[str, int] = {}
+    tokens_per_s = 0.0
+    tokens_total = 0
+    for view in views:
+        stats = (view.get("statusz") or {}).get("stats") or {}
+        for status, n in (stats.get("requests_by_status") or {}).items():
+            status_totals[status] = status_totals.get(status, 0) + int(n)
+        tokens_total += int(stats.get("tokens_total") or 0)
+        wall = (stats.get("goodput") or {}).get("wall_s")
+        if wall and stats.get("tokens_total") is not None:
+            tokens_per_s += stats["tokens_total"] / wall
+        for name, state in (stats.get("summary_states") or {}).items():
+            if name not in merged or not state.get("count"):
+                continue
+            incoming = StatSummary.from_state(state)
+            if merged[name] is None:
+                merged[name] = incoming
+            else:
+                merged[name].merge(incoming)
+    worst = None
+    for row in rows:
+        w = row.get("slo_worst")
+        if w is None or w.get("burn_rate_fast") is None:
+            continue
+        if worst is None or (
+            w["burn_rate_fast"] > worst["burn_rate_fast"]
+        ):
+            worst = {**w, "endpoint": row["endpoint"]}
+    return {
+        "endpoints": rows,
+        "healthy": sum(1 for r in rows if r["ok"]),
+        "unhealthy": sum(1 for r in rows if not r["ok"]),
+        "aggregate": {
+            "requests_by_status": status_totals,
+            "tokens_total": tokens_total,
+            "tokens_per_s": round(tokens_per_s, 2),
+            **{
+                name: s.snapshot(ndigits=6)
+                for name, s in merged.items()
+                if s is not None
+            },
+        },
+        **({"slo_worst": worst} if worst else {}),
+    }
+
+
+def render_fleet(fleet: dict) -> str:
+    """Human one-screen rendering (scripts/obs_aggregate.py default)."""
+    lines = ["ddp_tpu fleet view", "=" * 18]
+    lines.append(
+        f"endpoints     : {fleet['healthy']} healthy, "
+        f"{fleet['unhealthy']} unhealthy"
+    )
+    agg = fleet["aggregate"]
+    if agg.get("requests_by_status"):
+        detail = ", ".join(
+            f"{k}: {v}"
+            for k, v in sorted(agg["requests_by_status"].items())
+        )
+        lines.append(
+            f"requests      : {sum(agg['requests_by_status'].values())} "
+            f"({detail})"
+        )
+    lines.append(
+        f"tokens        : {agg.get('tokens_total', 0)} total, "
+        f"{agg.get('tokens_per_s', 0.0)} tok/s aggregate"
+    )
+    for name, label in (
+        ("ttft_s", "ttft"),
+        ("tpot_s", "tpot"),
+        ("queue_s", "queue wait"),
+    ):
+        snap = agg.get(name)
+        if snap and snap.get("count"):
+            lines.append(
+                f"{label:<14}: p50 {snap.get('p50')}s  "
+                f"p95 {snap.get('p95')}s  (n={snap['count']})"
+            )
+    worst = fleet.get("slo_worst")
+    if worst:
+        lines.append(
+            f"slo worst     : {worst.get('objective')} burn "
+            f"{worst.get('burn_rate_fast')} at {worst.get('endpoint')}"
+            + (" [BREACHED]" if worst.get("breached") else "")
+        )
+    for row in fleet["endpoints"]:
+        bits = [f"ok={1 if row['ok'] else 0}"]
+        if "error" in row:
+            bits.append(f"error={row['error']}")
+        if row.get("draining"):
+            bits.append("draining")
+        if "active" in row and "slots" in row:
+            bits.append(f"lanes={row['active']}/{row['slots']}")
+        if "queue_depth" in row:
+            bits.append(f"queue={row['queue_depth']}")
+        if "tokens_per_s" in row:
+            bits.append(f"tok/s={row['tokens_per_s']}")
+        if row.get("slo_breached"):
+            bits.append("SLO-BREACHED")
+        if "build" in row:
+            bits.append(f"build={row['build']}")
+        lines.append(f"  {row['endpoint']}: " + " ".join(bits))
+    return "\n".join(lines) + "\n"

@@ -237,6 +237,55 @@ def validate_promtext(text: str) -> int:
 # ---- renderers -------------------------------------------------------
 
 
+def _render_build_info(b: PromBuilder, bi: Optional[dict], name: str) -> None:
+    """The ``ddp_tpu_build_info`` provenance gauge (value 1, identity
+    in the labels — the Prometheus *_info idiom). Shared by both
+    exporters so a fleet scrape spots version skew in one query;
+    absent when the snapshot carries no block (pre-build-info
+    streams stay byte-identical)."""
+    if not bi:
+        return
+    b.add(
+        name, 1,
+        labels={k: str(v) for k, v in sorted(bi.items())},
+        help="package/jax/backend provenance (value is always 1)",
+    )
+
+
+def _render_slo(b: PromBuilder, slo: Optional[dict]) -> None:
+    """SLO gauges (obs/slo.py): target/current/burn-rate/breached per
+    objective. Absent-key gated — an engine without --slo renders no
+    ddp_tpu_slo_* series at all (the disabled-pin convention)."""
+    if not slo:
+        return
+    for obj in slo.get("objectives") or []:
+        labels = {"objective": obj["name"]}
+        b.add(
+            "ddp_tpu_slo_target", obj.get("target"), labels=labels,
+            help="objective bound (seconds, or fraction for "
+            "availability)",
+        )
+        b.add(
+            "ddp_tpu_slo_current", obj.get("current"), labels=labels,
+            help="fast-window SLI value (absent until observed)",
+        )
+        for window, key in (("fast", "burn_rate_fast"),
+                            ("slow", "burn_rate_slow")):
+            b.add(
+                "ddp_tpu_slo_burn_rate", obj.get(key),
+                labels={**labels, "window": window},
+                help="error-budget burn rate (1.0 = budget consumed "
+                "exactly)",
+            )
+        b.add(
+            "ddp_tpu_slo_breached",
+            1 if obj.get("breached") else 0,
+            labels=labels,
+            help="1 while the current windowed value violates the "
+            "objective",
+        )
+
+
 def render_serve(
     stats: dict,
     *,
@@ -284,9 +333,23 @@ def render_serve(
             "ddp_tpu_serve_requests_total", count,
             labels={"status": status}, metric_type="counter",
         )
+    b.add(
+        "ddp_tpu_serve_tokens_total", stats.get("tokens_total"),
+        metric_type="counter",
+        help="tokens scheduled across all requests (the aggregator's "
+        "fleet tokens/s source)",
+    )
     b.summary(
         "ddp_tpu_serve_ttft_seconds", stats.get("ttft_s"),
         help="submit to first token",
+    )
+    b.summary(
+        "ddp_tpu_serve_tpot_seconds", stats.get("tpot_s"),
+        help="decode seconds per output token (per request)",
+    )
+    b.summary(
+        "ddp_tpu_serve_queue_wait_seconds", stats.get("queue_s"),
+        help="submit to decode-lane bind",
     )
     b.summary(
         "ddp_tpu_serve_decode_tokens_per_second",
@@ -295,6 +358,8 @@ def render_serve(
     b.summary(
         "ddp_tpu_serve_step_latency_seconds", stats.get("step_latency_s")
     )
+    _render_slo(b, stats.get("slo"))
+    _render_build_info(b, stats.get("build_info"), "ddp_tpu_build_info")
     for prog, count in sorted((stats.get("compile_counts") or {}).items()):
         b.add(
             "ddp_tpu_serve_compiled_programs", count,
@@ -421,6 +486,7 @@ def render_train(snap: dict) -> str:
         help="1 - high_water/limit (absent off-TPU: no honest limit)",
     )
     b.summary("ddp_tpu_train_step_seconds", snap.get("step_time"))
+    _render_build_info(b, snap.get("build_info"), "ddp_tpu_build_info")
     return b.render()
 
 

@@ -60,6 +60,29 @@ def snapshot_env() -> dict:
     return out
 
 
+def build_info() -> dict:
+    """The provenance block every long-lived process should publish.
+
+    Package version, jax version, backend and platform — the fields
+    bench.py's ``_env_fields()`` made load-bearing for the perf
+    trajectory (a CPU-fallback capture must never be read as an
+    on-chip one), now stamped on trainer ``run_start`` records,
+    ``/statusz``, and the linted ``ddp_tpu_build_info`` gauge on both
+    ``/metricsz`` exporters, so an aggregator scraping a fleet can
+    tell a version-skewed endpoint at a glance.
+    """
+    import jax
+
+    import ddp_tpu
+
+    return {
+        "version": ddp_tpu.__version__,
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def _sanitize(obj):
     """Strict-JSON form: non-finite floats → null, keys → str."""
     if isinstance(obj, dict):

@@ -156,6 +156,43 @@ class Tracer:
             return
         self._record("X", name, start_perf, max(0.0, dur_s), args)
 
+    def async_complete(
+        self,
+        name: str,
+        start_perf: float,
+        dur_s: float,
+        aid: str,
+        args: Optional[dict] = None,
+        *,
+        cat: str = "request",
+    ) -> None:
+        """A nestable async span (Perfetto ph ``b``/``e``) recorded
+        retroactively. ``aid`` is the async id — events sharing
+        (cat, id) land on one async track, which is how per-request
+        lifecycle spans group across engine steps (obs/reqtrace.py).
+        Free when disabled, like every recording path."""
+        if not self.enabled:
+            return
+        self._record("b", name, start_perf, 0.0, args, aid=aid, cat=cat)
+        self._record(
+            "e", name, start_perf + max(0.0, dur_s), 0.0, None,
+            aid=aid, cat=cat,
+        )
+
+    def async_instant(
+        self,
+        name: str,
+        t_perf: float,
+        aid: str,
+        args: Optional[dict] = None,
+        *,
+        cat: str = "request",
+    ) -> None:
+        """A nestable async instant (ph ``n``) at an explicit stamp."""
+        if not self.enabled:
+            return
+        self._record("n", name, t_perf, 0.0, args, aid=aid, cat=cat)
+
     def _end_span(self, name: str, t0: float, args: Optional[dict]) -> None:
         now = time.perf_counter()
         self._record("X", name, t0, now - t0, args)
@@ -163,12 +200,14 @@ class Tracer:
     def _record(
         self, ph: str, name: str, t0: float, dur_s: float,
         args: Optional[dict],
+        aid: Optional[str] = None,
+        cat: Optional[str] = None,
     ) -> None:
         tid = threading.get_ident()
         with self._lock:
             if len(self._events) == self.ring_events:
                 self._dropped += 1
-            self._events.append((ph, name, t0, dur_s, tid, args))
+            self._events.append((ph, name, t0, dur_s, tid, args, aid, cat))
             if ph == "X":
                 summ = self._summaries.get(name)
                 if summ is None:
@@ -185,7 +224,7 @@ class Tracer:
         if limit is not None:
             raw = raw[-limit:]
         out = []
-        for ph, name, t0, dur_s, tid, args in raw:
+        for ph, name, t0, dur_s, tid, args, aid, cat in raw:
             ev: dict[str, Any] = {
                 "ph": ph,
                 "name": name,
@@ -197,6 +236,11 @@ class Tracer:
                 ev["dur"] = round(dur_s * 1e6, 3)
             if ph == "i":
                 ev["s"] = "t"  # thread-scoped instant
+            if ph in ("b", "e", "n"):
+                # Nestable async events: matched per (pid, cat, id) —
+                # the per-request lifecycle tracks (obs/reqtrace.py).
+                ev["cat"] = cat or "request"
+                ev["id"] = aid
             if args:
                 ev["args"] = args
             out.append(ev)
@@ -351,5 +395,17 @@ def validate_trace_file(path: str) -> dict:
             if not isinstance(dur, (int, float)) or dur < 0:
                 raise ValueError(
                     f"{path}: complete event {i} needs dur >= 0"
+                )
+        if ph in ("b", "e", "n"):
+            # Nestable async events (the per-request lifecycle spans):
+            # Perfetto matches them per (pid, cat, id) — both fields
+            # are load-bearing, so their absence is a schema error.
+            if not isinstance(ev.get("id"), (str, int)):
+                raise ValueError(
+                    f"{path}: async event {i} missing id"
+                )
+            if not isinstance(ev.get("cat"), str):
+                raise ValueError(
+                    f"{path}: async event {i} missing cat"
                 )
     return doc

@@ -109,11 +109,26 @@ class Completion:
     # target accepted over this request's verify rounds (None on the
     # non-speculative path, or before any round ran).
     spec_acceptance: Optional[float] = None
+    # Seconds queued before a lane bound the request (None for
+    # requests evicted from the queue — they never bound).
+    queue_s: Optional[float] = None
+    # Request-trace digest (obs/reqtrace.py): trace id + queue/
+    # prefill/decode split + spec stats. None with tracing off.
+    trace: Optional[dict] = None
 
     @property
     def decode_tokens_per_s(self) -> float:
         n = len(self.tokens) - 1  # tokens after the prefill token
         return n / self.decode_seconds if self.decode_seconds > 0 else 0.0
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Time per output token (decode only) — the per-request SLI
+        behind the tpot_p50 objective; None before a second token."""
+        n = len(self.tokens) - 1
+        if n <= 0 or self.decode_seconds <= 0:
+            return None
+        return self.decode_seconds / n
 
 
 @dataclass
@@ -129,6 +144,7 @@ class _Slot:
     emitted: int = 0
     prefill_pos: int = 0  # prompt tokens ingested so far
     first_token_at: Optional[float] = None  # None = no token observed
+    queue_s: Optional[float] = None  # submit → lane bind wait
     # Speculative-decoding tallies for this occupancy (host-side —
     # the verify round's matched counts are fetched anyway).
     spec_drafted: int = 0
@@ -189,6 +205,11 @@ class ServeEngine:
         draft_spec: Optional[LMSpec] = None,
         draft_params: Any = None,
         spec_tokens: int = 0,
+        reqtrace: bool = False,
+        reqtrace_keep: int = 512,
+        trace_seed: Optional[int] = None,
+        slo=None,
+        recorder=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -326,6 +347,14 @@ class ServeEngine:
         self._sanitizer = Sanitizer(sanitize)
         self._started_at = clock()
         self._productive_s = 0.0
+        # Per-request 64-bit trace-id space: deterministic when the
+        # caller seeds it (tests), collision-free across replicas when
+        # left to entropy (scripts/serve.py default) — merged fleet
+        # traces must keep requests from different engines apart.
+        import os as _os
+
+        if trace_seed is None:
+            trace_seed = int.from_bytes(_os.urandom(8), "little")
         self.scheduler = Scheduler(
             max_queue=max_queue,
             prefill_len=prefill_len,
@@ -334,8 +363,46 @@ class ServeEngine:
             chunk=chunk,
             min_bucket=min_bucket,
             token_budget=self.step_token_budget,
+            trace_seed=trace_seed,
             clock=clock,
         )
+        # Request-level distributed tracing (obs/reqtrace.py): OFF by
+        # default and pinned free when off — every recording site
+        # below guards on one `is not None` check, so a disabled
+        # engine allocates no per-request trace state at all. Enabled,
+        # events are stamped only at points the engine already touches
+        # the host (the PR-3 transfer invariant holds under
+        # --sanitize, re-pinned by tests/test_reqtrace.py).
+        from ddp_tpu.obs.reqtrace import RequestTracer
+
+        self._reqtrace = (
+            RequestTracer(keep=reqtrace_keep) if reqtrace else None
+        )
+        # SLO engine (obs/slo.py): observes every retired request;
+        # breach transitions land in the metrics stream AND the flight
+        # recorder (the PR-4 post-mortem ring) before any caller hook.
+        from ddp_tpu.obs.recorder import FlightRecorder, build_info
+
+        self._recorder = recorder if recorder is not None else (
+            FlightRecorder(None)
+        )
+        self._slo = slo
+        self._user_breach_cb = None
+        if slo is not None:
+            prev = slo.on_breach
+            # Re-attachment (a fresh engine over the same SLOEngine,
+            # e.g. a restart loop): adopt the ORIGINAL caller hook,
+            # not the dead engine's interceptor — chaining through it
+            # would duplicate breach records into a retired engine's
+            # metrics/flight streams.
+            if (
+                getattr(prev, "__func__", None)
+                is ServeEngine._on_slo_breach
+            ):
+                prev = prev.__self__._user_breach_cb
+            self._user_breach_cb = prev
+            slo.on_breach = self._on_slo_breach
+        self._build_info = build_info()
         # {min_bucket · 2^i} ∪ {chunk}: the whole compiled-width set.
         self.buckets = self.scheduler.bucket_list()
         self._slots = [_Slot() for _ in range(slots)]
@@ -366,6 +433,15 @@ class ServeEngine:
         self.ttft = StatSummary()
         self.decode_rate = StatSummary()
         self.step_latency = StatSummary()
+        # User-facing latency SLIs, always on (two float appends per
+        # request): queue wait (submit → lane bind) and TPOT (decode
+        # seconds per output token) — what the SLO engine evaluates
+        # and the fleet aggregator merges.
+        self.queue_wait = StatSummary()
+        self.tpot = StatSummary()
+        # Monotone token counter (the aggregator's tokens/s source —
+        # per-request rate summaries are not additive across a fleet).
+        self.tokens_emitted_total = 0
         # Monotone aggregate counters (the /metricsz exposition needs
         # totals, not just the JSONL event stream): admission rejects
         # by reason, finished requests by status.
@@ -505,6 +581,11 @@ class ServeEngine:
                 reason=adm.reason,
                 queue_depth=self.scheduler.depth,
             )
+        elif self._reqtrace is not None:
+            # The admit event: the request's 64-bit trace id exists
+            # from this point on (assigned by the scheduler), and the
+            # submit call is already a host-side touch point.
+            self._reqtrace.admit(adm.request.rid, adm.request.trace_id)
         return adm
 
     def result(self, rid: int) -> Optional[Completion]:
@@ -638,14 +719,21 @@ class ServeEngine:
             ),
         }
 
-    def stats(self, *, include_ledger: bool = False) -> dict:
+    def stats(
+        self, *, include_ledger: bool = False,
+        include_states: bool = False,
+    ) -> dict:
         """JSON-ready operational snapshot (the /stats endpoint).
 
         ``include_ledger`` embeds the full per-executable compile
         ledger; the default keeps the snapshot scalar-cheap — the
         /metricsz renderer only reads the gauge fields, and a
         Prometheus scrape must not pay a per-profile dict copy (which
-        grows with the ledger) for three gauges.
+        grows with the ledger) for three gauges. ``include_states``
+        embeds the latency summaries' full mergeable StatSummary
+        states (reservoir included) — what /statusz serves so the
+        fleet aggregator (obs/aggregate.py) can merge EXACTLY; off by
+        default for the same scrape-cost reason.
         """
         return {
             "slots": self.num_slots,
@@ -653,11 +741,47 @@ class ServeEngine:
             "queue_depth": self.scheduler.depth,
             "steps": self._steps,
             "completed": len(self._completed),
+            "tokens_total": self.tokens_emitted_total,
             "ttft_s": self.ttft.snapshot(),
+            "tpot_s": self.tpot.snapshot(ndigits=6),
+            "queue_s": self.queue_wait.snapshot(ndigits=6),
             "decode_tokens_per_s": self.decode_rate.snapshot(),
             "step_latency_s": self.step_latency.snapshot(ndigits=6),
             "rejects": dict(self.reject_counts),
             "requests_by_status": dict(self.status_counts),
+            "build_info": dict(self._build_info),
+            **(
+                {
+                    "summary_states": {
+                        "ttft_s": self.ttft.to_state(),
+                        "tpot_s": self.tpot.to_state(),
+                        "queue_s": self.queue_wait.to_state(),
+                        "decode_tokens_per_s":
+                            self.decode_rate.to_state(),
+                    }
+                }
+                if include_states
+                else {}
+            ),
+            # SLO + request-trace state render only when configured:
+            # with both off the /metricsz exposition stays
+            # byte-identical to the pre-SLO engine's (the PR-2/PR-9
+            # disabled-pin convention; pinned by tests/test_slo.py).
+            **(
+                {"slo": self._slo.state()}
+                if self._slo is not None
+                else {}
+            ),
+            **(
+                {
+                    "reqtrace": {
+                        "live": self._reqtrace.live_count,
+                        "retained": self._reqtrace.retired_count,
+                    }
+                }
+                if self._reqtrace is not None
+                else {}
+            ),
             "compile_counts": self.compile_counts(),
             "prefill": {
                 "chunk": self.prefill_chunk,
@@ -742,12 +866,14 @@ class ServeEngine:
                 evictions += 1
         for req in self.scheduler.evict_expired():
             now2 = self.clock()
-            self._completed[req.rid] = Completion(
+            c = Completion(
                 rid=req.rid, status=TIMEOUT_QUEUE, prompt=req.prompt,
                 tokens=[], ttft=None, decode_seconds=0.0,
                 submitted=req.submitted, finished=now2,
             )
-            self._record_request(self._completed[req.rid])
+            self._completed[req.rid] = c
+            self._retire_trace(c)
+            self._record_request(c)
             evictions += 1
 
         for slot in self._slots:
@@ -839,13 +965,21 @@ class ServeEngine:
             chunk_tokens += live
             if traced:
                 jax.block_until_ready(self._toks)
+            chunk_dur = time.perf_counter() - t0
             self.tracer.complete(
-                "serve.prefill_chunk", t0, time.perf_counter() - t0,
+                "serve.prefill_chunk", t0, chunk_dur,
                 {"rid": req.rid, "slot": i, "start": start,
                  "width": width, "final": final}
                 if traced
                 else None,
             )
+            if self._reqtrace is not None:
+                tr = self._reqtrace.get(req.rid)
+                if tr is not None:
+                    tr.prefill_chunk(
+                        t0, chunk_dur, start=start, bucket=width,
+                        tokens=live, final=final,
+                    )
             if final:
                 slot.emitted = 1
                 produced += 1
@@ -887,6 +1021,13 @@ class ServeEngine:
             )
             for i in emit_lanes:
                 self._slots[i].emitted += 1
+                if self._reqtrace is not None:
+                    # Aggregate decode accounting: one counter bump
+                    # per lane per step, folded into ONE req.decode
+                    # span at retire — never an event per token.
+                    tr = self._reqtrace.get(self._slots[i].request.rid)
+                    if tr is not None:
+                        tr.decode_step(t0)
             self._pending.append(("decode", self._toks, emit_lanes))
             produced += len(emit_lanes)
 
@@ -898,6 +1039,7 @@ class ServeEngine:
             self._productive_s += self.clock() - w0
 
         self._steps += 1
+        self.tokens_emitted_total += produced
         self.step_latency.add(time.perf_counter() - t_step)
         # Speculative rounds report their per-step acceptance in the
         # serve_step stream (the ISSUE-10 contract); non-speculative
@@ -986,6 +1128,7 @@ class ServeEngine:
             )
         t_np = np.asarray(target)  # [S, γ] int32
         m_np = np.asarray(matched)  # [S] int32
+        round_dur = time.perf_counter() - t0
         produced = 0
         drafted = accepted = 0
         for i in emit_lanes:
@@ -1002,11 +1145,18 @@ class ServeEngine:
             accepted += m
             slot.spec_drafted += gamma
             slot.spec_accepted += m
+            if self._reqtrace is not None:
+                tr = self._reqtrace.get(slot.request.rid)
+                if tr is not None:
+                    tr.spec_round(
+                        t0, round_dur, drafted=gamma, accepted=m,
+                        emitted=n,
+                    )
         self.spec_drafted_total += drafted
         self.spec_accepted_total += accepted
         self._step_spec = (drafted, accepted)
         self.tracer.complete(
-            "serve.spec_verify", t0, time.perf_counter() - t0,
+            "serve.spec_verify", t0, round_dur,
             {"lanes": len(emit_lanes), "drafted": drafted,
              "accepted": accepted}
             if traced
@@ -1026,12 +1176,14 @@ class ServeEngine:
         """
         if len(req.prompt) > min(self.prefill_len, self.spec.total_len - 1):
             now = self.clock()
-            self._completed[req.rid] = Completion(
+            c = Completion(
                 rid=req.rid, status=REJECTED_TOO_LONG, prompt=req.prompt,
                 tokens=[], ttft=None, decode_seconds=0.0,
                 submitted=req.submitted, finished=now,
             )
-            self._record_request(self._completed[req.rid])
+            self._completed[req.rid] = c
+            self._retire_trace(c)
+            self._record_request(c)
             return False
         slot.request = req
         slot.tokens = []
@@ -1040,6 +1192,14 @@ class ServeEngine:
         slot.first_token_at = None
         slot.spec_drafted = 0
         slot.spec_accepted = 0
+        # Queue wait closes here: the SLI behind queue_s_p99 and the
+        # req.queue span (the bind is already a host-side touch point).
+        slot.queue_s = max(0.0, self.clock() - req.submitted)
+        self.queue_wait.add(slot.queue_s)
+        if self._reqtrace is not None:
+            tr = self._reqtrace.get(req.rid)
+            if tr is not None:
+                tr.bind(self._reqtrace.clock())
         # Sampling config reaches the device with the request's first
         # chunk (prefill_chunk installs it at the lane) — nothing to
         # upload here.
@@ -1106,20 +1266,75 @@ class ServeEngine:
                 if slot.spec_drafted
                 else None
             ),
+            queue_s=slot.queue_s,
         )
         self._completed[req.rid] = c
         if len(c.tokens) > 1:
             self.decode_rate.add(c.decode_tokens_per_s)
+        if c.tpot_s is not None:
+            self.tpot.add(c.tpot_s)
         if c.spec_acceptance is not None:
             self.accept_rate.add(c.spec_acceptance)
+        self._retire_trace(c)
         self._record_request(c)
         slot.request = None
         slot.tokens = []
         slot.emitted = 0
         slot.prefill_pos = 0
         slot.first_token_at = None
+        slot.queue_s = None
         slot.spec_drafted = 0
         slot.spec_accepted = 0
+
+    def _retire_trace(self, c: Completion) -> None:
+        """Close the request's trace (if tracing) and hang the digest
+        on the completion — called from every retirement path."""
+        if self._reqtrace is None:
+            return
+        t = self._reqtrace.retire(c.rid, c.status, tracer=self.tracer)
+        if t is not None:
+            c.trace = t.summary()
+
+    def _on_slo_breach(self, state: dict) -> None:
+        """SLO alert transition (obs/slo.py multi-window burn): one
+        record into the metrics stream AND the flight recorder ring
+        (the PR-4 post-mortem artifact) per False→True transition,
+        then the caller's hook if any."""
+        fields = dict(
+            objective=state["name"],
+            target=state["target"],
+            current=state["current"],
+            burn_rate_fast=state["burn_rate_fast"],
+            burn_rate_slow=state["burn_rate_slow"],
+            window_n=state["window_n"],
+        )
+        self.metrics.write("slo_breach", **fields)
+        self._recorder.record("slo_breach", **fields)
+        if self._user_breach_cb is not None:
+            self._user_breach_cb(state)
+
+    def request_timeline(self, key) -> Optional[dict]:
+        """One request's full event timeline by rid or hex trace id —
+        the /requestz payload. None when unknown (or tracing off)."""
+        if self._reqtrace is None:
+            return None
+        t = self._reqtrace.lookup(key)
+        if t is None:
+            return None
+        doc = t.timeline()
+        doc["live"] = t.retire_t is None
+        return doc
+
+    def emit_request_spans(self) -> int:
+        """Retroactively emit retired request traces into the span
+        tracer (→ count). The bench path: its timed window runs with
+        the tracer's measuring mode off (span fidelity would destroy
+        the dispatch/retire overlap being measured) and exports the
+        request spans afterwards — stamps were recorded live, so the
+        exported timeline is the measured one."""
+        if self._reqtrace is None:
+            return 0
+        return self._reqtrace.emit_all(self.tracer)
 
     def _record_request(self, c: Completion) -> None:
         self.status_counts[c.status] = self.status_counts.get(c.status, 0) + 1
@@ -1139,4 +1354,29 @@ class ServeEngine:
         # that actually ran verify rounds carry the field.
         if c.spec_acceptance is not None:
             fields["spec_acceptance"] = c.spec_acceptance
+        # The user-facing SLIs (absent when the request never bound a
+        # lane / never decoded — aggregation sees only real values).
+        if c.queue_s is not None:
+            fields["queue_s"] = round(c.queue_s, 6)
+        if c.tpot_s is not None:
+            fields["tpot_s"] = round(c.tpot_s, 6)
+        # Cross-plane correlation key: present only when request
+        # tracing is on (the serve_request schema is otherwise
+        # byte-compatible with the pre-reqtrace stream).
+        if c.trace is not None:
+            fields["trace_id"] = c.trace["trace_id"]
         self.metrics.write("serve_request", **fields)
+        # Feed the SLO engine from the same retirement: the SLIs are
+        # host floats already in hand, and availability counts every
+        # SERVICE-terminal status (a timeout IS an unavailability
+        # event). Client-class rejections are excluded — a burst of
+        # over-long prompts must not burn the availability budget and
+        # page the operator while valid traffic is served perfectly
+        # (admission rejects never reach this path either).
+        if self._slo is not None and c.status != REJECTED_TOO_LONG:
+            self._slo.observe(
+                ttft_s=c.ttft,
+                tpot_s=c.tpot_s,
+                queue_s=c.queue_s,
+                ok=c.status == COMPLETE,
+            )

@@ -75,6 +75,12 @@ class Request:
     seed: int = 0
     deadline: Optional[float] = None  # absolute, in clock() time
     submitted: float = 0.0
+    # 64-bit distributed-tracing id, assigned AT ADMISSION
+    # (obs/reqtrace.derive_trace_id): the one key that follows the
+    # request through the HTTP response, the metrics stream, the
+    # Perfetto trace and /requestz. 0 = unassigned (bare schedulers
+    # constructed without a trace seed in tests).
+    trace_id: int = 0
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -109,6 +115,11 @@ class Scheduler:
     chunk: int = 0  # 0 = one prefill_len-wide chunk per prompt
     min_bucket: int = 0  # 0 = no bucketing below the chunk width
     token_budget: int = 0  # 0 = unlimited (no co-scheduling bound)
+    # Seed for the per-request 64-bit trace ids (obs/reqtrace.py):
+    # deterministic in (seed, rid) so tests can pin ids; a serving
+    # process seeds from os.urandom so two replicas' id spaces don't
+    # collide in a merged fleet trace.
+    trace_seed: int = 0
     clock: Callable[[], float] = time.monotonic
     _queue: deque = field(default_factory=deque)
     _ids: "itertools.count" = field(default_factory=itertools.count)
@@ -158,8 +169,11 @@ class Scheduler:
         if len(self._queue) >= self.max_queue:
             return Admission(False, QUEUE_FULL)
         now = self.clock()
+        from ddp_tpu.obs.reqtrace import derive_trace_id
+
+        rid = next(self._ids)
         req = Request(
-            rid=next(self._ids),
+            rid=rid,
             prompt=prompt,
             max_new_tokens=int(max_new_tokens),
             temperature=float(temperature),
@@ -167,6 +181,7 @@ class Scheduler:
             seed=int(seed),
             deadline=None if timeout is None else now + float(timeout),
             submitted=now,
+            trace_id=derive_trace_id(self.trace_seed, rid),
         )
         self._queue.append(req)
         return Admission(True, request=req)

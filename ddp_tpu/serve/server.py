@@ -19,14 +19,26 @@ every container this repo targets, and the API is three routes:
   GET  /stats      → 200 engine.stats() (TTFT/throughput summaries,
                     compile counts — the static-shape invariant is an
                     OBSERVABLE, not a comment)
-  GET  /statusz    → 200 {"ok", "stats", "trace"} — stats plus the
-                    live span-trace tail (``.trace`` is a loadable
-                    Perfetto traceEvents document) and the engine's
-                    goodput snapshot (ddp_tpu.obs)
+  GET  /statusz    → 200 {"ok", "stats", "trace", "build_info"} —
+                    stats (including mergeable summary states, SLO
+                    state when --slo is set, and build provenance)
+                    plus the live span-trace tail (``.trace`` is a
+                    loadable Perfetto traceEvents document) and the
+                    engine's goodput snapshot (ddp_tpu.obs); what the
+                    fleet aggregator (scripts/obs_aggregate.py)
+                    scrapes
   GET  /metricsz   → 200 Prometheus text exposition of the live
-                    counters/summaries (TTFT, occupancy, rejects,
+                    counters/summaries (TTFT/TPOT/queue-wait,
+                    occupancy, rejects, SLO burn gauges, build info,
                     goodput — obs/promtext.py), so runs are
                     scrapeable without parsing JSONL
+  GET  /requestz?id=RID|0xTRACEID
+                   → 200 one request's full lifecycle timeline
+                    (admit → queue → prefill chunks → spec rounds →
+                    decode → retire; obs/reqtrace.py); without ?id=,
+                    the recently retired requests. 404 on unknown
+                    ids; requires the engine's request tracing
+                    (scripts/serve.py --reqtrace)
 
 The handler blocks until its request completes (simple request/
 response serving); queue position and slot availability decide
@@ -266,14 +278,47 @@ class LMServer:
             # the span trace — the ``trace`` value is itself a valid
             # Chrome/Perfetto ``traceEvents`` document, so
             # ``curl .../statusz | jq .trace > t.json`` loads directly.
+            # include_states=True: the latency summaries' mergeable
+            # StatSummary states ride along so a fleet aggregator
+            # (obs/aggregate.py) merges EXACTLY instead of averaging
+            # percentiles.
             with self._lock:
                 return {
                     "ok": self._engine_error is None,
                     "draining": self.draining,
-                    "stats": self.engine.stats(),
+                    "stats": self.engine.stats(include_states=True),
                     "trace": self.engine.tracer.snapshot(limit=512),
                 }
         return None
+
+    def requestz(self, query: str) -> tuple[int, dict]:
+        """GET /requestz[?id=...] → (status, payload): one request's
+        reconstructed lifecycle timeline (obs/reqtrace.py), or the
+        recently retired set when no id is given."""
+        from urllib.parse import parse_qs
+
+        if self.engine._reqtrace is None:
+            return 404, {
+                "error": "request tracing is off (scripts/serve.py "
+                "--reqtrace, or ServeEngine(reqtrace=True))"
+            }
+        params = parse_qs(query or "")
+        key = (params.get("id") or [None])[0]
+        with self._lock:
+            if key is None:
+                return 200, {
+                    "enabled": True,
+                    "live": self.engine._reqtrace.live_count,
+                    "recent": self.engine._reqtrace.recent(),
+                }
+            timeline = self.engine.request_timeline(key)
+        if timeline is None:
+            return 404, {
+                "error": f"unknown request {key!r} (rid or 0x-prefixed "
+                "trace id; retired timelines are retained up to the "
+                "reqtrace_keep bound)"
+            }
+        return 200, timeline
 
 
 def _make_handler(server: LMServer):
@@ -304,7 +349,12 @@ def _make_handler(server: LMServer):
             )
 
         def do_GET(self):  # noqa: N802
-            payload = server.snapshot(self.path)
+            route, _, query = self.path.partition("?")
+            if route == "/requestz":
+                status, payload = server.requestz(query)
+                self._send(status, payload)
+                return
+            payload = server.snapshot(route)
             if payload is None:
                 self._send(404, {"error": f"no route {self.path}"})
             elif isinstance(payload, str):

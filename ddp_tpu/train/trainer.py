@@ -1715,6 +1715,11 @@ class Trainer:
         gp = self._goodput.snapshot()
         if gp:
             snap["goodput"] = gp.get("goodput")
+        # Stamped at run_start; a snapshot scraped before train()
+        # simply renders no build_info gauge (absent ≠ zero).
+        bi = getattr(self, "_build_info", None)
+        if bi:
+            snap["build_info"] = bi
         return snap
 
     def _on_health_events(
@@ -2126,15 +2131,25 @@ class Trainer:
         }
         if self._goodput.prev_world is not None:
             world_fields["prev_data_shards"] = self._goodput.prev_world
+        # Build provenance on the generation anchor (ISSUE 11): the
+        # same version/jax/backend/platform block bench records carry,
+        # so a resumed run that crossed an image upgrade — or a fleet
+        # member running skewed code — is visible from the stream
+        # alone. Matching ddp_tpu_build_info gauge on /metricsz.
+        from ddp_tpu.obs.recorder import build_info
+
+        self._build_info = build_info()
         self._recorder.record(
             "run_start", start_epoch=start_epoch,
-            restarts=self._goodput.restarts, **world_fields,
+            restarts=self._goodput.restarts,
+            build_info=self._build_info, **world_fields,
         )
         self.metrics_writer.write(
             "run_start",
             start_epoch=start_epoch,
             restarts=self._goodput.restarts,
             global_batch_size=self.global_batch_size,
+            build_info=self._build_info,
             **world_fields,
         )
         # Mid-epoch preemption saves are tagged with their (incomplete)

@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# Request tracing, SLOs, and the fleet aggregator (ISSUE 11 /
+# docs/OBSERVABILITY.md "Request tracing & SLOs"): a traced server
+# with a deliberately tight objective, real traffic, one request's
+# full timeline from /requestz, the breach on /metricsz and in the
+# flight recorder, a merged Perfetto trace whose request lifecycles
+# validate causally, the aggregator's fleet view across TWO scraped
+# endpoints, and the health_report serve triage. Green on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example21}
+rm -rf "$WORK" && mkdir -p "$WORK"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+# 1. Two demo servers (the second is the "sick" replica: a tight TTFT
+#    objective a CPU box is guaranteed to breach), both with request
+#    tracing, SLOs, span traces, metrics streams, and a flight
+#    recorder for the breach events.
+start_server() {  # port, slo-spec, suffix
+    python scripts/serve.py --init_demo --port "$1" \
+        --slots 2 --reqtrace --slo "$2" \
+        --trace_dir "$WORK/traces$3" --metrics_file "$WORK/serve$3.jsonl" \
+        --flight_dir "$WORK/flight$3" --sanitize \
+        >"$WORK/server$3.log" 2>&1 &
+}
+start_server 8041 "ttft_p99<30s,availability>0.99" _a
+start_server 8042 "ttft_p99<1ms,tpot_p50<80ms,availability>0.999" _b
+trap 'kill $(jobs -p) 2>/dev/null || true' EXIT
+for port in 8041 8042; do
+    for _ in $(seq 60); do
+        curl -sf "localhost:$port/healthz" >/dev/null 2>&1 && break
+        sleep 1
+    done
+done
+
+# 2. Traffic through both — greedy and seeded, mixed lengths.
+for port in 8041 8042; do
+    curl -s "localhost:$port/generate" \
+        -d '{"prompt_tokens": [7, 3, 9], "max_new_tokens": 12}' >/dev/null
+    curl -s "localhost:$port/generate" \
+        -d '{"prompt_tokens": [1, 2, 3, 4, 5, 6], "max_new_tokens": 8,
+             "temperature": 0.8, "seed": 7}' >/dev/null
+done
+
+# 3. Where did request 0 spend its time? The /requestz timeline:
+#    admit -> queue -> prefill_chunk[i] -> decode -> retire, with the
+#    64-bit trace id that also names its spans in the Perfetto trace.
+echo "--- /requestz?id=0 (server a)"
+curl -s "localhost:8041/requestz?id=0" | python -c \
+    'import json,sys; d=json.load(sys.stdin); \
+     print(json.dumps({"rid": d["rid"], "trace_id": d["trace_id"], \
+     "summary": d["summary"], \
+     "events": [e["name"] for e in d["events"]]}, indent=1))'
+echo "--- recently retired"
+curl -s "localhost:8041/requestz" | python -m json.tool
+
+# 4. The seeded breach, visible on the scrape surface: burn-rate and
+#    breached gauges (linted — validate_promtext runs in the smoke
+#    tier), SLO state on /statusz, and the build_info gauge both
+#    servers carry.
+echo "--- SLO gauges (sick replica)"
+curl -s localhost:8042/metricsz | grep -E 'ddp_tpu_slo_|ddp_tpu_build_info'
+curl -s localhost:8042/statusz | python -c \
+    'import json,sys; s=json.load(sys.stdin)["stats"]["slo"]; \
+     print(json.dumps({"breached": s["breached"], "objectives": \
+     [(o["name"], o["breached"], o["burn_rate_fast"]) for o in s["objectives"]]}))'
+
+# 5. The fleet view the ROADMAP item-1 router will consume: both
+#    endpoints scraped live, latency summaries merged EXACTLY
+#    (StatSummary.merge over /statusz states), worst-endpoint SLO
+#    burn naming the replica to shed/roll first. Exit status 1 is
+#    CORRECT here — the fleet contains a breached endpoint.
+python scripts/obs_aggregate.py http://127.0.0.1:8041 http://127.0.0.1:8042 \
+    && { echo "expected breached fleet to exit 1"; exit 1; } || true
+
+# 6. Drain both (SIGTERM), which exports traces, dumps the flight
+#    recorders (breach events in the ring), and closes the streams.
+kill -TERM $(jobs -p) 2>/dev/null || true
+wait 2>/dev/null || true
+python - <<'EOF'
+import json
+dump = json.load(open("/tmp/ddp_tpu_example21/flight_b/flight_rank0.json"))
+breaches = [r for r in dump["records"] if r["kind"] == "slo_breach"]
+assert breaches, "no slo_breach records in the flight dump"
+print("flight recorder breach:", json.dumps(breaches[0]))
+EOF
+
+# 7. Merge the per-rank traces: the sidecar reconstructs every
+#    request's lifecycle across files and validates causal ordering
+#    (requests.count == requests.causal_ok).
+python scripts/trace_merge.py "$WORK/traces_a" "$WORK/traces_b" \
+    -o "$WORK/merged.trace.json"
+
+# 8. Offline fleet view from the metrics streams alone (no live
+#    processes), and the serve triage section on the health report.
+python scripts/obs_aggregate.py "$WORK/serve_a.jsonl" "$WORK/serve_b.jsonl" || true
+python scripts/health_report.py "$WORK/serve_b.jsonl"
+
+echo "example 21 OK"

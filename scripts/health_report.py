@@ -260,6 +260,61 @@ def build_report(records: list[dict]) -> str:
     if comm:
         lines.append(f"comm/step     : {comm[-1]:,} bytes (estimate)")
 
+    # Serve triage (ISSUE 11): user-facing latency percentiles, queue
+    # wait, SLO burn and speculative acceptance — only when the stream
+    # carries serve records (scripts/serve.py --metrics_file), so
+    # pre-existing trainer streams stay byte-identical.
+    serve_reqs = [r for r in records if r.get("kind") == "serve_request"]
+    serve_steps = [r for r in records if r.get("kind") == "serve_step"]
+    slo_breaches = [r for r in records if r.get("kind") == "slo_breach"]
+    if serve_reqs or serve_steps or slo_breaches:
+        by_status: dict[str, int] = {}
+        ttft, tpot, queue = StatSummary(), StatSummary(), StatSummary()
+        acc = StatSummary()
+        for r in serve_reqs:
+            s = r.get("status", "?")
+            by_status[s] = by_status.get(s, 0) + 1
+            for summ, key in (
+                (ttft, "ttft_s"), (tpot, "tpot_s"), (queue, "queue_s"),
+                (acc, "spec_acceptance"),
+            ):
+                if r.get(key) is not None:
+                    summ.add(r[key])
+        detail = ", ".join(
+            f"{k}: {v}" for k, v in sorted(by_status.items())
+        )
+        steps_note = (
+            f", {len(serve_steps)} engine step(s)" if serve_steps else ""
+        )
+        lines.append(
+            f"serve         : {len(serve_reqs)} request(s)"
+            + (f" ({detail})" if detail else "")
+            + steps_note
+        )
+        for label, summ in (
+            ("serve ttft", ttft), ("serve tpot", tpot),
+            ("serve queue", queue),
+        ):
+            if summ.count:
+                lines.append(
+                    f"{label:<14}: p50 {_fmt(summ.percentile(50), 4)}s  "
+                    f"p99 {_fmt(summ.percentile(99), 4)}s"
+                )
+        if acc.count:
+            lines.append(
+                f"spec accept   : mean "
+                f"{_fmt(acc.snapshot().get('mean'), 4)} over "
+                f"{acc.count} request(s)"
+            )
+        if slo_breaches:
+            last = slo_breaches[-1]
+            lines.append(
+                f"slo           : {len(slo_breaches)} breach event(s), "
+                f"last {last.get('objective')} burn "
+                f"{_fmt(last.get('burn_rate_fast'), 1)} (fast) / "
+                f"{_fmt(last.get('burn_rate_slow'), 1)} (slow)"
+            )
+
     sentry = [h for h in health if h.get("detector") != "nonfinite"]
     if sentry:
         by_det: dict[str, int] = {}

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Fleet telemetry aggregator: N serve endpoints (or files), one view.
+
+    # live: scrape /statusz + /metricsz on each endpoint
+    python scripts/obs_aggregate.py http://127.0.0.1:8000 \
+        http://127.0.0.1:8001
+
+    # offline: per-rank metrics JSONL streams (--metrics_file output)
+    python scripts/obs_aggregate.py serve_a.jsonl serve_b.jsonl
+
+    # machine-readable (the router's input shape)
+    python scripts/obs_aggregate.py --json http://127.0.0.1:8000 ...
+
+Merges per-endpoint latency summaries EXACTLY through
+``StatSummary.merge`` (the /statusz payload carries full mergeable
+states, not just snapshots), sums token throughput, and points at the
+endpoint burning its SLO budget fastest — the least-loaded-dispatch
+and roll-the-sick-replica-first signals the ROADMAP item-1 router
+will consume (ddp_tpu/obs/aggregate.py has the library surface).
+
+Exit status: 0 when every endpoint answered healthy, 1 when any
+endpoint is unreachable/unhealthy or any scraped SLO is breached —
+cron-able as a fleet health probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddp_tpu.obs.aggregate import (  # noqa: E402
+    load_metrics_file,
+    merge_fleet,
+    render_fleet,
+    scrape_endpoint,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "targets", nargs="+",
+        help="http(s):// serve endpoints to scrape, and/or metrics "
+        "JSONL files to read offline (mixable)",
+    )
+    p.add_argument("--json", action="store_true", help="emit the fleet "
+                   "view as JSON instead of the one-screen rendering")
+    p.add_argument(
+        "--timeout", type=float, default=5.0,
+        help="per-endpoint scrape timeout (seconds)",
+    )
+    args = p.parse_args(argv)
+
+    views = []
+    for target in args.targets:
+        if target.startswith(("http://", "https://")):
+            views.append(scrape_endpoint(target, timeout=args.timeout))
+        else:
+            try:
+                views.append(load_metrics_file(target))
+            except OSError as e:
+                views.append(
+                    {"endpoint": target, "ok": False,
+                     "error": f"{type(e).__name__}: {e}"}
+                )
+    fleet = merge_fleet(views)
+    if args.json:
+        print(json.dumps(fleet))
+    else:
+        sys.stdout.write(render_fleet(fleet))
+    breached = any(
+        r.get("slo_breached") for r in fleet["endpoints"]
+    )
+    return 0 if fleet["unhealthy"] == 0 and not breached else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

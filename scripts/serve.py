@@ -93,6 +93,29 @@ def main() -> None:
         "seconds, then exit cleanly",
     )
     p.add_argument(
+        "--reqtrace", action="store_true",
+        help="per-request distributed tracing (ddp_tpu.obs.reqtrace): "
+        "every request gets a 64-bit trace id at admission, its "
+        "lifecycle (admit -> queue -> prefill chunks -> spec rounds "
+        "-> decode -> retire) is reconstructable at /requestz?id=... "
+        "and exported as Perfetto async spans under --trace_dir; "
+        "completions carry a .trace digest",
+    )
+    p.add_argument(
+        "--slo", default=None, metavar="SPEC",
+        help="declarative serving objectives evaluated live over "
+        "rolling 5m/1h windows with burn-rate alerting, e.g. "
+        "'ttft_p99<0.5s,tpot_p50<80ms,availability>0.999' — state on "
+        "/statusz, ddp_tpu_slo_* gauges on /metricsz, breach events "
+        "into the metrics stream and the flight recorder",
+    )
+    p.add_argument(
+        "--flight_dir", default=None,
+        help="flight-recorder directory (ddp_tpu.obs.recorder): SLO "
+        "breach events ride the bounded ring and the dump lands here "
+        "on shutdown (flight_rank0.json)",
+    )
+    p.add_argument(
         "--sanitize", action="store_true",
         help="arm jax.transfer_guard('disallow') around the decode "
         "dispatch: any implicit host transfer in the hot loop raises "
@@ -222,6 +245,19 @@ def main() -> None:
         enabled=bool(args.trace_dir),
         ring_events=args.trace_ring_events,
     )
+    # SLO engine + flight recorder (ISSUE 11): objectives evaluated
+    # live inside the serving process; breach events land in the
+    # metrics stream and the recorder ring (dumped on shutdown so a
+    # post-mortem sees them even when nobody scraped /metricsz).
+    from ddp_tpu.obs.recorder import FlightRecorder, build_info, snapshot_env
+    from ddp_tpu.obs.slo import SLOEngine
+
+    slo = SLOEngine(args.slo) if args.slo else None
+    recorder = FlightRecorder(args.flight_dir)
+    recorder.set_context(
+        build_info=build_info(), env=snapshot_env(),
+        slo=args.slo, role="serve",
+    )
     engine = ServeEngine(
         spec,
         params,
@@ -240,6 +276,9 @@ def main() -> None:
         draft_spec=draft_spec,
         draft_params=draft_params,
         spec_tokens=args.spec_tokens,
+        reqtrace=args.reqtrace,
+        slo=slo,
+        recorder=recorder,
     )
     if not args.no_warmup:
         # Compile the bounded program set (one chunk program per
@@ -280,6 +319,9 @@ def main() -> None:
                         "cache_bytes_per_slot":
                             engine.cache_bytes_per_slot(),
                         "spec_tokens": engine.spec_tokens,
+                        "build_info": build_info(),
+                        "reqtrace": bool(args.reqtrace),
+                        **({"slo": args.slo} if args.slo else {}),
                     }
                 ),
                 flush=True,
@@ -309,6 +351,9 @@ def main() -> None:
         # skip the metrics close below).
         if args.trace_dir:
             try:
+                # Any request spans whose retire fell outside a traced
+                # window (or that never emitted) ride the export too.
+                engine.emit_request_spans()
                 path = tracer.export_to_dir(args.trace_dir)
                 print(json.dumps({"trace": path}), flush=True)
             except OSError as e:
@@ -316,6 +361,12 @@ def main() -> None:
                     json.dumps({"trace_error": str(e)}),
                     file=sys.stderr, flush=True,
                 )
+        # The flight recorder's ring (SLO breach events included)
+        # lands on disk even for a clean exit — a breach that paged
+        # nobody must still be findable post-hoc. dump() never raises.
+        dump = recorder.dump("shutdown")
+        if dump:
+            print(json.dumps({"flight": dump}), flush=True)
         metrics.close()
 
 

@@ -29,6 +29,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from ddp_tpu.obs.reqtrace import (  # noqa: E402
+    reconstruct_requests,
+    validate_request_timeline,
+)
 from ddp_tpu.obs.tracer import validate_trace_file  # noqa: E402
 from ddp_tpu.utils.metrics import StatSummary  # noqa: E402
 
@@ -96,6 +100,35 @@ def merge_traces(paths: list[str]) -> dict:
             ent = counters.setdefault(key, {"samples": 0, "max": value})
             ent["samples"] += 1
             ent["max"] = max(ent["max"], value)
+    # Per-request timelines (obs/reqtrace.py async spans, cat
+    # "request"): reconstruct each trace id's lifecycle ACROSS rank
+    # files and causally validate it — the merged sidecar answers
+    # "did every request's admit→retire chain survive the merge"
+    # without opening the Perfetto UI. Partial timelines (ring
+    # overwrite, a request mid-flight at export) are counted, not
+    # fatal: a merged fleet view must degrade, not refuse.
+    requests: dict = {}
+    req_timelines = reconstruct_requests(events)
+    if req_timelines:
+        causal_ok = 0
+        by_reason: dict[str, int] = {}
+        problems: list[str] = []
+        for tid, timeline in req_timelines.items():
+            try:
+                summary = validate_request_timeline(timeline)
+            except ValueError as e:
+                if len(problems) < 8:
+                    problems.append(f"{tid}: {e}")
+                continue
+            causal_ok += 1
+            reason = summary.get("reason") or "?"
+            by_reason[reason] = by_reason.get(reason, 0) + 1
+        requests = {
+            "count": len(req_timelines),
+            "causal_ok": causal_ok,
+            "by_reason": by_reason,
+            **({"problems": problems} if problems else {}),
+        }
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -104,6 +137,7 @@ def merge_traces(paths: list[str]) -> dict:
             "ranks": ranks,
             "dropped_events": dropped,
             **({"counters": counters} if counters else {}),
+            **({"requests": requests} if requests else {}),
             "span_summaries": {
                 n: s.to_state() for n, s in merged_summaries.items()
             },
@@ -122,6 +156,11 @@ def main(argv=None) -> None:
         help="trace files and/or directories of *.trace.json",
     )
     p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "--request", default=None, metavar="ID",
+        help="also print one request's reconstructed timeline (hex "
+        "trace id, e.g. 0x63cb...) from the merged events",
+    )
     args = p.parse_args(argv)
 
     paths = expand_inputs(args.inputs, output=args.output)
@@ -146,9 +185,23 @@ def main(argv=None) -> None:
                     if "counters" in merged["ddp_tpu"]
                     else {}
                 ),
+                **(
+                    {"requests": merged["ddp_tpu"]["requests"]}
+                    if "requests" in merged["ddp_tpu"]
+                    else {}
+                ),
             }
         )
     )
+    if args.request:
+        timelines = reconstruct_requests(merged["traceEvents"])
+        timeline = timelines.get(args.request)
+        if timeline is None:
+            raise SystemExit(
+                f"{args.request}: no such request in the merged trace "
+                f"(known ids: {sorted(timelines)[:8]}...)"
+            )
+        print(json.dumps({"request": args.request, "events": timeline}))
 
 
 if __name__ == "__main__":

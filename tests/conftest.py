@@ -151,6 +151,15 @@ _SMOKE_PATTERNS = (
     # exposition lint (the trace-schema validator's siblings)
     "test_health.py::test_disabled_health_is_pinned_free",
     "test_promtext.py::test_builder_render_and_validate",
+    # request tracing + SLO (ISSUE 11): span schema + causal-ordering
+    # validation, the seeded-breach gauge lint (validate_promtext
+    # over every new gauge), and the off-is-free exposition pin
+    "test_reqtrace.py::TestPerfettoExport::"
+    "test_exported_spans_reconstruct_causally",
+    "test_slo.py::TestEngineAndGauges::"
+    "test_seeded_breach_visible_everywhere",
+    "test_slo.py::TestEngineAndGauges::"
+    "test_disabled_exposition_byte_identical",
     "test_optim_extras.py::TestParamEma::test_recurrence_exact",
     # one real trainer e2e (the priciest smoke entry, ~1 min compile)
     "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",
@@ -314,6 +323,11 @@ _SLOW_PATTERNS = (
     "test_spec_decode.py::TestSpecEngine::test_compile_counts_stable_and_labeled",
     "test_spec_decode.py::TestSpecEngine::test_selfdraft_acceptance_is_one",
     "test_spec_decode.py::TestVerifyStep::test_full_match_advances_gamma",
+    # ISSUE-11 request tracing: the speculative-engine timeline pin
+    # compiles the whole draft program set (~10 s); the plain-engine
+    # schema/causality/transfer pins stay in tier-1.
+    "test_reqtrace.py::TestSpecRounds::"
+    "test_spec_engine_timeline_carries_rounds",
 )
 
 

@@ -566,3 +566,65 @@ def test_health_report_golden(tmp_path):
     )
     assert proc2.returncode != 0
     assert "empty.jsonl" in proc2.stderr
+
+
+# Serve triage (ISSUE 11): the SAME trainer stream plus serve-path
+# records (serve_request / serve_step / slo_breach, the
+# scripts/serve.py --metrics_file shapes). The serve section renders
+# ONLY when these records exist — the plain-trainer golden above
+# staying byte-identical IS the gating pin.
+_SERVE_REPORT_FIXTURE = _REPORT_FIXTURE + [
+    {"kind": "serve_step", "time": 6.0, "step": 1, "queue_depth": 2,
+     "active_slots": 2, "slot_occupancy": 1.0, "evictions": 0,
+     "tokens": 3, "prefill_chunk_tokens": 8, "dispatch_s": 0.002,
+     "retire_s": 0.001},
+    {"kind": "serve_step", "time": 6.1, "step": 2, "queue_depth": 0,
+     "active_slots": 2, "slot_occupancy": 1.0, "evictions": 0,
+     "tokens": 2, "prefill_chunk_tokens": 0, "dispatch_s": 0.001,
+     "retire_s": 0.001},
+    {"kind": "serve_request", "time": 6.2, "rid": 0,
+     "status": "complete", "prompt_len": 5, "new_tokens": 4,
+     "decode_tokens_per_s": 120.0, "ttft_s": 0.031, "queue_s": 0.004,
+     "tpot_s": 0.0083, "spec_acceptance": 0.75,
+     "trace_id": "0x00000000deadbeef"},
+    {"kind": "serve_request", "time": 6.3, "rid": 1,
+     "status": "complete", "prompt_len": 3, "new_tokens": 3,
+     "decode_tokens_per_s": 95.0, "ttft_s": 0.062, "queue_s": 0.011,
+     "tpot_s": 0.0105, "spec_acceptance": 0.5,
+     "trace_id": "0x00000000cafef00d"},
+    {"kind": "serve_request", "time": 6.4, "rid": 2,
+     "status": "timeout_queue", "prompt_len": 7, "new_tokens": 0,
+     "decode_tokens_per_s": 0.0},
+    {"kind": "slo_breach", "time": 6.5, "objective": "ttft_p99",
+     "target": 0.05, "current": 0.062, "burn_rate_fast": 33.3,
+     "burn_rate_slow": 33.3, "window_n": 2},
+]
+
+
+def test_health_report_serve_section_golden(tmp_path):
+    """Golden pin for the serve triage section (TTFT/TPOT/queue
+    percentiles, status mix, spec acceptance, SLO burn), and the
+    gating guarantee: the serve lines appear IFF serve records do."""
+    fixture = tmp_path / "serve_metrics.jsonl"
+    fixture.write_text(
+        "".join(json.dumps(r) + "\n" for r in _SERVE_REPORT_FIXTURE)
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "health_report.py"),
+            str(fixture),
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    golden = open(
+        os.path.join(REPO, "tests", "golden", "serve_report.txt")
+    ).read()
+    assert proc.stdout == golden
+    # The serve section is strictly additive over the trainer report:
+    # every pre-existing line renders unchanged, in order.
+    trainer_golden = open(
+        os.path.join(REPO, "tests", "golden", "health_report.txt")
+    ).read()
+    assert set(trainer_golden.splitlines()) <= set(golden.splitlines())
