@@ -949,6 +949,11 @@ def run_serve_bench(
             "cache_bytes_per_slot": v_eng.cache_bytes_per_slot(),
             "compile_programs": sum(counts.values()),
             "compile_budget": v_eng.compile_budget(),
+            # Paged-KV pool/prefix gauges (PR 12) — absent on the
+            # fixed-lane variants, same gate as /metricsz.
+            **(
+                {"paged": v_eng.page_stats()} if v_eng.paged else {}
+            ),
             **_env_fields(),
         }
 
@@ -981,6 +986,13 @@ def run_serve_bench(
         ),
         "int8_kv": _variant("int8_kv", decode_attn="auto",
                             kv_dtype="int8"),
+        # Paged KV (PR 12): page-pool cache + radix prefix index at
+        # the capacity-neutral pool size. This traffic has no shared
+        # prefixes, so the record measures the paged layout's pure
+        # overhead (gather/scatter through the table); the reuse win
+        # is serve_prefix's job.
+        "paged_kv": _variant("paged_kv", decode_attn="auto",
+                             page_size=16),
     }
     base_bytes = variants["baseline"]["cache_bytes_per_slot"]
     int8_bytes = variants["int8_kv"]["cache_bytes_per_slot"]
@@ -1088,6 +1100,202 @@ def run_serve_bench(
         "wall_s": round(wall, 3),
         "d_model": d,
         "depth": depth,
+        "device_kind": getattr(device, "device_kind", "unknown"),
+    }
+
+
+def run_serve_prefix_bench(
+    *,
+    slots: int = 8,
+    page_size: int = 16,
+    prefix_tokens: int = 96,
+    tail_tokens: int = 16,
+    new_tokens: int = 32,
+    n_requests: int = 24,
+    seed: int = 0,
+) -> dict:
+    """Shared-prefix serving: the paged KV + radix index win (PR 12).
+
+    The serve_decode entry's traffic shares nothing, so it measures
+    the paged layout's overhead; THIS entry measures what the layout
+    exists for. Open-loop traffic where every request shares one
+    system prompt (``prefix_tokens``) and differs only in a short
+    user tail — the fleet-routing regime PAPERS.md #1 identifies as
+    where TPU serving loses to GPU baselines today. One seed request
+    publishes the prefix pages; the rest fork them copy-free. The
+    record carries:
+
+    - token-level **prefix-hit rate** (matched prompt tokens /
+      admitted prompt tokens) — the chunked prefill never runs for
+      matched tokens, so this is the prefill-compute discount;
+    - the **effective-slots multiplier**: peak Σ per-lane page
+      mappings over unique mapped pages — how many lane-copies of
+      residency the pool is serving per physical page (1.0 = the
+      fixed-lane baseline, > 1 = the int8-compounding capacity win);
+    - **TTFT p50/p99 split hit vs miss** — what reuse buys the user;
+    - throughput vs a fixed-lane engine over the identical traffic
+      (honest CPU nulls: off-TPU the gather/scatter overhead and the
+      skipped prefill compute both land on the same cores).
+
+    Both hit-rate and multiplier floors are asserted (>= 0.5 and
+    > 1.5): they are scheduling facts, not timing facts, so a miss is
+    a regression in the radix index, not noise.
+    """
+    import time
+
+    import jax
+    import numpy as np
+
+    from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.serve.engine import ServeEngine
+    from ddp_tpu.utils.metrics import StatSummary
+
+    device = jax.devices()[0]
+    vocab, d, depth, heads = 8192, 1024, 8, 8
+    if device.platform != "tpu":
+        # CPU fallback shape (the serve_decode convention): the
+        # engine/index logic is platform-free; keep it minutes-cheap.
+        vocab, d, depth, heads = 512, 128, 2, 4
+        slots = min(slots, 4)
+        prefix_tokens, tail_tokens = min(prefix_tokens, 48), 8
+        new_tokens, n_requests = min(new_tokens, 16), min(n_requests, 12)
+    prompt_len = prefix_tokens + tail_tokens
+    total_len = prompt_len + new_tokens
+    if total_len % page_size:
+        total_len += page_size - total_len % page_size
+    spec = LMSpec(
+        vocab_size=vocab, total_len=total_len, d_model=d,
+        depth=depth, num_heads=heads,
+    )
+    params = init_lm(spec, seed=0)
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, vocab, prefix_tokens).tolist()
+    prompts = [
+        prefix + rng.integers(0, vocab, tail_tokens).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def _drive(eng) -> dict:
+        """Identical traffic shape per engine: the first request runs
+        alone (on the paged engine it publishes the prefix), then the
+        rest arrive as a burst — concurrent lanes really fork."""
+        eng.warmup()
+        counts = eng.compile_counts()
+        t0 = time.perf_counter()
+        rids = [eng.submit(prompts[0], new_tokens).request.rid]
+        eng.run()
+        eff_peak = None
+        for p in prompts[1:]:
+            adm = eng.submit(p, new_tokens)
+            assert adm.accepted, adm.reason
+            rids.append(adm.request.rid)
+        while eng.pending:
+            eng.step()
+            ps = eng.page_stats()
+            if ps and ps["effective_slots_multiplier"] is not None:
+                eff_peak = max(
+                    eff_peak or 0.0, ps["effective_slots_multiplier"]
+                )
+        wall = time.perf_counter() - t0
+        assert eng.compile_counts() == counts, (
+            "serve_prefix recompiled after warmup"
+        )
+        hit_ttft, miss_ttft = StatSummary(), StatSummary()
+        tokens = 0
+        for r in rids:
+            c = eng.result(r)
+            assert c is not None and c.status == "complete", (
+                r, None if c is None else c.status
+            )
+            tokens += len(c.tokens)
+            if c.ttft is None:
+                continue
+            # Fixed-lane completions carry prefix_hit_tokens=None —
+            # no prefix cache means EVERY request pays the miss path,
+            # so the control's TTFTs all land in the miss summary
+            # (ttft_hit_s stays count-0 there by construction).
+            if c.prefix_hit_tokens:
+                hit_ttft.add(c.ttft)
+            else:
+                miss_ttft.add(c.ttft)
+
+        def pct(s, q):
+            return round(s.percentile(q), 4) if s.count else None
+
+        return {
+            "tokens_per_s": round(tokens / wall, 1),
+            "total_tokens": tokens,
+            "wall_s": round(wall, 3),
+            "ttft_hit_s": {
+                "count": hit_ttft.count,
+                "p50": pct(hit_ttft, 50), "p99": pct(hit_ttft, 99),
+            },
+            "ttft_miss_s": {
+                "count": miss_ttft.count,
+                "p50": pct(miss_ttft, 50), "p99": pct(miss_ttft, 99),
+            },
+            "effective_slots_multiplier_peak": eff_peak,
+            **(
+                {"paged": eng.page_stats()} if eng.paged else {}
+            ),
+        }
+
+    paged_eng = ServeEngine(
+        spec, params, slots=slots, prefill_len=prompt_len,
+        max_queue=max(16, n_requests), page_size=page_size,
+    )
+    paged = _drive(paged_eng)
+    baseline = _drive(
+        ServeEngine(
+            spec, params, slots=slots, prefill_len=prompt_len,
+            max_queue=max(16, n_requests),
+        )
+    )
+    hit_rate = paged["paged"]["prefix_hit_rate"]
+    eff = paged["effective_slots_multiplier_peak"]
+    # Scheduling facts, not timing facts (see docstring) — assert.
+    assert hit_rate is not None and hit_rate >= 0.5, (
+        f"prefix hit rate {hit_rate} below the 0.5 floor on a "
+        "shared-prefix workload: radix matching is broken"
+    )
+    assert eff is not None and eff > 1.5, (
+        f"effective-slots multiplier {eff} never exceeded 1.5 with "
+        f"{slots} lanes forking a {prefix_tokens}-token prefix: page "
+        "sharing is broken"
+    )
+    env = _env_fields()
+    _assert_provenance(env)
+    return {
+        "metric": "serve_prefix_hit_rate",
+        "value": hit_rate,
+        **env,
+        **(
+            {
+                "note": "CPU-fallback capture: wall-clock numbers are "
+                "honest CPU nulls (skipped prefill compute and table "
+                "gather overhead share the same cores); hit rate and "
+                "effective-slots multiplier are platform-free facts"
+            }
+            if env["cpu_fallback"]
+            else {}
+        ),
+        "effective_slots_multiplier_peak": eff,
+        "paged_vs_baseline_tokens_per_s": (
+            round(paged["tokens_per_s"] / baseline["tokens_per_s"], 3)
+            if baseline["tokens_per_s"]
+            else None
+        ),
+        "paged_kv": paged,
+        "fixed_lane_baseline": baseline,
+        "unit": "hit fraction",
+        "slots": slots,
+        "page_size": page_size,
+        "kv_pages": paged_eng.kv_pages,
+        "prefix_tokens": prefix_tokens,
+        "tail_tokens": tail_tokens,
+        "new_tokens": new_tokens,
+        "n_requests": n_requests,
+        "total_len": total_len,
         "device_kind": getattr(device, "device_kind", "unknown"),
     }
 
@@ -1792,6 +2000,10 @@ def _run_extra_benches() -> None:
         # engine under open-loop Poisson arrivals — sustained tokens/s
         # + TTFT, the complement of the raw decode scan above.
         ("serve_decode", run_serve_bench),
+        # Shared-prefix serving (PR 12): paged KV + radix prefix
+        # reuse — hit rate, effective-slots multiplier, TTFT hit vs
+        # miss against a fixed-lane control on identical traffic.
+        ("serve_prefix", run_serve_prefix_bench),
         ("loader", run_loader_bench),
     ]:
         try:

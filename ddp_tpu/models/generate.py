@@ -32,6 +32,8 @@ from ddp_tpu.ops.attention import best_attention, dot_product_attention
 from ddp_tpu.ops.decode import (
     decode_attention,
     dequantize_kv,
+    gather_paged_kv,
+    paged_decode_attention,
     quantize_kv,
 )
 
@@ -512,7 +514,164 @@ def init_slot_cache(
     )
 
 
-def _write_kv_rows(cache: SlotCache, layer: int, k, v, pos):
+class PagedSlotCache(NamedTuple):
+    """Paged variant of :class:`SlotCache` (PR 12 — serve/pages.py).
+
+    K/V live in a POOL of ``page_size``-token pages (``k``/``v``:
+    [depth, num_pages, page_size, H_kv, Dh]) instead of per-slot
+    lanes; each slot's logical [total_len] lane is spelled by its row
+    of ``table`` ([S, lane_pages] int32 page ids, lane_pages =
+    total_len // page_size), so two slots whose prompts share a
+    prefix can map the SAME pages copy-free — the radix-index reuse
+    the engine's PrefixCache hands out. ``pos`` is [S] exactly as in
+    SlotCache; ``k_scale``/``v_scale`` ([depth, num_pages, page_size,
+    H_kv] fp32) exist only for int8 pools, mirroring the fixed-lane
+    convention (empty tuples otherwise, two distinct buffers for
+    donation).
+
+    Page id 0 is the engine's reserved SCRATCH page: all-zero table
+    rows (idle lanes, warmup) read and write it, and any write whose
+    position falls at/past the lane's table end is dropped outright
+    (the scatter indices are pushed out of bounds — cleaner than the
+    fixed-lane clamp-to-last-line, and required: a clamped write
+    could land in a page another lane shares).
+
+    The cache KIND is trace-time static (isinstance dispatch), like
+    the int8 dtype: one engine compiles either the paged or the
+    fixed-lane program set, never both.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    pos: jax.Array
+    table: jax.Array
+    k_scale: Any = ()
+    v_scale: Any = ()
+
+    def quantized(self) -> bool:
+        return self.k.dtype == jnp.int8
+
+    @property
+    def page_size(self) -> int:
+        return int(self.k.shape[2])
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.k.shape[1])
+
+
+def init_paged_slot_cache(
+    spec: LMSpec,
+    slots: int,
+    *,
+    num_pages: int,
+    page_size: int,
+    dtype=jnp.float32,
+) -> PagedSlotCache:
+    """Allocate the page pool + all-zero (scratch-mapped) tables.
+
+    ``total_len`` must be a multiple of ``page_size`` (the engine
+    validates and names the flags); the pool's HBM is ``num_pages ·
+    page_size`` cache lines regardless of ``slots`` — the decoupling
+    that turns int8's bytes/slot win into an effective-slots win.
+    """
+    if spec.total_len % page_size:
+        raise ValueError(
+            f"page_size {page_size} must divide total_len "
+            f"{spec.total_len}"
+        )
+    head_dim = spec.d_model // spec.num_heads
+    shape = (spec.depth, num_pages, page_size, _kv_heads(spec), head_dim)
+    scales = (
+        (jnp.zeros(shape[:-1], jnp.float32),
+         jnp.zeros(shape[:-1], jnp.float32))
+        if dtype == jnp.int8
+        else ((), ())
+    )
+    return PagedSlotCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        pos=jnp.zeros((slots,), jnp.int32),
+        table=jnp.zeros(
+            (slots, spec.total_len // page_size), jnp.int32
+        ),
+        k_scale=scales[0],
+        v_scale=scales[1],
+    )
+
+
+def _page_scatter_ids(
+    table: jax.Array, posns: jax.Array, page_size: int, num_pages: int
+):
+    """Absolute positions → (page ids, in-page offsets) for writes.
+
+    ``table``: [..., lane_pages] int32 rows; ``posns``: positions with
+    the same leading batch dims (the decode/verify path passes the
+    whole [S, lane_pages] table with [S, T] positions, a chunk passes
+    one lane's [lane_pages] row with [C] positions). THE one
+    definition of the out-of-lane convention: positions at/past the
+    table's end map to page id ``num_pages`` — OUT of bounds, so the
+    scatter drops them (jit's documented mode), the paged analogue of
+    the fixed-lane position-ceiling clamp, minus the garbage line.
+    """
+    lane_pages = table.shape[-1]
+    pidx = jnp.minimum(posns // page_size, lane_pages - 1)
+    pids = jnp.take_along_axis(table, pidx, axis=-1)
+    pids = jnp.where(
+        posns < lane_pages * page_size, pids, jnp.int32(num_pages)
+    )
+    return pids, posns % page_size
+
+
+def _paged_write_rows(cache: PagedSlotCache, layer: int, k, v, pos):
+    """Paged twin of the fixed-lane row write: scatter each lane's T
+    rows through its page table (quantize-on-write on int8 pools).
+    ``k``/``v``: [S, T, H_kv, Dh]; row t of lane s lands at absolute
+    position ``pos[s] + t``."""
+    T = k.shape[1]
+    posns = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    pids, offs = _page_scatter_ids(
+        cache.table, posns, cache.page_size, cache.num_pages
+    )  # both [S, T]
+    ck, cv, ksc, vsc = cache.k, cache.v, cache.k_scale, cache.v_scale
+    if cache.quantized():
+        qk, k_s = quantize_kv(k)
+        qv, v_s = quantize_kv(v)
+        ck = ck.at[layer, pids, offs].set(qk)
+        cv = cv.at[layer, pids, offs].set(qv)
+        ksc = ksc.at[layer, pids, offs].set(k_s)
+        vsc = vsc.at[layer, pids, offs].set(v_s)
+    else:
+        ck = ck.at[layer, pids, offs].set(k.astype(ck.dtype))
+        cv = cv.at[layer, pids, offs].set(v.astype(cv.dtype))
+    return cache._replace(k=ck, v=cv, k_scale=ksc, v_scale=vsc)
+
+
+def _full_kv(cache, layer: int):
+    """All S lanes' [L, H_kv, Dh] float views for ``layer`` —
+    dequantized if int8, gathered through the page tables if paged.
+    The verify step's key/value source (decode steps go through
+    ops/decode instead, where the flash path avoids materializing
+    this)."""
+    kf, vf = cache.k[layer], cache.v[layer]
+    if isinstance(cache, PagedSlotCache):
+        kf = gather_paged_kv(kf, cache.table)
+        vf = gather_paged_kv(vf, cache.table)
+        if cache.quantized():
+            kf = dequantize_kv(
+                kf, gather_paged_kv(cache.k_scale[layer], cache.table)
+            )
+            vf = dequantize_kv(
+                vf, gather_paged_kv(cache.v_scale[layer], cache.table)
+            )
+        return kf, vf
+    if cache.quantized():
+        kf = dequantize_kv(kf, cache.k_scale[layer])
+        vf = dequantize_kv(vf, cache.v_scale[layer])
+    return kf, vf
+
+
+def _write_kv_rows(cache, layer: int, k, v, pos):
     """Write per-lane K/V rows at each lane's position, in place.
 
     ``k``/``v``: [S, T, H_kv, Dh] float rows for positions
@@ -521,8 +680,12 @@ def _write_kv_rows(cache: SlotCache, layer: int, k, v, pos):
     the cache never holds full-precision lines. Returns the updated
     cache. The vmapped ``dynamic_update_slice`` clamps per lane, so
     callers must pre-clamp ``pos`` when T > 1 (a clamp-shift would
-    silently move the write over live lines).
+    silently move the write over live lines). Paged caches take the
+    scatter-through-the-table twin instead (same rows, same
+    positions; out-of-lane writes dropped, not clamped).
     """
+    if isinstance(cache, PagedSlotCache):
+        return _paged_write_rows(cache, layer, k, v, pos)
     write = jax.vmap(
         lambda lane, row, p: lax.dynamic_update_slice(
             lane, row, (p, 0, 0)
@@ -600,10 +763,21 @@ def slot_decode_step(
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
         cache = _write_kv_rows(cache, i, k, v, pos)
         ksc, vsc = _lane_scales(cache, i)
-        attn = decode_attention(
-            q[:, 0], cache.k[i], cache.v[i], pos, ksc, vsc,
-            impl=attn_impl,
-        )  # [S, H, Dh] fp32
+        if isinstance(cache, PagedSlotCache):
+            # Same banded math over the table's gathered view
+            # (ops/decode.paged_decode_attention, block_k =
+            # page_size) — scratch/stale entries sit past ``pos`` and
+            # are masked, so the paged step is token-identical to the
+            # fixed-lane one (pinned by tests/test_paged.py).
+            attn = paged_decode_attention(
+                q[:, 0], cache.k[i], cache.v[i], cache.table, pos,
+                ksc, vsc, impl=attn_impl,
+            )  # [S, H, Dh] fp32
+        else:
+            attn = decode_attention(
+                q[:, 0], cache.k[i], cache.v[i], pos, ksc, vsc,
+                impl=attn_impl,
+            )  # [S, H, Dh] fp32
         attn = attn.reshape(S, 1, spec.d_model).astype(x.dtype)
         x = _block_finish(spec, p, x, attn)
     x = _layer_norm(x, params["ln_final"])
@@ -878,12 +1052,10 @@ def slot_verify_step(
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
         cache = _write_kv_rows(cache, i, k, v, wstart)
-        ksc, vsc = _lane_scales(cache, i)
-        kf = cache.k[i]
-        vf = cache.v[i]
-        if cache.quantized():
-            kf = dequantize_kv(kf, ksc)
-            vf = dequantize_kv(vf, vsc)
+        # Full [S, L] float views: dequantized if int8, gathered
+        # through the page tables if paged (_full_kv) — the verify
+        # math itself is cache-layout-blind.
+        kf, vf = _full_kv(cache, i)
         qg = q.reshape(S, K, H_kv, G, Dh)
         logits = (
             jnp.einsum(
@@ -1002,8 +1174,26 @@ def prefill_chunk(
     )
     x = x + pe.astype(x.dtype)
     quantized = cache.quantized()
+    paged = isinstance(cache, PagedSlotCache)
     ck, cv = cache.k, cache.v
     ksc, vsc = cache.k_scale, cache.v_scale
+    if paged:
+        # One lane's table row + this chunk's scatter coordinates,
+        # computed once outside the layer loop: positions
+        # [start, start + C) map through the row to (page id, offset)
+        # pairs. The engine's min_bucket clamp keeps start + C <=
+        # total_len (the tail-chunk invariant), so the only
+        # non-private targets are pad positions past the lane's
+        # demand — those rows land in whatever the table says (their
+        # page, or scratch page 0) above the live region, overwritten
+        # before they become attendable exactly like fixed-lane pads.
+        row = lax.dynamic_index_in_dim(
+            cache.table, slot, 0, keepdims=False
+        )  # [lane_pages] int32
+        pids, offs = _page_scatter_ids(
+            row, start + jnp.arange(C, dtype=jnp.int32),
+            cache.page_size, cache.num_pages,
+        )
     for i in range(spec.depth):
         p = params[f"block{i + 1}"]
         q, k, v = _block_qkv(p, x, H, Dh, H_kv)
@@ -1014,40 +1204,68 @@ def prefill_chunk(
             # cache-bytes halving is actually earned.
             wk, k_s = quantize_kv(k)
             wv, v_s = quantize_kv(v)
-            ksc = lax.dynamic_update_slice(
-                ksc, k_s[:, None], (i, slot, start, 0)
-            )
-            vsc = lax.dynamic_update_slice(
-                vsc, v_s[:, None], (i, slot, start, 0)
-            )
+            if paged:
+                ksc = ksc.at[i, pids, offs].set(k_s[0])
+                vsc = vsc.at[i, pids, offs].set(v_s[0])
+            else:
+                ksc = lax.dynamic_update_slice(
+                    ksc, k_s[:, None], (i, slot, start, 0)
+                )
+                vsc = lax.dynamic_update_slice(
+                    vsc, v_s[:, None], (i, slot, start, 0)
+                )
         else:
             wk, wv = k.astype(ck.dtype), v.astype(cv.dtype)
-        ck = lax.dynamic_update_slice(
-            ck, wk[:, None], (i, slot, start, 0, 0)
-        )
-        cv = lax.dynamic_update_slice(
-            cv, wv[:, None], (i, slot, start, 0, 0)
-        )
+        if paged:
+            ck = ck.at[i, pids, offs].set(wk[0])
+            cv = cv.at[i, pids, offs].set(wv[0])
+        else:
+            ck = lax.dynamic_update_slice(
+                ck, wk[:, None], (i, slot, start, 0, 0)
+            )
+            cv = lax.dynamic_update_slice(
+                cv, wv[:, None], (i, slot, start, 0, 0)
+            )
         if lane_attend:
-            lane_k = lax.dynamic_index_in_dim(
-                ck[i], slot, axis=0, keepdims=False
-            )
-            lane_v = lax.dynamic_index_in_dim(
-                cv[i], slot, axis=0, keepdims=False
-            )
-            if quantized:
-                lane_k = dequantize_kv(
-                    lane_k,
-                    lax.dynamic_index_in_dim(
-                        ksc[i], slot, axis=0, keepdims=False
-                    ),
+            if paged:
+                # The lane's logical [L] view is its table row's
+                # gather — write-then-attend, so a continuation chunk
+                # sees both the matched PREFIX pages (the hit's whole
+                # point: those tokens were never prefilled here) and
+                # this chunk's freshly scattered rows.
+                lane_k = jnp.take(ck[i], row, axis=0)
+                lane_k = lane_k.reshape(-1, *lane_k.shape[2:])
+                lane_v = jnp.take(cv[i], row, axis=0)
+                lane_v = lane_v.reshape(-1, *lane_v.shape[2:])
+                if quantized:
+                    sck = jnp.take(ksc[i], row, axis=0)
+                    scv = jnp.take(vsc[i], row, axis=0)
+                    lane_k = dequantize_kv(
+                        lane_k, sck.reshape(-1, sck.shape[2])
+                    )
+                    lane_v = dequantize_kv(
+                        lane_v, scv.reshape(-1, scv.shape[2])
+                    )
+            else:
+                lane_k = lax.dynamic_index_in_dim(
+                    ck[i], slot, axis=0, keepdims=False
                 )
-                lane_v = dequantize_kv(
-                    lane_v,
-                    lax.dynamic_index_in_dim(
-                        vsc[i], slot, axis=0, keepdims=False
-                    ),
+                lane_v = lax.dynamic_index_in_dim(
+                    cv[i], slot, axis=0, keepdims=False
                 )
+                if quantized:
+                    lane_k = dequantize_kv(
+                        lane_k,
+                        lax.dynamic_index_in_dim(
+                            ksc[i], slot, axis=0, keepdims=False
+                        ),
+                    )
+                    lane_v = dequantize_kv(
+                        lane_v,
+                        lax.dynamic_index_in_dim(
+                            vsc[i], slot, axis=0, keepdims=False
+                        ),
+                    )
             attn = dot_product_attention(
                 q.astype(jnp.float32),
                 jnp.repeat(lane_k, G, axis=1)[None].astype(jnp.float32),
@@ -1096,7 +1314,12 @@ def prefill_chunk(
     temps = put(temps, temperature[None].astype(temps.dtype), (slot,))
     top_ps = put(top_ps, top_p[None].astype(top_ps.dtype), (slot,))
     return (
-        SlotCache(k=ck, v=cv, pos=new_pos, k_scale=ksc, v_scale=vsc),
+        # _replace keeps the cache KIND: the paged pytree carries its
+        # table through untouched (tables only change at the engine's
+        # bind/retire host events, never inside a program).
+        cache._replace(
+            k=ck, v=cv, pos=new_pos, k_scale=ksc, v_scale=vsc
+        ),
         new_toks, seeds, steps, temps, top_ps, first,
     )
 

@@ -388,6 +388,37 @@ def render_serve(
         "ddp_tpu_serve_spec_acceptance", dp.get("spec_acceptance"),
         help="lifetime accepted/drafted fraction",
     )
+    # Paged KV + radix prefix cache (PR 12): pool occupancy and
+    # prefix-reuse counters. The whole block is absent-key gated on
+    # the engine's paged mode, so a fixed-lane engine's exposition
+    # stays byte-identical.
+    pg = stats.get("paged") or {}
+    b.add(
+        "ddp_tpu_serve_prefix_hits_total", pg.get("prefix_hits"),
+        metric_type="counter",
+        help="requests that matched cached prefix pages at bind",
+    )
+    b.add(
+        "ddp_tpu_serve_prefix_misses_total", pg.get("prefix_misses"),
+        metric_type="counter",
+    )
+    b.add(
+        "ddp_tpu_serve_prefix_hit_rate", pg.get("prefix_hit_rate"),
+        help="prompt tokens served from cached pages / prompt tokens "
+        "admitted (token-level, lifetime)",
+    )
+    b.add(
+        "ddp_tpu_serve_pages_free", pg.get("pages_free"),
+        help="allocatable pages (excluding evictable cached prefixes)",
+    )
+    b.add(
+        "ddp_tpu_serve_pages_resident", pg.get("pages_resident"),
+        help="pages holding live KV: lane-mapped or prefix-cached",
+    )
+    b.add(
+        "ddp_tpu_serve_pages_shared", pg.get("pages_shared"),
+        help="pages mapped by two or more lanes (copy-free forks)",
+    )
     gp = stats.get("goodput") or {}
     b.add("ddp_tpu_serve_productive_seconds_total", gp.get("productive_s"),
           metric_type="counter")

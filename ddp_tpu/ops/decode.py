@@ -318,6 +318,70 @@ def _decode_body(
         ).astype(o_ref.dtype)
 
 
+# ---- paged KV: gather lane views through int32 page tables ----------
+#
+# The paged cache (models/generate.PagedSlotCache, PR 12) stores K/V
+# as a POOL of page_size-token blocks shared across lanes; a lane's
+# logical [L, H_kv, Dh] view is its page table's gather. Keeping the
+# gather here (rather than inline in generate.py) gives both decode
+# paths one definition: the jnp reference runs the EXACT fixed-lane
+# einsum math over the gathered view (bit-identical off-TPU — the
+# token-identity pin), and the flash path streams the gathered lanes
+# through the same Pallas kernel with block_k = page_size. The gather
+# itself is one XLA dynamic-gather over int32 ids — static shape
+# arithmetic, no host sync (lint TN fixture ddp002_tn.py pins the
+# pattern).
+#
+# Honest cost note: the gather MATERIALIZES the per-lane views before
+# the kernel runs, so on this path the kernel's dead-block skip saves
+# compute only — the gather already paid O(total_len) HBM traffic per
+# layer per step, the bandwidth the fixed-lane banded read avoids.
+# The O(pos) paged hot path needs IN-KERNEL table indexing (a
+# scalar-prefetch BlockSpec index_map resolving page ids per grid
+# row, the vLLM/TPU paged-attention shape) — the wired on-chip
+# follow-up; until then an on-chip capture of paged+flash measures
+# gather + kernel, and bench.py's serve_decode paged_kv sub-record
+# should be read accordingly.
+
+
+def gather_paged_kv(pages: jax.Array, table: jax.Array) -> jax.Array:
+    """[num_pages, page_size, ...] pool + [S, n] int32 table →
+    [S, n·page_size, ...] per-lane views (works for K/V rows AND their
+    int8 per-head scale planes — anything page-major)."""
+    g = jnp.take(pages, table, axis=0)  # [S, n, page_size, ...]
+    S, n, ps = g.shape[:3]
+    return g.reshape(S, n * ps, *g.shape[3:])
+
+
+def paged_decode_attention(
+    q, k_pages, v_pages, table, pos, k_scale=None, v_scale=None, *,
+    impl: str = "reference", interpret: bool | None = None,
+):
+    """Single-query banded attention over paged lanes → [S, H, Dh].
+
+    ``k_pages``/``v_pages``: one layer's page pool ([num_pages,
+    page_size, H_kv, Dh]); ``table``: [S, n_lane_pages] int32 page ids
+    (0 = the engine's scratch page); ``pos``: [S] as in
+    :func:`decode_attention_reference`. Semantics are EXACTLY the
+    fixed-lane call over the table's gathered view — positions past
+    ``pos[s]`` (including every scratch-page line) are masked, so a
+    stale or zero table entry above the live region can never leak
+    into the softmax. ``block_k = page_size`` aligns the flash
+    kernel's dead-block skip with page boundaries (compute-side only
+    here — see the module's cost note: the gather materializes the
+    full lane views first; in-kernel table indexing is the on-chip
+    follow-up).
+    """
+    k = gather_paged_kv(k_pages, table)
+    v = gather_paged_kv(v_pages, table)
+    ks = gather_paged_kv(k_scale, table) if k_scale is not None else None
+    vs = gather_paged_kv(v_scale, table) if v_scale is not None else None
+    return decode_attention(
+        q, k, v, pos, ks, vs,
+        impl=impl, block_k=int(k_pages.shape[1]), interpret=interpret,
+    )
+
+
 # ---- runtime selection + mesh composition ---------------------------
 
 

@@ -10,14 +10,19 @@ Layer map:
 
   scheduler.py   admission control, FIFO queue, deadlines, chunked-
                  prefill planning (pure host)
+  pages.py       paged-KV page pool + radix prefix index: refcounted
+                 page_size-token pages, LRU eviction of cached
+                 prefixes, page-granular prompt matching (pure host)
   engine.py      slots, continuous batching, the device-resident
                  decode loop (fused on-device sampling, chunked
-                 bucketed prefill, bounded compile set)
+                 bucketed prefill, bounded compile set; optional
+                 paged KV + prefix reuse via --page_size)
   server.py      stdlib HTTP frontend + background engine thread
   scripts/serve.py (repo root)  checkpoint → listening server CLI
 """
 
 from ddp_tpu.serve.engine import Completion, ServeEngine  # noqa: F401
+from ddp_tpu.serve.pages import PrefixCache, page_demand  # noqa: F401
 from ddp_tpu.serve.scheduler import (  # noqa: F401
     Admission,
     Request,

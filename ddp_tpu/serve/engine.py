@@ -63,7 +63,10 @@ import numpy as np
 # Aliased: ``prefill_chunk`` is also an engine CONFIG name (the chunk
 # width __init__ parameter), which would shadow the function inside
 # closures defined there.
-from ddp_tpu.models.generate import init_slot_cache
+from ddp_tpu.models.generate import (
+    init_paged_slot_cache,
+    init_slot_cache,
+)
 from ddp_tpu.models.generate import prefill_chunk as _prefill_chunk
 from ddp_tpu.models.generate import (
     slot_decode_sample_step as _decode_sample,
@@ -72,6 +75,7 @@ from ddp_tpu.models.generate import slot_decode_step as _decode_step
 from ddp_tpu.models.generate import slot_verify_step as _verify_step
 from ddp_tpu.models.lm import LMSpec
 from ddp_tpu.obs.tracer import Tracer
+from ddp_tpu.serve.pages import PrefixCache, page_demand
 from ddp_tpu.serve.scheduler import (
     Admission,
     Request,
@@ -115,6 +119,10 @@ class Completion:
     # Request-trace digest (obs/reqtrace.py): trace id + queue/
     # prefill/decode split + spec stats. None with tracing off.
     trace: Optional[dict] = None
+    # Paged-KV prefix reuse only (PR 12): prompt tokens served from
+    # cached prefix pages — zero prefill compute paid for them. None
+    # on fixed-lane engines; 0 = paged but missed.
+    prefix_hit_tokens: Optional[int] = None
 
     @property
     def decode_tokens_per_s(self) -> float:
@@ -135,6 +143,10 @@ class Completion:
 class _Slot:
     """Host-side bookkeeping for one lane."""
 
+    # Lane index, fixed at engine construction. Identity, not a
+    # field-equality lookup: two freshly-reset slots compare equal
+    # under the generated __eq__, so list.index() would be wrong.
+    index: int = -1
     request: Optional[Request] = None
     tokens: list[int] = field(default_factory=list)
     # Tokens SCHEDULED on device for this request, including ones whose
@@ -149,6 +161,11 @@ class _Slot:
     # the verify round's matched counts are fetched anyway).
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Paged mode only: the page ids this lane's table maps (prefix +
+    # private, in position order) and how many leading prompt tokens
+    # came from cached prefix pages.
+    pages: list[int] = field(default_factory=list)
+    matched_tokens: int = 0
 
     @property
     def free(self) -> bool:
@@ -182,6 +199,22 @@ class ServeEngine:
     can ride along with a full decode batch). ``clock`` is injectable
     for deterministic tests; MetricsWriter ``metrics`` may be shared
     with a trainer's stream or omitted.
+
+    ``page_size`` > 0 (power of two dividing ``spec.total_len``)
+    switches the KV cache to the PAGED layout (PR 12): K/V live in a
+    pool of ``kv_pages`` pages (default slots · total_len/page_size +
+    1 — capacity-neutral vs fixed lanes, plus the scratch page) and
+    each lane maps pages through an int32 table, so prompts sharing a
+    prefix prefill it ONCE and fork the pages copy-free
+    (serve/pages.PrefixCache: radix index, refcounts, LRU eviction of
+    cached prefixes). Admission then accounts in free pages instead
+    of lanes × ctx_len; outputs stay token-identical to the
+    fixed-lane engine (pinned by tests/test_paged.py). Speculative
+    decoding composes: the γ-1 write reserve is accounted in pages,
+    while the DRAFT cache stays fixed-lane (it is lane-private —
+    nothing to share); a prefix hit skips TARGET prefill for the
+    matched tokens, which can only lower draft acceptance there,
+    never correctness (verify guarantees the stream).
     """
 
     def __init__(
@@ -202,6 +235,8 @@ class ServeEngine:
         xprof=None,
         decode_attn: str = "auto",
         kv_dtype: str = "fp32",
+        page_size: int = 0,
+        kv_pages: Optional[int] = None,
         draft_spec: Optional[LMSpec] = None,
         draft_params: Any = None,
         spec_tokens: int = 0,
@@ -241,6 +276,50 @@ class ServeEngine:
                 f"kv_dtype must be fp32|int8, got {kv_dtype!r}"
             )
         self.kv_dtype = kv_dtype
+        # Paged KV + radix prefix reuse (PR 12, serve/pages.py):
+        # --page_size > 0 flips the cache to the page-pool layout
+        # (PagedSlotCache) and admission to free-page accounting.
+        # 0 (the default) is the fixed-lane control — byte-identical
+        # transfer shapes, compile counts and /metricsz exposition to
+        # the pre-paging engine.
+        if kv_pages is not None and not page_size:
+            raise ValueError(
+                "--kv_pages needs --page_size (the page pool only "
+                "exists in paged mode)"
+            )
+        self.paged = bool(page_size)
+        self.page_size = int(page_size)
+        if self.paged:
+            if page_size < 1 or (page_size & (page_size - 1)):
+                raise ValueError(
+                    f"--page_size must be a power of two, got "
+                    f"{page_size}"
+                )
+            if spec.total_len % page_size:
+                raise ValueError(
+                    f"--page_size {page_size} must divide the model's "
+                    f"total_len {spec.total_len}: a partial tail page "
+                    "would break the page-granular tail-chunk "
+                    "invariant (every chunk write maps through whole "
+                    "pages)"
+                )
+            self._lane_pages = spec.total_len // page_size
+            self.kv_pages = int(
+                kv_pages
+                if kv_pages is not None
+                # Capacity-neutral default: the pool holds exactly the
+                # fixed-lane layout's lines (+ the scratch page), so
+                # any sharing is pure headroom.
+                else slots * self._lane_pages + 1
+            )
+            if self.kv_pages < self._lane_pages + 1:
+                raise ValueError(
+                    f"--kv_pages {self.kv_pages} cannot hold one "
+                    f"full-context lane: needs >= total_len/"
+                    f"--page_size + 1 scratch = {self._lane_pages + 1}"
+                    " (a maximal request could never bind — permanent "
+                    "queue head starvation)"
+                )
         # Speculative decoding: a draft LM proposes spec_tokens greedy
         # continuations per lane; the target verifies them in ONE
         # batched step (models/generate.slot_verify_step). The verify
@@ -405,11 +484,30 @@ class ServeEngine:
         self._build_info = build_info()
         # {min_bucket · 2^i} ∪ {chunk}: the whole compiled-width set.
         self.buckets = self.scheduler.bucket_list()
-        self._slots = [_Slot() for _ in range(slots)]
-        self._cache = init_slot_cache(
-            spec, slots,
-            dtype=jnp.int8 if kv_dtype == "int8" else jnp.float32,
-        )
+        self._slots = [_Slot(index=i) for i in range(slots)]
+        cache_dtype = jnp.int8 if kv_dtype == "int8" else jnp.float32
+        if self.paged:
+            self._cache = init_paged_slot_cache(
+                spec, slots,
+                num_pages=self.kv_pages, page_size=self.page_size,
+                dtype=cache_dtype,
+            )
+            self._prefix = PrefixCache(self.kv_pages, self.page_size)
+            # Host mirror of the device page table: mutated at
+            # bind/retire, uploaded (one [S, lane_pages] int32 array)
+            # before the next dispatch — the steady-state decode loop
+            # still transfers nothing but the [S] token vector.
+            self._table_np = np.zeros(
+                (slots, self._lane_pages), np.int32
+            )
+            self._table_dirty = False
+            # Admission stalls where the FIFO head's page demand
+            # outran the pool (requeued, retried next step).
+            self.page_starved_binds = 0
+        else:
+            self._cache = init_slot_cache(
+                spec, slots, dtype=cache_dtype,
+            )
         # Device-resident token vector: output of the last decode (or
         # chunk splice), input to the next — the decode loop never
         # routes tokens through the host. NOT donated anywhere: the
@@ -701,6 +799,26 @@ class ServeEngine:
             leaves += [self._cache.k_scale, self._cache.v_scale]
         return sum(int(x.nbytes) for x in leaves) // self.num_slots
 
+    def page_stats(self) -> Optional[dict]:
+        """Paged-mode pool/index snapshot (None on fixed-lane engines
+        — the /metricsz absent-key gate). ``effective_slots_multiplier``
+        is the reuse win: pages the lane-copies baseline would keep
+        resident (Σ per-lane mappings) over the UNIQUE mapped pages —
+        1.0 with no sharing, > 1 when prefix pages are forked."""
+        if not self.paged:
+            return None
+        refs = self._prefix.mapped_page_refs
+        uniq = self._prefix.mapped_pages
+        return {
+            **self._prefix.stats(),
+            "lane_pages": self._lane_pages,
+            "pages_mapped": uniq,
+            "page_starved_binds": self.page_starved_binds,
+            "effective_slots_multiplier": (
+                round(refs / uniq, 3) if uniq else None
+            ),
+        }
+
     def spec_acceptance_rate(self) -> Optional[float]:
         """Lifetime draft-acceptance fraction, None before any verify
         round (or when speculation is off)."""
@@ -762,6 +880,12 @@ class ServeEngine:
                 }
                 if include_states
                 else {}
+            ),
+            # Paged KV + prefix index (PR 12): absent on fixed-lane
+            # engines, so the default /metricsz exposition stays
+            # byte-identical to the pre-paging engine's.
+            **(
+                {"paged": self.page_stats()} if self.paged else {}
             ),
             # SLO + request-trace state render only when configured:
             # with both off the /metricsz exposition stays
@@ -882,7 +1006,22 @@ class ServeEngine:
             req = self.scheduler.next_request()
             if req is None:
                 break
-            self._admit_to_slot(slot, req)
+            if self._admit_to_slot(slot, req) == "starved":
+                # Page-starved bind: _admit_to_slot put the FIFO head
+                # back at the queue front — stop admitting (later
+                # requests must not overtake it) and retry after
+                # retirements free pages.
+                break
+
+        # Paged mode: page-table mutations (binds above, retires at
+        # the top of this step) upload ONCE here, before any dispatch
+        # — one [S, lane_pages] int32 host→device copy per mutating
+        # step, nothing on the steady-state path.
+        if self.paged and self._table_dirty:
+            self._cache = self._cache._replace(
+                table=jnp.asarray(self._table_np)
+            )
+            self._table_dirty = False
 
         # Everything below is device dispatch + the one-step-lagged
         # retirement; anything fetched in (6) was dispatched LAST step
@@ -1054,6 +1193,21 @@ class ServeEngine:
                     round(accepted / drafted, 4) if drafted else None
                 ),
             )
+        if self.paged:
+            # Paged gauges ride the step stream (health_report's
+            # page/prefix triage line keys on their presence);
+            # fixed-lane engines keep the serve_step schema
+            # byte-identical.
+            p = self._prefix
+            spec_fields.update(
+                pages_free=p.free_pages,
+                pages_resident=p.resident_pages,
+                pages_shared=p.shared_pages,
+                prefix_hit_rate=(
+                    round(p.hit_rate(), 4)
+                    if p.hit_rate() is not None else None
+                ),
+            )
         self.metrics.write(
             "serve_step",
             step=self._steps,
@@ -1164,15 +1318,19 @@ class ServeEngine:
         )
         return produced
 
-    def _admit_to_slot(self, slot: _Slot, req: Request) -> bool:
-        """Bind a popped request to a lane; False = rejected instead.
+    def _admit_to_slot(self, slot: _Slot, req: Request) -> str:
+        """Bind a popped request to a lane → "bound" | "rejected" |
+        "starved".
 
-        The belt to admission's braces: a prompt that cannot be served
-        (longer than the admission ceiling, or leaving no room to
-        decode) but slipped past the front door — a mutated scheduler
-        config, a future code path — completes as REJECTED_TOO_LONG
-        here rather than surfacing as a cryptic shape error from the
-        middle of a jitted program.
+        "rejected": the belt to admission's braces — a prompt that
+        cannot be served (longer than the admission ceiling, or
+        leaving no room to decode) but slipped past the front door —
+        a mutated scheduler config, a future code path — completes
+        as REJECTED_TOO_LONG here rather than surfacing as a cryptic
+        shape error from the middle of a jitted program. "starved"
+        (paged only): the page pool could not satisfy the request's
+        demand — it went back to the queue FRONT and the caller must
+        stop admitting this step.
         """
         if len(req.prompt) > min(self.prefill_len, self.spec.total_len - 1):
             now = self.clock()
@@ -1184,14 +1342,61 @@ class ServeEngine:
             self._completed[req.rid] = c
             self._retire_trace(c)
             self._record_request(c)
-            return False
+            return "rejected"
+        matched = 0
+        pids: list[int] = []
+        if self.paged:
+            # Page-based admission (the lanes×ctx_len ceiling's
+            # replacement): the lane must own every page it could
+            # write — prompt + decode budget + the speculative γ-1
+            # write reserve, in PAGES (serve/pages.page_demand), so a
+            # verify round can never scatter into an unowned page.
+            got = self._prefix.acquire(
+                req.prompt,
+                page_demand(
+                    len(req.prompt), req.max_new_tokens,
+                    self.page_size,
+                    total_len=self.spec.total_len,
+                    reserve=self.spec_tokens - 1
+                    if self.spec_tokens else 0,
+                ),
+            )
+            if got is None:
+                # Pool exhausted even after LRU eviction: requeue at
+                # the FRONT (FIFO order intact) and let the caller
+                # stop admitting until retirements free pages.
+                self.page_starved_binds += 1
+                self.scheduler.push_front(req)
+                return "starved"
+            pids, matched = got
         slot.request = req
         slot.tokens = []
         slot.emitted = 0
-        slot.prefill_pos = 0
+        slot.prefill_pos = matched
         slot.first_token_at = None
         slot.spec_drafted = 0
         slot.spec_accepted = 0
+        slot.pages = pids
+        slot.matched_tokens = matched
+        if self.paged:
+            self._table_np[slot.index] = 0
+            self._table_np[slot.index, : len(pids)] = pids
+            self._table_dirty = True
+            # Position FLOOR at the matched-prefix length, applied on
+            # device before any dispatch: idle-shape decode steps
+            # write garbage at each lane's pos between chunks, and
+            # pos >= matched at all times is exactly the invariant
+            # that keeps those writes out of SHARED prefix pages
+            # (private pages tolerate them — every line is rewritten
+            # by its covering chunk or decode step before it becomes
+            # attendable, the PR-3 invariant). A hit also skips the
+            # matched pages' prefill outright: the first tail chunk
+            # starts at ``matched`` through the continuation program.
+            self._cache = self._cache._replace(
+                pos=self._cache.pos.at[jnp.asarray(slot.index)].set(
+                    jnp.int32(matched)
+                )
+            )
         # Queue wait closes here: the SLI behind queue_s_p99 and the
         # req.queue span (the bind is already a host-side touch point).
         slot.queue_s = max(0.0, self.clock() - req.submitted)
@@ -1203,7 +1408,7 @@ class ServeEngine:
         # Sampling config reaches the device with the request's first
         # chunk (prefill_chunk installs it at the lane) — nothing to
         # upload here.
-        return True
+        return "bound"
 
     def _drain(self, items: Optional[list] = None) -> int:
         """Fetch dispatched-but-unread token values → tokens appended.
@@ -1267,6 +1472,9 @@ class ServeEngine:
                 else None
             ),
             queue_s=slot.queue_s,
+            prefix_hit_tokens=(
+                slot.matched_tokens if self.paged else None
+            ),
         )
         self._completed[req.rid] = c
         if len(c.tokens) > 1:
@@ -1277,6 +1485,19 @@ class ServeEngine:
             self.accept_rate.add(c.spec_acceptance)
         self._retire_trace(c)
         self._record_request(c)
+        if self.paged and slot.pages:
+            # Publish the prompt's fully-prefilled pages into the
+            # radix index (decode output stays private; prefill_pos
+            # caps mid-prefill evictions) and unmap everything the
+            # lane held — published pages go LRU-cached at refcount
+            # 0, the rest return to the free list. The lane's table
+            # row zeroes (→ the scratch page) so the idle-shape
+            # decode can never write into freed/reallocated pages.
+            self._prefix.release(
+                req.prompt, slot.pages, slot.prefill_pos
+            )
+            self._table_np[slot.index] = 0
+            self._table_dirty = True
         slot.request = None
         slot.tokens = []
         slot.emitted = 0
@@ -1285,6 +1506,8 @@ class ServeEngine:
         slot.queue_s = None
         slot.spec_drafted = 0
         slot.spec_accepted = 0
+        slot.pages = []
+        slot.matched_tokens = 0
 
     def _retire_trace(self, c: Completion) -> None:
         """Close the request's trace (if tracing) and hang the digest
@@ -1365,6 +1588,10 @@ class ServeEngine:
         # byte-compatible with the pre-reqtrace stream).
         if c.trace is not None:
             fields["trace_id"] = c.trace["trace_id"]
+        # Prefix-reuse accounting, paged engines only (the bench's
+        # TTFT hit-vs-miss split reads this).
+        if c.prefix_hit_tokens is not None:
+            fields["prefix_hit_tokens"] = c.prefix_hit_tokens
         self.metrics.write("serve_request", **fields)
         # Feed the SLO engine from the same retirement: the SLIs are
         # host floats already in hand, and availability counts every

@@ -256,6 +256,15 @@ class Scheduler:
         budget would starve even the first chunk, one unbudgeted
         chunk is planned anyway — an idle engine must make prefill
         progress.
+
+        Paged engines (PR 12) need no per-step PAGE accounting here:
+        a lane's whole page demand — every chunk's live tokens, the
+        decode budget, and the speculative γ-1 write reserve — is
+        acquired at BIND (serve/pages.page_demand), so any chunk this
+        planner schedules writes into pages the lane already owns
+        (pad overhang past the demand falls into the scratch page).
+        The token budget stays the compute-side constraint; pages
+        are the residency-side one.
         """
         budget = (
             self.token_budget - decoding
@@ -297,6 +306,20 @@ class Scheduler:
         deadline check on its first decode step.
         """
         return self._queue.popleft() if self._queue else None
+
+    def push_front(self, req: Request) -> None:
+        """Return a popped request to the queue HEAD, order intact.
+
+        The paged engine's admission backpressure (PR 12): with a
+        paged KV cache the binding resource is FREE PAGES, not lanes ×
+        ctx_len — a popped head whose page demand (serve/pages.
+        page_demand, γ-reserve included) cannot be satisfied even
+        after LRU eviction goes back to the front and admission stops
+        for the step, so a big request is delayed, never starved by
+        smaller ones overtaking it. Deliberately exempt from the
+        ``max_queue`` bound: the request was already admitted once.
+        """
+        self._queue.appendleft(req)
 
     @property
     def depth(self) -> int:

@@ -306,6 +306,29 @@ def build_report(records: list[dict]) -> str:
                 f"{_fmt(acc.snapshot().get('mean'), 4)} over "
                 f"{acc.count} request(s)"
             )
+        # Paged-KV page/prefix triage (PR 12): only when the stream
+        # carries paged serve_step fields — fixed-lane streams (and
+        # every pre-paging golden) stay byte-identical.
+        paged_steps = [
+            r for r in serve_steps if r.get("pages_free") is not None
+        ]
+        if paged_steps:
+            last = paged_steps[-1]
+            hits = sum(
+                1 for r in serve_reqs if r.get("prefix_hit_tokens")
+            )
+            misses = sum(
+                1
+                for r in serve_reqs
+                if r.get("prefix_hit_tokens") == 0
+            )
+            lines.append(
+                f"pages         : free {_fmt(last.get('pages_free'))}"
+                f", resident {_fmt(last.get('pages_resident'))}"
+                f", shared {_fmt(last.get('pages_shared'))}; prefix "
+                f"hit rate {_fmt(last.get('prefix_hit_rate'), 4)} "
+                f"({hits} hit / {misses} miss)"
+            )
         if slo_breaches:
             last = slo_breaches[-1]
             lines.append(

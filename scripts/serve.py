@@ -144,6 +144,21 @@ def main() -> None:
         "slot drops ~2.7x, so a chip fits more --slots",
     )
     p.add_argument(
+        "--page_size", type=int, default=0,
+        help="paged KV + radix prefix cache (serve/pages.py): KV "
+        "lives in a pool of this-many-token pages and prompts "
+        "sharing a prefix prefill it once and fork the pages "
+        "copy-free (power of two dividing total_len; 0 = the "
+        "fixed-lane cache)",
+    )
+    p.add_argument(
+        "--kv_pages", type=int, default=None,
+        help="page-pool size for --page_size (default: slots x "
+        "total_len/page_size + 1 scratch — capacity-neutral vs "
+        "fixed lanes; smaller pools lean on prefix sharing, "
+        "admission waits on free pages)",
+    )
+    p.add_argument(
         "--spec_tokens", type=int, default=0,
         help="speculative decoding: draft-propose this many greedy "
         "tokens per lane per round, verified in ONE target step "
@@ -273,6 +288,8 @@ def main() -> None:
         xprof=Xprof(enabled=args.xprof),
         decode_attn=args.decode_attn,
         kv_dtype=args.kv_dtype,
+        page_size=args.page_size,
+        kv_pages=args.kv_pages,
         draft_spec=draft_spec,
         draft_params=draft_params,
         spec_tokens=args.spec_tokens,
@@ -319,6 +336,11 @@ def main() -> None:
                         "cache_bytes_per_slot":
                             engine.cache_bytes_per_slot(),
                         "spec_tokens": engine.spec_tokens,
+                        **(
+                            {"paged": engine.page_stats()}
+                            if engine.paged
+                            else {}
+                        ),
                         "build_info": build_info(),
                         "reqtrace": bool(args.reqtrace),
                         **({"slo": args.slo} if args.slo else {}),

@@ -328,6 +328,46 @@ _SLOW_PATTERNS = (
     # schema/causality/transfer pins stay in tier-1.
     "test_reqtrace.py::TestSpecRounds::"
     "test_spec_engine_timeline_carries_rounds",
+    # third measured cut (PR 12): the tier-1 wall clock sat at
+    # 736-871 s across back-to-back identical runs on this 1-core
+    # host (~18% load variance) — over the 870 s budget on a bad
+    # day. These are the ≥9 s survivors of the PR-10/11 serve-family
+    # additions (measured via --durations on this host); each builds
+    # its own engine/server pair, and each invariant keeps a cheaper
+    # fast-tier sibling (seeded identity: test_serve seeded pin;
+    # transfer spy: test_serve + test_paged spies; aggregator: the
+    # in-process merge tests in test_slo's engine class).
+    "test_spec_decode.py::TestSpecEngine::test_seeded_equivalent",
+    "test_spec_decode.py::TestSpecEngine::"
+    "test_transfer_stays_small_int32_under_sanitize",
+    "test_serve.py::TestDecodePath::"
+    "test_tail_chunk_near_total_len_matches_generate",
+    "test_slo.py::TestAggregator::"
+    "test_fleet_view_across_two_scraped_endpoints",
+    "test_slo.py::TestAggregator::test_cli_end_to_end",
+    "test_slo.py::TestAggregator::test_offline_metrics_files_merge",
+    # ...and the 6-9 s band, after the cut above still left only
+    # ~25 s of margin on a loaded run (812 s measured): each has a
+    # cheaper fast-tier guard (warmup-count pin: bench.py asserts
+    # compile_counts stability on every capture; flash+int8: the
+    # per-op quantization pins; HTTP surface: test_graceful_drain).
+    "test_serve.py::TestEngine::test_no_recompilation_after_warmup",
+    "test_flash_decode.py::TestFlashEngine::"
+    "test_flash_int8_compose_under_sanitize",
+    "test_serve.py::TestServer::test_http_roundtrip",
+    "test_spec_decode.py::TestSpecEngine::test_metrics_carry_acceptance",
+    # paged KV (PR 12): every identity sweep that compiles its own
+    # engine pair re-measured past (or near) the 9 s line — the
+    # tier-1 budget was already within ~60 s of its 870 s ceiling
+    # before this PR, so only the compile-light pins stay fast: the
+    # transfer spy, /metricsz byte-identity, page-starved FIFO
+    # requeue, the rejection matrix, and the pure-host allocator
+    # property tests. The identity sweeps (incl. the forked-prefix
+    # reuse pin) run in the full round gate like the other heavy
+    # serve identity tests.
+    "test_paged.py::TestTokenIdentity",
+    "test_paged.py::TestTransfersAndCompiles::test_no_recompilation_after_warmup",
+    "test_paged.py::TestConstructionValidation::test_spec_engine_allocates_reserve_pages",
 )
 
 

@@ -133,3 +133,41 @@ def flash_decode_call(q, k, pos):
         out_shape=jax.ShapeDtypeStruct((rows, 1, Dh), jnp.float32),
         scratch_shapes=[pl.ANY((1, Dh), jnp.float32)],
     )(q, k, pos)
+
+
+# ---- paged-KV page-table patterns (serve/pages + PR 12) -------------
+# Page-table gather/scatter is DEVICE-side int32 indexing: jnp.take
+# through an int32 table, advanced-index `.at[...].set` scatters, and
+# //-style page arithmetic over TRACED positions (or static page_size
+# ints) — none of it may read as a host sync even though the paged
+# decode/chunk programs are jit roots.
+
+
+@jax.jit
+def paged_gather_lanes(pages, table):
+    # [num_pages, page_size, H, D] pool + [S, n] int32 table → lanes
+    g = jnp.take(pages, table, axis=0)
+    S, n, ps = g.shape[:3]  # static shape arithmetic, not a sync
+    return g.reshape(S, n * ps, *g.shape[3:])
+
+
+@jax.jit
+def paged_scatter_rows(pool, table, rows, pos, page_size):
+    # traced positions → (page id, offset) pairs; OOB ids drop the
+    # write — all device-side jnp, no host round-trip
+    posns = pos[:, None] + jnp.arange(rows.shape[1], dtype=jnp.int32)
+    lane_pages = table.shape[1]
+    pidx = jnp.minimum(posns // page_size, lane_pages - 1)
+    pids = jnp.take_along_axis(table, pidx, axis=1)
+    pids = jnp.where(
+        posns < lane_pages * page_size, pids,
+        jnp.int32(pool.shape[0]),
+    )
+    return pool.at[pids, posns % page_size].set(rows)
+
+
+def paged_demand_pages(prompt_len, budget, page_size, total_len):
+    # pure host math on host ints (the scheduler's page accounting):
+    # reached only from the engine's host loop, never from a jit root
+    need = min(total_len, prompt_len + budget)
+    return -(-need // page_size)
