@@ -1300,6 +1300,350 @@ def run_serve_prefix_bench(
     }
 
 
+def run_serve_fleet_bench(
+    *,
+    n_replicas: int = 3,
+    slots: int = 4,
+    page_size: int = 16,
+    prefix_tokens: int = 48,
+    tail_tokens: int = 8,
+    new_tokens: int = 8,
+    groups: int = 4,
+    per_group: int = 6,
+    kill_at: int = 12,
+) -> dict:
+    """Fleet serving (ISSUE 14): a REAL ≥3-replica CPU fleet —
+    subprocess ``scripts/serve.py --init_demo`` engines behind the
+    serve/fleet.py router — under open-loop shared-prefix traffic.
+
+    Three phases over one fleet (distinct prefix sets, so the radix
+    caches never cross-pollinate):
+
+    1. **random dispatch** (the control): per-replica prefix-hit
+       rates when traffic sprays everywhere;
+    2. **prefix affinity**: the same traffic shape routed by the
+       prompt-hash → preferred-replica map — the AFFINITY hit rate
+       MUST beat the random one (asserted: it is a routing fact, not
+       a timing fact), plus aggregate tokens/s and p99 TTFT;
+    3. **kill drill**: ``kill:replica1@request<kill_at>`` mid-burst —
+       ALL submitted requests complete (zero dropped, ASSERTED), no
+       completion is delivered twice (fleet trace-id uniqueness,
+       ASSERTED), exactly one replica restart (ASSERTED), replayed
+       requests recorded, and recovery time measured from the
+       SIGKILL to the first completion the restarted replica serves.
+    """
+    import tempfile
+    import threading
+    import time
+    import urllib.request
+
+    import numpy as np
+
+    from ddp_tpu.serve.fleet import (
+        FleetChaos,
+        ReplicaManager,
+        Router,
+        RouterConfig,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab, seq_len = 256, 128
+    n_requests = groups * per_group
+
+    def make_prompts(phase_seed):
+        prng = np.random.default_rng(phase_seed)
+        prefixes = [
+            prng.integers(0, vocab, prefix_tokens).tolist()
+            for _ in range(groups)
+        ]
+        return [
+            prefixes[g] + prng.integers(0, vocab, tail_tokens).tolist()
+            for g in range(groups)
+            for _ in range(per_group)
+        ]
+
+    def paged_counts(url):
+        with urllib.request.urlopen(url + "/statusz", timeout=10) as r:
+            pg = json.loads(r.read()).get("stats", {}).get("paged") or {}
+        return (
+            int(pg.get("prefix_hits") or 0),
+            int(pg.get("prefix_misses") or 0),
+            pg.get("prefix_hit_rate"),
+        )
+
+    def drive(router, prompts):
+        """Per-group seeding request first (publishes the prefix),
+        then the open-loop burst — the serve_prefix traffic shape at
+        fleet scale."""
+        results: list[dict] = []
+        lock = threading.Lock()
+
+        def one(i):
+            status, payload = router.dispatch(
+                {
+                    "prompt_tokens": prompts[i],
+                    "max_new_tokens": new_tokens,
+                }
+            )
+            with lock:
+                # http_status is OURS; the payload's own "status" is
+                # the completion status ("complete"/"timeout_...").
+                results.append(
+                    {"i": i, "http_status": status, **payload}
+                )
+
+        t0 = time.perf_counter()
+        seed_threads = [
+            threading.Thread(target=one, args=(g * per_group,))
+            for g in range(groups)
+        ]
+        for t in seed_threads:
+            t.start()
+        for t in seed_threads:
+            t.join()
+        rest = [
+            i for i in range(len(prompts)) if i % per_group != 0
+        ]
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in rest
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return results, wall
+
+    def phase_summary(results, wall):
+        from ddp_tpu.utils.metrics import StatSummary
+
+        ttft = StatSummary()
+        tokens = 0
+        for r in results:
+            tokens += len(r.get("tokens") or [])
+            if r.get("ttft_s") is not None:
+                ttft.add(r["ttft_s"])
+        return {
+            "completed": sum(
+                1 for r in results if r["http_status"] == 200
+            ),
+            "tokens_per_s": round(tokens / wall, 2) if wall else None,
+            "total_tokens": tokens,
+            "wall_s": round(wall, 3),
+            "ttft_p50_s": (
+                round(ttft.percentile(50), 4) if ttft.count else None
+            ),
+            "ttft_p99_s": (
+                round(ttft.percentile(99), 4) if ttft.count else None
+            ),
+        }
+
+    workdir = tempfile.mkdtemp(prefix="ddp_tpu_fleet_bench_")
+    mgr = ReplicaManager(
+        n_replicas,
+        [
+            "--init_demo",
+            "--slots", str(slots),
+            "--page_size", str(page_size),
+            "--vocab_size", str(vocab),
+            "--seq_len", str(seq_len),
+        ],
+        workdir=workdir,
+        max_restarts=2,
+        restart_backoff=0.2,
+    )
+    record: dict = {"metric": "serve_fleet_affinity_hit_rate"}
+    try:
+        mgr.start()
+        assert mgr.wait_healthy(420), "fleet never became healthy"
+        urls = [r.url for r in mgr.replicas]
+
+        def hit_deltas(before):
+            after = [paged_counts(u) for u in urls]
+            per_replica = []
+            hits = misses = 0
+            for (h0, m0, _), (h1, m1, rate) in zip(before, after):
+                dh, dm = h1 - h0, m1 - m0
+                hits += dh
+                misses += dm
+                per_replica.append(
+                    {
+                        "hits": dh, "misses": dm,
+                        "hit_rate": (
+                            round(dh / (dh + dm), 4)
+                            if dh + dm
+                            else None
+                        ),
+                        "lifetime_hit_rate": rate,
+                    }
+                )
+            total = hits + misses
+            return (
+                round(hits / total, 4) if total else None,
+                per_replica,
+                after,
+            )
+
+        # Phase 1: random dispatch (the control the affinity claim
+        # is measured against).
+        base = [paged_counts(u) for u in urls]
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(affinity=False, trace_seed=1),
+            )
+        )
+        results_r, wall_r = drive(router, make_prompts(101))
+        random_rate, random_per_replica, base = hit_deltas(base)
+
+        # Phase 2: prefix affinity (distinct prefixes — no help from
+        # phase 1's published pages).
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(
+                    affinity=True,
+                    affinity_page=page_size,
+                    trace_seed=2,
+                ),
+            )
+        )
+        results_a, wall_a = drive(router, make_prompts(202))
+        affinity_rate, affinity_per_replica, base = hit_deltas(base)
+
+        # Phase 3: the kill drill.
+        chaos = FleetChaos(f"kill:replica1@request{kill_at}", mgr)
+        kill_time = [None]
+        orig_kill = mgr.kill_replica
+
+        def timed_kill(index):
+            kill_time[0] = time.perf_counter()
+            orig_kill(index)
+
+        mgr.kill_replica = timed_kill
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(
+                    affinity=True,
+                    affinity_page=page_size,
+                    retry_backoff_s=0.02,
+                    trace_seed=3,
+                ),
+                on_dispatch=chaos.on_dispatch,
+            )
+        )
+        results_k, wall_k = drive(router, make_prompts(303))
+        assert mgr.chaos_kills == 1, "the drill never fired"
+        # zero dropped, zero duplicated — ASSERTED
+        dropped = [
+            r for r in results_k if r["http_status"] != 200
+        ]
+        assert not dropped, f"kill drill dropped {len(dropped)} requests"
+        tids = [r["router"]["trace_id"] for r in results_k]
+        assert len(set(tids)) == len(results_k), (
+            "duplicate completion delivered (trace-id collision)"
+        )
+        # The non-vacuous half of zero-dup: (replica, replica-rid)
+        # names the REPLICA-SIDE completion each response came from —
+        # a collision would mean one engine completion was delivered
+        # to two clients (a replayed/hedged response double-served).
+        served = [
+            (r["router"]["replica"], r.get("rid")) for r in results_k
+        ]
+        assert len(set(served)) == len(results_k), (
+            "one replica completion was delivered twice"
+        )
+        # exactly one replica restart
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if mgr.restarts_total == 1 and all(
+                r.state == "healthy" for r in mgr.replicas
+            ):
+                break
+            time.sleep(0.25)
+        assert mgr.restarts_total == 1, (
+            f"expected exactly one restart, saw {mgr.restarts_total}"
+        )
+        # recovery time: SIGKILL → first completion the RESTARTED
+        # replica serves (trickle until the router hands it one).
+        recovery_s = None
+        probe_deadline = time.monotonic() + 120
+        while time.monotonic() < probe_deadline:
+            status, payload = router.dispatch(
+                {
+                    "prompt_tokens": rng.integers(
+                        0, vocab, page_size
+                    ).tolist(),
+                    "max_new_tokens": 2,
+                }
+            )
+            if (
+                status == 200
+                and payload["router"]["replica"] == 1
+            ):
+                recovery_s = time.perf_counter() - kill_time[0]
+                break
+            time.sleep(0.2)
+        kill_drill = {
+            **phase_summary(results_k, wall_k),
+            "killed_replica": 1,
+            "kill_at_request": kill_at,
+            "replays_total": router.replays_total,
+            "retries_total": router.retries_total,
+            "restarts": mgr.restarts_total,
+            "recovery_s": (
+                round(recovery_s, 3) if recovery_s else None
+            ),
+            "dropped": 0,
+            "duplicated": 0,
+        }
+
+        # The headline assert: affinity must beat random dispatch on
+        # per-replica prefix-hit rate — the reason the router hashes
+        # prompts at all. A routing fact, not a timing fact.
+        assert affinity_rate is not None and random_rate is not None
+        assert affinity_rate > random_rate, (
+            f"affinity hit rate {affinity_rate} does not beat random "
+            f"{random_rate}: prefix affinity is not keeping replica "
+            "caches warm"
+        )
+        env = _env_fields()
+        _assert_provenance(env)
+        record.update(
+            value=affinity_rate,
+            **env,
+            unit="hit fraction",
+            random_dispatch_hit_rate=random_rate,
+            affinity_hit_rate=affinity_rate,
+            per_replica_random=random_per_replica,
+            per_replica_affinity=affinity_per_replica,
+            random_dispatch=phase_summary(results_r, wall_r),
+            affinity=phase_summary(results_a, wall_a),
+            kill_drill=kill_drill,
+            n_replicas=n_replicas,
+            slots=slots,
+            page_size=page_size,
+            prefix_tokens=prefix_tokens,
+            tail_tokens=tail_tokens,
+            new_tokens=new_tokens,
+            n_requests_per_phase=n_requests,
+            **(
+                {
+                    "note": "CPU-fallback capture: throughput/TTFT "
+                    "are honest CPU nulls (replicas share cores); "
+                    "hit rates, replay/restart accounting and "
+                    "zero-drop/zero-dup are platform-free facts"
+                }
+                if env["cpu_fallback"]
+                else {}
+            ),
+        )
+    finally:
+        mgr.stop()
+    return record
+
+
 def run_loader_bench(
     *, n: int = 4096, side: int = 96, batch: int = 256, epochs: int = 3
 ) -> dict:
@@ -2004,6 +2348,12 @@ def _run_extra_benches() -> None:
         # reuse — hit rate, effective-slots multiplier, TTFT hit vs
         # miss against a fixed-lane control on identical traffic.
         ("serve_prefix", run_serve_prefix_bench),
+        # Fleet serving (ISSUE 14): a real 3-replica subprocess fleet
+        # behind the router — affinity-vs-random prefix-hit rates
+        # (asserted), aggregate tokens/s + p99 TTFT, and the kill
+        # drill (zero dropped / zero duplicated / one restart,
+        # asserted; recovery time + replays recorded).
+        ("serve_fleet", run_serve_fleet_bench),
         ("loader", run_loader_bench),
     ]:
         try:

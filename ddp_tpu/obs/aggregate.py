@@ -37,12 +37,38 @@ from ddp_tpu.utils.metrics import StatSummary
 MERGED_SUMMARIES = ("ttft_s", "tpot_s", "queue_s", "decode_tokens_per_s")
 
 
+def classify_unreachable(exc: BaseException) -> str:
+    """``'timeout'`` | ``'refused'`` | ``'unreachable'`` for a failed
+    scrape/dispatch — the distinction the fleet router's circuit
+    breaker needs: a TIMEOUT is a maybe-overloaded replica (count it
+    toward the consecutive-failure threshold), a REFUSED connection is
+    a dead one (nothing is listening — eject immediately instead of
+    letting user requests time out against it). ``urllib``'s URLError
+    wraps the underlying OSError in ``.reason``; unwrap before
+    classifying."""
+    import socket
+    import urllib.error
+
+    if isinstance(exc, urllib.error.URLError) and isinstance(
+        exc.reason, BaseException
+    ):
+        exc = exc.reason
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return "timeout"
+    if isinstance(exc, ConnectionRefusedError):
+        return "refused"
+    return "unreachable"
+
+
 def scrape_endpoint(url: str, *, timeout: float = 5.0) -> dict:
     """One endpoint's live view: /statusz JSON + linted /metricsz.
 
     Never raises on a dead endpoint — the fleet view must render with
     a hole where the sick replica is, not crash: failures come back
-    as ``{"ok": False, "error": ...}`` rows.
+    as ``{"ok": False, "health": "timeout"|"refused"|..., "error":
+    ...}`` rows. ``health`` distinguishes a scrape that TIMED OUT
+    (endpoint alive but slow/overloaded) from one that was REFUSED
+    (nothing listening) — the router treats the two differently.
     """
     import urllib.error
     import urllib.request
@@ -58,7 +84,12 @@ def scrape_endpoint(url: str, *, timeout: float = 5.0) -> dict:
             text = r.read().decode()
         view["metricsz_samples"] = validate_promtext(text)
         view["ok"] = bool(view["statusz"].get("ok", False))
-    except (OSError, ValueError) as e:
+        view["health"] = "ok" if view["ok"] else "unhealthy"
+    except ValueError as e:
+        view["health"] = "bad_payload"
+        view["error"] = f"{type(e).__name__}: {e}"
+    except OSError as e:
+        view["health"] = classify_unreachable(e)
         view["error"] = f"{type(e).__name__}: {e}"
     return view
 
@@ -147,6 +178,8 @@ def _endpoint_row(view: dict) -> dict:
         "endpoint": view.get("endpoint"),
         "ok": bool(view.get("ok")),
     }
+    if "health" in view:
+        row["health"] = view["health"]
     if "error" in view:
         row["error"] = view["error"]
         return row
@@ -289,6 +322,10 @@ def render_fleet(fleet: dict) -> str:
         )
     for row in fleet["endpoints"]:
         bits = [f"ok={1 if row['ok'] else 0}"]
+        if not row["ok"] and row.get("health"):
+            # timeout (maybe-overloaded) vs refused (dead) — the two
+            # demand different operator responses, so name which.
+            bits.append(f"health={row['health']}")
         if "error" in row:
             bits.append(f"error={row['error']}")
         if row.get("draining"):

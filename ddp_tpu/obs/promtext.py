@@ -449,6 +449,96 @@ def render_serve(
     return b.render()
 
 
+def render_fleet(
+    snap: dict,
+    *,
+    up: Optional[bool] = None,
+    draining: Optional[bool] = None,
+) -> str:
+    """Fleet router/manager snapshot → exposition (the fleet
+    frontend's /metricsz; serve/fleet.py ``Router.state()`` merged
+    with the manager's counters). Per-replica serving metrics stay on
+    each replica's own /metricsz — these are the ROUTER's facts:
+    health gating, breaker state, replay/hedge accounting, restarts.
+    """
+    b = PromBuilder()
+    if up is not None:
+        b.add(
+            "ddp_tpu_fleet_up", 1 if up else 0,
+            help="1 while at least one replica is dispatchable",
+        )
+    if draining is not None:
+        b.add(
+            "ddp_tpu_fleet_draining", 1 if draining else 0,
+            help="1 while the fleet frontend rejects new admissions",
+        )
+    b.add(
+        "ddp_tpu_fleet_replicas", snap.get("replicas"),
+        help="supervised replica processes",
+    )
+    b.add(
+        "ddp_tpu_fleet_replicas_healthy", snap.get("replicas_healthy"),
+        help="replicas passing /healthz and accepting dispatch",
+    )
+    b.add(
+        "ddp_tpu_fleet_replicas_draining", snap.get("replicas_draining"),
+    )
+    b.add(
+        "ddp_tpu_fleet_replicas_dead", snap.get("replicas_dead"),
+        help="replicas whose process is down (restarting or out of "
+        "restart budget)",
+    )
+    b.add(
+        "ddp_tpu_fleet_breaker_open", snap.get("breaker_open"),
+        help="replicas whose circuit breaker is not closed (open or "
+        "half-open: shedding user traffic)",
+    )
+    b.add(
+        "ddp_tpu_fleet_breaker_opens_total", snap.get("breaker_opens_total"),
+        metric_type="counter",
+        help="lifetime closed->open transitions across all breakers",
+    )
+    b.add(
+        "ddp_tpu_fleet_dispatched_total", snap.get("dispatched_total"),
+        metric_type="counter", help="requests the router dispatched",
+    )
+    b.add(
+        "ddp_tpu_fleet_retries_total", snap.get("retries_total"),
+        metric_type="counter",
+        help="re-dispatches after a failed/backpressured attempt",
+    )
+    b.add(
+        "ddp_tpu_fleet_replays_total", snap.get("replays_total"),
+        metric_type="counter",
+        help="in-flight requests replayed to a surviving replica "
+        "after their replica died mid-request",
+    )
+    b.add(
+        "ddp_tpu_fleet_hedges_total", snap.get("hedges_total"),
+        metric_type="counter",
+        help="straggler requests duplicated to a second replica",
+    )
+    b.add(
+        "ddp_tpu_fleet_hedge_wins_total", snap.get("hedge_wins_total"),
+        metric_type="counter",
+        help="hedged requests the SECOND replica answered first",
+    )
+    b.add(
+        "ddp_tpu_fleet_restarts_total", snap.get("restarts_total"),
+        metric_type="counter",
+        help="replica process restarts the manager performed",
+    )
+    b.add(
+        "ddp_tpu_fleet_rolling_restarts_total",
+        snap.get("rolling_restarts_total"),
+        metric_type="counter",
+        help="completed fleet-wide rolling restarts (drain -> wait "
+        "-> restart -> re-admit, one replica at a time)",
+    )
+    _render_build_info(b, snap.get("build_info"), "ddp_tpu_build_info")
+    return b.render()
+
+
 def render_train(snap: dict) -> str:
     """Trainer telemetry snapshot → exposition.
 

@@ -35,6 +35,18 @@ Spec grammar (comma-separated events; see docs/ROBUSTNESS.md)::
                                   of the latest checkpoint on disk —
                                   the torn-write drill for the
                                   manifest/quarantine fallback path
+    kill:replica<R>@request<N>    FLEET drills (serve/fleet.py): when
+    stall:replica<R>@request<N>:<S>s
+                                  the fleet router dispatches its Nth
+                                  request, the replica MANAGER SIGKILLs
+                                  replica R (the mid-traffic death the
+                                  router must replay around) or
+                                  SIGSTOPs it for S seconds (the
+                                  straggling replica hedging should
+                                  beat; SIGCONT restores it). Replica
+                                  events never fire inside a trainer —
+                                  ``ChaosEngine`` skips them; the
+                                  fleet manager owns their firing.
 
 "Step N" means the global optimizer-step counter (which survives
 restarts via the checkpoint), checked at the step boundary before the
@@ -71,6 +83,15 @@ _STALL_RE = re.compile(
     r":(?P<seconds>\d+(?:\.\d+)?)s$"
 )
 _CORRUPT_RE = re.compile(r"^ckpt_corrupt:latest$")
+# Fleet drills (serve/fleet.py): the trigger point is the router's
+# global dispatch counter, not a training step — a serving fleet has
+# no step clock, but "the Nth request" is just as deterministic.
+_REPLICA_RE = re.compile(
+    r"^(?P<kind>kill|stall)"
+    r":replica(?P<replica>\d+)"
+    r"@request(?P<request>\d+)"
+    r"(?::(?P<seconds>\d+(?:\.\d+)?)s)?$"
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,12 +107,22 @@ class ChaosEvent:
     step: int | None = None
     epoch: int | None = None
     seconds: float = 0.0
+    # Fleet drills: ``replica`` + ``request`` instead of rank +
+    # step/epoch. A replica event belongs to the fleet manager
+    # (serve/fleet.py), never to a trainer rank.
+    replica: int | None = None
+    request: int | None = None
 
     @property
     def token(self) -> str:
         """Canonical spec token (the ledger id; format/parse round-trip)."""
         if self.kind == "ckpt_corrupt":
             return "ckpt_corrupt:latest"
+        if self.replica is not None:
+            base = f"{self.kind}:replica{self.replica}@request{self.request}"
+            if self.kind == "stall":
+                base += f":{self.seconds:g}s"
+            return base
         at = (
             f"step{self.step}" if self.step is not None
             else f"epoch{self.epoch}"
@@ -156,12 +187,40 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
         if _CORRUPT_RE.match(token):
             events.append(ChaosEvent(kind="ckpt_corrupt"))
             continue
+        m = _REPLICA_RE.match(token)
+        if m:
+            kind = m.group("kind")
+            seconds = m.group("seconds")
+            if kind == "stall":
+                if seconds is None:
+                    raise ValueError(
+                        f"chaos replica stall needs a duration: {token!r}"
+                    )
+                if float(seconds) <= 0:
+                    raise ValueError(
+                        f"chaos stall duration must be > 0: {token!r}"
+                    )
+            elif seconds is not None:
+                raise ValueError(
+                    f"chaos replica kill takes no duration: {token!r}"
+                )
+            events.append(
+                ChaosEvent(
+                    kind=kind,
+                    replica=int(m.group("replica")),
+                    request=int(m.group("request")),
+                    seconds=float(seconds) if seconds else 0.0,
+                )
+            )
+            continue
         raise ValueError(
             f"bad chaos event {token!r}; grammar: "
             "kill:rank<R>@step<N>|epoch<N>, "
             "sigterm:rank<R>@step<N>|epoch<N>, "
             "shrink:rank<R>@step<N>|epoch<N>, grow:+1@step<N>|epoch<N>, "
-            "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest"
+            "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest, "
+            "kill:replica<R>@request<N>, "
+            "stall:replica<R>@request<N>:<S>s"
         )
     return tuple(events)
 
@@ -169,6 +228,16 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
 def format_chaos(events: Iterable[ChaosEvent]) -> str:
     """Events → canonical spec string (``parse_chaos`` round-trips)."""
     return ",".join(e.token for e in events)
+
+
+def fleet_events(
+    events: Iterable[ChaosEvent] | str | None,
+) -> tuple[ChaosEvent, ...]:
+    """The replica-scoped subset of a plan — what the fleet manager
+    (serve/fleet.py) owns. Accepts a spec string for CLI plumbing."""
+    if isinstance(events, str) or events is None:
+        events = parse_chaos(events)
+    return tuple(e for e in events if e.replica is not None)
 
 
 def corrupt_latest_checkpoint(
@@ -293,6 +362,11 @@ class ChaosEngine:
     # ---- trigger points ----------------------------------------------
 
     def _mine(self, ev: ChaosEvent) -> bool:
+        if ev.replica is not None:
+            # Fleet events (kill:replica<R>@request<N>) fire from the
+            # replica MANAGER's dispatch counter (serve/fleet.py) —
+            # a trainer rank never owns one.
+            return False
         if ev.kind in ("ckpt_corrupt", "grow"):
             # one filesystem, one corruptor; one world, one grow
             # requester (any single rank works — rank 0 is the

@@ -18,10 +18,23 @@ Layer map:
                  bucketed prefill, bounded compile set; optional
                  paged KV + prefix reuse via --page_size)
   server.py      stdlib HTTP frontend + background engine thread
+  fleet.py       N supervised replica processes behind a health-gated
+                 router: prefix-affinity + least-loaded dispatch,
+                 retry/hedging/circuit-breaking, replay on replica
+                 death, rolling restart (pure host)
   scripts/serve.py (repo root)  checkpoint → listening server CLI
+  scripts/fleet.py (repo root)  N-replica fleet frontend CLI
 """
 
 from ddp_tpu.serve.engine import Completion, ServeEngine  # noqa: F401
+from ddp_tpu.serve.fleet import (  # noqa: F401
+    CircuitBreaker,
+    FleetServer,
+    Replica,
+    ReplicaManager,
+    Router,
+    RouterConfig,
+)
 from ddp_tpu.serve.pages import PrefixCache, page_demand  # noqa: F401
 from ddp_tpu.serve.scheduler import (  # noqa: F401
     Admission,

@@ -53,6 +53,7 @@ compiled shape, so per-request values would recompile per mix.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -184,6 +185,30 @@ class _Slot:
             self.request is not None
             and self.prefill_pos >= len(self.request.prompt)
         )
+
+
+def drain_eta_s(
+    retire_times: list[float], depth: int
+) -> Optional[float]:
+    """Seconds until ``depth`` queued requests drain at the measured
+    retirement rate, from a window of retire clock times.
+
+    The backpressure Retry-After derivation (pure — unit-testable
+    with synthetic clocks): (count-1) retirements over the window's
+    span give requests/second; depth over that rate is the ETA. None
+    when the window can't support a rate (fewer than two retires, or
+    a same-instant burst) — callers fall back to a static hint. An
+    empty queue still returns a positive beat (one retirement
+    period): the 429 raced a retire, and "retry immediately" is how
+    thundering herds start.
+    """
+    if len(retire_times) < 2:
+        return None
+    span = retire_times[-1] - retire_times[0]
+    if span <= 0:
+        return None
+    rate = (len(retire_times) - 1) / span
+    return max(1, depth) / rate
 
 
 class ServeEngine:
@@ -540,6 +565,12 @@ class ServeEngine:
         # Monotone token counter (the aggregator's tokens/s source —
         # per-request rate summaries are not additive across a fleet).
         self.tokens_emitted_total = 0
+        # Recent retirement clock times (bounded): the queue-drain-rate
+        # window behind ``queue_drain_eta_s`` — what a backpressure
+        # 429's Retry-After is derived from, so a rejected client (or
+        # the fleet router) backs off for as long as the queue will
+        # actually take to drain instead of hammering.
+        self._retire_times: deque = deque(maxlen=32)
         # Monotone aggregate counters (the /metricsz exposition needs
         # totals, not just the JSONL event stream): admission rejects
         # by reason, finished requests by status.
@@ -825,6 +856,17 @@ class ServeEngine:
         if not self.spec_drafted_total:
             return None
         return self.spec_accepted_total / self.spec_drafted_total
+
+    def queue_drain_eta_s(self) -> Optional[float]:
+        """Estimated seconds until the CURRENT queue drains, from the
+        recent retirement rate (``drain_eta_s`` over the bounded
+        retire-time window). None before two retirements exist — the
+        caller falls back to a static Retry-After then. This is what a
+        backpressure (queue_full) rejection advertises: "come back
+        when a seat should be free", not a constant."""
+        return drain_eta_s(
+            list(self._retire_times), self.scheduler.depth
+        )
 
     def goodput(self) -> dict:
         """Device-busy seconds over wall seconds since engine start."""
@@ -1477,6 +1519,7 @@ class ServeEngine:
             ),
         )
         self._completed[req.rid] = c
+        self._retire_times.append(now)
         if len(c.tokens) > 1:
             self.decode_rate.add(c.decode_tokens_per_s)
         if c.tpot_s is not None:

@@ -1,0 +1,1598 @@
+"""Fleet serving: supervised replicas behind a health-gated router.
+
+One ``ServeEngine`` is one replica; the ROADMAP's "millions of users"
+need N of them behind a front door where a replica dying is a
+NON-EVENT. This module composes the robustness pieces the repo
+already drills one at a time — per-worker restart-with-backoff
+(runtime/launch.py), graceful drain + ``/healthz`` (serve/server.py),
+deterministic fault injection (runtime/chaos.py), and the fleet
+telemetry view (obs/aggregate.py) — into that front door:
+
+- ``ReplicaManager`` spawns N replica PROCESSES (each a full
+  ``scripts/serve.py`` engine on its own port, restoring the same
+  checkpoint), supervises them with exit classification + capped
+  exponential backoff up to ``max_restarts`` per replica, and keeps
+  every replica's state (``starting`` / ``healthy`` / ``draining`` /
+  ``dead``) current from process liveness plus a ``/healthz`` poll
+  loop. Fleet chaos (``kill:replica<R>@request<N>`` /
+  ``stall:replica<R>@request<N>:<S>s``) fires from here, keyed on the
+  router's global dispatch counter.
+- ``Router`` dispatches requests with least-loaded selection plus
+  PREFIX AFFINITY (a stable hash of the prompt's leading page-aligned
+  tokens names a preferred replica, so the paged radix cache stays
+  warm per replica; spill to least-loaded when the preferred replica
+  is saturated) and wraps every dispatch in the robustness envelope:
+  per-request deadline propagation, bounded retry with jittered
+  exponential backoff on connection-level failures, optional
+  tail-latency hedging (first completion wins, the loser is
+  cancelled), and a per-replica circuit breaker (consecutive-failure
+  threshold → open; half-open probe via ``/healthz``; close on
+  success). A REFUSED connection trips the breaker immediately —
+  nothing is listening — while a TIMEOUT only counts toward the
+  threshold (maybe-overloaded; obs/aggregate.classify_unreachable is
+  the shared classifier). In-flight requests on a replica that dies
+  are REPLAYED to a survivor (safe: a request is stateless
+  prompt+params until completion) and the replay is surfaced in the
+  response's ``router`` digest, never silently duplicated — the
+  router returns exactly one response per request, stamped with a
+  fleet-level trace id.
+- ``FleetServer`` is the HTTP front door: ``POST /generate`` through
+  the router, fleet ``/healthz``/``/statusz``/``/metricsz`` (statusz
+  delegates to obs/aggregate.py, scraped live from member replicas —
+  the view PR 11 built "for the router" now feeds it), and
+  ``POST /rollz`` for a rolling restart: drain → wait → restart →
+  re-admit, one replica at a time, zero dropped requests.
+
+Pure host-side stdlib — no JAX anywhere in this module; the device
+work lives in the replica processes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import itertools
+import json
+import logging
+import os
+import queue as _queue
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional, Sequence
+from urllib.parse import urlsplit
+
+from ddp_tpu.obs.aggregate import classify_unreachable
+from ddp_tpu.obs.reqtrace import (
+    derive_trace_id,
+    format_trace_id,
+    splitmix64,
+)
+from ddp_tpu.runtime.chaos import ChaosEvent, fleet_events
+from ddp_tpu.runtime.launch import classify_exit, free_port
+
+logger = logging.getLogger("ddp_tpu")
+
+# Replica lifecycle states (the router dispatches to HEALTHY only).
+STARTING = "starting"  # process up, /healthz not yet answering ok
+HEALTHY = "healthy"
+DRAINING = "draining"  # finishing lanes, admitting nothing new
+DEAD = "dead"  # process down (restart pending or budget exhausted)
+STOPPED = "stopped"  # deliberately stopped (fleet shutdown)
+
+
+# ---------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Per-replica dispatch gate: closed → open → half-open → closed.
+
+    CLOSED passes traffic; ``threshold`` consecutive failures (or one
+    ``trip()`` — a refused connection) opens it. OPEN sheds all user
+    traffic for ``cooldown_s``, then ``probe_due()`` moves to
+    HALF_OPEN, where the next ``/healthz`` probe decides: success
+    closes, failure re-opens (fresh cooldown). User traffic never
+    probes — the manager's poll loop does, so a sick replica stops
+    timing out user requests the moment it trips. ``clock`` is
+    injectable (tests drive the cooldown explicitly).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        cooldown_s: float = 2.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1: {threshold}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        # Dispatch handler threads and the manager's poll thread both
+        # touch this breaker; its own lock keeps the count/state
+        # transitions atomic without entangling the router/manager
+        # locks.
+        self._mu = threading.Lock()
+        self.state = self.CLOSED
+        self.failures = 0  # consecutive, reset by any success
+        self.opens_total = 0
+        self._opened_at: Optional[float] = None
+
+    def allow_traffic(self) -> bool:
+        """User dispatch passes only while CLOSED (half-open is probed
+        by /healthz, not by user requests)."""
+        return self.state == self.CLOSED
+
+    def probe_due(self) -> bool:
+        """True when the breaker wants a /healthz probe: OPEN past its
+        cooldown (transitions to HALF_OPEN) or already HALF_OPEN (a
+        lost probe must not wedge the breaker open forever)."""
+        with self._mu:
+            if (
+                self.state == self.OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s
+            ):
+                self.state = self.HALF_OPEN
+                return True
+            return self.state == self.HALF_OPEN
+
+    def record_success(self) -> None:
+        """Any successful exchange closes and resets the count."""
+        with self._mu:
+            self.state = self.CLOSED
+            self.failures = 0
+
+    def record_failure(self) -> None:
+        """One more consecutive failure; opens at ``threshold`` (or
+        instantly from HALF_OPEN — the probe failed)."""
+        with self._mu:
+            self.failures += 1
+            if self.state == self.HALF_OPEN or (
+                self.state == self.CLOSED
+                and self.failures >= self.threshold
+            ):
+                self._open()
+
+    def trip(self) -> None:
+        """Immediate open: a REFUSED connection means nothing is
+        listening — eject now rather than letting ``threshold`` user
+        requests time out first."""
+        with self._mu:
+            if self.state != self.OPEN:
+                self._open()
+
+    def _open(self) -> None:
+        self.state = self.OPEN
+        self._opened_at = self._clock()
+        self.opens_total += 1
+        self.failures = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "failures": self.failures,
+            "opens_total": self.opens_total,
+        }
+
+
+# ---------------------------------------------------------------------
+# Transport (injectable: tests drive a fake, no sockets)
+# ---------------------------------------------------------------------
+
+
+class ReplicaUnreachable(Exception):
+    """A dispatch/probe that never produced an HTTP response.
+
+    ``kind`` is obs/aggregate.classify_unreachable's verdict
+    (``timeout`` / ``refused`` / ``unreachable``); ``sent`` is True
+    when the request had been handed to the replica before the
+    failure — the REPLAY case (the replica may have started work;
+    the retry is a replay, and the router says so); ``cancelled``
+    marks a hedging loser whose socket the router closed on purpose.
+    """
+
+    def __init__(self, kind: str, *, sent: bool, cancelled: bool = False):
+        super().__init__(kind)
+        self.kind = kind
+        self.sent = sent
+        self.cancelled = cancelled
+
+
+class _HttpCall:
+    """One cancellable POST: ``run()`` blocks to a response,
+    ``cancel()`` (from another thread) aborts the socket — how a
+    hedging loser dies."""
+
+    def __init__(self, url: str, path: str, body: dict, timeout: float):
+        sp = urlsplit(url)
+        self._conn = http.client.HTTPConnection(
+            sp.hostname, sp.port, timeout=max(0.05, timeout)
+        )
+        self._path = path
+        self._body = body
+        self.cancelled = False
+
+    def run(self) -> tuple[int, dict]:
+        sent = False
+        try:
+            data = json.dumps(self._body).encode()
+            self._conn.request(
+                "POST", self._path, body=data,
+                headers={"Content-Type": "application/json"},
+            )
+            sent = True
+            resp = self._conn.getresponse()
+            payload = json.loads(resp.read() or b"{}")
+            return resp.status, payload
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            raise ReplicaUnreachable(
+                classify_unreachable(e), sent=sent,
+                cancelled=self.cancelled,
+            ) from e
+        finally:
+            self._conn.close()
+
+    def cancel(self) -> None:
+        self.cancelled = True
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class HttpTransport:
+    """The router's only I/O, behind two methods so tests can fake it:
+    ``start`` returns a cancellable call handle, ``get_json`` is the
+    probe/scrape path."""
+
+    def start(
+        self, url: str, path: str, body: dict, timeout: float
+    ) -> _HttpCall:
+        return _HttpCall(url, path, body, timeout)
+
+    def get_json(self, url: str, path: str, timeout: float) -> dict:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + path, timeout=max(0.05, timeout)
+            ) as r:
+                return json.loads(r.read().decode())
+        except (OSError, ValueError) as e:
+            raise ReplicaUnreachable(
+                classify_unreachable(e), sent=True
+            ) from e
+
+
+# ---------------------------------------------------------------------
+# Replica view
+# ---------------------------------------------------------------------
+
+
+class Replica:
+    """One supervised serving process + the router's live view of it.
+
+    The manager owns ``proc``/``state``/``restarts``; the router owns
+    ``inflight`` (its local dispatch count) and the breaker; the poll
+    loop refreshes ``slots``/``active``/``queue_depth`` from
+    ``/healthz``. A bare Replica (no proc) is how unit tests and
+    in-process routers use this class.
+    """
+
+    def __init__(self, index: int, url: Optional[str] = None):
+        self.index = int(index)
+        self.url = url
+        self.state = STARTING if url is None else HEALTHY
+        self.breaker = CircuitBreaker()
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.restarts = 0
+        self.expected_exit = False  # drain/rolling-restart SIGTERM
+        self.respawn_at: Optional[float] = None
+        self.last_exit: Optional[str] = None
+        self.inflight = 0
+        self.slots: Optional[int] = None
+        self.active = 0
+        self.queue_depth = 0
+        self.started_at: Optional[float] = None
+        self.refused_probes = 0  # consecutive, on a non-STARTING replica
+
+    @property
+    def load(self) -> int:
+        """Least-loaded ordering key: router-local in-flight plus the
+        last-probed engine queue depth."""
+        return self.inflight + self.queue_depth
+
+    def snapshot(self) -> dict:
+        return {
+            "index": self.index,
+            "url": self.url,
+            "state": self.state,
+            "inflight": self.inflight,
+            "queue_depth": self.queue_depth,
+            "restarts": self.restarts,
+            "breaker": self.breaker.snapshot(),
+            **(
+                {"last_exit": self.last_exit} if self.last_exit else {}
+            ),
+        }
+
+
+# ---------------------------------------------------------------------
+# Prefix affinity
+# ---------------------------------------------------------------------
+
+
+def affinity_key(prompt: Sequence[int], page: int) -> int:
+    """Stable 64-bit hash of the prompt's leading PAGE-ALIGNED tokens.
+
+    Page-aligned so it keys exactly the pages the replica's radix
+    index can serve: two prompts sharing a prefix through the same
+    page boundary hash identically and land on the same replica,
+    keeping that replica's prefix cache warm (PR 12). Prompts shorter
+    than one page return 0 — no affinity, pure least-loaded. The
+    chained splitmix64 fold is order-sensitive and cheap.
+    """
+    if page <= 0:
+        return 0
+    n = (len(prompt) // page) * page
+    if n <= 0:
+        return 0
+    h = 0
+    for t in prompt[:n]:
+        h = splitmix64(h ^ (int(t) & 0xFFFFFFFFFFFFFFFF))
+    return h or 1
+
+
+def retry_backoff_s(
+    attempt: int, base: float, cap: float, rng: random.Random
+) -> float:
+    """Full-jitter exponential backoff: U(0, min(cap, base·2^attempt)).
+
+    Jitter decorrelates a retry herd (every client that saw the same
+    failure would otherwise retry in lockstep); the cap bounds the
+    worst sleep; the pure form (seeded rng in) is what tests pin.
+    """
+    return rng.uniform(0.0, min(cap, base * (2.0 ** max(0, attempt))))
+
+
+# ---------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RouterConfig:
+    """The robustness-envelope knobs (docs/SERVING.md table)."""
+
+    retry_max: int = 3  # re-dispatch budget per request
+    retry_backoff_s: float = 0.05  # jittered-exponential base
+    retry_backoff_cap_s: float = 1.0
+    hedge_after_s: Optional[float] = None  # None = hedging off
+    affinity_page: int = 16  # 0 = least-loaded only
+    affinity: bool = True  # False = random dispatch (the control)
+    saturation_depth: int = 4  # spill when inflight >= slots + this
+    default_deadline_s: float = 120.0  # requests without a timeout
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 2.0
+    trace_seed: int = 0  # fleet-level trace-id space
+
+
+class Router:
+    """Health-gated dispatch over a fixed replica list.
+
+    One response per request, always: retries, replays and hedges are
+    internal — the caller sees a single (status, payload) stamped
+    with a ``router`` digest (fleet rid + trace id, serving replica,
+    attempts, replays, hedge outcome) so nothing recovers silently.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        config: Optional[RouterConfig] = None,
+        *,
+        transport: Optional[HttpTransport] = None,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+        on_dispatch: Optional[Callable[[int], None]] = None,
+    ):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self.config = config or RouterConfig()
+        self.transport = transport or HttpTransport()
+        self._clock = clock
+        self._rng = rng or random.Random(self.config.trace_seed)
+        # Chaos hook: called with the global dispatch ordinal BEFORE
+        # the attempt goes out — `kill:replica<R>@request<N>` fires
+        # between admission and dispatch, the worst moment.
+        self._on_dispatch = on_dispatch
+        self._lock = threading.Lock()
+        self._rid = itertools.count(1)
+        for r in self.replicas:
+            r.breaker = CircuitBreaker(
+                self.config.breaker_threshold,
+                self.config.breaker_cooldown_s,
+                clock=clock,
+            )
+        self.dispatched_total = 0
+        self.completed_total = 0
+        self.retries_total = 0
+        self.replays_total = 0
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
+        self.no_replica_total = 0
+        self.deadline_exceeded_total = 0
+
+    # ---- selection ---------------------------------------------------
+
+    def _eligible(self, exclude: set[int]) -> list[Replica]:
+        return [
+            r
+            for r in self.replicas
+            if r.state == HEALTHY
+            and r.breaker.allow_traffic()
+            and r.index not in exclude
+        ]
+
+    def _saturated(self, r: Replica) -> bool:
+        slots = r.slots if r.slots else 1
+        return r.inflight >= slots + self.config.saturation_depth
+
+    def _select(
+        self, prompt: Sequence[int], exclude: set[int]
+    ) -> Optional[Replica]:
+        """Affinity-preferred, least-loaded otherwise. Call under the
+        lock. The preferred index is ``key % len(replicas)`` over the
+        FIXED replica list, so it survives restarts (replica N's
+        replacement inherits N's affinity and re-warms the same
+        prefixes)."""
+        elig = self._eligible(exclude)
+        if not elig:
+            return None
+        if not self.config.affinity:
+            return self._rng.choice(elig)
+        key = affinity_key(prompt, self.config.affinity_page)
+        if key:
+            pref = self.replicas[key % len(self.replicas)]
+            if (
+                pref.index not in exclude
+                and pref in elig
+                and not self._saturated(pref)
+            ):
+                return pref
+        return min(elig, key=lambda r: (r.load, r.index))
+
+    # ---- dispatch ----------------------------------------------------
+
+    def dispatch(self, body: dict) -> tuple[int, dict]:
+        """POST /generate through the robustness envelope →
+        (http_status, payload-with-router-digest)."""
+        prompt = body.get("prompt_tokens") or []
+        try:
+            timeout = (
+                float(body["timeout"]) if body.get("timeout") is not None
+                else None
+            )
+        except (TypeError, ValueError):
+            timeout = None
+        # `is not None`, not truthiness: a client's explicit
+        # timeout=0 means "an already-expired deadline" (immediate
+        # 504), never "use the 120s default".
+        deadline = self._clock() + (
+            timeout
+            if timeout is not None
+            else self.config.default_deadline_s
+        )
+        with self._lock:
+            frid = next(self._rid)
+            self.dispatched_total += 1
+            ordinal = self.dispatched_total
+            hook = self._on_dispatch
+        trace_id = derive_trace_id(self.config.trace_seed, frid)
+        if hook is not None:
+            # Outside the lock: the chaos hook may SIGKILL a replica,
+            # and the poll loop needs the lock to mark it dead.
+            hook(ordinal)
+        digest = {
+            "rid": frid,
+            "trace_id": format_trace_id(trace_id),
+            "replica": None,
+            "attempts": 0,
+            "replays": 0,
+            "hedged": False,
+            "hedge_won": False,
+        }
+        exclude: set[int] = set()  # failed THIS request
+        backoff_i = 0
+        idle_rounds = 0  # rounds with NO eligible replica at all
+        hard_failure = False  # any connection-level failure seen
+        # Max measured Retry-After across 429s this request saw: when
+        # the WHOLE fleet is merely full (no hard failures), the
+        # client gets backpressure-with-a-hint, not a fake 502.
+        saturated_retry_after: Optional[float] = None
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                return self._finish(
+                    504, {"error": "deadline_exceeded"}, digest,
+                )
+            with self._lock:
+                first = self._select(prompt, exclude)
+            if first is None:
+                # Idle rounds spend the same budget as failed
+                # attempts: a fleet of open breakers must converge on
+                # a 503, not spin the backoff loop forever.
+                idle_rounds += 1
+                if (
+                    digest["attempts"] + idle_rounds
+                    > self.config.retry_max
+                ):
+                    with self._lock:
+                        self.no_replica_total += 1
+                    # Pure backpressure (every exclusion came from a
+                    # 429, no hard failure) names itself and carries
+                    # the drain hint, whichever exit path exhausts
+                    # the budget first.
+                    saturated = (
+                        saturated_retry_after is not None
+                        and not hard_failure
+                    )
+                    return self._finish(
+                        503,
+                        {
+                            "error": (
+                                "fleet_saturated"
+                                if saturated
+                                else "no_replica_available"
+                            ),
+                            **(
+                                {
+                                    "retry_after_s": round(
+                                        saturated_retry_after, 2
+                                    )
+                                }
+                                if saturated_retry_after is not None
+                                else {}
+                            ),
+                        },
+                        digest,
+                    )
+                # Every currently-eligible replica failed this
+                # request: forget the exclusions after a backoff beat
+                # — one of them (or a restart) may have recovered.
+                exclude = set()
+                self._backoff(backoff_i, remaining)
+                backoff_i += 1
+                continue
+            digest["attempts"] += 1
+            winner, status, payload, hedged, hedge_won, failures = (
+                self._race(first, prompt, body, deadline, exclude)
+            )
+            if hedged:
+                digest["hedged"] = True
+                with self._lock:
+                    self.hedges_total += 1
+                    if hedge_won:
+                        self.hedge_wins_total += 1
+                if hedge_won:
+                    digest["hedge_won"] = True
+            for rep, err in failures:
+                hard_failure = True
+                self._note_failure(rep, err)
+                exclude.add(rep.index)
+                if err.sent:
+                    # The request had reached a replica that then died
+                    # mid-flight: the next attempt is a REPLAY (safe —
+                    # stateless until completion — but never silent).
+                    digest["replays"] += 1
+                    with self._lock:
+                        self.replays_total += 1
+            if winner is not None:
+                if status == 429:
+                    ra = payload.get("retry_after_s")
+                    if isinstance(ra, (int, float)):
+                        saturated_retry_after = max(
+                            saturated_retry_after or 0.0, float(ra)
+                        )
+                handled = self._handle_response(
+                    winner, status, payload, digest, exclude
+                )
+                if handled is not None:
+                    return handled
+            if digest["attempts"] > self.config.retry_max:
+                if saturated_retry_after is not None and not hard_failure:
+                    # Every attempt was answered — the fleet is FULL,
+                    # not broken. Backpressure with the largest
+                    # measured drain ETA, never a fake 502 (the
+                    # docs/SERVING.md contract: 503 only when no
+                    # replica can take the request).
+                    return self._finish(
+                        503,
+                        {
+                            "error": "fleet_saturated",
+                            "retry_after_s": round(
+                                saturated_retry_after, 2
+                            ),
+                        },
+                        digest,
+                    )
+                return self._finish(
+                    502,
+                    {
+                        "error": "upstream_failed",
+                        "detail": (
+                            f"{failures[-1][1].kind}"
+                            if failures
+                            else "retries exhausted"
+                        ),
+                    },
+                    digest,
+                )
+            with self._lock:
+                self.retries_total += 1
+            self._backoff(backoff_i, deadline - self._clock())
+            backoff_i += 1
+
+    def _handle_response(
+        self,
+        rep: Replica,
+        status: int,
+        payload: dict,
+        digest: dict,
+        exclude: set[int],
+    ) -> Optional[tuple[int, dict]]:
+        """An HTTP response arrived: deliver it, or turn replica-local
+        backpressure/drain into a routed retry. Returns None to keep
+        retrying."""
+        if status == 500:
+            # engine failed: the process answers HTTP but cannot
+            # serve. Count toward the breaker and re-route.
+            rep.breaker.record_failure()
+            exclude.add(rep.index)
+            return None
+        rep.breaker.record_success()
+        if status == 503 and payload.get("error") == "draining":
+            # The replica started draining between our poll and this
+            # dispatch: update the router's view and re-route — drain
+            # is honored fleet-wide, not surfaced to the client.
+            with self._lock:
+                rep.state = DRAINING
+            exclude.add(rep.index)
+            return None
+        if status == 429:
+            # Backpressure with a measured Retry-After: this replica
+            # is full, another may not be — retry elsewhere now, only
+            # backing off when everyone is full (the retry loop's
+            # no-eligible path).
+            exclude.add(rep.index)
+            return None
+        digest["replica"] = rep.index
+        with self._lock:
+            self.completed_total += 1
+        return self._finish(status, payload, digest)
+
+    def _finish(
+        self, status: int, payload: dict, digest: dict
+    ) -> tuple[int, dict]:
+        if status == 504:
+            with self._lock:
+                self.deadline_exceeded_total += 1
+        payload = dict(payload)
+        payload["router"] = digest
+        return status, payload
+
+    def _backoff(self, attempt: int, remaining: float) -> None:
+        if remaining <= 0:
+            return
+        delay = retry_backoff_s(
+            attempt,
+            self.config.retry_backoff_s,
+            self.config.retry_backoff_cap_s,
+            self._rng,
+        )
+        time.sleep(min(delay, max(0.0, remaining)))
+
+    def _note_failure(self, rep: Replica, err: ReplicaUnreachable) -> None:
+        """The satellite-2 distinction, applied: refused = dead, eject
+        immediately; timeout/reset = maybe-overloaded, count toward
+        the consecutive-failure threshold."""
+        if err.kind == "refused":
+            rep.breaker.trip()
+        else:
+            rep.breaker.record_failure()
+
+    # ---- the race: one attempt, optionally hedged --------------------
+
+    def _race(
+        self,
+        first: Replica,
+        prompt: Sequence[int],
+        body: dict,
+        deadline: float,
+        exclude: set[int],
+    ):
+        """Run one attempt; if it straggles past ``hedge_after_s``,
+        duplicate it to a second replica — FIRST COMPLETION WINS, the
+        loser's socket is closed (its replica finishes the wasted
+        decode, but the client sees exactly one response). Returns
+        ``(winner, status, payload, hedged, hedge_won, failures)``;
+        ``winner`` None means every launched attempt failed at the
+        connection level (``failures`` holds them for breaker/replay
+        accounting)."""
+        results: _queue.Queue = _queue.Queue()
+        calls: dict[int, object] = {}
+
+        def _run(rep: Replica, call) -> None:
+            try:
+                status, payload = call.run()
+                results.put((rep, status, payload, None))
+            except ReplicaUnreachable as e:
+                results.put((rep, None, None, e))
+            finally:
+                with self._lock:
+                    rep.inflight -= 1
+
+        def _launch(rep: Replica) -> None:
+            remaining = max(0.05, deadline - self._clock())
+            b = dict(body)
+            # Deadline propagation: the replica's own queue-timeout
+            # eviction enforces the same deadline we are racing, so a
+            # doomed request dies in ITS queue, not on our socket.
+            b["timeout"] = round(remaining, 3)
+            call = self.transport.start(
+                rep.url, "/generate", b, remaining + 2.0
+            )
+            calls[rep.index] = call
+            with self._lock:
+                rep.inflight += 1
+            threading.Thread(
+                target=_run, args=(rep, call), daemon=True
+            ).start()
+
+        _launch(first)
+        outstanding = {first.index: first}
+        hedged = False
+        failures: list[tuple[Replica, ReplicaUnreachable]] = []
+        hedge_at = (
+            self._clock() + self.config.hedge_after_s
+            if self.config.hedge_after_s is not None
+            else None
+        )
+        while outstanding:
+            now = self._clock()
+            if (
+                hedge_at is not None
+                and not hedged
+                and now >= hedge_at
+            ):
+                with self._lock:
+                    second = self._select(
+                        prompt,
+                        exclude | set(outstanding),
+                    )
+                if second is not None:
+                    hedged = True
+                    _launch(second)
+                    outstanding[second.index] = second
+                hedge_at = None  # one hedge per dispatch, fired or not
+            if hedge_at is not None and not hedged:
+                wait = max(0.005, min(hedge_at, deadline + 2.0) - now)
+            else:
+                wait = max(0.005, deadline + 2.0 - now)
+            try:
+                rep, status, payload, err = results.get(timeout=wait)
+            except _queue.Empty:
+                if self._clock() > deadline + 2.0:
+                    # Transport timeouts should have fired already;
+                    # treat stragglers as timeouts and let the retry
+                    # loop (which re-checks the deadline) decide.
+                    for idx in list(outstanding):
+                        calls[idx].cancel()
+                        del outstanding[idx]
+                    return None, None, None, hedged, False, failures
+                continue
+            outstanding.pop(rep.index, None)
+            if err is not None:
+                if not err.cancelled:
+                    failures.append((rep, err))
+                continue
+            # First completion wins: cancel the rest.
+            for idx in outstanding:
+                calls[idx].cancel()
+            hedge_won = hedged and rep.index != first.index
+            return rep, status, payload, hedged, hedge_won, failures
+        return None, None, None, hedged, False, failures
+
+    # ---- state -------------------------------------------------------
+
+    def state(self) -> dict:
+        """JSON-ready router snapshot: the fleet /statusz block, the
+        render_fleet gauge source, and the fleet_poll record body."""
+        with self._lock:
+            by_state: dict[str, int] = {}
+            for r in self.replicas:
+                by_state[r.state] = by_state.get(r.state, 0) + 1
+            return {
+                "replicas": len(self.replicas),
+                "replicas_healthy": by_state.get(HEALTHY, 0),
+                "replicas_draining": by_state.get(DRAINING, 0),
+                "replicas_dead": by_state.get(DEAD, 0),
+                "replicas_starting": by_state.get(STARTING, 0),
+                "breaker_open": sum(
+                    1
+                    for r in self.replicas
+                    if r.breaker.state != CircuitBreaker.CLOSED
+                ),
+                "breaker_opens_total": sum(
+                    r.breaker.opens_total for r in self.replicas
+                ),
+                "dispatched_total": self.dispatched_total,
+                "completed_total": self.completed_total,
+                "retries_total": self.retries_total,
+                "replays_total": self.replays_total,
+                "hedges_total": self.hedges_total,
+                "hedge_wins_total": self.hedge_wins_total,
+                "no_replica_total": self.no_replica_total,
+                "deadline_exceeded_total": self.deadline_exceeded_total,
+                "replica_states": [r.snapshot() for r in self.replicas],
+            }
+
+
+# ---------------------------------------------------------------------
+# Replica manager (process supervision)
+# ---------------------------------------------------------------------
+
+
+def _serve_script() -> str:
+    """Default replica entrypoint: the repo's scripts/serve.py."""
+    return os.path.join(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        "scripts",
+        "serve.py",
+    )
+
+
+class ReplicaManager:
+    """Spawns and supervises N ``scripts/serve.py`` replica processes.
+
+    Each replica serves on its own port (restoring the same
+    checkpoint — ``serve_args`` is the shared CLI tail). Supervision
+    is the PR-5 recipe applied per replica: a dead process is
+    CLASSIFIED (runtime/launch.classify_exit), restarted after capped
+    exponential backoff, up to ``max_restarts`` times — independent
+    budgets, because one crash-looping replica must not spend its
+    siblings' budget. The poll loop keeps every replica's state
+    current from ``/healthz`` (which also carries drain visibility
+    and the queue-depth load signal) and runs the breakers' half-open
+    probes. Fleet chaos fires from here via ``kill_replica`` /
+    ``stall_replica`` (SIGKILL / SIGSTOP-then-SIGCONT).
+    """
+
+    def __init__(
+        self,
+        n_replicas: int,
+        serve_args: Sequence[str],
+        *,
+        workdir: str,
+        script: Optional[str] = None,
+        python: str = sys.executable,
+        max_restarts: int = 2,
+        restart_backoff: float = 0.5,
+        poll_interval: float = 0.25,
+        probe_timeout: float = 2.0,
+        startup_grace_s: float = 120.0,
+        transport: Optional[HttpTransport] = None,
+        clock: Callable[[], float] = time.monotonic,
+        metrics=None,
+    ):
+        if n_replicas < 1:
+            raise ValueError(f"need at least 1 replica, got {n_replicas}")
+        self.serve_args = list(serve_args)
+        self.script = script or _serve_script()
+        self.python = python
+        self.workdir = workdir
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff = float(restart_backoff)
+        self.poll_interval = float(poll_interval)
+        self.probe_timeout = float(probe_timeout)
+        self.startup_grace_s = float(startup_grace_s)
+        self.transport = transport or HttpTransport()
+        self._clock = clock
+        self.metrics = metrics
+        self.replicas = [Replica(i) for i in range(n_replicas)]
+        self.restarts_total = 0
+        self.rolling_restarts_total = 0
+        self.chaos_kills = 0
+        self.chaos_stalls = 0
+        self._logs: dict[int, object] = {}
+        self._stall_timers: list[threading.Timer] = []
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()  # spawn/kill vs poll
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def start(self) -> "ReplicaManager":
+        os.makedirs(self.workdir, exist_ok=True)
+        for rep in self.replicas:
+            self._spawn(rep)
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fleet-poll", daemon=True
+        )
+        self._poll_thread.start()
+        return self
+
+    def stop(self, *, drain_timeout: float = 0.0) -> None:
+        """Stop everything. ``drain_timeout > 0`` drains first: every
+        replica gets SIGTERM (its graceful path) and that long to
+        finish lanes before the kill."""
+        self._stop.set()
+        for t in self._stall_timers:
+            t.cancel()
+        for rep in self.replicas:
+            rep.expected_exit = True
+            rep.state = STOPPED
+            if rep.proc is not None and rep.proc.poll() is None:
+                try:
+                    rep.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = self._clock() + max(0.0, drain_timeout)
+        for rep in self.replicas:
+            if rep.proc is None:
+                continue
+            try:
+                rep.proc.wait(max(0.1, deadline - self._clock()))
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait(10)
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+        for log in self._logs.values():
+            try:
+                log.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ReplicaManager":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- spawning ----------------------------------------------------
+
+    def _spawn(self, rep: Replica) -> None:
+        port = free_port()
+        argv = [
+            self.python,
+            self.script,
+            *self.serve_args,
+            "--host",
+            "127.0.0.1",
+            "--port",
+            str(port),
+        ]
+        log = self._logs.get(rep.index)
+        if log is None:
+            log = open(
+                os.path.join(self.workdir, f"replica{rep.index}.log"),
+                "ab",
+            )
+            self._logs[rep.index] = log
+        rep.proc = subprocess.Popen(
+            argv, stdout=log, stderr=subprocess.STDOUT
+        )
+        rep.port = port
+        rep.url = f"http://127.0.0.1:{port}"
+        rep.state = STARTING
+        rep.started_at = self._clock()
+        rep.respawn_at = None
+        rep.refused_probes = 0
+        logger.info(
+            "fleet: replica %d spawned (pid %d, %s)",
+            rep.index, rep.proc.pid, rep.url,
+        )
+
+    def wait_healthy(self, timeout: float = 180.0) -> bool:
+        """Block until every replica is HEALTHY (startup barrier for
+        CLIs/tests); False on timeout."""
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            if all(r.state == HEALTHY for r in self.replicas):
+                return True
+            time.sleep(0.05)
+        return False
+
+    # ---- chaos -------------------------------------------------------
+
+    def kill_replica(self, index: int) -> None:
+        """SIGKILL replica ``index`` — the mid-traffic death drill.
+        The poll loop classifies and restarts it; the router replays
+        its in-flight requests."""
+        rep = self.replicas[index]
+        with self._lock:
+            if rep.proc is not None and rep.proc.poll() is None:
+                self.chaos_kills += 1
+                logger.warning(
+                    "fleet chaos: SIGKILL replica %d (pid %d)",
+                    index, rep.proc.pid,
+                )
+                rep.proc.kill()
+
+    def stall_replica(self, index: int, seconds: float) -> None:
+        """SIGSTOP replica ``index`` for ``seconds`` (then SIGCONT) —
+        the straggler drill hedging should beat: the process is alive
+        but answers nothing, so probes time out (breaker counts them)
+        and in-flight requests straggle."""
+        rep = self.replicas[index]
+        with self._lock:
+            if rep.proc is None or rep.proc.poll() is not None:
+                return
+            self.chaos_stalls += 1
+            pid = rep.proc.pid
+            logger.warning(
+                "fleet chaos: SIGSTOP replica %d for %.1fs (pid %d)",
+                index, seconds, pid,
+            )
+            os.kill(pid, signal.SIGSTOP)
+
+            def _resume() -> None:
+                try:
+                    os.kill(pid, signal.SIGCONT)
+                except OSError:
+                    pass
+
+            t = threading.Timer(seconds, _resume)
+            t.daemon = True
+            t.start()
+            self._stall_timers.append(t)
+
+    # ---- rolling restart ---------------------------------------------
+
+    def rolling_restart(
+        self,
+        *,
+        drain_timeout: float = 30.0,
+        healthy_timeout: float = 180.0,
+    ) -> dict:
+        """Drain → wait → restart → re-admit, ONE replica at a time.
+
+        Marking the replica DRAINING stops new router dispatch
+        immediately; SIGTERM triggers the replica's own graceful
+        drain (running lanes finish, then a clean exit); the respawn
+        must reach HEALTHY before the next replica starts — so the
+        fleet never has more than one replica out, and no request is
+        dropped. Returns a per-replica report."""
+        report = []
+        for rep in self.replicas:
+            entry = {"replica": rep.index, "ok": False}
+            report.append(entry)
+            with self._lock:
+                if rep.proc is None or rep.proc.poll() is not None:
+                    entry["skipped"] = "not running"
+                    continue
+                rep.state = DRAINING  # router stops new dispatch NOW
+                rep.expected_exit = True
+                rep.proc.send_signal(signal.SIGTERM)
+            try:
+                rep.proc.wait(drain_timeout + 10.0)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait(10)
+                entry["forced"] = True
+            with self._lock:
+                self._spawn(rep)
+                rep.expected_exit = False
+            deadline = self._clock() + healthy_timeout
+            while self._clock() < deadline:
+                if rep.state == HEALTHY:
+                    entry["ok"] = True
+                    break
+                time.sleep(0.05)
+            if not entry["ok"]:
+                logger.warning(
+                    "fleet: rolling restart stopped — replica %d did "
+                    "not come back healthy", rep.index,
+                )
+                return {"ok": False, "replicas": report}
+        self.rolling_restarts_total += 1
+        return {"ok": True, "replicas": report}
+
+    # ---- supervision -------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            for rep in self.replicas:
+                try:
+                    self._poll_replica(rep)
+                except Exception:  # noqa: BLE001 — supervision survives
+                    logger.exception(
+                        "fleet: poll failed for replica %d", rep.index
+                    )
+            self._write_poll_record()
+            self._stop.wait(self.poll_interval)
+
+    def _poll_replica(self, rep: Replica) -> None:
+        with self._lock:
+            proc = rep.proc
+            if proc is None or rep.state == STOPPED or rep.expected_exit:
+                return
+            code = proc.poll()
+            if code is not None:
+                self._handle_exit(rep, code)
+                return
+        # Probe outside the lock: a slow/black-holed replica must not
+        # stall supervision of its siblings.
+        try:
+            health = self.transport.get_json(
+                rep.url, "/healthz", self.probe_timeout
+            )
+        except ReplicaUnreachable as e:
+            self._handle_probe_failure(rep, e)
+            return
+        ok = bool(health.get("ok"))
+        with self._lock:
+            rep.refused_probes = 0
+            rep.slots = health.get("slots", rep.slots)
+            rep.active = int(health.get("active") or 0)
+            rep.queue_depth = int(health.get("queue_depth") or 0)
+            if not ok:
+                # Answers HTTP but reports sick (engine loop died):
+                # breaker-open it like a timeout series would.
+                rep.breaker.record_failure()
+                return
+            if health.get("draining"):
+                rep.state = DRAINING
+                return
+            if rep.state in (STARTING, DRAINING):
+                rep.state = HEALTHY
+            # A successful probe is a success like any other: it
+            # resets the consecutive-failure count (the documented
+            # contract — sporadic probe timeouts hours apart must not
+            # accumulate into a spurious open on an idle replica) and
+            # closes a tripped breaker once its cooldown has moved it
+            # to HALF_OPEN. During a real overload the probes time
+            # out too (they share the replica's HTTP server), so this
+            # never masks a sick replica. An OPEN breaker inside its
+            # cooldown stays open — probe_due() is what spaces the
+            # recovery probes.
+            if rep.breaker.state == CircuitBreaker.CLOSED:
+                rep.breaker.record_success()
+            elif rep.breaker.probe_due():
+                rep.breaker.record_success()
+
+    def _handle_probe_failure(
+        self, rep: Replica, err: ReplicaUnreachable
+    ) -> None:
+        with self._lock:
+            if rep.state == STARTING:
+                # Not bound yet: normal during startup (warmup
+                # compiles take a while); only a stuck-forever start
+                # is a failure.
+                if (
+                    rep.started_at is not None
+                    and self._clock() - rep.started_at
+                    > self.startup_grace_s
+                ):
+                    logger.warning(
+                        "fleet: replica %d never became healthy "
+                        "(%.0fs) — restarting", rep.index,
+                        self.startup_grace_s,
+                    )
+                    if rep.proc is not None:
+                        rep.proc.kill()
+                return
+            if err.kind == "refused":
+                rep.breaker.trip()
+                rep.refused_probes += 1
+                # Defense in depth behind the proc-liveness check:
+                # repeated REFUSED probes mean nothing is listening —
+                # if waitpid somehow never reports the death (or the
+                # process is alive but unbound), force the restart
+                # path rather than trusting poll() forever.
+                if rep.refused_probes >= 3 and rep.proc is not None:
+                    logger.warning(
+                        "fleet: replica %d refused %d probes — "
+                        "forcing restart", rep.index, rep.refused_probes,
+                    )
+                    rep.refused_probes = 0
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+                    self._handle_exit(
+                        rep, rep.proc.poll() if rep.proc else 1
+                    )
+            else:
+                rep.breaker.record_failure()
+
+    def _handle_exit(self, rep: Replica, code: int) -> None:
+        """Process death under the lock: classify, budget, schedule
+        the respawn (backoff rides ``respawn_at`` so one dead
+        replica's backoff never blocks polling the others)."""
+        now = self._clock()
+        if rep.state != DEAD:
+            rep.last_exit = classify_exit(code)
+            rep.state = DEAD
+            backoff = min(
+                30.0, self.restart_backoff * (2.0 ** rep.restarts)
+            )
+            if rep.restarts >= self.max_restarts:
+                rep.respawn_at = None
+                logger.error(
+                    "fleet: replica %d dead (%s) — %d/%d restarts "
+                    "exhausted", rep.index, rep.last_exit,
+                    rep.restarts, self.max_restarts,
+                )
+            else:
+                rep.respawn_at = now + backoff
+                logger.warning(
+                    "fleet: replica %d died (%s) — restart %d/%d in "
+                    "%.1fs", rep.index, rep.last_exit,
+                    rep.restarts + 1, self.max_restarts, backoff,
+                )
+            return
+        if rep.respawn_at is not None and now >= rep.respawn_at:
+            rep.restarts += 1
+            self.restarts_total += 1
+            self._spawn(rep)
+
+    # ---- telemetry ---------------------------------------------------
+
+    def state(self) -> dict:
+        return {
+            "restarts_total": self.restarts_total,
+            "rolling_restarts_total": self.rolling_restarts_total,
+            "chaos_kills": self.chaos_kills,
+            "chaos_stalls": self.chaos_stalls,
+            "max_restarts": self.max_restarts,
+        }
+
+    def _write_poll_record(self) -> None:
+        if self.metrics is None:
+            return
+        snap = {}
+        by_state: dict[str, int] = {}
+        for r in self.replicas:
+            by_state[r.state] = by_state.get(r.state, 0) + 1
+        snap.update(
+            replicas=len(self.replicas),
+            replicas_healthy=by_state.get(HEALTHY, 0),
+            replicas_draining=by_state.get(DRAINING, 0),
+            replicas_dead=by_state.get(DEAD, 0),
+            breaker_open=sum(
+                1
+                for r in self.replicas
+                if r.breaker.state != CircuitBreaker.CLOSED
+            ),
+            breaker_opens_total=sum(
+                r.breaker.opens_total for r in self.replicas
+            ),
+            **self.state(),
+        )
+        if self.router is not None:
+            rs = self.router.state()
+            for k in (
+                "dispatched_total", "retries_total", "replays_total",
+                "hedges_total", "hedge_wins_total",
+            ):
+                snap[k] = rs[k]
+        self.metrics.write("fleet_poll", **snap)
+
+    # Set by attach_router (the poll record wants router counters too).
+    router: Optional[Router] = None
+
+    def attach_router(self, router: Router) -> Router:
+        """Bind a router over this manager's replicas (they share the
+        Replica objects, so poll-loop state flows straight into
+        selection)."""
+        self.router = router
+        return router
+
+
+# ---------------------------------------------------------------------
+# Fleet chaos (grammar in runtime/chaos.py; firing lives here)
+# ---------------------------------------------------------------------
+
+
+class FleetChaos:
+    """Fires ``kill:replica<R>@request<N>`` / ``stall:...`` events on
+    the router's dispatch counter. In-memory once-latch (a fleet
+    frontend doesn't restart mid-drill the way a trainer does, so no
+    ledger file); wire via ``Router(on_dispatch=chaos.on_dispatch)``.
+    """
+
+    def __init__(
+        self,
+        events: Sequence[ChaosEvent] | str | None,
+        manager: ReplicaManager,
+    ):
+        self.events = fleet_events(events)
+        self.manager = manager
+        self._fired: set[str] = set()
+        for ev in self.events:
+            if ev.replica >= len(manager.replicas):
+                raise ValueError(
+                    f"chaos names replica {ev.replica} but the fleet "
+                    f"has {len(manager.replicas)}"
+                )
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.events)
+
+    def on_dispatch(self, ordinal: int) -> None:
+        for ev in self.events:
+            if ev.request == ordinal and ev.token not in self._fired:
+                self._fired.add(ev.token)
+                if ev.kind == "kill":
+                    self.manager.kill_replica(ev.replica)
+                else:
+                    self.manager.stall_replica(ev.replica, ev.seconds)
+
+
+# ---------------------------------------------------------------------
+# HTTP front door
+# ---------------------------------------------------------------------
+
+
+class FleetServer:
+    """The fleet's stdlib-HTTP frontend (scripts/fleet.py):
+
+      POST /generate   → Router.dispatch (one response per request,
+                         ``router`` digest included)
+      GET  /healthz    → fleet liveness (ok while >= 1 replica is
+                         dispatchable) + per-replica states
+      GET  /statusz    → router/manager state + the obs/aggregate.py
+                         fleet view scraped LIVE from member replicas
+                         (merged latency summaries, per-endpoint
+                         health with the timeout/refused distinction)
+      GET  /metricsz   → linted ``ddp_tpu_fleet_*`` gauges
+                         (obs/promtext.render_fleet)
+      POST /rollz      → rolling restart (drain → wait → restart →
+                         re-admit, one replica at a time), in the
+                         background; the response acknowledges start
+
+    Draining the FLEET (SIGTERM path): stop admitting here (503 +
+    Retry-After, the single-replica contract), then drain members.
+    """
+
+    def __init__(
+        self,
+        manager: ReplicaManager,
+        router: Router,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        drain_retry_after: float = 5.0,
+    ):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.manager = manager
+        self.router = router
+        self.drain_retry_after = float(drain_retry_after)
+        self._draining = threading.Event()
+        self._roll_thread: Optional[threading.Thread] = None
+        self._roll_state: dict = {"running": False}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: D102
+                pass
+
+            def _send_text(
+                self, status, text, ctype, headers=None
+            ) -> None:
+                data = text.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send(self, status, payload, headers=None) -> None:
+                self._send_text(
+                    status, json.dumps(payload), "application/json",
+                    headers,
+                )
+
+            def do_GET(self):  # noqa: N802
+                route = self.path.partition("?")[0]
+                if route == "/healthz":
+                    payload = server.healthz()
+                    self._send(
+                        200 if payload["ok"] else 503, payload
+                    )
+                elif route == "/statusz":
+                    self._send(200, server.statusz())
+                elif route == "/metricsz":
+                    from ddp_tpu.obs.promtext import CONTENT_TYPE
+
+                    self._send_text(
+                        200, server.metricsz(), CONTENT_TYPE
+                    )
+                else:
+                    self._send(
+                        404, {"error": f"no route {self.path}"}
+                    )
+
+            def do_POST(self):  # noqa: N802
+                route = self.path.partition("?")[0]
+                if route == "/rollz":
+                    self._send(*server.start_roll())
+                    return
+                if route != "/generate":
+                    self._send(
+                        404, {"error": f"no route {self.path}"}
+                    )
+                    return
+                if server.draining:
+                    self._send(
+                        503,
+                        {
+                            "error": "draining",
+                            "retry_after_s": server.drain_retry_after,
+                        },
+                        {
+                            "Retry-After": str(
+                                int(server.drain_retry_after)
+                            )
+                        },
+                    )
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(body, dict):
+                        raise ValueError("body must be a JSON object")
+                except (ValueError, TypeError) as e:
+                    self._send(400, {"error": f"bad JSON body: {e}"})
+                    return
+                status, payload = server.router.dispatch(body)
+                headers = None
+                if payload.get("retry_after_s"):
+                    headers = {
+                        "Retry-After": str(
+                            max(
+                                1,
+                                int(payload["retry_after_s"] + 0.999),
+                            )
+                        )
+                    }
+                self._send(status, payload, headers)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        self._draining.set()
+
+    def start(self) -> "FleetServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FleetServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- route bodies ------------------------------------------------
+
+    def healthz(self) -> dict:
+        rs = self.router.state()
+        return {
+            "ok": rs["replicas_healthy"] > 0,
+            "draining": self.draining,
+            "replicas": rs["replicas"],
+            "replicas_healthy": rs["replicas_healthy"],
+            "replicas_draining": rs["replicas_draining"],
+            "replicas_dead": rs["replicas_dead"],
+        }
+
+    def statusz(self) -> dict:
+        """Router + manager state, plus the obs/aggregate.py fleet
+        view scraped LIVE from the member replicas — the aggregator
+        PR 11 built as "the router's input" is also the fleet's own
+        status surface (one fleet view, not two)."""
+        from ddp_tpu.obs.aggregate import merge_fleet, scrape_endpoint
+        from ddp_tpu.obs.recorder import build_info
+
+        # Scrape members in PARALLEL: the views are independent, and
+        # a serial loop would add probe_timeout of blocking per sick
+        # replica to every /statusz — exactly during the incidents
+        # this endpoint exists to diagnose.
+        urls = [
+            r.url for r in self.router.replicas if r.url is not None
+        ]
+        views: list[Optional[dict]] = [None] * len(urls)
+
+        def _scrape(i: int) -> None:
+            views[i] = scrape_endpoint(
+                urls[i], timeout=self.manager.probe_timeout
+            )
+
+        scrapers = [
+            threading.Thread(target=_scrape, args=(i,), daemon=True)
+            for i in range(len(urls))
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join()
+        return {
+            "ok": self.healthz()["ok"],
+            "draining": self.draining,
+            "router": self.router.state(),
+            "manager": self.manager.state(),
+            "roll": dict(self._roll_state),
+            "fleet": merge_fleet([v for v in views if v is not None]),
+            "build_info": build_info(),
+        }
+
+    def metricsz(self) -> str:
+        from ddp_tpu.obs.promtext import render_fleet
+        from ddp_tpu.obs.recorder import build_info
+
+        rs = self.router.state()
+        snap = {**rs, **self.manager.state(), "build_info": build_info()}
+        return render_fleet(
+            snap,
+            up=rs["replicas_healthy"] > 0,
+            draining=self.draining,
+        )
+
+    def start_roll(self) -> tuple[int, dict]:
+        """POST /rollz: kick a rolling restart in the background —
+        the request acknowledges the start; /statusz tracks progress
+        (a roll takes replica-startup minutes; no HTTP client should
+        hold a socket that long)."""
+        if self._roll_thread is not None and self._roll_thread.is_alive():
+            return 409, {"error": "rolling restart already running"}
+
+        def _roll() -> None:
+            self._roll_state = {"running": True}
+            result = self.manager.rolling_restart()
+            self._roll_state = {"running": False, **result}
+
+        self._roll_thread = threading.Thread(
+            target=_roll, name="fleet-roll", daemon=True
+        )
+        self._roll_thread.start()
+        return 202, {"rolling": True}

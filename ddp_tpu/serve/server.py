@@ -9,7 +9,8 @@ every container this repo targets, and the API is three routes:
   POST /generate   {"prompt_tokens": [...], "max_new_tokens": N,
                     "temperature"?, "top_p"?, "seed"?, "timeout"?}
                    → 200 {"rid", "status", "tokens", "ttft_s", ...}
-                   → 429 {"error": "queue_full"} on backpressure
+                   → 429 {"error": "queue_full"} + ``Retry-After``
+                     (the measured queue-drain ETA) on backpressure
                    → 400 {"error": "prompt_too_long" | ...} on
                      permanently-invalid requests
                    → 400 on malformed bodies
@@ -49,6 +50,7 @@ immediately with the scheduler's reason.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -217,8 +219,24 @@ class LMServer:
             # semantics); the validation reasons are permanent client
             # errors — a 429 would invite retry loops on requests that
             # can never be served.
-            status = 429 if adm.reason == QUEUE_FULL else 400
-            return status, {"error": adm.reason}
+            if adm.reason == QUEUE_FULL:
+                # Backpressure carries WHEN to come back, like the
+                # drain path's 503 does: the queue-drain-rate ETA
+                # (bounded to a sane header range), falling back to
+                # the static drain hint before any retire window
+                # exists. In the JSON too, for in-process callers.
+                with self._lock:
+                    eta = self.engine.queue_drain_eta_s()
+                retry_after = (
+                    min(60.0, max(1.0, eta))
+                    if eta is not None
+                    else self.drain_retry_after
+                )
+                return 429, {
+                    "error": adm.reason,
+                    "retry_after_s": round(retry_after, 2),
+                }
+            return 400, {"error": adm.reason}
         rid = adm.request.rid
         while True:
             with self._lock:
@@ -387,6 +405,16 @@ def _make_handler(server: LMServer):
                 # when to come back (to the replacement process).
                 headers = {
                     "Retry-After": str(int(server.drain_retry_after))
+                }
+            elif status == 429 and payload.get("retry_after_s"):
+                # Backpressure 429s carry the queue-drain ETA the
+                # engine measured — a client (or the fleet router)
+                # backs off for as long as a seat will actually take
+                # to free, instead of a blind constant.
+                headers = {
+                    "Retry-After": str(
+                        max(1, math.ceil(payload["retry_after_s"]))
+                    )
                 }
             self._send(status, payload, headers)
 

@@ -1,0 +1,177 @@
+#!/usr/bin/env bash
+# Fleet serving (ISSUE 14 / docs/SERVING.md "Fleet serving",
+# docs/ROBUSTNESS.md "Fleet drills"): a 3-replica fleet behind the
+# health-gated router — kill one replica mid-traffic and watch the
+# breaker trip, the replay digest, and the supervised restart on the
+# fleet /statusz; every client completes. Then a rolling restart
+# (drain -> wait -> restart -> re-admit) with zero dropped requests,
+# and the health_report fleet triage. Green on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example23}
+rm -rf "$WORK" && mkdir -p "$WORK"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+# 1. A 3-replica fleet: every replica is a full scripts/serve.py
+#    process on its own port (same demo model, paged KV so prefix
+#    affinity has a cache to keep warm). The --chaos drill arms a
+#    SIGKILL of replica 1 at the router's 6th dispatch.
+python scripts/fleet.py --replicas 3 --port 8050 \
+    --workdir "$WORK" --metrics_file "$WORK/fleet.jsonl" \
+    --max_restarts 2 --restart_backoff 0.5 \
+    --chaos "kill:replica1@request6" \
+    -- --init_demo --slots 2 --page_size 16 \
+       --vocab_size 128 --seq_len 64 \
+    >"$WORK/fleet.log" 2>&1 &
+FLEET_PID=$!
+trap 'kill $FLEET_PID 2>/dev/null || true' EXIT
+for _ in $(seq 180); do
+    curl -sf localhost:8050/healthz >/dev/null 2>&1 && break
+    sleep 1
+done
+echo "--- fleet up"
+curl -s localhost:8050/healthz; echo
+
+# 2. Mid-traffic kill: 10 clients share a 24-token system prompt
+#    (admission ceiling is seq_len/2 = 32, so prompt + tail fits).
+#    Dispatch #6 SIGKILLs replica 1 — its in-flight requests are
+#    REPLAYED to survivors (visible in each response's router
+#    digest), and ALL 10 clients complete.
+SYS=$(python -c 'print([(5*i+2) % 128 for i in range(24)])')
+python - "$SYS" <<'EOF'
+import json
+import sys
+import threading
+import urllib.request
+
+sys_prompt = json.loads(sys.argv[1])
+results = []
+lock = threading.Lock()
+
+def client(i):
+    body = json.dumps({
+        "prompt_tokens": sys_prompt + [i + 1, i + 2],
+        "max_new_tokens": 6,
+    }).encode()
+    with urllib.request.urlopen(
+        urllib.request.Request(
+            "http://localhost:8050/generate", data=body
+        ), timeout=300,
+    ) as r:
+        with lock:
+            results.append(json.load(r))
+
+threads = [threading.Thread(target=client, args=(i,)) for i in range(10)]
+for t in threads: t.start()
+for t in threads: t.join()
+assert len(results) == 10, len(results)
+assert all(r["status"] == "complete" for r in results)
+tids = [r["router"]["trace_id"] for r in results]
+assert len(set(tids)) == 10, "a completion was delivered twice"
+replays = sum(r["router"]["replays"] for r in results)
+print(f"all 10 clients complete; {replays} replay(s); "
+      f"trace ids unique")
+EOF
+
+# 3. The drill on the fleet surfaces: breaker + restart accounting on
+#    /metricsz, replica states + the live aggregate view on /statusz.
+sleep 2
+echo "--- /metricsz (fleet gauges)"
+curl -s localhost:8050/metricsz | grep -E \
+    "fleet_(replicas_healthy|breaker_open|replays_total|restarts_total) "
+echo "--- /statusz (router + scraped member view)"
+curl -s localhost:8050/statusz | python -c '
+import json, sys
+d = json.load(sys.stdin)
+r = d["router"]
+print(json.dumps({
+    "replicas_healthy": r["replicas_healthy"],
+    "replays_total": r["replays_total"],
+    "breaker_opens_total": r["breaker_opens_total"],
+    "manager_restarts": d["manager"]["restarts_total"],
+    "aggregate_tokens": d["fleet"]["aggregate"].get("tokens_total"),
+}, indent=1))'
+
+# 4. Wait for the killed replica to be restarted and healthy again
+#    (supervised restart with backoff — the PR-5 machinery per
+#    replica), then a ROLLING RESTART: drain -> wait -> restart ->
+#    re-admit, one replica at a time, with traffic running — zero
+#    dropped requests.
+python - <<'EOF'
+import json
+import threading
+import time
+import urllib.request
+
+def statusz():
+    with urllib.request.urlopen(
+        "http://localhost:8050/statusz", timeout=10
+    ) as r:
+        return json.load(r)
+
+deadline = time.time() + 240
+while time.time() < deadline:
+    d = statusz()
+    if (d["router"]["replicas_healthy"] == 3
+            and d["manager"]["restarts_total"] == 1):
+        break
+    time.sleep(1)
+assert d["manager"]["restarts_total"] == 1, d["manager"]
+print("replica restarted:", d["manager"]["restarts_total"],
+      "restart(s), fleet healthy 3/3")
+
+# traffic during the roll
+stop = threading.Event()
+outcomes = []
+def trickle():
+    i = 0
+    while not stop.is_set():
+        i += 1
+        body = json.dumps({
+            "prompt_tokens": [(3 * i + j) % 128 for j in range(8)],
+            "max_new_tokens": 3,
+        }).encode()
+        try:
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    "http://localhost:8050/generate", data=body
+                ), timeout=300,
+            ) as r:
+                outcomes.append(json.load(r)["status"])
+        except Exception as e:  # noqa: BLE001 — the assert below
+            outcomes.append(f"error: {e}")
+
+t = threading.Thread(target=trickle)
+t.start()
+req = urllib.request.Request(
+    "http://localhost:8050/rollz", data=b"{}"
+)
+with urllib.request.urlopen(req, timeout=10) as r:
+    print("rollz:", json.load(r))
+deadline = time.time() + 600
+while time.time() < deadline:
+    roll = statusz()["roll"]
+    if roll.get("ok") is not None and not roll.get("running"):
+        break
+    time.sleep(2)
+stop.set()
+t.join()
+assert roll.get("ok"), roll
+bad = [o for o in outcomes if o != "complete"]
+assert not bad, bad
+print(f"rolling restart complete ({len(outcomes)} requests during "
+      "the roll, zero dropped)")
+d = statusz()
+print("rolling_restarts_total:",
+      d["manager"]["rolling_restarts_total"])
+EOF
+
+# 5. Shut the fleet down (SIGTERM = fleet-wide drain) and print the
+#    triage lines the fleet_poll records feed.
+kill -TERM $FLEET_PID
+wait $FLEET_PID 2>/dev/null || true
+echo "--- health_report (fleet triage)"
+python scripts/health_report.py "$WORK/fleet.jsonl" | grep -E "fleet"
+
+echo "example 23 OK"

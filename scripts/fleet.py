@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Fleet serving CLI: N supervised replicas behind the health-gated
+router (ddp_tpu.serve.fleet; docs/SERVING.md "Fleet serving").
+
+    python scripts/fleet.py --replicas 3 --port 8100 \
+        -- --init_demo --slots 2 --page_size 16
+    curl -s localhost:8100/generate -d \
+        '{"prompt_tokens": [1, 2, 3], "max_new_tokens": 16}'
+
+Everything after ``--`` is forwarded VERBATIM to every replica's
+``scripts/serve.py`` (same checkpoint, same engine knobs — each
+replica gets its own ``--port``). The frontend exposes:
+
+  POST /generate   routed with prefix affinity + least-loaded spill,
+                   bounded retry, optional hedging (--hedge_after),
+                   per-replica circuit breakers; responses carry a
+                   ``router`` digest (replica, attempts, replays,
+                   hedge outcome, fleet trace id)
+  GET  /healthz    fleet liveness (>= 1 dispatchable replica)
+  GET  /statusz    router + manager state, plus the live
+                   obs/aggregate.py fleet view scraped from members
+  GET  /metricsz   linted ddp_tpu_fleet_* gauges
+  POST /rollz      rolling restart: drain -> wait -> restart ->
+                   re-admit, one replica at a time, zero dropped
+
+``--chaos "kill:replica1@request8"`` arms fleet drills
+(runtime/chaos.py grammar) fired on the router's dispatch counter.
+SIGTERM drains the FLEET: the frontend stops admitting (503 +
+Retry-After), replicas drain their lanes, then everything exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument(
+        "--workdir", default="/tmp/ddp_tpu_fleet",
+        help="per-replica logs land here (replicaN.log)",
+    )
+    p.add_argument(
+        "--max_restarts", type=int, default=2,
+        help="per-replica restart budget (PR-5 semantics: classified "
+        "exit, capped exponential backoff)",
+    )
+    p.add_argument("--restart_backoff", type=float, default=0.5)
+    p.add_argument(
+        "--poll_interval", type=float, default=0.25,
+        help="supervision cadence: /healthz probes, breaker half-open "
+        "probes, process liveness",
+    )
+    p.add_argument(
+        "--hedge_after", type=float, default=None,
+        help="tail-latency hedging: duplicate a request still "
+        "unanswered after this many seconds to a second replica — "
+        "first completion wins, the loser is cancelled (off by "
+        "default)",
+    )
+    p.add_argument(
+        "--retry_max", type=int, default=3,
+        help="re-dispatch budget per request (connection-level "
+        "failures; jittered exponential backoff between tries)",
+    )
+    p.add_argument("--retry_backoff", type=float, default=0.05)
+    p.add_argument(
+        "--breaker_threshold", type=int, default=3,
+        help="consecutive failures that open a replica's circuit "
+        "breaker (a refused connection opens it immediately)",
+    )
+    p.add_argument(
+        "--breaker_cooldown", type=float, default=2.0,
+        help="open -> half-open probe interval",
+    )
+    p.add_argument(
+        "--affinity_page", type=int, default=16,
+        help="prefix-affinity granularity: the prompt's leading "
+        "page-aligned tokens hash to a preferred replica so its "
+        "radix prefix cache stays warm (0 = least-loaded only; "
+        "match the replicas' --page_size)",
+    )
+    p.add_argument(
+        "--chaos", default=None,
+        help="fleet drills, e.g. 'kill:replica1@request8,"
+        "stall:replica0@request4:2.5s' — fired on the router's "
+        "dispatch counter (runtime/chaos.py grammar)",
+    )
+    p.add_argument(
+        "--metrics_file", default=None,
+        help="fleet_poll JSONL records (scripts/health_report.py "
+        "prints the fleet triage lines from them)",
+    )
+    p.add_argument(
+        "--drain_timeout", type=float, default=30.0,
+        help="SIGTERM: stop admitting at the frontend, then give "
+        "replicas this long to finish lanes before the kill",
+    )
+    p.add_argument(
+        "serve_args", nargs=argparse.REMAINDER,
+        help="everything after -- goes verbatim to every replica's "
+        "scripts/serve.py",
+    )
+    args = p.parse_args()
+    serve_args = list(args.serve_args)
+    if serve_args and serve_args[0] == "--":
+        serve_args = serve_args[1:]
+    if any(a in ("--port", "--host") for a in serve_args):
+        raise SystemExit(
+            "replica --port/--host are manager-assigned; drop them "
+            "from the forwarded serve args"
+        )
+
+    from ddp_tpu.serve.fleet import (
+        FleetChaos,
+        FleetServer,
+        ReplicaManager,
+        Router,
+        RouterConfig,
+    )
+    from ddp_tpu.utils.metrics import MetricsWriter
+
+    metrics = MetricsWriter(args.metrics_file)
+    manager = ReplicaManager(
+        args.replicas,
+        serve_args,
+        workdir=args.workdir,
+        max_restarts=args.max_restarts,
+        restart_backoff=args.restart_backoff,
+        poll_interval=args.poll_interval,
+        metrics=metrics,
+    )
+    config = RouterConfig(
+        retry_max=args.retry_max,
+        retry_backoff_s=args.retry_backoff,
+        hedge_after_s=args.hedge_after,
+        affinity_page=args.affinity_page,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown,
+        trace_seed=int.from_bytes(os.urandom(8), "little"),
+    )
+    stop_event = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_event.set())
+    chaos = FleetChaos(args.chaos, manager) if args.chaos else None
+    try:
+        manager.start()
+        router = manager.attach_router(
+            Router(
+                manager.replicas,
+                config,
+                on_dispatch=chaos.on_dispatch if chaos else None,
+            )
+        )
+        healthy = manager.wait_healthy()
+        with FleetServer(
+            manager, router, host=args.host, port=args.port
+        ) as server:
+            print(
+                json.dumps(
+                    {
+                        "fleet": server.url,
+                        "metricsz": server.url + "/metricsz",
+                        "replicas": [
+                            r.url for r in manager.replicas
+                        ],
+                        "all_healthy": healthy,
+                        "hedge_after": args.hedge_after,
+                        "affinity_page": args.affinity_page,
+                        **(
+                            {"chaos": args.chaos} if args.chaos else {}
+                        ),
+                    }
+                ),
+                flush=True,
+            )
+            try:
+                stop_event.wait()
+            except KeyboardInterrupt:
+                pass
+            # Fleet-wide drain: frontend first (new admissions get
+            # 503 + Retry-After), then the members finish their lanes
+            # inside manager.stop(drain_timeout) below.
+            server.begin_drain()
+            print(
+                json.dumps({"draining": True}), flush=True
+            )
+    finally:
+        manager.stop(drain_timeout=args.drain_timeout)
+        metrics.close()
+
+
+if __name__ == "__main__":
+    main()

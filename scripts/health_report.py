@@ -338,6 +338,32 @@ def build_report(records: list[dict]) -> str:
                 f"{_fmt(last.get('burn_rate_slow'), 1)} (slow)"
             )
 
+    # Fleet triage (serve/fleet.py): the router/manager poll records
+    # carry cumulative counters, so the LAST one is the fleet's
+    # current shape — replicas up/draining/dead, breakers shedding,
+    # replay/hedge accounting, restarts. Gated on record presence:
+    # trainer and single-replica serve streams (and every existing
+    # golden) stay byte-identical.
+    fleet_polls = [r for r in records if r.get("kind") == "fleet_poll"]
+    if fleet_polls:
+        f = fleet_polls[-1]
+        lines.append(
+            f"fleet         : {f.get('replicas_healthy', 0)}/"
+            f"{f.get('replicas', 0)} healthy"
+            f", {f.get('replicas_draining', 0)} draining"
+            f", {f.get('replicas_dead', 0)} dead"
+            f"; breakers open {f.get('breaker_open', 0)} "
+            f"({f.get('breaker_opens_total', 0)} lifetime)"
+        )
+        lines.append(
+            f"fleet traffic : {f.get('dispatched_total', 0)} dispatched"
+            f", {f.get('replays_total', 0)} replayed"
+            f", hedges {f.get('hedge_wins_total', 0)}/"
+            f"{f.get('hedges_total', 0)} won"
+            f"; restarts {f.get('restarts_total', 0)}"
+            f", rolling {f.get('rolling_restarts_total', 0)}"
+        )
+
     sentry = [h for h in health if h.get("detector") != "nonfinite"]
     if sentry:
         by_det: dict[str, int] = {}

@@ -161,6 +161,15 @@ _SMOKE_PATTERNS = (
     "test_slo.py::TestEngineAndGauges::"
     "test_disabled_exposition_byte_identical",
     "test_optim_extras.py::TestParamEma::test_recurrence_exact",
+    # fleet router (ISSUE 14): breaker state machine, retry math,
+    # hedging first-completion-wins, and the fleet gauge lint — all
+    # fake-transport/fake-clock, milliseconds each
+    "test_fleet.py::TestCircuitBreaker::"
+    "test_state_machine_closed_open_halfopen_closed",
+    "test_fleet.py::test_retry_backoff_bounds",
+    "test_fleet.py::TestHedging::"
+    "test_first_completion_wins_and_loser_cancelled",
+    "test_fleet.py::test_render_fleet_gauges_lint_clean",
     # one real trainer e2e (the priciest smoke entry, ~1 min compile)
     "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",
 )

@@ -1,0 +1,876 @@
+"""Fleet serving layer (serve/fleet.py + scripts/fleet.py, ISSUE 14).
+
+Unit tier (no sockets, no processes — fake transports and clocks):
+
+1. **Circuit breaker state machine** — closed → open → half-open →
+   closed with a fake clock; a refused connection trips immediately.
+2. **Retry/backoff bounds** — full-jitter exponential stays inside
+   [0, min(cap, base·2^i)].
+3. **Hedging** — first completion wins, the loser's call is
+   CANCELLED, hedge counters account the win.
+4. **Prefix affinity** — page-aligned stability (same leading pages →
+   same key → same replica), saturation spill to least-loaded.
+5. **Drain-aware dispatch** — a DRAINING replica takes no new
+   dispatch; a replica that answers 503/draining mid-flight is
+   re-routed without the client seeing it.
+6. **Fleet chaos grammar** — kill:replica<R>@request<N> /
+   stall:...:<S>s round-trips; ChaosEngine never fires replica
+   events (they belong to the manager).
+7. **Aggregate health classification** — a scrape that TIMES OUT is
+   distinguished from one that was REFUSED (satellite: the breaker
+   needs the difference).
+
+Slow tier: the kill drill — a REAL 2-replica fleet (subprocess
+``scripts/serve.py --init_demo`` engines), ``kill:replica1@request3``
+mid-traffic: ALL submitted requests complete with correct tokens,
+exactly ONE replica restart, and no completion is delivered twice
+(fleet trace-id uniqueness over the full response set).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from ddp_tpu.runtime.chaos import (
+    ChaosEngine,
+    ChaosEvent,
+    fleet_events,
+    format_chaos,
+    parse_chaos,
+)
+from ddp_tpu.serve.fleet import (
+    DRAINING,
+    HEALTHY,
+    CircuitBreaker,
+    Replica,
+    ReplicaUnreachable,
+    Router,
+    RouterConfig,
+    affinity_key,
+    retry_backoff_s,
+)
+
+
+# ---------------------------------------------------------------------
+# Fakes
+# ---------------------------------------------------------------------
+
+
+class FakeCall:
+    def __init__(self, fn, body):
+        self.fn = fn
+        self.body = body
+        self.cancelled = False
+
+    def run(self):
+        return self.fn(self.body, self)
+
+    def cancel(self):
+        self.cancelled = True
+
+
+class FakeTransport:
+    """url → handler(body, call) returning (status, payload) or
+    raising ReplicaUnreachable; calls are recorded for cancel pins."""
+
+    def __init__(self, handlers):
+        self.handlers = handlers
+        self.calls: list[FakeCall] = []
+
+    def start(self, url, path, body, timeout):
+        call = FakeCall(self.handlers[url], body)
+        self.calls.append(call)
+        return call
+
+    def get_json(self, url, path, timeout):
+        return {"ok": True}
+
+
+def _replicas(n, slots=2):
+    reps = [Replica(i, f"http://replica{i}") for i in range(n)]
+    for r in reps:
+        r.slots = slots
+    return reps
+
+
+def _ok(rid=1, **extra):
+    return 200, {
+        "rid": rid, "status": "complete", "tokens": [1, 2], **extra,
+    }
+
+
+# ---------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_state_machine_closed_open_halfopen_closed(self):
+        t = [0.0]
+        cb = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: t[0])
+        assert cb.state == CircuitBreaker.CLOSED and cb.allow_traffic()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED  # below threshold
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN and cb.opens_total == 1
+        assert not cb.allow_traffic()
+        # cooldown not elapsed: no probe, still open
+        t[0] = 4.9
+        assert not cb.probe_due() and cb.state == CircuitBreaker.OPEN
+        # cooldown elapsed: half-open, wants exactly a probe
+        t[0] = 5.0
+        assert cb.probe_due() and cb.state == CircuitBreaker.HALF_OPEN
+        assert not cb.allow_traffic()  # user traffic never probes
+        # failed probe re-opens with a fresh cooldown
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.OPEN and cb.opens_total == 2
+        t[0] = 9.9
+        assert not cb.probe_due()
+        t[0] = 10.0
+        assert cb.probe_due()
+        cb.record_success()
+        assert cb.state == CircuitBreaker.CLOSED and cb.allow_traffic()
+        assert cb.failures == 0
+
+    def test_success_resets_consecutive_count(self):
+        cb = CircuitBreaker(threshold=3)
+        cb.record_failure()
+        cb.record_failure()
+        cb.record_success()
+        cb.record_failure()
+        cb.record_failure()
+        assert cb.state == CircuitBreaker.CLOSED  # never 3 consecutive
+
+    def test_refused_trips_immediately(self):
+        cb = CircuitBreaker(threshold=5)
+        cb.trip()
+        assert cb.state == CircuitBreaker.OPEN and cb.opens_total == 1
+        cb.trip()  # idempotent while open
+        assert cb.opens_total == 1
+
+
+def test_retry_backoff_bounds():
+    rng = random.Random(7)
+    base, cap = 0.05, 1.0
+    for attempt in range(12):
+        for _ in range(50):
+            d = retry_backoff_s(attempt, base, cap, rng)
+            assert 0.0 <= d <= min(cap, base * 2**attempt)
+    # the cap binds for large attempts
+    assert any(
+        retry_backoff_s(30, base, cap, rng) > 0.9 for _ in range(200)
+    )
+
+
+# ---------------------------------------------------------------------
+# Prefix affinity
+# ---------------------------------------------------------------------
+
+
+class TestAffinity:
+    def test_page_aligned_stability(self):
+        prefix = [(7 * i + 3) % 97 for i in range(32)]
+        k = affinity_key(prefix, 16)
+        # tails past the last page boundary don't change the key
+        assert affinity_key(prefix + [5], 16) == k
+        assert affinity_key(prefix + [9, 9, 9], 16) == k
+        # a different prefix hashes elsewhere
+        assert affinity_key([1] * 32, 16) != k
+        # a token change INSIDE the aligned region changes the key
+        other = list(prefix)
+        other[0] += 1
+        assert affinity_key(other, 16) != k
+        # shorter than one page → no affinity
+        assert affinity_key([1, 2, 3], 16) == 0
+        assert affinity_key(prefix, 0) == 0
+
+    def test_router_prefers_affinity_then_spills_on_saturation(self):
+        reps = _replicas(3)
+        router = Router(
+            reps,
+            RouterConfig(affinity_page=8, saturation_depth=2),
+            transport=FakeTransport({}),
+        )
+        prompt = [(3 * i) % 50 for i in range(16)]
+        key = affinity_key(prompt, 8)
+        pref = reps[key % 3]
+        assert router._select(prompt, set()) is pref
+        # load the others: affinity still wins (not least-loaded)
+        for r in reps:
+            if r is not pref:
+                r.inflight = 1
+        assert router._select(prompt, set()) is pref
+        # saturate the preferred replica: spill to least-loaded
+        pref.inflight = pref.slots + 2  # slots + saturation_depth
+        spill = router._select(prompt, set())
+        assert spill is not pref
+        assert spill.load == min(
+            r.load for r in reps if r is not pref
+        )
+        # short prompt: least-loaded from the start
+        assert router._select([1], set()).load == min(r.load for r in reps)
+
+    def test_drain_and_breaker_gate_selection(self):
+        reps = _replicas(2)
+        router = Router(reps, transport=FakeTransport({}))
+        reps[0].state = DRAINING
+        assert router._select([1], set()) is reps[1]
+        reps[1].breaker.trip()
+        assert router._select([1], set()) is None
+
+
+# ---------------------------------------------------------------------
+# Dispatch: retry, replay, hedging, drain
+# ---------------------------------------------------------------------
+
+
+def _router(handlers, reps=None, **cfg):
+    """Deterministic first pick: affinity on with page 0 = pure
+    least-loaded = lowest index on an idle fleet, so handlers[0] is
+    always the first attempt."""
+    reps = reps or _replicas(len(handlers))
+    defaults = dict(
+        affinity=True, affinity_page=0,
+        retry_backoff_s=0.001, retry_backoff_cap_s=0.01,
+    )
+    defaults.update(cfg)
+    router = Router(
+        reps,
+        RouterConfig(**defaults),
+        transport=FakeTransport(
+            {r.url: handlers[i] for i, r in enumerate(reps)}
+        ),
+        rng=random.Random(0),
+    )
+    return router, reps
+
+
+class TestDispatch:
+    def test_retry_replays_after_midflight_death(self):
+        """A SENT request whose connection dies is replayed to a
+        survivor; the response says so (never a silent recovery)."""
+
+        def dead(body, call):
+            raise ReplicaUnreachable("unreachable", sent=True)
+
+        def alive(body, call):
+            return _ok()
+
+        router, reps = _router([dead, alive])
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1, 2], "max_new_tokens": 2}
+        )
+        assert status == 200 and payload["status"] == "complete"
+        d = payload["router"]
+        assert d["replica"] == 1 and d["replays"] == 1
+        assert d["attempts"] >= 1
+        assert router.replays_total == 1
+        # the dead replica's breaker counted the failure
+        assert reps[0].breaker.failures == 1 or (
+            reps[0].breaker.state != CircuitBreaker.CLOSED
+        )
+
+    def test_refused_ejects_immediately(self):
+        """Satellite semantics: refused = dead → breaker OPEN on the
+        first failure, not after the threshold."""
+
+        def refused(body, call):
+            raise ReplicaUnreachable("refused", sent=False)
+
+        def alive(body, call):
+            return _ok()
+
+        router, reps = _router([refused, alive], breaker_threshold=5)
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 200
+        assert reps[0].breaker.state == CircuitBreaker.OPEN
+        assert payload["router"]["replays"] == 0  # never sent → retry,
+        # not replay
+
+    def test_timeout_counts_toward_threshold(self):
+        def timeout(body, call):
+            raise ReplicaUnreachable("timeout", sent=True)
+
+        def alive(body, call):
+            return _ok()
+
+        router, reps = _router([timeout, alive], breaker_threshold=3)
+        router.dispatch({"prompt_tokens": [1], "max_new_tokens": 1})
+        assert reps[0].breaker.state == CircuitBreaker.CLOSED
+        assert reps[0].breaker.failures == 1
+
+    def test_all_replicas_down_converges_to_503(self):
+        def dead(body, call):
+            raise ReplicaUnreachable("unreachable", sent=False)
+
+        router, _ = _router([dead, dead], retry_max=2)
+        t0 = time.monotonic()
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status in (502, 503)
+        assert time.monotonic() - t0 < 5.0  # bounded, no spin
+        assert payload["router"]["replica"] is None
+
+    def test_draining_response_reroutes_without_client_503(self):
+        """A replica that began draining between polls answers 503 +
+        draining; the router re-routes and updates its view — the
+        CLIENT sees a completion."""
+
+        def draining(body, call):
+            return 503, {"error": "draining", "retry_after_s": 5.0}
+
+        def alive(body, call):
+            return _ok()
+
+        router, reps = _router([draining, alive])
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 200 and payload["router"]["replica"] == 1
+        assert reps[0].state == DRAINING
+
+    def test_backpressure_429_tries_another_replica(self):
+        def full(body, call):
+            return 429, {"error": "queue_full", "retry_after_s": 2.0}
+
+        def alive(body, call):
+            return _ok()
+
+        router, _ = _router([full, alive])
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 200 and payload["router"]["replica"] == 1
+
+    def test_whole_fleet_full_is_backpressure_not_502(self):
+        """Every replica answering 429 means the fleet is FULL, not
+        broken: the client gets 503 fleet_saturated with the largest
+        measured Retry-After, never upstream_failed."""
+
+        def full_a(body, call):
+            return 429, {"error": "queue_full", "retry_after_s": 3.0}
+
+        def full_b(body, call):
+            return 429, {"error": "queue_full", "retry_after_s": 7.0}
+
+        router, _ = _router([full_a, full_b], retry_max=2)
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 503
+        assert payload["error"] == "fleet_saturated"
+        assert payload["retry_after_s"] == 7.0
+
+    def test_explicit_timeout_zero_is_immediate_504(self):
+        """timeout=0 is an already-expired deadline, not 'use the
+        default': the request must fail immediately, not block the
+        client's socket for default_deadline_s."""
+
+        def alive(body, call):
+            return _ok()
+
+        router, _ = _router([alive])
+        t0 = time.monotonic()
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1, "timeout": 0}
+        )
+        assert status == 504 and payload["error"] == "deadline_exceeded"
+        assert time.monotonic() - t0 < 1.0
+
+    def test_deadline_exceeded_is_504(self):
+        def slow_then_dead(body, call):
+            # fails AFTER the deadline: the retry loop's re-check
+            # must surface 504, not keep retrying a doomed request
+            time.sleep(0.1)
+            raise ReplicaUnreachable("timeout", sent=True)
+
+        router, _ = _router([slow_then_dead])
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1, "timeout": 0.05}
+        )
+        assert status == 504
+        assert payload["error"] == "deadline_exceeded"
+        assert router.deadline_exceeded_total == 1
+
+    def test_deadline_propagates_to_replica_body(self):
+        seen = {}
+
+        def capture(body, call):
+            seen.update(body)
+            return _ok()
+
+        router, _ = _router([capture])
+        router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1, "timeout": 30.0}
+        )
+        # the forwarded timeout is the REMAINING deadline, not the
+        # original (bounded above by it)
+        assert 0 < seen["timeout"] <= 30.0
+
+
+class TestHedging:
+    def test_first_completion_wins_and_loser_cancelled(self):
+        release = threading.Event()
+
+        def slow(body, call):
+            # straggler: parks until cancelled/released
+            release.wait(5.0)
+            if call.cancelled:
+                raise ReplicaUnreachable(
+                    "unreachable", sent=True, cancelled=True
+                )
+            return 200, {"src": "slow"}
+
+        def fast(body, call):
+            return 200, {"src": "fast"}
+
+        reps = _replicas(2)
+        transport = FakeTransport(
+            {reps[0].url: slow, reps[1].url: fast}
+        )
+        router = Router(
+            reps,
+            RouterConfig(affinity=False, hedge_after_s=0.03),
+            transport=transport,
+            rng=random.Random(3),
+        )
+        # force the straggler first: replica 1 looks loaded
+        reps[1].inflight = 1
+        router.config = RouterConfig(
+            affinity=True, affinity_page=0, hedge_after_s=0.03,
+        )  # affinity_page=0 → least-loaded → replica 0 first
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        release.set()
+        assert status == 200 and payload["src"] == "fast"
+        d = payload["router"]
+        assert d["hedged"] and d["hedge_won"] and d["replica"] == 1
+        assert router.hedges_total == 1
+        assert router.hedge_wins_total == 1
+        # the loser's call was cancelled
+        slow_calls = [
+            c for c in transport.calls if c.fn is slow
+        ]
+        assert slow_calls and slow_calls[0].cancelled
+
+    def test_primary_win_is_not_a_hedge_win(self):
+        def fastish(body, call):
+            time.sleep(0.06)
+            return 200, {"src": "primary"}
+
+        def other(body, call):
+            time.sleep(0.5)
+            return 200, {"src": "hedge"}
+
+        reps = _replicas(2)
+        reps[1].inflight = 1  # primary = replica 0
+        router = Router(
+            reps,
+            RouterConfig(
+                affinity=True, affinity_page=0, hedge_after_s=0.02,
+            ),
+            transport=FakeTransport(
+                {reps[0].url: fastish, reps[1].url: other}
+            ),
+            rng=random.Random(4),
+        )
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 200 and payload["src"] == "primary"
+        assert payload["router"]["hedged"]
+        assert not payload["router"]["hedge_won"]
+        assert router.hedge_wins_total == 0
+
+    def test_single_replica_never_hedges(self):
+        def slow(body, call):
+            time.sleep(0.08)
+            return _ok()
+
+        router, _ = _router([slow], hedge_after_s=0.02)
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        assert status == 200
+        assert not payload["router"]["hedged"]
+        assert router.hedges_total == 0
+
+
+def test_trace_ids_unique_across_dispatches():
+    def alive(body, call):
+        return _ok()
+
+    router, _ = _router([alive])
+    tids = set()
+    for _ in range(32):
+        _, payload = router.dispatch(
+            {"prompt_tokens": [1], "max_new_tokens": 1}
+        )
+        tids.add(payload["router"]["trace_id"])
+    assert len(tids) == 32
+
+
+class TestManagerProbes:
+    """The poll loop's breaker semantics, over a fake transport (no
+    processes: a fake proc that never exits)."""
+
+    class _FakeProc:
+        pid = 0
+
+        def poll(self):
+            return None
+
+    class _HealthyTransport:
+        def get_json(self, url, path, timeout):
+            return {
+                "ok": True, "slots": 2, "active": 0,
+                "queue_depth": 0, "draining": False,
+            }
+
+    def _manager(self, tmp_path, transport):
+        from ddp_tpu.serve.fleet import ReplicaManager
+
+        mgr = ReplicaManager(
+            1, [], workdir=str(tmp_path), transport=transport
+        )
+        rep = mgr.replicas[0]
+        rep.proc = self._FakeProc()
+        rep.url = "http://replica0"
+        rep.state = HEALTHY
+        return mgr, rep
+
+    def test_probe_success_resets_consecutive_failures(self, tmp_path):
+        """Sporadic dispatch/probe timeouts hours apart must not
+        accumulate into a spurious open: any successful /healthz
+        probe resets a CLOSED breaker's count (the documented
+        'consecutive' contract)."""
+        mgr, rep = self._manager(tmp_path, self._HealthyTransport())
+        rep.breaker.record_failure()
+        rep.breaker.record_failure()  # 2 of 3
+        mgr._poll_replica(rep)
+        assert rep.breaker.failures == 0
+        assert rep.breaker.state == CircuitBreaker.CLOSED
+
+    def test_probe_closes_half_open_only_after_cooldown(self, tmp_path):
+        """An OPEN breaker inside its cooldown stays open through a
+        successful probe; past the cooldown the probe closes it — the
+        half-open recovery path rides /healthz."""
+        t = [0.0]
+        mgr, rep = self._manager(tmp_path, self._HealthyTransport())
+        rep.breaker = CircuitBreaker(
+            threshold=3, cooldown_s=5.0, clock=lambda: t[0]
+        )
+        rep.breaker.trip()
+        mgr._poll_replica(rep)  # inside cooldown: stays open
+        assert rep.breaker.state == CircuitBreaker.OPEN
+        t[0] = 5.0
+        mgr._poll_replica(rep)  # past cooldown: half-open → closed
+        assert rep.breaker.state == CircuitBreaker.CLOSED
+
+
+# ---------------------------------------------------------------------
+# Fleet chaos grammar
+# ---------------------------------------------------------------------
+
+
+class TestFleetChaosGrammar:
+    def test_round_trip_and_fields(self):
+        spec = "kill:replica1@request8,stall:replica0@request4:2.5s"
+        ev = parse_chaos(spec)
+        assert ev == (
+            ChaosEvent(kind="kill", replica=1, request=8),
+            ChaosEvent(
+                kind="stall", replica=0, request=4, seconds=2.5
+            ),
+        )
+        assert format_chaos(ev) == spec
+        assert parse_chaos(format_chaos(ev)) == ev
+        # mixes with trainer events in one plan
+        mixed = parse_chaos("kill:rank1@step20," + spec)
+        assert fleet_events(mixed) == ev
+        assert fleet_events(spec) == ev
+
+    def test_rejections(self):
+        for bad in (
+            "stall:replica0@request4",  # stall needs a duration
+            "stall:replica0@request4:0s",  # positive duration
+            "kill:replica1@request8:2s",  # kill takes no duration
+            "kill:replica1@step8",  # replicas trigger on requests
+            "sigterm:replica1@request8",  # only kill/stall are fleet
+        ):
+            with pytest.raises(ValueError):
+                parse_chaos(bad)
+
+    def test_trainer_chaos_engine_never_fires_replica_events(self):
+        eng = ChaosEngine(
+            "kill:replica0@request1", rank=0, ledger_path=None
+        )
+        # replica events are not the trainer's: no trigger point ever
+        # matches, and _mine rejects them outright
+        eng.on_start(None)
+        eng.on_epoch(0)
+        for step in range(4):
+            eng.on_step(step)  # would SIGKILL this process if fired
+        assert eng._load_ledger() == set()
+
+
+# ---------------------------------------------------------------------
+# Aggregate health classification (satellite)
+# ---------------------------------------------------------------------
+
+
+class TestScrapeHealthClassification:
+    def test_refused_is_distinguished(self):
+        from ddp_tpu.obs.aggregate import scrape_endpoint
+
+        view = scrape_endpoint("http://127.0.0.1:9", timeout=1.0)
+        assert view["ok"] is False
+        assert view["health"] == "refused"
+
+    def test_timeout_is_distinguished(self):
+        import socket
+
+        from ddp_tpu.obs.aggregate import scrape_endpoint
+
+        # a listener that accepts and then says nothing: the scrape
+        # connects fine and then times out reading — the
+        # maybe-overloaded case, NOT the dead case
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        try:
+            view = scrape_endpoint(
+                f"http://127.0.0.1:{port}", timeout=0.3
+            )
+        finally:
+            srv.close()
+        assert view["ok"] is False
+        assert view["health"] == "timeout"
+
+    def test_classify_unreachable_unwraps_urlerror(self):
+        import socket
+        import urllib.error
+
+        from ddp_tpu.obs.aggregate import classify_unreachable
+
+        assert (
+            classify_unreachable(ConnectionRefusedError()) == "refused"
+        )
+        assert classify_unreachable(socket.timeout()) == "timeout"
+        assert classify_unreachable(TimeoutError()) == "timeout"
+        assert (
+            classify_unreachable(
+                urllib.error.URLError(ConnectionRefusedError())
+            )
+            == "refused"
+        )
+        assert (
+            classify_unreachable(ConnectionResetError())
+            == "unreachable"
+        )
+
+
+# ---------------------------------------------------------------------
+# Fleet gauges + health_report line (satellite)
+# ---------------------------------------------------------------------
+
+
+def test_render_fleet_gauges_lint_clean():
+    from ddp_tpu.obs.promtext import render_fleet, validate_promtext
+
+    reps = _replicas(3)
+    reps[1].breaker.trip()
+    router = Router(reps, transport=FakeTransport({}))
+    snap = {
+        **router.state(),
+        "restarts_total": 1,
+        "rolling_restarts_total": 0,
+        "build_info": {"version": "0.0", "backend": "cpu"},
+    }
+    text = render_fleet(snap, up=True, draining=False)
+    assert validate_promtext(text) > 0
+    assert "ddp_tpu_fleet_replicas_healthy 3" in text
+    assert "ddp_tpu_fleet_breaker_open 0" in text
+    assert "ddp_tpu_fleet_replays_total 0" in text
+    assert "ddp_tpu_fleet_hedges_total 0" in text
+    assert "ddp_tpu_fleet_hedge_wins_total 0" in text
+    assert "ddp_tpu_fleet_restarts_total 1" in text
+
+
+def test_render_fleet_reflects_breaker_after_router_attach():
+    from ddp_tpu.obs.promtext import render_fleet
+
+    reps = _replicas(2)
+    router = Router(reps, transport=FakeTransport({}))
+    reps[0].breaker.trip()  # AFTER attach: the router's breakers
+    text = render_fleet(router.state(), up=True)
+    assert "ddp_tpu_fleet_breaker_open 1" in text
+    assert "ddp_tpu_fleet_breaker_opens_total 1" in text
+
+
+def test_health_report_fleet_line_gated_on_records(tmp_path):
+    import subprocess
+    import sys
+
+    stream = tmp_path / "fleet.jsonl"
+    recs = [
+        {
+            "kind": "fleet_poll", "time": 1.0, "replicas": 3,
+            "replicas_healthy": 2, "replicas_draining": 1,
+            "replicas_dead": 0, "breaker_open": 1,
+            "breaker_opens_total": 2, "dispatched_total": 40,
+            "replays_total": 3, "hedges_total": 5,
+            "hedge_wins_total": 2, "restarts_total": 1,
+            "rolling_restarts_total": 1,
+        },
+    ]
+    stream.write_text(
+        "".join(json.dumps(r) + "\n" for r in recs)
+    )
+    out = subprocess.run(
+        [sys.executable, "scripts/health_report.py", str(stream)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "fleet         : 2/3 healthy, 1 draining, 0 dead" in out
+    assert "breakers open 1 (2 lifetime)" in out
+    assert (
+        "fleet traffic : 40 dispatched, 3 replayed, hedges 2/5 won"
+        in out
+    )
+    assert "restarts 1, rolling 1" in out
+    # gated: a stream without fleet records prints no fleet line
+    empty = tmp_path / "train.jsonl"
+    empty.write_text(
+        json.dumps({"kind": "step", "step": 1, "loss": 1.0}) + "\n"
+    )
+    out2 = subprocess.run(
+        [sys.executable, "scripts/health_report.py", str(empty)],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "fleet" not in out2
+
+
+# ---------------------------------------------------------------------
+# Slow tier: the real kill drill
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_kill_drill_zero_dropped_zero_duplicated(tmp_path):
+    """2-replica fleet, ``kill:replica1@request3`` mid-traffic:
+
+    - ALL submitted requests complete (zero dropped), with tokens
+      identical to a re-ask of the same prompts on the stable fleet
+      (greedy decoding over identical weights — a replay must not
+      change the answer);
+    - goodput-style accounting shows exactly ONE replica restart;
+    - no completion is delivered twice: fleet trace ids are unique
+      over the full response set.
+    """
+    from ddp_tpu.serve.fleet import (
+        FleetChaos,
+        ReplicaManager,
+        Router,
+        RouterConfig,
+    )
+
+    n_requests = 8
+    mgr = ReplicaManager(
+        2,
+        [
+            "--init_demo", "--slots", "2",
+            "--seq_len", "64", "--vocab_size", "64",
+        ],
+        workdir=str(tmp_path),
+        max_restarts=2,
+        restart_backoff=0.2,
+    )
+    try:
+        mgr.start()
+        chaos = FleetChaos("kill:replica1@request3", mgr)
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(affinity_page=8, retry_backoff_s=0.02),
+                on_dispatch=chaos.on_dispatch,
+            )
+        )
+        assert mgr.wait_healthy(300), "fleet never became healthy"
+
+        prompts = [
+            [(i * 5 + j) % 64 for j in range(12)]
+            for i in range(n_requests)
+        ]
+        results: list[tuple[int, int, dict]] = []
+        lock = threading.Lock()
+
+        def client(i):
+            status, payload = router.dispatch(
+                {"prompt_tokens": prompts[i], "max_new_tokens": 6}
+            )
+            with lock:
+                results.append((i, status, payload))
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # zero dropped: every request came back complete
+        assert len(results) == n_requests
+        for i, status, payload in results:
+            assert status == 200, (i, status, payload.get("error"))
+            assert payload["status"] == "complete"
+        # zero duplicated: trace-id uniqueness over the response set
+        # (pins the digest plumbing) AND (replica, replica-rid)
+        # uniqueness — the replica-side completion identity, which a
+        # double-served replay/hedge WOULD collide on
+        tids = [p["router"]["trace_id"] for _, _, p in results]
+        assert len(set(tids)) == n_requests
+        served = [
+            (p["router"]["replica"], p.get("rid"))
+            for _, _, p in results
+        ]
+        assert len(set(served)) == n_requests, served
+        # the kill really happened and was really survived
+        assert mgr.chaos_kills == 1
+        # exactly one restart, once the replica is back
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            if (
+                mgr.restarts_total == 1
+                and all(r.state == HEALTHY for r in mgr.replicas)
+            ):
+                break
+            time.sleep(0.25)
+        assert mgr.restarts_total == 1, mgr.restarts_total
+        assert all(r.state == HEALTHY for r in mgr.replicas)
+        # correct tokens: greedy decoding over identical weights —
+        # re-asking the stable fleet must reproduce every completion,
+        # replayed or not
+        for i, _, payload in results:
+            status2, payload2 = router.dispatch(
+                {"prompt_tokens": prompts[i], "max_new_tokens": 6}
+            )
+            assert status2 == 200
+            assert payload2["tokens"] == payload["tokens"], i
+        # the drill left its mark in the router accounting
+        state = router.state()
+        assert state["dispatched_total"] == 2 * n_requests
+        assert state["completed_total"] == 2 * n_requests
+    finally:
+        mgr.stop()

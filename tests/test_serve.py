@@ -606,6 +606,57 @@ class TestServer:
             assert stats["compile_counts"] == eng.compile_counts()
             assert stats["ttft_s"]["count"] >= 1
 
+    def test_queue_full_429_carries_retry_after(self, params):
+        """Backpressure 503/429s must tell clients WHEN to come back
+        (ISSUE 14 satellite): a queue_full rejection carries a
+        Retry-After header derived from the queue drain rate (static
+        fallback before any retire window exists), matching the drain
+        path's existing header — so the fleet router (and any
+        client) backs off instead of hammering."""
+        import urllib.error
+        import urllib.request
+
+        from ddp_tpu.serve.server import LMServer
+
+        eng = ServeEngine(SPEC, params, slots=2, prefill_len=8)
+        with LMServer(eng) as srv:
+            # deterministic backpressure: shrink the bound so EVERY
+            # submit rejects queue_full, no racing the engine loop
+            eng.scheduler.max_queue = 0
+            req = urllib.request.Request(
+                srv.url + "/generate",
+                data=json.dumps(
+                    {"prompt_tokens": [1, 2], "max_new_tokens": 2}
+                ).encode(),
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=30)
+            assert exc.value.code == 429
+            retry_after = exc.value.headers["Retry-After"]
+            assert retry_after is not None and int(retry_after) >= 1
+            body = json.loads(exc.value.read())
+            assert body["error"] == "queue_full"
+            assert body["retry_after_s"] >= 1.0
+            # no retire history yet: the static drain hint backs it
+            assert body["retry_after_s"] == srv.drain_retry_after
+
+    def test_queue_drain_eta_math(self):
+        """The Retry-After derivation is pure and pinned: recent
+        retire rate over the synthetic window, depth over rate."""
+        from ddp_tpu.serve.engine import drain_eta_s
+
+        # 5 retires over 2s -> 2 req/s; 6 queued -> 3s
+        times = [10.0, 10.5, 11.0, 11.5, 12.0]
+        assert drain_eta_s(times, 6) == pytest.approx(3.0)
+        # empty queue still returns one retirement period (never
+        # "retry immediately")
+        assert drain_eta_s(times, 0) == pytest.approx(0.5)
+        # no usable window -> None (caller falls back to the static
+        # hint)
+        assert drain_eta_s([], 4) is None
+        assert drain_eta_s([1.0], 4) is None
+        assert drain_eta_s([2.0, 2.0], 4) is None
+
     def test_graceful_drain(self, params):
         """The SIGTERM drain contract (scripts/serve.py): admissions
         stop with 503 + Retry-After, running lanes finish, and the
