@@ -1869,7 +1869,9 @@ def _zero_bench_impl(
         opt_bytes_per_device,
         zero_comm_bytes,
     )
-    from ddp_tpu.runtime.mesh import MeshSpec, data_axes, make_mesh
+    from ddp_tpu.runtime.mesh import (
+        MeshSpec, data_axes, make_mesh, slice_block_size,
+    )
     from ddp_tpu.utils.metrics import StatSummary
 
     devices = jax.devices()
@@ -1881,12 +1883,10 @@ def _zero_bench_impl(
     batch = batch_per_shard * world
     rng = np.random.default_rng(0)
     sh = NamedSharding(mesh, P(data_axes(mesh)))
-    images = jax.device_put(
-        rng.integers(0, 256, (batch, 28, 28, 1), dtype=np.uint8), sh
-    )
-    labels = jax.device_put(
-        rng.integers(0, 10, (batch,)).astype(np.int32), sh
-    )
+    images_np = rng.integers(0, 256, (batch, 28, 28, 1), dtype=np.uint8)
+    labels_np = rng.integers(0, 10, (batch,)).astype(np.int32)
+    images = jax.device_put(images_np, sh)
+    labels = jax.device_put(labels_np, sh)
 
     ddp_state = replicate_state(
         create_train_state(model, tx, sample, seed=0), mesh
@@ -1894,6 +1894,25 @@ def _zero_bench_impl(
     zero_state, layout = create_zero_state(
         model, tx, sample, mesh, seed=0, bucket_mb=bucket_mb
     )
+    bf16_state, bf16_layout = create_zero_state(
+        model, tx, sample, mesh, seed=0, bucket_mb=bucket_mb,
+        gather_dtype="bf16",
+    )
+    # Two emulated slices for the hierarchical variant (dcn outermost
+    # — runtime/mesh.py): world must split 2×(world/2). At world 2 the
+    # per-slice group would be 1 (nothing to scatter) — skipped with a
+    # note rather than recorded as a vacuous number.
+    hier_ok = world >= 4 and world % 2 == 0
+    if hier_ok:
+        hier_mesh = make_mesh(
+            MeshSpec(dcn=2, data=world // 2), devices=devices
+        )
+        hsh = NamedSharding(hier_mesh, P(data_axes(hier_mesh)))
+        h_images = jax.device_put(images_np, hsh)
+        h_labels = jax.device_put(labels_np, hsh)
+        hier_state, hier_layout = create_zero_state(
+            model, tx, sample, hier_mesh, seed=0, bucket_mb=bucket_mb
+        )
     # Each variant dispatches through the xprof compile ledger
     # (obs/xprof.py): the record then carries real compile seconds per
     # variant, the HBM high-water of the measured loops, and — the
@@ -1903,39 +1922,69 @@ def _zero_bench_impl(
 
     xprof = Xprof(enabled=True)
     hbm = DeviceMemorySampler(enabled=True)
+
+    def zstep(lay, **kw):
+        return make_zero_train_step(model, tx, mesh, lay, donate=False, **kw)
+
+    # name -> (instrumented step, state, (images, labels))
     variants = {
         "ddp": (
             xprof.instrument(
                 make_train_step(model, tx, mesh, donate=False), "ddp"
             ),
-            ddp_state,
+            ddp_state, (images, labels),
         ),
         "zero": (
-            xprof.instrument(
-                make_zero_train_step(model, tx, mesh, layout, donate=False),
-                "zero",
-            ),
-            zero_state,
+            xprof.instrument(zstep(layout), "zero"),
+            zero_state, (images, labels),
         ),
         "zero_serialized": (
+            xprof.instrument(zstep(layout, overlap=False), "zero_serialized"),
+            zero_state, (images, labels),
+        ),
+        "gather_bf16": (
             xprof.instrument(
-                make_zero_train_step(
-                    model, tx, mesh, layout, donate=False, overlap=False
-                ),
-                "zero_serialized",
+                zstep(bf16_layout, gather_dtype="bf16"), "gather_bf16"
             ),
-            zero_state,
+            bf16_state, (images, labels),
+        ),
+        "gather_bf16_serialized": (
+            xprof.instrument(
+                zstep(bf16_layout, gather_dtype="bf16", overlap=False),
+                "gather_bf16_serialized",
+            ),
+            bf16_state, (images, labels),
         ),
     }
+    if hier_ok:
+        variants["hier"] = (
+            xprof.instrument(
+                make_zero_train_step(
+                    model, tx, hier_mesh, hier_layout, donate=False
+                ),
+                "hier",
+            ),
+            hier_state, (h_images, h_labels),
+        )
+        variants["hier_serialized"] = (
+            xprof.instrument(
+                make_zero_train_step(
+                    model, tx, hier_mesh, hier_layout, donate=False,
+                    overlap=False,
+                ),
+                "hier_serialized",
+            ),
+            hier_state, (h_images, h_labels),
+        )
     p50 = {}
     split = {}
     final_loss = {}
-    for name, (step, state0) in variants.items():
+    for name, (step, state0, (imgs, lbls)) in variants.items():
         state = state0
         summary = StatSummary()
         for i in range(warmup_steps + timed_steps):
             t0 = time.perf_counter()
-            state, metrics = step(state, images, labels)
+            state, metrics = step(state, imgs, lbls)
             jax.block_until_ready(metrics.loss)
             if i >= warmup_steps:
                 summary.add(time.perf_counter() - t0)
@@ -1944,14 +1993,18 @@ def _zero_bench_impl(
         # obs/steptime attribution of one more step: dispatch-return
         # vs block_until_ready — the same split the trainer records.
         (_, m2), disp_s, comp_s, _ = dispatch_compute_split(
-            step, state, images, labels
+            step, state, imgs, lbls
         )
         split[name] = {
             "dispatch_s": round(disp_s, 6), "compute_s": round(comp_s, 6),
         }
-    overlap_fraction = max(
-        0.0, 1.0 - p50["zero"] / max(p50["zero_serialized"], 1e-9)
-    )
+
+    def overlap(fast, slow):
+        return round(
+            max(0.0, 1.0 - p50[fast] / max(p50[slow], 1e-9)), 4
+        )
+
+    overlap_fraction = overlap("zero", "zero_serialized")
     opt_mem = {
         "ddp": opt_bytes_per_device(ddp_state.opt_state),
         "zero": opt_bytes_per_device(zero_state.opt_state),
@@ -1973,6 +2026,97 @@ def _zero_bench_impl(
         compile_s[rec["label"]] = round(
             compile_s.get(rec["label"], 0.0) + rec["compile_time_s"], 3
         )
+
+    # --- sub-records: the pod-scale comm variants, each with its own
+    # analytic pricing, HLO cross-check, overlap control, and
+    # provenance (gather dtype + the mesh's axis shape — what makes
+    # BENCH_* comparisons across flat/hier captures greppable in one
+    # field, like the platform/backend/cpu_fallback trio).
+    def mesh_axes_of(m):
+        return {a: int(s) for a, s in m.shape.items() if int(s) > 1}
+
+    bf16_est = zero_comm_bytes(bf16_layout, world, gather_dtype="bf16")
+    sub = {
+        "gather_bf16": {
+            "gather_dtype": "bf16",
+            "mesh_axes": mesh_axes_of(mesh),
+            "step_time_p50_s": p50["gather_bf16"],
+            "dispatch_compute": split["gather_bf16"],
+            "overlap_fraction": overlap(
+                "gather_bf16", "gather_bf16_serialized"
+            ),
+            "comm_bytes": bf16_est,
+            "hlo_comm_check": xprof.comm_check(
+                "gather_bf16", bf16_est["total"], world
+            ),
+            "opt_state_bytes_per_device": opt_bytes_per_device(
+                bf16_state.opt_state
+            ),
+            "final_loss": final_loss["gather_bf16"],
+            "loss_delta_vs_ddp": round(
+                abs(final_loss["gather_bf16"] - final_loss["ddp"]), 6
+            ),
+        },
+    }
+    # The headline byte claim, ASSERTED: half-width gathers move half
+    # the all-gather bytes in the analytic model AND the compiled HLO.
+    assert 2 * bf16_est["all_gather"] == comm_est["zero"]["all_gather"]
+    bf16_check = sub["gather_bf16"]["hlo_comm_check"]
+    zero_check = comm_check["zero"]
+    if bf16_check and zero_check:
+        ratio = bf16_check["measured_by_kind"]["all_gather"] / max(
+            1, zero_check["measured_by_kind"]["all_gather"]
+        )
+        sub["gather_bf16"]["hlo_ag_ratio_vs_fp32"] = round(ratio, 4)
+        assert abs(ratio - 0.5) < 0.05, (
+            f"bf16 gather not half-width in HLO: {ratio}"
+        )
+    if hier_ok:
+        hier_est = zero_comm_bytes(
+            hier_layout, world // 2, dcn=2
+        )
+        flat_on_pod = zero_comm_bytes(
+            hier_layout, world // 2, dcn=2, hier=False
+        )
+        hier_check = xprof.comm_check(
+            "hier", hier_est["total"], world,
+            expected_by_axis=hier_est["by_axis"],
+            slice_size=slice_block_size(hier_mesh),
+        )
+        sub["hier"] = {
+            "gather_dtype": "fp32",
+            "mesh_axes": mesh_axes_of(hier_mesh),
+            "step_time_p50_s": p50["hier"],
+            "dispatch_compute": split["hier"],
+            "overlap_fraction": overlap("hier", "hier_serialized"),
+            "comm_bytes": hier_est,
+            "flat_comm_bytes": flat_on_pod,
+            "hlo_comm_check": hier_check,
+            "opt_state_bytes_per_device": opt_bytes_per_device(
+                hier_state.opt_state
+            ),
+            "final_loss": final_loss["hier"],
+            "loss_delta_vs_ddp": round(
+                abs(final_loss["hier"] - final_loss["ddp"]), 6
+            ),
+        }
+        # Cross-slice bytes ≤ 1/N_data of the flat all-data traffic —
+        # the hierarchy's reason to exist, asserted not narrated.
+        assert (
+            hier_est["by_axis"]["dcn"]["total"]
+            <= flat_on_pod["total"] / (world // 2) + 64
+        )
+        if hier_check is not None:
+            assert hier_check["within_tolerance"], hier_check
+    else:
+        sub["hier"] = {
+            "skipped": f"world {world} < 4: a 2-slice mesh would have "
+            "a 1-wide ICI group (nothing to scatter)",
+        }
+    for rec in sub.values():
+        rec.setdefault("gather_dtype", None)
+        rec.update(_env_fields())
+
     return {
         "metric": "zero_weight_update_sharding",
         **_env_fields(),
@@ -1983,7 +2127,7 @@ def _zero_bench_impl(
         "timed_steps": timed_steps,
         "step_time_p50_s": p50,
         "dispatch_compute": split,
-        "overlap_fraction": round(overlap_fraction, 4),
+        "overlap_fraction": overlap_fraction,
         "comm_bytes": comm_est,
         "hlo_comm_check": comm_check,
         "compile_time_s": compile_s,
@@ -1998,6 +2142,7 @@ def _zero_bench_impl(
             abs(final_loss["zero"] - final_loss["ddp"]), 6
         ),
         "final_loss": final_loss,
+        "variants": sub,
     }
 
 
@@ -2138,9 +2283,9 @@ def run_elastic_bench(*, timeout: float = 600.0) -> dict:
 
 def run_zero_bench() -> dict:
     """Headline `zero` entry — in-process when the backend has ≥ 2
-    devices, else re-run in a subprocess with 2 emulated CPU devices
-    (world size ≥ 2 is the point: at world 1 there is nothing to
-    scatter and no memory to win)."""
+    devices, else re-run in a subprocess with 4 emulated CPU devices
+    (world ≥ 2 is the point — nothing to scatter at 1 — and 4 lets
+    the hierarchical variant emulate 2 slices × 2)."""
     import os
     import subprocess
     import sys
@@ -2152,7 +2297,7 @@ def run_zero_bench() -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env["XLA_FLAGS"] = (
         env.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=2"
+        + " --xla_force_host_platform_device_count=4"
     ).strip()
     try:
         proc = subprocess.run(

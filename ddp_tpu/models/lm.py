@@ -302,6 +302,7 @@ def create_lm_train_state(
     *,
     seed: int = 0,
     zero_layout=None,
+    zero_gather_dtype=None,
 ) -> LMTrainState:
     """Replicated state, or fsdp-sharded at rest when the mesh has an
     ``fsdp`` axis > 1 (parallel/seq_fsdp.py — moments shard with the
@@ -311,7 +312,9 @@ def create_lm_train_state(
     weight-update sharding variant: params replicate as usual but the
     optimizer state rests as flat fp32 buckets sharded 1/N over
     ``data`` — the layout ``make_lm_train_step(..., zero_layout=)``
-    updates in place.
+    updates in place. ``zero_gather_dtype='bf16'`` adds the fp32
+    master shards the half-width gather keeps exact (parallel/zero.py
+    module docstring).
     """
     from ddp_tpu.models.seq_transformer import sharded_or_replicated_state
 
@@ -326,7 +329,8 @@ def create_lm_train_state(
             step=jax.device_put(jnp.zeros((), jnp.int32), rep),
             params=params,
             opt_state=create_zero_opt_state(
-                params, optimizer, mesh, zero_layout
+                params, optimizer, mesh, zero_layout,
+                gather_dtype=zero_gather_dtype or jnp.float32,
             ),
         )
     return sharded_or_replicated_state(
@@ -578,6 +582,8 @@ def make_lm_train_step(
     health: bool = False,
     health_inject: tuple[str, int] | None = None,
     zero_layout=None,
+    zero_gather_dtype=None,
+    zero_grad_clip_norm: float = 0.0,
 ):
     """dp×sp[×fsdp] causal-LM step: ``step(state, tokens)``.
 
@@ -585,8 +591,14 @@ def make_lm_train_step(
     in-graph GSPMD expression (parallel/zero.py ``zero_gspmd_update``):
     gradients constrain into data-sharded flat buckets, the optimizer
     runs on 1/N shards with the moments resting sharded, and the SPMD
-    partitioner derives the parameter all-gather. Loss/metrics math is
+    partitioner derives the parameter all-gather — composing with
+    populated ``model``/``seq`` axes, where the buckets shard over
+    ``data`` and replicate over the model axes. Loss/metrics math is
     untouched — trajectories pin against the plain step.
+    ``zero_gather_dtype='bf16'`` gathers the updated params half-width
+    over fp32 master shards; ``zero_grad_clip_norm`` applies the
+    global-norm clip inside the sharded update (the trainer builds the
+    optimizer without the chained clip in zero mode).
 
     ``jit=False`` returns the raw (untraced) step for callers that
     embed it in a larger program — the compiled-epoch runner
@@ -669,6 +681,8 @@ def make_lm_train_step(
             params, opt_state = zero_gspmd_update(
                 optimizer, zero_layout, mesh, grads,
                 state.opt_state, state.params,
+                gather_dtype=zero_gather_dtype or jnp.float32,
+                grad_clip_norm=zero_grad_clip_norm,
             )
         else:
             updates, opt_state = optimizer.update(

@@ -85,7 +85,20 @@ _COLLECTIVE_RE = re.compile(
     + "|".join(_COLLECTIVE_OPS)
     + r")(-start|-done)?\("
 )
+# The `%name` defining the instruction, scanned BACKWARD from a
+# collective match: async `-done` ops reference their `-start` by this
+# name, which is how the done's bytes re-join the start's groups.
+_DEF_NAME_RE = re.compile(r"%([\w.\-]+)\s*$")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
 _SHAPE_RE = re.compile(r"(pred|[suf]\d+|bf16|c\d+)\[([0-9,]*)\]")
+# The two HLO spellings of group membership: explicit nested braces
+# (`replica_groups={{0,1},{2,3}}`) and the iota/v2 form
+# (`replica_groups=[2,2]<=[4]` — reshape iota(4) to [2,2], each row a
+# group — optionally with a transpose, `<=[2,2]T(1,0)`).
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{(\{[0-9,{}]*\})\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
 
 
 def _shape_bytes(dtype: str, dims: str) -> int:
@@ -96,56 +109,185 @@ def _shape_bytes(dtype: str, dims: str) -> int:
     return n * _HLO_DTYPE_BYTES.get(dtype, 4)
 
 
+def _parse_replica_groups(line: str) -> Optional[list[list[int]]]:
+    """The collective's replica groups from its HLO line, or None when
+    the op carries none (= one group of the whole world)."""
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        import numpy as np
+
+        gshape = [int(x) for x in m.group(1).split(",")]
+        rshape = [int(x) for x in m.group(2).split(",")]
+        ids = np.arange(int(np.prod(rshape))).reshape(rshape)
+        if m.group(3):
+            perm = [int(x) for x in m.group(3).split(",")]
+            ids = ids.transpose(perm)
+        return [
+            [int(v) for v in row]
+            for row in ids.reshape(-1).reshape(gshape)
+        ]
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        groups = []
+        for grp in re.findall(r"\{([0-9,\s]*)\}", m.group(1)):
+            ids = [int(v) for v in grp.split(",") if v.strip()]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    return None
+
+
 def parse_hlo_collectives(hlo_text: str) -> dict[str, dict]:
-    """Optimized-HLO text → per-kind ``{count, result_bytes}``.
+    """Optimized-HLO text → per-kind ``{count, result_bytes, ops}``.
 
     ``result_bytes`` sums each collective's RESULT shape(s): the full
     array for all-reduce/all-gather, the 1/N shard for reduce-scatter
     — :func:`ring_collective_traffic` converts to wire traffic.
+
+    ``ops`` lists each instance as ``{result_bytes, groups}`` where
+    ``groups`` is the parsed ``replica_groups`` membership (None = the
+    whole world in one group). SUBGROUP collectives — the hierarchical
+    zero step's within-slice scatter and cross-slice shard exchange —
+    ring-model over their own group size, and the membership is what
+    :func:`hlo_axis_traffic` attributes to ICI vs DCN. Async pairs:
+    the ``-start`` op carries the attributes but its tuple result
+    aliases the operand, so the groups are recorded at ``-start``
+    keyed by its instruction NAME and the bytes counted at the
+    ``-done`` that references that name as its operand — an overlapped
+    schedule may retire dones out of start order, so FIFO pairing
+    would cross-wire groups (positional fallback only when the
+    operand reference is unresolvable).
     """
     out: dict[str, dict] = {}
+    pending: dict[str, dict] = {}
     for m in _COLLECTIVE_RE.finditer(hlo_text):
         shapes, op, suffix = m.group(1), m.group(2), m.group(3)
+        bol = hlo_text.rfind("\n", 0, m.start()) + 1
+        eol = hlo_text.find("\n", m.end())
+        line = hlo_text[m.end() : eol if eol >= 0 else len(hlo_text)]
         if suffix == "-start":
+            named = _DEF_NAME_RE.search(hlo_text[bol : m.start()].rstrip())
+            key = named.group(1) if named else f"?{len(pending)}"
+            pending.setdefault(op, {})[key] = _parse_replica_groups(line)
             continue  # its tuple aliases the operand; `-done` counts
+        if suffix == "-done":
+            queued = pending.get(op, {})
+            ref = _OPERAND_NAME_RE.search(line)
+            if ref is not None and ref.group(1) in queued:
+                groups = queued.pop(ref.group(1))
+            elif queued:  # unresolvable reference: oldest pending
+                groups = queued.pop(next(iter(queued)))
+            else:
+                groups = _parse_replica_groups(line)
+        else:
+            groups = _parse_replica_groups(line)
         total = sum(
             _shape_bytes(dt, dims) for dt, dims in _SHAPE_RE.findall(shapes)
         )
-        ent = out.setdefault(op, {"count": 0, "result_bytes": 0})
+        ent = out.setdefault(
+            op, {"count": 0, "result_bytes": 0, "ops": []}
+        )
         ent["count"] += 1
         ent["result_bytes"] += total
+        ent["ops"].append({"result_bytes": total, "groups": groups})
     return out
+
+
+def _op_ring_bytes(op: str, result_bytes: int, group: int) -> int:
+    """One collective instance → per-replica ring traffic over its own
+    group: all-reduce 2·(g−1)/g of the full bytes, all-gather (g−1)/g
+    of its (full) result, reduce-scatter (g−1)·its (shard) result,
+    permute one hop."""
+    if group <= 1:
+        return 0
+    frac = (group - 1) / group
+    if op == "all-reduce":
+        return int(2 * frac * result_bytes)
+    if op == "reduce-scatter":
+        return int((group - 1) * result_bytes)
+    if op == "collective-permute":
+        return int(result_bytes)
+    return int(frac * result_bytes)  # all-gather / all-to-all
 
 
 def ring_collective_traffic(
     collectives: dict[str, dict], world: int
 ) -> dict[str, int]:
     """HLO result bytes → per-replica ring traffic, the model
-    ``parallel/zero.zero_comm_bytes`` prices: all-reduce moves
-    2·(N−1)/N of the full bytes, all-gather (N−1)/N of its (full)
-    result, reduce-scatter (N−1)·its (shard) result, permute one hop.
+    ``parallel/zero.zero_comm_bytes`` prices. Subgroup-aware: an op
+    whose ``replica_groups`` name a smaller group ring-models over
+    THAT size (groups absent = one ring over ``world``), so the
+    hierarchical step's within-slice and cross-slice collectives each
+    price over their own fabric's group.
     """
-    frac = (world - 1) / max(1, world)
-    traffic = {
-        "all_reduce": int(
-            2 * frac * collectives.get("all-reduce", {}).get("result_bytes", 0)
-        ),
-        "all_gather": int(
-            frac * collectives.get("all-gather", {}).get("result_bytes", 0)
-        ),
-        "reduce_scatter": int(
-            (world - 1)
-            * collectives.get("reduce-scatter", {}).get("result_bytes", 0)
-        ),
-        "collective_permute": int(
-            collectives.get("collective-permute", {}).get("result_bytes", 0)
-        ),
-        "all_to_all": int(
-            frac * collectives.get("all-to-all", {}).get("result_bytes", 0)
-        ),
-    }
+    traffic = {}
+    for op, key in (
+        ("all-reduce", "all_reduce"),
+        ("all-gather", "all_gather"),
+        ("reduce-scatter", "reduce_scatter"),
+        ("collective-permute", "collective_permute"),
+        ("all-to-all", "all_to_all"),
+    ):
+        ent = collectives.get(op, {})
+        ops = ent.get("ops")
+        if ops is None:
+            # Pre-extension dict (stored records): aggregate math.
+            ops = [
+                {"result_bytes": ent.get("result_bytes", 0), "groups": None}
+            ] if ent else []
+        traffic[key] = sum(
+            _op_ring_bytes(
+                op,
+                o["result_bytes"],
+                len(o["groups"][0]) if o.get("groups") else world,
+            )
+            for o in ops
+        )
     traffic["total"] = sum(traffic.values())
     return traffic
+
+
+def hlo_axis_traffic(
+    collectives: dict[str, dict], *, slice_size: int, world: int
+) -> dict[str, dict[str, int]]:
+    """Ring traffic split by fabric: ``ici`` (every group stays inside
+    one slice block) vs ``dcn`` (any group spans slices).
+
+    Replica ids group into contiguous per-slice blocks of
+    ``slice_size`` because the mesh's ``dcn`` axis is OUTERMOST
+    (runtime/mesh.py ``slice_block_size``) — so id//slice_size is the
+    slice, and a group with members in two slices rides the slow
+    fabric. Ops without groups span the world: dcn iff
+    ``world > slice_size``.
+    """
+    out = {
+        "ici": {"total": 0}, "dcn": {"total": 0},
+    }
+    for op, key in (
+        ("all-reduce", "all_reduce"),
+        ("all-gather", "all_gather"),
+        ("reduce-scatter", "reduce_scatter"),
+        ("collective-permute", "collective_permute"),
+        ("all-to-all", "all_to_all"),
+    ):
+        for axis in out:
+            out[axis].setdefault(key, 0)
+        for o in collectives.get(op, {}).get("ops", []):
+            groups = o.get("groups")
+            if groups:
+                g = len(groups[0])
+                crossing = any(
+                    len({i // max(1, slice_size) for i in grp}) > 1
+                    for grp in groups
+                )
+            else:
+                g = world
+                crossing = world > slice_size
+            b = _op_ring_bytes(op, o["result_bytes"], g)
+            axis = "dcn" if crossing else "ici"
+            out[axis][key] += b
+            out[axis]["total"] += b
+    return out
 
 
 def _leaf_sig(leaf) -> str:
@@ -457,16 +599,26 @@ class Xprof:
                     return p.flops
         return None
 
+    def label_collectives(self, label: str) -> Optional[dict]:
+        """Raw parsed collectives of the label's most recent AOT
+        compile (the per-axis attribution input), or None."""
+        with self._lock:
+            for p in reversed(self._ledger):
+                if p.label == label and not p.fallback:
+                    return p.collectives
+        return None
+
     def collective_traffic(
         self, label: str, world: int
     ) -> Optional[dict[str, int]]:
         """Ring-model per-replica traffic of the label's most recent
         compile, or None when nothing compiled (or no collectives)."""
-        with self._lock:
-            for p in reversed(self._ledger):
-                if p.label == label and not p.fallback:
-                    return ring_collective_traffic(p.collectives, world)
-        return None
+        coll = self.label_collectives(label)
+        return (
+            ring_collective_traffic(coll, world)
+            if coll is not None
+            else None
+        )
 
     def comm_check(
         self,
@@ -475,11 +627,20 @@ class Xprof:
         world: int,
         *,
         tolerance: float = 0.05,
+        expected_by_axis: Optional[dict] = None,
+        slice_size: Optional[int] = None,
     ) -> Optional[dict]:
         """Hand-ledger vs HLO: does ``expected_total`` (e.g. the zero
         strategy's ``zero_comm_bytes`` estimate) match the compiled
         program's ring traffic within ``tolerance``? None until the
-        label compiles; otherwise a JSON-ready verdict."""
+        label compiles; otherwise a JSON-ready verdict.
+
+        ``expected_by_axis`` + ``slice_size`` extend the verdict per
+        fabric (the hierarchical zero claim): each axis's analytic
+        total (``zero_comm_bytes``'s ``by_axis[...]["total"]``) is
+        checked against the replica-group-attributed HLO traffic
+        (:func:`hlo_axis_traffic`) under the same tolerance, and the
+        overall ``within_tolerance`` requires every axis to hold."""
         measured = self.collective_traffic(label, world)
         if measured is None:
             return None
@@ -494,7 +655,7 @@ class Xprof:
             # free — a nonzero measurement against a zero estimate is
             # exactly the drift this exists to catch.
             within = measured["total"] == 0
-        return {
+        out = {
             "label": label,
             "expected_comm_bytes": int(expected_total),
             "measured_comm_bytes": measured["total"],
@@ -504,6 +665,44 @@ class Xprof:
             "ratio": round(ratio, 4) if ratio is not None else None,
             "within_tolerance": within,
         }
+        if expected_by_axis is not None and slice_size:
+            coll = self.label_collectives(label)
+            split = hlo_axis_traffic(
+                coll or {}, slice_size=slice_size, world=world
+            )
+            by_axis = {}
+            for axis, exp in expected_by_axis.items():
+                exp_total = int(
+                    exp["total"] if isinstance(exp, dict) else exp
+                )
+                got = split.get(axis, {}).get("total", 0)
+                aratio = got / exp_total if exp_total else None
+                # A small ABSOLUTE slack on top of the ratio band: the
+                # scalar loss/accuracy/norm reductions (a few 4-byte
+                # all-reduces) ride whichever fabric their pmean spans
+                # and are not part of the analytic shard-payload model
+                # — at real bucket sizes they are noise, but against a
+                # small per-axis expectation they would fail the pure
+                # ratio test spuriously.
+                awithin = (
+                    abs(aratio - 1.0) <= tolerance
+                    or abs(got - exp_total) <= 64
+                    if aratio is not None
+                    else got <= 64
+                )
+                by_axis[axis] = {
+                    "expected_comm_bytes": exp_total,
+                    "measured_comm_bytes": int(got),
+                    "ratio": (
+                        round(aratio, 4) if aratio is not None else None
+                    ),
+                    "within_tolerance": awithin,
+                }
+                out["within_tolerance"] = (
+                    out["within_tolerance"] and awithin
+                )
+            out["by_axis"] = by_axis
+        return out
 
 
 # ---- device memory: high-water and headroom ---------------------------

@@ -89,6 +89,13 @@ class TrainConfig:
     # --model long_context (classifier) or causal_lm (decoder LM) —
     # on the synthetic_seq dataset.
     mesh_seq: int = 1
+    # Two-level pod geometry: number of SLICES on the mesh's outermost
+    # dcn axis (runtime/mesh.py). Slices are joined by the slow
+    # inter-slice fabric; the hierarchical zero step reduce-scatters
+    # within a slice over ICI and exchanges only 1/N shards across
+    # slices over DCN. On CPU, --spawn P --emulate_devices K emulates
+    # P slices of K chips (process boundaries = the slow fabric).
+    mesh_dcn: int = 1
     seq_len: int = 2048  # total sequence length (long_context/causal_lm)
     seq_dim: int = 16  # input feature channels per token
     seq_strategy: str = "ring"  # ring | ulysses
@@ -125,6 +132,13 @@ class TrainConfig:
     # scheduler more collectives to overlap with backward compute,
     # larger ones amortize per-collective latency.
     zero_bucket_mb: float = 4.0
+    # Wire dtype of the zero parameter all-gather. "fp32" (default) is
+    # bit-identical to the pre-flag path. "bf16" halves the dominant
+    # all-gather bytes (PAPERS.md #3's headline win): the optimizer
+    # math and the fp32 MASTER shards (kept in opt_state, sharded like
+    # the moments) stay full precision — only the forward sees
+    # bf16-rounded params, so rounding never compounds across steps.
+    zero_gather_dtype: str = "fp32"  # fp32 | bf16
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
     # (resnet*, vit*, vit_moe*); simple_cnn has no block stack to remat.
@@ -353,6 +367,19 @@ class TrainConfig:
             "--zero_bucket_mb", type=float, default=cls.zero_bucket_mb,
             help="gradient bucket size target for --parallel zero "
             "(MB; smaller = more overlap-schedulable collectives)",
+        )
+        p.add_argument(
+            "--zero_gather_dtype", default=cls.zero_gather_dtype,
+            choices=("fp32", "bf16"),
+            help="wire dtype of the zero param all-gather: bf16 halves "
+            "the dominant collective while fp32 master shards keep the "
+            "update exact (fp32 = bit-identical default)",
+        )
+        p.add_argument(
+            "--mesh_dcn", type=int, default=cls.mesh_dcn,
+            help="pod slices on the outermost dcn axis: the zero step "
+            "goes hierarchical (reduce-scatter within a slice over "
+            "ICI, exchange 1/N shards across slices over DCN)",
         )
         p.add_argument("--remat", action="store_true")
         p.add_argument("--emulate_devices", type=int, default=None)

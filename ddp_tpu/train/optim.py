@@ -138,30 +138,32 @@ def check_zero_compatible(
     1/N flat parameter SHARDS, so every transform in the chain must be
     *elementwise* — sgd, momentum, adam, adamw, weight decay and the
     schedules all are (their ``init``/``update`` accept the sharded
-    moment trees unchanged). Two config knobs are not, and composing
-    them is out of scope rather than silently wrong:
+    moment trees unchanged). One config knob is not, and composing it
+    is out of scope rather than silently wrong: the parameter EMA
+    keeps a full-shape parameter average inside ``opt_state`` and
+    ``evaluate()`` reads it back as a param tree — flat 1/N shards
+    cannot serve either end.
 
-    - global-norm clipping reads a norm over the WHOLE gradient tree
-      before scaling (clipping per shard is a different algorithm);
-    - the parameter EMA keeps a full-shape parameter average inside
-      ``opt_state`` and ``evaluate()`` reads it back as a param tree —
-      flat 1/N shards cannot serve either end.
+    ``--grad_clip_norm`` DOES compose (it used to be rejected here):
+    the global norm is one scalar, and the scattered buckets partition
+    the reduced gradient exactly, so a psum of per-shard squared sums
+    over the shard axis is the whole-tree norm without ever
+    materializing the full gradient. The zero steps apply it in-step
+    (``make_zero_train_step``/``zero_gspmd_update`` ``grad_clip_norm``
+    — the Trainer builds the optimizer WITHOUT the chained optax clip
+    and threads the knob there instead; parity-pinned against the ddp
+    chain). ``grad_clip_norm`` stays in the signature so the composing
+    rule is documented at the same door that once rejected it.
 
     A structural backstop at layout time (parallel/zero.py
     ``_opt_template``: every state leaf scalar or bucket-shaped)
     additionally catches hand-built optimizers whose STATE has the
-    wrong shape — but it is shape-based, so a STATELESS cross-element
-    transform (``clip_by_global_norm`` carries EmptyState) slips it;
-    direct-API callers composing their own optax chains own the
-    elementwise contract themselves.
+    wrong shape; direct-API callers composing their own optax chains
+    own the elementwise contract themselves (a chained
+    ``clip_by_global_norm`` carries EmptyState and would silently clip
+    PER SHARD — use the step's knob, not the chain).
     """
-    del name  # all registered families pass once the knobs are clear
-    if grad_clip_norm:
-        raise ValueError(
-            "--grad_clip_norm computes a GLOBAL gradient norm, which "
-            "couples elements across the sharded update — not "
-            "composable with --parallel zero; drop one"
-        )
+    del name, grad_clip_norm
     if ema_decay:
         raise ValueError(
             "--ema_decay keeps a full-shape parameter average inside "

@@ -414,22 +414,58 @@ class Trainer:
         # optimizer update, all-gather params. Validated here, before
         # any device or dataset work, so a bad combination fails with
         # the flags named.
+        # Two-level pod geometry (--mesh_dcn, runtime/mesh.py): the
+        # slice axis is a replica axis of the explicit shard_map
+        # families — the DDP image step (flat reduction spans it) and
+        # the zero step (which goes HIERARCHICAL over it). The
+        # annotation-driven/pipelined/sequence paths have not earned
+        # the axis yet; reject with the flags named.
+        if config.mesh_dcn < 1:
+            raise ValueError(
+                f"--mesh_dcn must be >= 1, got {config.mesh_dcn}"
+            )
+        if config.mesh_dcn > 1 and (
+            self.use_spmd
+            or self.pipe_mode
+            or self.seq_mode
+            or config.fast_epoch
+        ):
+            raise ValueError(
+                "--mesh_dcn slices the replica axes of the explicit "
+                "shard_map families: the DDP image path and --parallel "
+                "zero (hierarchical collectives). Drop the slice axis "
+                "or the GSPMD/pipe/seq/fast_epoch flags"
+            )
         self.zero_mode = config.parallel == "zero"
+        # Global-norm clipping under zero is applied IN-STEP from the
+        # scattered shards (psum of per-shard squared sums); the
+        # optimizer is then built without the chained optax clip.
+        self._zero_clip = 0.0
         if self.zero_mode:
             from ddp_tpu.train.optim import check_zero_compatible
 
-            if self.use_spmd:
+            if config.zero1 or config.mesh_fsdp > 1 or config.mesh_expert > 1:
                 raise ValueError(
                     "--parallel zero shards the update over the data "
-                    "axis; model/fsdp/expert meshes (and --zero1) "
-                    "already shard optimizer state their own way — "
-                    "fsdp IS ZeRO-3 — drop the axes/flag or --parallel"
+                    "axis; fsdp/expert meshes (and --zero1) already "
+                    "shard optimizer state their own way — fsdp IS "
+                    "ZeRO-3 — drop the axes/flag or --parallel"
                 )
-            if config.mesh_seq > 1 or config.mesh_pipe > 1:
+            if (
+                config.mesh_model > 1 or config.mesh_seq > 1
+            ) and not self.lm_mode:
+                raise ValueError(
+                    "--parallel zero composes with model/seq axes on "
+                    "--model causal_lm only (the GSPMD expression "
+                    "shards buckets over data and replicates them over "
+                    "the model axes); this model keeps the data axis "
+                    "only"
+                )
+            if config.mesh_pipe > 1:
                 raise ValueError(
                     "--parallel zero composes with the data axis only "
                     "(the sharded update scatters over it); drop "
-                    "--mesh_seq/--mesh_pipe or --parallel"
+                    "--mesh_pipe or --parallel"
                 )
             if self.pipe_mode or (self.seq_mode and not self.lm_mode):
                 raise ValueError(
@@ -454,6 +490,7 @@ class Trainer:
                 grad_clip_norm=config.grad_clip_norm,
                 ema_decay=config.ema_decay,
             )
+            self._zero_clip = config.grad_clip_norm
             if config.zero_bucket_mb <= 0:
                 raise ValueError(
                     f"--zero_bucket_mb must be > 0, got "
@@ -462,8 +499,18 @@ class Trainer:
         self._zero_layout = None
         # Per-step collective-payload estimate (parallel/zero.py): set
         # on the strategies whose comm story the bench compares (plain
-        # DDP and zero); None elsewhere omits the metrics field.
+        # DDP and zero); None elsewhere omits the metrics field. The
+        # by-axis split is present exactly when the step is
+        # hierarchical (dcn > 1) — flat streams keep their schema.
         self._comm_bytes: int | None = None
+        self._comm_by_axis: dict | None = None
+        # The once-per-run xprof cross-check compares _comm_bytes to
+        # the WHOLE program's collectives — only honest when the
+        # estimate covers them all. The zero×model/seq composition's
+        # program also carries TP/SP activation collectives the
+        # update-payload estimate deliberately omits, so the check is
+        # disabled there (the estimate still stamps records).
+        self._comm_check_enabled = True
         from ddp_tpu.data.augment import get_augmentation
 
         self.dataset = config.dataset
@@ -525,6 +572,7 @@ class Trainer:
             fsdp=config.mesh_fsdp,
             expert=config.mesh_expert,
             seq=config.mesh_seq,
+            dcn=config.mesh_dcn,
         )
         if config.elastic:
             # Elastic world resize (docs/ROBUSTNESS.md): this process
@@ -680,7 +728,11 @@ class Trainer:
             weight_decay=config.weight_decay,
             warmup_steps=config.warmup_steps,
             decay_steps=config.decay_steps,
-            grad_clip_norm=config.grad_clip_norm,
+            # In zero mode the clip moves into the sharded step (a
+            # chained optax clip would read PER-SHARD norms there).
+            grad_clip_norm=(
+                0.0 if self.zero_mode else config.grad_clip_norm
+            ),
             ema_decay=config.ema_decay,
             lr_milestones=milestones,
             lr_decay_factor=config.lr_decay_factor,
@@ -796,13 +848,16 @@ class Trainer:
                     # expression (parallel/zero.py zero_gspmd_update):
                     # the bucket layout is built from abstract shapes
                     # so no replicated moment tree ever materializes.
+                    # model/seq axes compose here — buckets shard over
+                    # data and replicate over them (check_zero_mesh
+                    # allow_model_axes).
                     from ddp_tpu.parallel.zero import (
                         build_layout,
                         check_zero_mesh,
                         zero_comm_bytes,
                     )
 
-                    check_zero_mesh(self.mesh)
+                    check_zero_mesh(self.mesh, allow_model_axes=True)
                     seq_spec = self.seq_spec
                     self._zero_layout = build_layout(
                         jax.eval_shape(
@@ -816,7 +871,13 @@ class Trainer:
                         int(self.mesh.shape["data"]),
                         grad_accum_steps=config.grad_accum_steps,
                         gspmd=True,
+                        gather_dtype=config.zero_gather_dtype,
                     )["total"]
+                    if config.mesh_model > 1 or config.mesh_seq > 1:
+                        # TP/SP activation collectives are in the
+                        # program but not the update-payload estimate
+                        # — a ratio check would alarm spuriously.
+                        self._comm_check_enabled = False
                 # Instrumented HERE (not on the label-dropping lambda
                 # below): only the raw jit object can lower for the
                 # xprof compile ledger.
@@ -827,6 +888,12 @@ class Trainer:
                         grad_accum_steps=config.grad_accum_steps,
                         label_smoothing=config.label_smoothing,
                         zero_layout=self._zero_layout,
+                        zero_gather_dtype=(
+                            config.zero_gather_dtype
+                            if self.zero_mode
+                            else None
+                        ),
+                        zero_grad_clip_norm=self._zero_clip,
                         **hkw,
                     ),
                     "train_step",
@@ -841,6 +908,9 @@ class Trainer:
                     self.seq_spec, self.optimizer, self.mesh,
                     seed=config.seed,
                     zero_layout=self._zero_layout,
+                    zero_gather_dtype=(
+                        config.zero_gather_dtype if self.zero_mode else None
+                    ),
                 )
             else:
                 from ddp_tpu.models.seq_transformer import (
@@ -1187,6 +1257,7 @@ class Trainer:
             self.state, self._zero_layout = create_zero_state(
                 self.model, self.optimizer, sample, self.mesh,
                 seed=config.seed, bucket_mb=config.zero_bucket_mb,
+                gather_dtype=config.zero_gather_dtype,
             )
             self.train_step = make_zero_train_step(
                 self.model, self.optimizer, self.mesh, self._zero_layout,
@@ -1194,15 +1265,21 @@ class Trainer:
                 grad_accum_steps=config.grad_accum_steps,
                 augment_fn=augment_fn,
                 label_smoothing=config.label_smoothing,
+                gather_dtype=config.zero_gather_dtype,
+                grad_clip_norm=self._zero_clip,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh, compute_dtype=compute_dtype
             )
-            self._comm_bytes = zero_comm_bytes(
+            cb = zero_comm_bytes(
                 self._zero_layout,
                 int(self.mesh.shape["data"]),
                 grad_accum_steps=config.grad_accum_steps,
-            )["total"]
+                dcn=config.mesh_dcn,
+                gather_dtype=config.zero_gather_dtype,
+            )
+            self._comm_bytes = cb["total"]
+            self._comm_by_axis = cb.get("by_axis")
         else:
             self.train_step = make_train_step(
                 self.model, self.optimizer, self.mesh,
@@ -1358,7 +1435,8 @@ class Trainer:
             from ddp_tpu.parallel.zero import ZeroElasticReshaper
 
             self._opt_reshape = ZeroElasticReshaper(
-                self.optimizer, self._zero_layout, self.mesh
+                self.optimizer, self._zero_layout, self.mesh,
+                gather_dtype=config.zero_gather_dtype,
             )
         self.ckpt = CheckpointManager(
             config.checkpoint_dir,
@@ -1666,11 +1744,23 @@ class Trainer:
         # collectives to check.
         if (
             self._comm_bytes is not None
+            and self._comm_check_enabled
             and not self._comm_checked
             and self.data_shards >= 2
         ):
+            from ddp_tpu.runtime.mesh import slice_block_size
+
             check = self._xprof.comm_check(
-                "train_step", self._comm_bytes, self.data_shards
+                "train_step", self._comm_bytes, self.data_shards,
+                # Hierarchical steps additionally pin each fabric:
+                # HLO collectives attribute to ici/dcn by their
+                # replica-group membership (obs/xprof.py).
+                expected_by_axis=self._comm_by_axis,
+                slice_size=(
+                    slice_block_size(self.mesh)
+                    if self._comm_by_axis is not None
+                    else None
+                ),
             )
             if check is not None:
                 self._comm_checked = True
@@ -1897,7 +1987,8 @@ class Trainer:
             from ddp_tpu.parallel.zero import create_zero_opt_state
 
             return create_zero_opt_state(
-                params, self.optimizer, self.mesh, self._zero_layout
+                params, self.optimizer, self.mesh, self._zero_layout,
+                gather_dtype=self.config.zero_gather_dtype,
             )
         return self.optimizer.init(params)
 
@@ -2551,10 +2642,23 @@ class Trainer:
                         # (parallel/zero.py estimates — static per
                         # strategy, no sync): present on the ddp/zero
                         # paths so the sharded update's comm story is
-                        # auditable next to the step times.
+                        # auditable next to the step times. The
+                        # hierarchical step splits it per fabric.
                         **(
                             {"comm_bytes": self._comm_bytes}
                             if self._comm_bytes is not None
+                            else {}
+                        ),
+                        **(
+                            {
+                                "comm_bytes_ici": self._comm_by_axis[
+                                    "ici"
+                                ]["total"],
+                                "comm_bytes_dcn": self._comm_by_axis[
+                                    "dcn"
+                                ]["total"],
+                            }
+                            if self._comm_by_axis is not None
                             else {}
                         ),
                     )
@@ -2643,6 +2747,9 @@ class Trainer:
             )
         if self._comm_bytes is not None:
             extra["comm_bytes"] = self._comm_bytes
+        if self._comm_by_axis is not None:
+            extra["comm_bytes_ici"] = self._comm_by_axis["ici"]["total"]
+            extra["comm_bytes_dcn"] = self._comm_by_axis["dcn"]["total"]
         if self._xprof.enabled:
             # Epoch-boundary memory sample + compile totals (the drain
             # inside also flushes compiles paid outside the log

@@ -251,14 +251,24 @@ def build_report(records: list[dict]) -> str:
 
     # Collective-payload estimate (the ddp/zero update strategies
     # stamp it — parallel/zero.py): only printed when present, so
-    # pre-zero streams keep their golden output byte-identical.
+    # pre-zero streams keep their golden output byte-identical. The
+    # hierarchical zero step additionally stamps the per-fabric split
+    # (comm_bytes_ici/dcn) — rendered inline when present, so flat
+    # streams (and every existing golden) stay byte-identical too.
     comm = [
-        r["comm_bytes"]
+        r
         for r in steps + epochs
         if r.get("comm_bytes") is not None
     ]
     if comm:
-        lines.append(f"comm/step     : {comm[-1]:,} bytes (estimate)")
+        last = comm[-1]
+        line = f"comm/step     : {last['comm_bytes']:,} bytes (estimate"
+        if last.get("comm_bytes_dcn") is not None:
+            line += (
+                f"; ici {last.get('comm_bytes_ici', 0):,} / "
+                f"dcn {last['comm_bytes_dcn']:,}"
+            )
+        lines.append(line + ")")
 
     # Serve triage (ISSUE 11): user-facing latency percentiles, queue
     # wait, SLO burn and speculative acceptance — only when the stream

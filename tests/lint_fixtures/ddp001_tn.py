@@ -58,3 +58,21 @@ def uniform_zero_update(flat_grads, param_shard, world):
     shard = lax.psum_scatter(flat_grads, "data", tiled=True) / world
     new_shard = param_shard - 0.01 * shard
     return lax.all_gather(new_shard, "data", tiled=True)
+
+
+def hierarchical_zero_update(flat_grads, world, slices):
+    # the two-level pod shape (parallel/zero.py hier): within-slice
+    # scatter over ICI, cross-slice shard exchange over the named dcn
+    # SUB-axis, within-slice gather — all unconditional, every rank
+    shard = lax.psum_scatter(flat_grads, ("data",), tiled=True)
+    shard = lax.psum(shard, "dcn") / (world * slices)
+    return lax.all_gather(shard, ("data",), axis=0, tiled=True)
+
+
+def multi_axis_flat_scatter(flat_grads):
+    # one flat collective spanning BOTH replica sub-axes (the hier
+    # bench's flat-on-pod control) — a tuple axis name is still a
+    # uniform collective, not a rank branch
+    return lax.psum_scatter(
+        flat_grads, ("dcn", "data"), scatter_dimension=0, tiled=True
+    )

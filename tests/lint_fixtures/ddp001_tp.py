@@ -56,3 +56,12 @@ def gather_params_on_main(param_shard, process_id):
     if process_id == 0:
         return lax.all_gather(param_shard, "data", tiled=True)  # ddp-expect: DDP001
     return param_shard
+
+
+def dcn_exchange_on_slice_zero(shard, ctx):
+    # the hierarchical trap: "only slice 0 needs to push the shards"
+    # — the cross-slice all-reduce carries the same every-rank
+    # contract as any collective; slice 1 blocks in its next psum
+    if ctx.is_main:
+        return lax.psum(shard, "dcn")  # ddp-expect: DDP001
+    return shard

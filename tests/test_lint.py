@@ -126,7 +126,7 @@ def test_text_report_golden():
         "(runtime/consensus.agree_any)]"
     )
     assert lines[-1] == (
-        "ddp-lint: 7 finding(s) (0 suppressed) in 1 file(s)"
+        "ddp-lint: 8 finding(s) (0 suppressed) in 1 file(s)"
     )
 
 

@@ -21,7 +21,13 @@ class TestSharding:
         assert batch.images.shape == (64, 28, 28, 1)
         assert batch.images.dtype == np.uint8
         spec = batch.images.sharding.spec
-        assert spec[0] == ("data", "fsdp", "expert") or spec[0] == "data"
+        # the batch dim shards over the data axes (runtime/mesh.py
+        # data_axes — dcn joined the family with the two-level mesh)
+        assert spec[0] in (
+            ("dcn", "data", "fsdp", "expert"),
+            ("data", "fsdp", "expert"),
+            "data",
+        )
         # 8 devices × 8 examples each
         assert len(batch.images.addressable_shards) == 8
         assert batch.images.addressable_shards[0].data.shape[0] == 8

@@ -83,20 +83,85 @@ def test_parse_hlo_collectives_synthetic():
     # TPU-layout-annotated f32[64,8]{1,0:T(8,128)} (tiling/memory-
     # space suffixes must parse — post-optimization TPU HLO carries
     # them on every shape)
-    assert got["all-reduce"] == {
-        "count": 3, "result_bytes": 4096 + 4 + 64 * 8 * 4,
-    }
-    assert got["reduce-scatter"] == {
-        "count": 2, "result_bytes": 2048 + 2048,
-    }
+    assert got["all-reduce"]["count"] == 3
+    assert got["all-reduce"]["result_bytes"] == 4096 + 4 + 64 * 8 * 4
+    assert got["reduce-scatter"]["count"] == 2
+    assert got["reduce-scatter"]["result_bytes"] == 2048 + 2048
     # sync variadic tuple result: both elements counted; the ASYNC
     # pair contributes only its -done result (the -start tuple
     # aliases the operand buffer — counting it would overstate ~1.5x)
-    assert got["all-gather"] == {
-        "count": 2, "result_bytes": (1024 + 4) + 1024,
-    }
+    assert got["all-gather"]["count"] == 2
+    assert got["all-gather"]["result_bytes"] == (1024 + 4) + 1024
     # -done counted once, -start skipped
-    assert got["collective-permute"] == {"count": 1, "result_bytes": 512}
+    assert got["collective-permute"]["count"] == 1
+    assert got["collective-permute"]["result_bytes"] == 512
+    # per-instance entries carry the payload split (groups absent here)
+    assert [o["result_bytes"] for o in got["all-reduce"]["ops"]] == [
+        4096, 4, 2048,
+    ]
+    assert all(o["groups"] is None for o in got["all-reduce"]["ops"])
+
+
+_HLO_SUBGROUP_FIXTURE = """
+HloModule jit_hier
+%rs = f32[256]{0} reduce-scatter(f32[1024]{0} %g), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+%ar = f32[256]{0} all-reduce(f32[256]{0} %rs), channel_id=2, replica_groups={{0,4},{1,5},{2,6},{3,7}}, to_apply=%add
+%ag = f32[1024]{0} all-gather(f32[256]{0} %p), channel_id=3, replica_groups=[2,4]<=[8], dimensions={0}
+%agt = f32[1024]{0} all-gather(f32[512]{0} %p2), channel_id=4, replica_groups=[4,2]<=[2,4]T(1,0), dimensions={0}
+%ars = f32[64]{0} all-reduce-start(f32[64]{0} %x), channel_id=5, replica_groups={{0,1},{2,3},{4,5},{6,7}}
+%ars.2 = f32[128]{0} all-reduce-start(f32[128]{0} %y), channel_id=6, replica_groups={{0,4},{1,5},{2,6},{3,7}}
+%ard.2 = f32[128]{0} all-reduce-done(f32[128]{0} %ars.2)
+%ard = f32[64]{0} all-reduce-done(f32[64]{0} %ars)
+"""
+
+
+def test_parse_hlo_subgroup_replica_groups():
+    """Hierarchical collectives name SUB-groups: explicit nested-brace
+    and iota (``[g,n]<=[N]``, optionally transposed) forms both parse
+    to memberships, and the async pair inherits the ``-start`` line's
+    groups (the ``-done`` line carries none)."""
+    got = parse_hlo_collectives(_HLO_SUBGROUP_FIXTURE)
+    rs = got["reduce-scatter"]["ops"]
+    assert rs[0]["groups"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    ar = got["all-reduce"]["ops"]
+    assert ar[0]["groups"] == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    # async pairs retire OUT of start order here (ard.2 before ard):
+    # the done's operand NAME re-joins it to ITS start's groups — a
+    # FIFO pairing would cross-wire the two
+    assert ar[1]["groups"] == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert ar[1]["result_bytes"] == 512
+    assert ar[2]["groups"] == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert ar[2]["result_bytes"] == 256
+    ag = got["all-gather"]["ops"]
+    # iota [2,4]<=[8]: reshape(iota(8), [2,4]) — contiguous rows
+    assert ag[0]["groups"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # transposed iota [4,2]<=[2,4]T(1,0): strided slice-crossing pairs
+    assert ag[1]["groups"] == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+
+def test_ring_traffic_subgroup_aware():
+    """An op ring-models over ITS OWN group size, not the world: the
+    hierarchical step's cross-slice exchange of a 1/N shard over S
+    slices prices 2·(S−1)/S of the SHARD — the whole point."""
+    from ddp_tpu.obs.xprof import hlo_axis_traffic
+
+    got = parse_hlo_collectives(_HLO_SUBGROUP_FIXTURE)
+    t = ring_collective_traffic(got, world=8)
+    # rs groups of 4: 3 · 1024-byte shard; ag groups of 4: (3/4)·4096
+    # plus the transposed ag over groups of 2: (1/2)·4096; ar groups
+    # of 2: 2·(1/2)·1024, async pairs 2·(1/2)·256 + 2·(1/2)·512
+    assert t["reduce_scatter"] == 3 * 1024
+    assert t["all_gather"] == int(0.75 * 4096) + int(0.5 * 4096)
+    assert t["all_reduce"] == 1024 + 256 + 512
+    # slice blocks of 4 (dcn outermost): the {0,4}-style groups cross
+    split = hlo_axis_traffic(got, slice_size=4, world=8)
+    assert split["dcn"]["all_reduce"] == 1024 + 512  # cross-slice psums
+    assert split["dcn"]["all_gather"] == int(0.5 * 4096)  # transposed ag
+    assert split["ici"]["reduce_scatter"] == 3 * 1024
+    assert split["ici"]["all_reduce"] == 256  # within-slice async pair
+    assert (
+        split["ici"]["total"] + split["dcn"]["total"] == t["total"]
+    )
 
 
 def test_ring_collective_traffic_model():

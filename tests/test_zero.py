@@ -273,6 +273,267 @@ def test_zero_grad_accum_matches_ddp_accum(devices):
     _assert_params_close(s1, s0)
 
 
+# ---- bf16 gather: half-width wire, fp32 masters ---------------------
+
+
+def test_zero_fp32_default_bit_identical(devices):
+    """``gather_dtype='fp32'`` (and the default) IS the pre-flag path:
+    same opt_state structure (no master shards), bitwise-identical
+    trajectory."""
+    mesh, s1, step_default, layout = _setup(devices, parallel_zero=True)
+    step_fp32 = z.make_zero_train_step(
+        TinyMLP(), optax.adam(1e-3), mesh, layout, donate=False,
+        gather_dtype="fp32",
+    )
+    s2 = s1
+    images, labels = _batch(mesh)
+    for _ in range(2):
+        s1, m1 = step_default(s1, images, labels)
+        s2, m2 = step_fp32(s2, images, labels)
+    assert float(m1.loss) == float(m2.loss)
+    _assert_params_close(s1, s2, rtol=0, atol=0)
+    assert jax.tree_util.tree_structure(
+        s1.opt_state
+    ) == jax.tree_util.tree_structure(s2.opt_state)
+    assert not isinstance(s1.opt_state, dict)  # no master level
+
+
+def test_zero_bf16_gather_tracks_fp32(devices):
+    """bf16 gathers over fp32 masters: the trajectory tracks the fp32
+    path within bf16 rounding (the masters keep the update exact — the
+    only divergence is the forward seeing bf16-rounded params), the
+    master shards rest data-sharded, and the analytic all-gather bytes
+    halve while the scatters stay fp32."""
+    mesh, s32, step32, _ = _setup(devices, parallel_zero=True)
+    sbf, layout = z.create_zero_state(
+        TinyMLP(), optax.adam(1e-3), jnp.zeros((1, 6), jnp.float32),
+        mesh, seed=0, bucket_mb=0.0001, gather_dtype="bf16",
+    )
+    stepbf = z.make_zero_train_step(
+        TinyMLP(), optax.adam(1e-3), mesh, layout, donate=False,
+        gather_dtype="bf16",
+    )
+    assert set(sbf.opt_state) == {"base", "master"}
+    for k, v in sbf.opt_state["master"].items():
+        assert "data" in jax.tree.leaves(tuple(v.sharding.spec)), (
+            k, v.sharding,
+        )
+    images, labels = _batch(mesh)
+    for _ in range(4):
+        s32, m32 = step32(s32, images, labels)
+        sbf, mbf = stepbf(sbf, images, labels)
+    assert abs(float(m32.loss) - float(mbf.loss)) < 5e-3
+    _assert_params_close(s32, sbf, rtol=1e-2, atol=1e-2)
+    # params at rest are fp32 CONTAINERS of bf16-rounded values
+    for p in jax.tree.leaves(sbf.params):
+        assert p.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(p), np.asarray(p.astype(jnp.bfloat16), np.float32)
+        )
+    e32 = z.zero_comm_bytes(layout, 8)
+    ebf = z.zero_comm_bytes(layout, 8, gather_dtype="bf16")
+    assert 2 * ebf["all_gather"] == e32["all_gather"]
+    assert ebf["reduce_scatter"] == e32["reduce_scatter"]
+
+
+def test_zero_bf16_hlo_all_gather_halves(devices):
+    """Acceptance pin: the compiled program's all-gather traffic is
+    0.5× the fp32 step's — measured from the optimized HLO (the wire
+    rides uint16; XLA:CPU's float normalization silently re-widens a
+    bf16 collective to fp32, which is exactly what this pin guards)."""
+    from ddp_tpu.obs.xprof import Xprof
+
+    world = 2
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=world), devices=devices[:world])
+    model, tx = TinyMLP(), optax.adam(1e-3)
+    sample = jnp.zeros((1, 6), jnp.float32)
+    xp = Xprof(enabled=True)
+    s32, l32 = z.create_zero_state(
+        model, tx, sample, mesh, seed=0, bucket_mb=0.0001
+    )
+    sbf, lbf = z.create_zero_state(
+        model, tx, sample, mesh, seed=0, bucket_mb=0.0001,
+        gather_dtype="bf16",
+    )
+    st32 = xp.instrument(
+        z.make_zero_train_step(model, tx, mesh, l32, donate=False), "fp32"
+    )
+    stbf = xp.instrument(
+        z.make_zero_train_step(
+            model, tx, mesh, lbf, donate=False, gather_dtype="bf16"
+        ),
+        "bf16",
+    )
+    rng = np.random.default_rng(0)
+    sh = NamedSharding(mesh, P(data_axes(mesh)))
+    images = jax.device_put(
+        rng.normal(size=(8, 6)).astype(np.float32), sh
+    )
+    labels = jax.device_put(
+        rng.integers(0, 7, (8,)).astype(np.int32), sh
+    )
+    st32(s32, images, labels)
+    stbf(sbf, images, labels)
+    c32 = xp.comm_check("fp32", z.zero_comm_bytes(l32, world)["total"], world)
+    cbf = xp.comm_check(
+        "bf16",
+        z.zero_comm_bytes(lbf, world, gather_dtype="bf16")["total"],
+        world,
+    )
+    assert c32["within_tolerance"], c32
+    assert cbf["within_tolerance"], cbf
+    ag32 = c32["measured_by_kind"]["all_gather"]
+    agbf = cbf["measured_by_kind"]["all_gather"]
+    assert abs(agbf / ag32 - 0.5) < 0.05, (agbf, ag32)
+    # the scatters did NOT shrink — only the gather is half-width
+    assert (
+        cbf["measured_by_kind"]["reduce_scatter"]
+        == c32["measured_by_kind"]["reduce_scatter"]
+    )
+
+
+# ---- hierarchical (dcn) step ----------------------------------------
+
+
+def test_zero_hier_matches_flat_and_ddp(devices):
+    """2 emulated slices × 4: the hierarchical step (within-slice
+    scatter/gather + cross-slice shard exchange) is the SAME math as
+    the flat step and the ddp baseline; the analytic cross-slice bytes
+    are ≤ 1/N of the flat all-data traffic; and the per-axis HLO
+    cross-check holds (replica-group attribution)."""
+    from ddp_tpu.obs.xprof import Xprof
+    from ddp_tpu.runtime.mesh import (
+        MeshSpec, make_mesh, slice_block_size,
+    )
+
+    mesh = make_mesh(MeshSpec(dcn=2, data=4), devices=devices)
+    assert slice_block_size(mesh) == 4
+    model, tx = TinyMLP(), optax.adam(1e-3)
+    sample = jnp.zeros((1, 6), jnp.float32)
+    sh, hlay = z.create_zero_state(
+        model, tx, sample, mesh, seed=0, bucket_mb=0.0001
+    )
+    assert hlay.world == 4  # shards stay 1/|data| — per-slice
+    sf, flay = z.create_zero_state(
+        model, tx, sample, mesh, seed=0, bucket_mb=0.0001, hier=False
+    )
+    assert flay.world == 8  # the flat control spans the pod
+    xp = Xprof(enabled=True)
+    step_h = xp.instrument(
+        z.make_zero_train_step(model, tx, mesh, hlay, donate=False), "hier"
+    )
+    step_f = z.make_zero_train_step(
+        model, tx, mesh, flay, donate=False, hier=False
+    )
+    from ddp_tpu.parallel.ddp import (
+        create_train_state, make_train_step, replicate_state,
+    )
+
+    sd = replicate_state(create_train_state(model, tx, sample, seed=0), mesh)
+    step_d = make_train_step(model, tx, mesh, donate=False)
+    images, labels = _batch(mesh)
+    for _ in range(3):
+        sh, mh = step_h(sh, images, labels)
+        sf, mf = step_f(sf, images, labels)
+        sd, md = step_d(sd, images, labels)
+        assert abs(float(mh.loss) - float(mf.loss)) < 1e-6
+        assert abs(float(mh.loss) - float(md.loss)) < 1e-6
+    _assert_params_close(sh, sf)
+    _assert_params_close(sh, sd)
+    # cross-slice bytes: hier moves 1/|data| of the flat traffic
+    ch = z.zero_comm_bytes(hlay, 4, dcn=2)
+    cf = z.zero_comm_bytes(flay, 4, dcn=2, hier=False)
+    assert cf["by_axis"]["ici"]["total"] == 0  # flat: all of it crosses
+    assert ch["by_axis"]["dcn"]["total"] <= cf["total"] / 4 + 64
+    # the compiled program agrees, per fabric
+    check = xp.comm_check(
+        "hier", ch["total"], 8,
+        expected_by_axis=ch["by_axis"],
+        slice_size=slice_block_size(mesh),
+    )
+    assert check is not None and check["within_tolerance"], check
+    assert check["by_axis"]["dcn"]["measured_comm_bytes"] <= (
+        cf["total"] / 4 + 64
+    )
+
+
+# ---- global-norm clipping from scattered shards ---------------------
+
+
+def test_zero_grad_clip_matches_ddp(devices):
+    """--grad_clip_norm composes (the lifted rejection): a tight clip
+    that actually engages, applied from the scattered shards, pins
+    against the ddp path's chained optax.clip_by_global_norm."""
+    tx_plain = optax.sgd(0.05, momentum=0.9)
+    tx_clip = optax.chain(
+        optax.clip_by_global_norm(0.1), optax.sgd(0.05, momentum=0.9)
+    )
+    mesh, s1, step1, _ = _setup(
+        devices, parallel_zero=True, tx=tx_plain, grad_clip_norm=0.1
+    )
+    _, s0, step0, _ = _setup(devices, parallel_zero=False, tx=tx_clip)
+    images, labels = _batch(mesh)
+    for _ in range(4):
+        s1, m1 = step1(s1, images, labels)
+        s0, m0 = step0(s0, images, labels)
+        assert abs(float(m1.loss) - float(m0.loss)) < 1e-6
+        # grad_norm metric is the PRE-clip norm on both paths
+        assert abs(float(m1.grad_norm) - float(m0.grad_norm)) < 1e-5
+    _assert_params_close(s1, s0)
+
+
+# ---- composition lift: zero × TP on the causal LM -------------------
+
+
+def test_zero_lm_composes_with_model_axis(devices):
+    """The lifted composition: zero's GSPMD expression on a data×model
+    mesh — buckets shard over ``data``, replicate over ``model`` — is
+    the same math as the replicated update on the SAME mesh."""
+    from ddp_tpu.models.lm import (
+        LMSpec, create_lm_train_state, init_lm, make_lm_train_step,
+    )
+    from ddp_tpu.models.seq_transformer import _batch_axes
+    from ddp_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(data=4, model=2), devices=devices)
+    z.check_zero_mesh(mesh, allow_model_axes=True)  # no raise
+    with pytest.raises(ValueError, match="data axis only"):
+        z.check_zero_mesh(mesh)
+    spec = LMSpec(
+        vocab_size=32, total_len=16, d_model=32, depth=1, num_heads=4
+    )
+    tx = optax.sgd(0.05, momentum=0.9)
+    layout = z.build_layout(
+        jax.eval_shape(lambda: init_lm(spec, seed=0)), 4, bucket_mb=0.01
+    )
+    s0 = create_lm_train_state(spec, tx, mesh, seed=0)
+    s1 = create_lm_train_state(spec, tx, mesh, seed=0, zero_layout=layout)
+    step0 = make_lm_train_step(spec, tx, mesh, donate=False)
+    step1 = make_lm_train_step(
+        spec, tx, mesh, donate=False, zero_layout=layout
+    )
+    toks = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(3).integers(0, 32, (8, 16)), jnp.int32
+        ),
+        NamedSharding(mesh, P(_batch_axes(mesh), "seq")),
+    )
+    for _ in range(3):
+        s0, m0 = step0(s0, toks)
+        s1, m1 = step1(s1, toks)
+        assert abs(float(m0.loss) - float(m1.loss)) < 1e-5
+    _assert_params_close(s1, s0, atol=1e-5)
+    # the moments shard over data and REPLICATE over model
+    for path, leaf in jax.tree_util.tree_flatten_with_path(s1.opt_state)[0]:
+        if getattr(leaf, "ndim", 0):
+            spec_names = jax.tree.leaves(tuple(leaf.sharding.spec))
+            assert "data" in spec_names and "model" not in spec_names, (
+                path, leaf.sharding,
+            )
+
+
 # ---- resting state: sharded moments, replicated params --------------
 
 
@@ -348,11 +609,13 @@ def test_zero_lm_gspmd_matches_plain_lm(devices):
 def test_optimizer_contract_rejections():
     from ddp_tpu.train.optim import check_zero_compatible
 
-    with pytest.raises(ValueError, match="GLOBAL gradient norm"):
-        check_zero_compatible("sgd", grad_clip_norm=1.0)
     with pytest.raises(ValueError, match="full-shape parameter average"):
         check_zero_compatible("adamw", ema_decay=0.999)
     check_zero_compatible("adam")  # clean knobs pass
+    # the grad-clip rejection is LIFTED: the global norm is computable
+    # from the scattered shards (one psum of per-shard squared sums) —
+    # the steps apply it in-step, so the knob now composes
+    check_zero_compatible("sgd", grad_clip_norm=1.0)
 
     # the structural backstop: a state leaf that is neither scalar nor
     # bucket-shaped names the elementwise contract
@@ -382,14 +645,23 @@ def test_trainer_rejects_incompatible_combos(tmp_path):
     cases = [
         (dict(zero1=True), "shard optimizer state"),
         (dict(mesh_fsdp=2), "shard optimizer state"),
-        (dict(mesh_model=2), "shard optimizer state"),
+        # model/seq axes compose on the causal LM's GSPMD path ONLY —
+        # the image family keeps the data-axis-only wall
+        (dict(mesh_model=2), "causal_lm only"),
         (dict(model="long_context"), "causal_lm"),
         (dict(model="pipe_vit", mesh_pipe=2), "data axis only"),
         (dict(fast_epoch=True), "own hot loop"),
         (dict(health=True), "FLAT"),
-        (dict(grad_clip_norm=1.0), "GLOBAL gradient norm"),
         (dict(ema_decay=0.99, optimizer="adamw"), "parameter average"),
         (dict(zero_bucket_mb=0.0), "zero_bucket_mb"),
+        # the slice axis belongs to the explicit shard_map families;
+        # the LM's GSPMD update derives flat collectives
+        (
+            dict(model="causal_lm", mesh_dcn=2, seq_len=16, vocab_size=32),
+            "slices the replica axes",
+        ),
+        (dict(mesh_dcn=0), "mesh_dcn"),
+        (dict(zero_gather_dtype="fp16"), "fp16"),
     ]
     for overrides, match in cases:
         with pytest.raises(ValueError, match=match):
@@ -451,6 +723,59 @@ def test_trainer_zero_e2e_sanitized_resume(tmp_path):
     assert summary2["history"][0]["epoch"] == 1
 
 
+def test_trainer_zero_hier_bf16_clip_e2e(tmp_path):
+    """The pod-scale composition through the Trainer on 2 emulated
+    slices × 4: hierarchical collectives + bf16 gathers + in-step
+    global-norm clipping in ONE run. The metrics stream carries the
+    per-axis comm split (comm_bytes_ici/dcn) on step AND epoch
+    records, and the xprof cross-check verdict covers both fabrics."""
+    from ddp_tpu.train.config import TrainConfig
+    from ddp_tpu.train.trainer import Trainer
+
+    metrics = str(tmp_path / "m.jsonl")
+    cfg = TrainConfig(
+        epochs=1,
+        batch_size=4,
+        parallel="zero",
+        mesh_dcn=2,
+        zero_gather_dtype="bf16",
+        grad_clip_norm=1.0,
+        optimizer="adam",
+        lr=1e-3,
+        checkpoint_dir=str(tmp_path / "ck"),
+        data_root=str(tmp_path / "data"),
+        synthetic_data=True,
+        synthetic_size=64,
+        log_interval=2,
+        eval_every=0,
+        metrics_file=metrics,
+        xprof=True,
+    )
+    t = Trainer(cfg)
+    assert t.zero_mode and t._comm_by_axis is not None
+    assert int(t.mesh.shape["dcn"]) == 2 and int(t.mesh.shape["data"]) == 4
+    # the optimizer chain carries NO optax clip — the step owns it
+    assert t._zero_clip == 1.0
+    summary = t.train()
+    t.close()
+    assert summary["epochs_run"] == 1 and np.isfinite(summary["final_loss"])
+    recs = [json.loads(line) for line in open(metrics)]
+    steps = [r for r in recs if r.get("kind") == "step"]
+    assert steps
+    for r in steps:
+        assert r["comm_bytes"] == (
+            r["comm_bytes_ici"] + r["comm_bytes_dcn"]
+        )
+        # cross-slice bytes are the SMALL side — that is the point
+        assert r["comm_bytes_dcn"] < r["comm_bytes_ici"]
+    epochs = [r for r in recs if r.get("kind") == "epoch"]
+    assert epochs and epochs[0]["comm_bytes_dcn"] == steps[0]["comm_bytes_dcn"]
+    checks = [r for r in recs if r.get("kind") == "xprof_check"]
+    assert checks, "xprof comm cross-check record missing"
+    assert checks[0]["within_tolerance"], checks[0]
+    assert set(checks[0]["by_axis"]) == {"ici", "dcn"}, checks[0]
+
+
 def test_trainer_zero_lm_trains(tmp_path):
     """--parallel zero --model causal_lm: the in-graph GSPMD path end
     to end — sharded flat moments through checkpoint save and eval."""
@@ -509,7 +834,27 @@ def test_health_report_comm_line(tmp_path):
     report = health_report.build_report(
         health_report.load_records(str(path))
     )
-    assert "comm/step     : 4,096 bytes" in report
+    assert "comm/step     : 4,096 bytes (estimate)" in report
+    # hierarchical streams carry the per-fabric split — the comm line
+    # gains an inline ici/dcn rendering, pinned here; flat streams
+    # (above) keep the exact pre-split line
+    path.write_text(
+        json.dumps(
+            {
+                "kind": "step", "step": 1, "loss": 1.0,
+                "comm_bytes": 6144, "comm_bytes_ici": 4096,
+                "comm_bytes_dcn": 2048,
+            }
+        )
+        + "\n"
+    )
+    report_hier = health_report.build_report(
+        health_report.load_records(str(path))
+    )
+    assert (
+        "comm/step     : 6,144 bytes (estimate; ici 4,096 / dcn 2,048)"
+        in report_hier
+    )
     # absent field → absent line (the golden pin stays byte-identical)
     path.write_text(
         json.dumps({"kind": "step", "step": 1, "loss": 1.0}) + "\n"
