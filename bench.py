@@ -1331,6 +1331,29 @@ def run_serve_fleet_bench(
        ASSERTED), exactly one replica restart (ASSERTED), replayed
        requests recorded, and recovery time measured from the
        SIGKILL to the first completion the restarted replica serves.
+
+    Disaggregation phases (PR 16) over the same fleet:
+
+    4. **prefill:decode ratio sweep** — 1:2, 1:1 and 2:1 tier splits
+       (roles are router-side, so the sweep re-labels the live
+       replicas) vs the homogeneous hybrid control, all under the
+       same long-prompt traffic shape: aggregate tokens/s and p99
+       TTFT per ratio (honest CPU nulls — replicas share cores),
+       page-migration latency p50/p99 from the router's own summary,
+       and zero dropped requests per ratio (ASSERTED);
+    5. **migration token identity** — the same prompts asked of the
+       1:2 disagg fleet and of the hybrid control must stream
+       IDENTICAL tokens (ASSERTED; greedy over identical weights —
+       disaggregation is a placement change, not a numerics change),
+       with every disagg response served by the decode tier
+       (ASSERTED);
+    6. **directory vs affinity under churn** — seed shared prefixes,
+       kill the affinity home of at least one group, serve through
+       the outage, then burst after the restart: the fleet-wide
+       prefix-hit rate with the prefix directory on must be >= the
+       affinity-only control's (ASSERTED — the directory re-warms
+       the restarted replica by pulling pages from the replica that
+       served during the outage; affinity alone restarts cold).
     """
     import tempfile
     import threading
@@ -1340,10 +1363,14 @@ def run_serve_fleet_bench(
     import numpy as np
 
     from ddp_tpu.serve.fleet import (
+        ROLE_DECODE,
+        ROLE_HYBRID,
+        ROLE_PREFILL,
         FleetChaos,
         ReplicaManager,
         Router,
         RouterConfig,
+        affinity_key,
     )
 
     rng = np.random.default_rng(0)
@@ -1449,7 +1476,9 @@ def run_serve_fleet_bench(
             "--seq_len", str(seq_len),
         ],
         workdir=workdir,
-        max_restarts=2,
+        # Budget for the kill drill (1 restart) plus one kill per
+        # churn trial in phase 6, even if they all land on replica 1.
+        max_restarts=4,
         restart_backoff=0.2,
     )
     record: dict = {"metric": "serve_fleet_affinity_hit_rate"}
@@ -1459,7 +1488,12 @@ def run_serve_fleet_bench(
         urls = [r.url for r in mgr.replicas]
 
         def hit_deltas(before):
-            after = [paged_counts(u) for u in urls]
+            # Re-read replica URLs: a restarted replica (phase 6
+            # churn) rebinds a fresh port, so the startup list goes
+            # stale the moment a kill drill fires.
+            after = [
+                paged_counts(r.url) for r in mgr.replicas
+            ]
             per_replica = []
             hits = misses = 0
             for (h0, m0, _), (h1, m1, rate) in zip(before, after):
@@ -1598,6 +1632,252 @@ def run_serve_fleet_bench(
             "dropped": 0,
             "duplicated": 0,
         }
+        # Phase 3 was the last chaos-wrapped phase; phase 6 kills
+        # replicas directly.
+        mgr.kill_replica = orig_kill
+
+        # Phase 4: prefill:decode ratio sweep vs the hybrid control.
+        # Roles are ROUTER-side placement over identical replica
+        # processes, so the sweep re-labels the live fleet — the same
+        # assignment `scripts/fleet.py --roles` makes at spawn time.
+        # saturation_depth is raised because the decode tier shrinks
+        # to 1-2 replicas: excess burst queues on the replicas
+        # instead of tripping the router's spill/503 path.
+        cutoff = 2 * page_size  # prefix traffic classifies prefill
+
+        def set_roles(roles):
+            for rep in mgr.replicas:
+                rep.role = ROLE_HYBRID
+            for rep, role in zip(mgr.replicas, roles):
+                rep.role = role
+
+        def disagg_counters(router):
+            ms = router.migration_seconds
+            return {
+                "prefill_handoffs": router.prefill_handoffs_total,
+                "migrations": router.migrations_total,
+                "migration_failures": (
+                    router.migration_failures_total
+                ),
+                "pages_migrated": router.pages_migrated_total,
+                "migration_p50_s": (
+                    round(ms.percentile(50), 4) if ms.count else None
+                ),
+                "migration_p99_s": (
+                    round(ms.percentile(99), 4) if ms.count else None
+                ),
+            }
+
+        ratio_sweep = {}
+        for label, roles, seed in (
+            ("1:2", [ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE], 404),
+            ("1:1", [ROLE_PREFILL, ROLE_DECODE], 414),
+            ("2:1", [ROLE_PREFILL, ROLE_PREFILL, ROLE_DECODE], 424),
+        ):
+            set_roles(roles)
+            subset = mgr.replicas[: len(roles)]
+            router = mgr.attach_router(
+                Router(
+                    subset,
+                    RouterConfig(
+                        affinity=True,
+                        affinity_page=page_size,
+                        saturation_depth=64,
+                        retry_max=5,
+                        disagg=True,
+                        prefill_cutoff_tokens=cutoff,
+                        trace_seed=seed,
+                    ),
+                )
+            )
+            results, wall = drive(router, make_prompts(seed))
+            dropped = sum(
+                1 for r in results if r["http_status"] != 200
+            )
+            assert not dropped, (
+                f"ratio {label} dropped {dropped} requests"
+            )
+            prefill_idx = {
+                r.index for r in subset if r.role == ROLE_PREFILL
+            }
+            assert all(
+                r["router"]["replica"] not in prefill_idx
+                for r in results
+            ), f"ratio {label}: client stream on the prefill tier"
+            ratio_sweep[label] = {
+                **phase_summary(results, wall),
+                "roles": roles,
+                **disagg_counters(router),
+            }
+        # Homogeneous control: same traffic shape, no tiers.
+        set_roles([])
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(
+                    affinity=True,
+                    affinity_page=page_size,
+                    saturation_depth=64,
+                    retry_max=5,
+                    trace_seed=434,
+                ),
+            )
+        )
+        results_h, wall_h = drive(router, make_prompts(434))
+        assert all(r["http_status"] == 200 for r in results_h)
+        hybrid_control = phase_summary(results_h, wall_h)
+
+        # Phase 5: migration token identity — the SAME prompts asked
+        # of the 1:2 disagg split and of the hybrid control must
+        # stream identical tokens (greedy over identical weights).
+        probe = make_prompts(606)[::per_group]
+        set_roles([ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE])
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(
+                    affinity=True,
+                    affinity_page=page_size,
+                    disagg=True,
+                    prefill_cutoff_tokens=cutoff,
+                    trace_seed=606,
+                ),
+            )
+        )
+        disagg_streams = []
+        for p in probe:
+            status, payload = router.dispatch(
+                {"prompt_tokens": p, "max_new_tokens": new_tokens}
+            )
+            assert status == 200, payload
+            assert payload["router"]["replica"] != 0, (
+                "identity probe served by the prefill tier"
+            )
+            disagg_streams.append(payload["tokens"])
+        identity_counters = disagg_counters(router)
+        assert identity_counters["migrations"] >= 1, (
+            "identity probes never migrated pages"
+        )
+        set_roles([])
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(
+                    affinity=True,
+                    affinity_page=page_size,
+                    trace_seed=616,
+                ),
+            )
+        )
+        for p, want in zip(probe, disagg_streams):
+            status, payload = router.dispatch(
+                {"prompt_tokens": p, "max_new_tokens": new_tokens}
+            )
+            assert status == 200, payload
+            assert payload["tokens"] == want, (
+                "migrated stream diverged from the hybrid stream"
+            )
+
+        # Phase 6: prefix directory vs affinity-only under churn.
+        # Both trials: seed each group's prefix on its affinity home,
+        # SIGKILL a home replica, serve through the outage (with the
+        # directory on, completions re-home each prefix to whoever
+        # served it), then burst once the victim restarts COLD.
+        def churn_trial(seed, use_directory):
+            prompts = make_prompts(seed)
+            router = mgr.attach_router(
+                Router(
+                    mgr.replicas,
+                    RouterConfig(
+                        affinity=True,
+                        affinity_page=page_size,
+                        retry_backoff_s=0.02,
+                        directory=use_directory,
+                        trace_seed=seed,
+                    ),
+                )
+            )
+            leaders = list(range(0, len(prompts), per_group))
+            for i in leaders:
+                status, payload = router.dispatch(
+                    {
+                        "prompt_tokens": prompts[i],
+                        "max_new_tokens": new_tokens,
+                    }
+                )
+                assert status == 200, payload
+            # Kill a replica that IS a group's affinity home, so the
+            # burst below actually exercises the cold-restart case.
+            homes = {
+                affinity_key(prompts[i], page_size)
+                % len(mgr.replicas)
+                for i in leaders
+            }
+            victim = min(homes)
+            r0 = mgr.restarts_total
+            mgr.kill_replica(victim)
+            deadline = time.monotonic() + 60
+            while (
+                mgr.replicas[victim].state == "healthy"
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            for i in leaders:
+                status, payload = router.dispatch(
+                    {
+                        "prompt_tokens": prompts[i],
+                        "max_new_tokens": new_tokens,
+                    }
+                )
+                assert status == 200, payload
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                if mgr.restarts_total > r0 and all(
+                    r.state == "healthy" for r in mgr.replicas
+                ):
+                    break
+                time.sleep(0.25)
+            assert (
+                mgr.replicas[victim].state == "healthy"
+            ), "churn victim never came back"
+            base = [paged_counts(r.url) for r in mgr.replicas]
+            results, wall = drive(router, prompts)
+            assert all(
+                r["http_status"] == 200 for r in results
+            ), "churn burst dropped requests"
+            rate, per_rep, _ = hit_deltas(base)
+            out = {
+                **phase_summary(results, wall),
+                "victim": victim,
+                "post_churn_hit_rate": rate,
+                "per_replica": per_rep,
+            }
+            if use_directory:
+                pulls = router.directory_pulls_total
+                hits = router.directory_pull_hits_total
+                out["directory_pulls"] = pulls
+                out["directory_pull_hits"] = hits
+                out["directory_pull_hit_rate"] = (
+                    round(hits / pulls, 4) if pulls else None
+                )
+            return out
+
+        churn_affinity = churn_trial(808, use_directory=False)
+        churn_directory = churn_trial(909, use_directory=True)
+        assert (
+            churn_directory["post_churn_hit_rate"] is not None
+            and churn_affinity["post_churn_hit_rate"] is not None
+        )
+        assert (
+            churn_directory["post_churn_hit_rate"]
+            >= churn_affinity["post_churn_hit_rate"]
+        ), (
+            f"directory hit rate "
+            f"{churn_directory['post_churn_hit_rate']} under churn "
+            f"fell below the affinity-only control "
+            f"{churn_affinity['post_churn_hit_rate']}: the prefix "
+            "tier is not re-warming restarted replicas"
+        )
 
         # The headline assert: affinity must beat random dispatch on
         # per-replica prefix-hit rate — the reason the router hashes
@@ -1621,6 +1901,16 @@ def run_serve_fleet_bench(
             random_dispatch=phase_summary(results_r, wall_r),
             affinity=phase_summary(results_a, wall_a),
             kill_drill=kill_drill,
+            disagg_ratio_sweep=ratio_sweep,
+            disagg_hybrid_control=hybrid_control,
+            disagg_prefill_cutoff_tokens=cutoff,
+            disagg_token_identity={
+                "prompts": len(probe),
+                "identical": True,
+                **identity_counters,
+            },
+            churn_affinity_only=churn_affinity,
+            churn_directory=churn_directory,
             n_replicas=n_replicas,
             slots=slots,
             page_size=page_size,
@@ -1631,9 +1921,12 @@ def run_serve_fleet_bench(
             **(
                 {
                     "note": "CPU-fallback capture: throughput/TTFT "
-                    "are honest CPU nulls (replicas share cores); "
-                    "hit rates, replay/restart accounting and "
-                    "zero-drop/zero-dup are platform-free facts"
+                    "(ratio sweep included) are honest CPU nulls "
+                    "(replicas share cores); hit rates, "
+                    "replay/restart accounting, zero-drop/zero-dup, "
+                    "migration token identity and the "
+                    "directory-vs-affinity churn ordering are "
+                    "platform-free facts"
                 }
                 if env["cpu_fallback"]
                 else {}
@@ -2497,7 +2790,11 @@ def _run_extra_benches() -> None:
         # behind the router — affinity-vs-random prefix-hit rates
         # (asserted), aggregate tokens/s + p99 TTFT, and the kill
         # drill (zero dropped / zero duplicated / one restart,
-        # asserted; recovery time + replays recorded).
+        # asserted; recovery time + replays recorded). PR 16 adds the
+        # disagg phases: prefill:decode ratio sweep (1:2/1:1/2:1) vs
+        # the hybrid control with migration latency p50/p99,
+        # migration token identity (asserted), and the prefix
+        # directory beating affinity-only under churn (asserted).
         ("serve_fleet", run_serve_fleet_bench),
         ("loader", run_loader_bench),
     ]:

@@ -185,6 +185,11 @@ def _endpoint_row(view: dict) -> dict:
         return row
     statusz = view.get("statusz") or {}
     stats = statusz.get("stats") or {}
+    # Disaggregated fleets (PR 16): replicas advertise their serving
+    # role on /statusz; classic replicas carry no key and the row
+    # stays byte-identical.
+    if "role" in statusz:
+        row["role"] = statusz["role"]
     for key in ("active", "slots", "queue_depth", "tokens_total"):
         if key in stats:
             row[key] = stats[key]
@@ -263,6 +268,25 @@ def merge_fleet(views: list[dict]) -> dict:
             w["burn_rate_fast"] > worst["burn_rate_fast"]
         ):
             worst = {**w, "endpoint": row["endpoint"]}
+    # Per-role rollup, present only when some endpoint advertises a
+    # role (disaggregated fleets) — classic fleet views stay
+    # byte-identical. Dead endpoints scraped before their role was
+    # known simply don't contribute; their holes still render in the
+    # endpoint rows above.
+    by_role: dict[str, dict[str, Any]] = {}
+    for row in rows:
+        role = row.get("role")
+        if not role:
+            continue
+        g = by_role.setdefault(
+            role,
+            {"replicas": 0, "tokens_per_s": 0.0, "queue_depth": 0},
+        )
+        g["replicas"] += 1
+        g["tokens_per_s"] = round(
+            g["tokens_per_s"] + row.get("tokens_per_s", 0.0), 2
+        )
+        g["queue_depth"] += int(row.get("queue_depth") or 0)
     return {
         "endpoints": rows,
         "healthy": sum(1 for r in rows if r["ok"]),
@@ -277,6 +301,7 @@ def merge_fleet(views: list[dict]) -> dict:
                 if s is not None
             },
         },
+        **({"by_role": by_role} if by_role else {}),
         **({"slo_worst": worst} if worst else {}),
     }
 
@@ -313,6 +338,11 @@ def render_fleet(fleet: dict) -> str:
                 f"{label:<14}: p50 {snap.get('p50')}s  "
                 f"p95 {snap.get('p95')}s  (n={snap['count']})"
             )
+    for role, g in sorted((fleet.get("by_role") or {}).items()):
+        lines.append(
+            f"role {role:<9}: {g['replicas']} replica(s), "
+            f"{g['tokens_per_s']} tok/s, queue={g['queue_depth']}"
+        )
     worst = fleet.get("slo_worst")
     if worst:
         lines.append(
@@ -322,6 +352,8 @@ def render_fleet(fleet: dict) -> str:
         )
     for row in fleet["endpoints"]:
         bits = [f"ok={1 if row['ok'] else 0}"]
+        if row.get("role"):
+            bits.append(f"role={row['role']}")
         if not row["ok"] and row.get("health"):
             # timeout (maybe-overloaded) vs refused (dead) — the two
             # demand different operator responses, so name which.

@@ -535,6 +535,68 @@ def render_fleet(
         help="completed fleet-wide rolling restarts (drain -> wait "
         "-> restart -> re-admit, one replica at a time)",
     )
+    # Disaggregation series (PR 16): every key below is ABSENT from a
+    # classic router's state(), so PromBuilder renders nothing and the
+    # exposition stays byte-identical when the feature is off.
+    for index, role in sorted(
+        (snap.get("replica_roles") or {}).items()
+    ):
+        b.add(
+            "ddp_tpu_fleet_role", 1,
+            labels={"replica": str(index), "role": role},
+            help="replica's serving role (prefill | decode | hybrid)",
+        )
+    b.add(
+        "ddp_tpu_fleet_prefill_handoffs_total",
+        snap.get("prefill_handoffs_total"),
+        metric_type="counter",
+        help="long prompts prefilled on the prefill tier before "
+        "their pages migrated to a decode replica",
+    )
+    b.add(
+        "ddp_tpu_fleet_migrations_total", snap.get("migrations_total"),
+        metric_type="counter",
+        help="completed KV-page migrations (export + install over "
+        "POST /pages)",
+    )
+    b.add(
+        "ddp_tpu_fleet_migration_failures_total",
+        snap.get("migration_failures_total"),
+        metric_type="counter",
+        help="migrations abandoned (export miss, pool full, "
+        "transport death) — the request replayed from the prompt",
+    )
+    b.add(
+        "ddp_tpu_fleet_pages_migrated_total",
+        snap.get("pages_migrated_total"),
+        metric_type="counter",
+        help="KV pages physically copied between replicas",
+    )
+    b.add(
+        "ddp_tpu_fleet_directory_pulls_total",
+        snap.get("directory_pulls_total"),
+        metric_type="counter",
+        help="prefix-directory lookups that found another replica "
+        "owning the prompt's pages and attempted a pull",
+    )
+    b.add(
+        "ddp_tpu_fleet_directory_pull_hits_total",
+        snap.get("directory_pull_hits_total"),
+        metric_type="counter",
+        help="directory pulls whose pages installed on the target",
+    )
+    b.add(
+        "ddp_tpu_fleet_directory_size", snap.get("directory_size"),
+        help="distinct leading-page prefixes the router can locate",
+    )
+    if "migration_seconds" in snap:
+        # summary() renders a count-0 series for an EMPTY snapshot, so
+        # the absent-key gate lives here, not inside the helper.
+        b.summary(
+            "ddp_tpu_fleet_migration_seconds",
+            snap.get("migration_seconds"),
+            help="one migration's export + push wall time",
+        )
     _render_build_info(b, snap.get("build_info"), "ddp_tpu_build_info")
     return b.render()
 

@@ -18,16 +18,30 @@ Layer map:
                  bucketed prefill, bounded compile set; optional
                  paged KV + prefix reuse via --page_size)
   server.py      stdlib HTTP frontend + background engine thread
+  disagg.py      KV-page wire format for the POST /pages transfer
+                 plane: versioned, CRC-guarded binary frames carrying
+                 raw K/V pages (fp32 or int8 + per-page scales),
+                 table row, positions, sampling state (pure host)
   fleet.py       N supervised replica processes behind a health-gated
                  router: prefix-affinity + least-loaded dispatch,
                  retry/hedging/circuit-breaking, replay on replica
-                 death, rolling restart (pure host)
+                 death, rolling restart; role-aware prefill/decode
+                 dispatch + fleet-global prefix directory (pure host)
   scripts/serve.py (repo root)  checkpoint → listening server CLI
   scripts/fleet.py (repo root)  N-replica fleet frontend CLI
 """
 
+from ddp_tpu.serve.disagg import (  # noqa: F401
+    PageFrame,
+    PageWireError,
+    decode_pages,
+    encode_pages,
+)
 from ddp_tpu.serve.engine import Completion, ServeEngine  # noqa: F401
 from ddp_tpu.serve.fleet import (  # noqa: F401
+    ROLE_DECODE,
+    ROLE_HYBRID,
+    ROLE_PREFILL,
     CircuitBreaker,
     FleetServer,
     Replica,

@@ -1,0 +1,308 @@
+"""Disaggregated serving: the KV-page transfer wire format.
+
+PAPERS.md #1's serving gap is architectural: prefill is compute-bound
+and bursty, decode is memory-bandwidth-bound and steady, and
+co-locating them means every long prompt steals step budget from
+every running decode lane. Splitting the phases across replicas needs
+exactly one new capability — moving a prompt's prefilled K/V state
+between processes — and the paged cache (PR 12) already stores that
+state in the right unit: ``page_size``-token pages whose contents are
+position-independent of the lane that wrote them (a page's bytes are
+fully determined by the token prefix that spells its trie path).
+
+This module is the WIRE FORMAT only: pure bytes <-> numpy, no JAX, no
+sockets. The transport is ``POST /pages`` (serve/server.py), the
+pool-side install is ``PrefixCache.adopt`` + ``ServeEngine.
+install_prefix``, and the policy (who ships what to whom) lives in
+the router (serve/fleet.py).
+
+Frame layout (all integers little-endian)::
+
+    magic   4s   b"DPKV"
+    version u16  PAGE_WIRE_VERSION
+    flags   u16  reserved, 0
+    crc     u32  CRC32 over everything AFTER this field
+    hlen    u32  header length in bytes
+    header  hlen bytes of UTF-8 JSON
+    frames  per header["frames"]: u32 length + raw bytes each
+
+The header carries the shape/dtype contract (depth, h_kv, d_head,
+page_size, dtype), the token prefix the pages hold K/V for, the
+source lane's page-table row (pool-local page ids, shipped for
+validation/debugging — the receiver allocates its OWN pages), the
+prefilled position count, and the request's sampling state. Receivers
+validate EVERYTHING against the declared shapes before any bytes
+reach a cache: a corrupt, truncated, or version-skewed payload raises
+:class:`PageWireError` with a machine-readable ``reason`` instead of
+installing garbage pages.
+
+K/V arrays are ``[depth, n_pages, page_size, h_kv, d_head]`` (the
+pool layout with the page axis sliced to the shipped pages); int8
+pools additionally ship per-page scale arrays
+``[depth, n_pages, page_size, h_kv]`` float32 — the quantization
+scales travel WITH the pages, so a migrated int8 prefix dequantizes
+bit-identically on the receiver.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+MAGIC = b"DPKV"
+PAGE_WIRE_VERSION = 1
+
+# reason codes, in rough order of how early decoding fails
+BAD_MAGIC = "bad_magic"
+VERSION_SKEW = "version_skew"
+TRUNCATED = "truncated"
+CRC_MISMATCH = "crc_mismatch"
+HEADER_INVALID = "header_invalid"
+SHAPE_MISMATCH = "shape_mismatch"
+
+_PREFIX = struct.Struct("<4sHHII")  # magic, version, flags, crc, hlen
+_FLEN = struct.Struct("<I")
+
+_DTYPES = {"fp32": np.float32, "int8": np.int8}
+
+
+class PageWireError(ValueError):
+    """A /pages payload that must NOT be installed.
+
+    ``reason`` is one of ``bad_magic`` / ``version_skew`` /
+    ``truncated`` / ``crc_mismatch`` / ``header_invalid`` /
+    ``shape_mismatch`` — the receiver's 400 body and the named error
+    the hardening tests pin. Raised before any byte touches a cache.
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+@dataclass
+class PageFrame:
+    """One decoded /pages payload: everything a receiver needs to host
+    the prefix locally.
+
+    ``tokens`` is the exact token prefix the pages hold (length
+    ``n_pages * page_size`` — only FULL pages ever ship, the same
+    rule ``PrefixCache.release`` publishes under). ``table_row`` is
+    the SOURCE pool's page ids in position order and ``positions``
+    the source lane's prefilled length; both are validation/debug
+    payload — the receiving pool allocates its own ids. ``sampling``
+    echoes the request's (seed, temperature, top_p) so a decode
+    replica can reconstruct the stream without re-asking the router.
+    """
+
+    page_size: int
+    dtype: str  # "fp32" | "int8"
+    tokens: list[int]
+    k: np.ndarray  # [depth, n_pages, page_size, h_kv, d_head]
+    v: np.ndarray
+    k_scale: Optional[np.ndarray] = None  # int8 only: [..., h_kv] f32
+    v_scale: Optional[np.ndarray] = None
+    table_row: list[int] = field(default_factory=list)
+    positions: int = 0
+    sampling: dict = field(default_factory=dict)
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.k.shape[1])
+
+
+def encode_pages(
+    tokens,
+    k: np.ndarray,
+    v: np.ndarray,
+    *,
+    page_size: int,
+    k_scale: Optional[np.ndarray] = None,
+    v_scale: Optional[np.ndarray] = None,
+    table_row=(),
+    positions: int = 0,
+    sampling: Optional[dict] = None,
+) -> bytes:
+    """Page arrays -> one self-validating binary payload.
+
+    ``k``/``v`` are ``[depth, n_pages, page_size, h_kv, d_head]``;
+    int8 pages must ship both scale arrays, fp32 pages neither.
+    ``len(tokens)`` must equal ``n_pages * page_size`` — partial
+    pages never ship (their tail positions were never prefilled).
+    """
+    k = np.ascontiguousarray(k)
+    v = np.ascontiguousarray(v)
+    if k.shape != v.shape or k.ndim != 5:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    depth, n_pages, ps, h_kv, d_head = k.shape
+    if ps != page_size:
+        raise ValueError(
+            f"page axis {ps} != declared page_size {page_size}"
+        )
+    if len(tokens) != n_pages * page_size:
+        raise ValueError(
+            f"{len(tokens)} tokens cannot fill {n_pages} pages of "
+            f"{page_size} (full pages only)"
+        )
+    dtype = "int8" if k.dtype == np.int8 else "fp32"
+    quant = dtype == "int8"
+    if quant != (k_scale is not None and v_scale is not None):
+        raise ValueError(
+            "int8 pages need k_scale AND v_scale; fp32 pages neither"
+        )
+    frames = [("k", k.astype(_DTYPES[dtype], copy=False)),
+              ("v", v.astype(_DTYPES[dtype], copy=False))]
+    if quant:
+        want = (depth, n_pages, page_size, h_kv)
+        for name, arr in (("k_scale", k_scale), ("v_scale", v_scale)):
+            arr = np.ascontiguousarray(arr, dtype=np.float32)
+            if arr.shape != want:
+                raise ValueError(
+                    f"{name} shape {arr.shape} != {want}"
+                )
+            frames.append((name, arr))
+    header = {
+        "page_size": int(page_size),
+        "dtype": dtype,
+        "depth": int(depth),
+        "h_kv": int(h_kv),
+        "d_head": int(d_head),
+        "n_pages": int(n_pages),
+        "tokens": [int(t) for t in tokens],
+        "table_row": [int(p) for p in table_row],
+        "positions": int(positions),
+        "sampling": dict(sampling or {}),
+        "frames": [name for name, _ in frames],
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    body = bytearray()
+    body += struct.pack("<I", len(hbytes))
+    body += hbytes
+    for _, arr in frames:
+        raw = arr.tobytes()
+        body += _FLEN.pack(len(raw))
+        body += raw
+    crc = zlib.crc32(bytes(body)) & 0xFFFFFFFF
+    return (
+        MAGIC
+        + struct.pack("<HH", PAGE_WIRE_VERSION, 0)
+        + struct.pack("<I", crc)
+        + bytes(body)
+    )
+
+
+def decode_pages(buf: bytes) -> PageFrame:
+    """One /pages payload -> :class:`PageFrame`, or
+    :class:`PageWireError` — nothing half-decoded ever escapes.
+
+    Validation order matters and is pinned by the hardening tests:
+    magic, version, CRC (over header AND frames — a flipped bit
+    anywhere fails here), then header schema, then frame shapes. A
+    payload that passes returns arrays whose shapes/dtypes match the
+    header exactly.
+    """
+    if len(buf) < _PREFIX.size:
+        raise PageWireError(
+            TRUNCATED, f"{len(buf)} bytes < {_PREFIX.size}-byte prefix"
+        )
+    magic, version, _flags, crc, hlen = _PREFIX.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise PageWireError(BAD_MAGIC, repr(magic))
+    if version != PAGE_WIRE_VERSION:
+        raise PageWireError(
+            VERSION_SKEW,
+            f"payload v{version}, this build speaks "
+            f"v{PAGE_WIRE_VERSION}",
+        )
+    body = buf[12:]  # everything the CRC covers (hlen field included)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise PageWireError(CRC_MISMATCH)
+    off = 4  # past the hlen u32 (re-read from the CRC-checked body)
+    (hlen,) = struct.unpack_from("<I", body, 0)
+    if off + hlen > len(body):
+        raise PageWireError(
+            TRUNCATED, f"header wants {hlen} bytes past the payload"
+        )
+    try:
+        header = json.loads(body[off : off + hlen].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise PageWireError(HEADER_INVALID, str(e)) from e
+    off += hlen
+    try:
+        page_size = int(header["page_size"])
+        dtype = header["dtype"]
+        depth = int(header["depth"])
+        h_kv = int(header["h_kv"])
+        d_head = int(header["d_head"])
+        n_pages = int(header["n_pages"])
+        tokens = [int(t) for t in header["tokens"]]
+        table_row = [int(p) for p in header.get("table_row", [])]
+        positions = int(header.get("positions", 0))
+        sampling = dict(header.get("sampling", {}))
+        frame_names = list(header["frames"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise PageWireError(HEADER_INVALID, str(e)) from e
+    if dtype not in _DTYPES:
+        raise PageWireError(HEADER_INVALID, f"unknown dtype {dtype!r}")
+    if min(page_size, depth, h_kv, d_head) < 1 or n_pages < 0:
+        raise PageWireError(HEADER_INVALID, "non-positive dimension")
+    if len(tokens) != n_pages * page_size:
+        raise PageWireError(
+            SHAPE_MISMATCH,
+            f"{len(tokens)} tokens vs {n_pages} pages of {page_size}",
+        )
+    quant = dtype == "int8"
+    want_frames = ["k", "v"] + (["k_scale", "v_scale"] if quant else [])
+    if frame_names != want_frames:
+        raise PageWireError(
+            SHAPE_MISMATCH,
+            f"frames {frame_names} != required {want_frames}",
+        )
+    kv_shape = (depth, n_pages, page_size, h_kv, d_head)
+    sc_shape = (depth, n_pages, page_size, h_kv)
+    arrays: dict[str, np.ndarray] = {}
+    for name in frame_names:
+        if off + _FLEN.size > len(body):
+            raise PageWireError(TRUNCATED, f"no length for frame {name}")
+        (flen,) = _FLEN.unpack_from(body, off)
+        off += _FLEN.size
+        if off + flen > len(body):
+            raise PageWireError(
+                TRUNCATED, f"frame {name} wants {flen} bytes"
+            )
+        shape = sc_shape if name.endswith("_scale") else kv_shape
+        np_dtype = (
+            np.float32 if name.endswith("_scale") else _DTYPES[dtype]
+        )
+        expected = int(np.prod(shape)) * np.dtype(np_dtype).itemsize
+        if flen != expected:
+            raise PageWireError(
+                SHAPE_MISMATCH,
+                f"frame {name}: {flen} bytes != {expected} for "
+                f"{shape} {np.dtype(np_dtype).name}",
+            )
+        arrays[name] = np.frombuffer(
+            body, dtype=np_dtype, count=int(np.prod(shape)), offset=off
+        ).reshape(shape)
+        off += flen
+    if off != len(body):
+        raise PageWireError(
+            TRUNCATED, f"{len(body) - off} trailing bytes"
+        )
+    return PageFrame(
+        page_size=page_size,
+        dtype=dtype,
+        tokens=tokens,
+        k=arrays["k"],
+        v=arrays["v"],
+        k_scale=arrays.get("k_scale"),
+        v_scale=arrays.get("v_scale"),
+        table_row=table_row,
+        positions=positions,
+        sampling=sampling,
+    )

@@ -850,6 +850,127 @@ class ServeEngine:
             ),
         }
 
+    # ---- disaggregated serving: page export/install (PR 16) ---------
+
+    def export_prefix(self, tokens) -> Optional[bytes]:
+        """Ship a cached prefix's raw pages (serve/disagg.py wire
+        format): the longest indexed prefix of ``tokens``, at page
+        granularity, K/V bytes (plus per-page scales on int8 pools)
+        gathered from the pool → one self-validating binary payload.
+        None on fixed-lane engines or when no full page of the prompt
+        is cached (the /pages/export 404).
+
+        The gather is a host read OUTSIDE the decode loop (migration
+        is a control-plane event, like the bind-time table upload) —
+        the steady-state transfer invariant is untouched.
+        """
+        if not self.paged:
+            return None
+        from ddp_tpu.serve.disagg import encode_pages
+
+        pids = self._prefix.match(
+            list(tokens), len(tokens) // self.page_size
+        )
+        if not pids:
+            return None
+        idx = jnp.asarray(pids, jnp.int32)
+        covered = list(tokens)[: len(pids) * self.page_size]
+        quant = self._cache.quantized()
+        return encode_pages(
+            covered,
+            np.asarray(self._cache.k[:, idx]),
+            np.asarray(self._cache.v[:, idx]),
+            page_size=self.page_size,
+            k_scale=(
+                np.asarray(self._cache.k_scale[:, idx]) if quant
+                else None
+            ),
+            v_scale=(
+                np.asarray(self._cache.v_scale[:, idx]) if quant
+                else None
+            ),
+            table_row=pids,
+            positions=len(covered),
+        )
+
+    def install_prefix(self, frame) -> Optional[dict]:
+        """Host another replica's prefilled pages (the POST /pages
+        implementation): validate the frame against THIS pool's
+        geometry, adopt the token path into the radix index
+        (serve/pages.PrefixCache.adopt), and copy only the pages the
+        index did not already hold into the device pool. → install
+        summary dict, or None when the pool cannot host the missing
+        pages (the caller degrades to a local prefill — never a torn
+        page set).
+
+        Raises serve/disagg.PageWireError(shape_mismatch) when the
+        frame's geometry or dtype disagrees with this engine — a
+        fleet mixing engine configs must fail loudly, not dequantize
+        garbage. Installed pages enter the index CACHED, so the next
+        local admission maps them as an ordinary prefix hit — the
+        decode stream is then the same continuation-program replay a
+        local hit takes, which is what makes migrated streams
+        token-identical to a hybrid replica (pinned by
+        tests/test_disagg.py).
+        """
+        from ddp_tpu.serve.disagg import SHAPE_MISMATCH, PageWireError
+
+        if not self.paged:
+            raise PageWireError(
+                SHAPE_MISMATCH, "this engine is not paged (--page_size)"
+            )
+        quant = self._cache.quantized()
+        depth, _, ps, h_kv, d_head = self._cache.k.shape
+        want_dtype = "int8" if quant else "fp32"
+        if frame.page_size != ps:
+            raise PageWireError(
+                SHAPE_MISMATCH,
+                f"frame page_size {frame.page_size} != pool {ps}",
+            )
+        if frame.dtype != want_dtype:
+            raise PageWireError(
+                SHAPE_MISMATCH,
+                f"frame dtype {frame.dtype} != pool {want_dtype}",
+            )
+        if frame.k.shape[0] != depth or frame.k.shape[3:] != (
+            h_kv, d_head,
+        ):
+            raise PageWireError(
+                SHAPE_MISMATCH,
+                f"frame kv {frame.k.shape} != pool "
+                f"[{depth}, ·, {ps}, {h_kv}, {d_head}]",
+            )
+        got = self._prefix.adopt(frame.tokens)
+        if got is None:
+            return None
+        pids, fill = got
+        cache = self._cache
+        for ordinal, pid in fill:
+            # One eager dynamic-index scatter per page: the page id is
+            # a traced scalar, so every install reuses ONE compiled
+            # update per array — migrations never grow the program
+            # set (the bounded-compile pin holds).
+            i = jnp.int32(pid)
+            cache = cache._replace(
+                k=cache.k.at[:, i].set(jnp.asarray(frame.k[:, ordinal])),
+                v=cache.v.at[:, i].set(jnp.asarray(frame.v[:, ordinal])),
+            )
+            if quant:
+                cache = cache._replace(
+                    k_scale=cache.k_scale.at[:, i].set(
+                        jnp.asarray(frame.k_scale[:, ordinal])
+                    ),
+                    v_scale=cache.v_scale.at[:, i].set(
+                        jnp.asarray(frame.v_scale[:, ordinal])
+                    ),
+                )
+        self._cache = cache
+        return {
+            "pages": len(pids),
+            "copied_pages": len(fill),
+            "tokens": len(pids) * self.page_size,
+        }
+
     def spec_acceptance_rate(self) -> Optional[float]:
         """Lifetime draft-acceptance fraction, None before any verify
         round (or when speculation is off)."""

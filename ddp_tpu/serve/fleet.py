@@ -73,6 +73,15 @@ from ddp_tpu.obs.reqtrace import (
 )
 from ddp_tpu.runtime.chaos import ChaosEvent, fleet_events
 from ddp_tpu.runtime.launch import classify_exit, free_port
+from ddp_tpu.utils.metrics import StatSummary
+
+# Disaggregated-serving roles (PR 16; docs/SERVING.md): which traffic
+# the ROUTER sends a replica. "hybrid" is the classic co-located
+# engine and the default — a roleless fleet behaves exactly as before.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_HYBRID = "hybrid"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_HYBRID)
 
 logger = logging.getLogger("ddp_tpu")
 
@@ -272,6 +281,61 @@ class HttpTransport:
                 classify_unreachable(e), sent=True
             ) from e
 
+    # ---- the /pages transfer plane (PR 16) --------------------------
+
+    def fetch_pages(
+        self, url: str, prompt_tokens: Sequence[int], timeout: float
+    ) -> tuple[int, bytes]:
+        """POST /pages/export on ``url`` → (status, raw body): the
+        owner's longest cached prefix of the prompt as one binary
+        page frame (200), or its JSON error body (404 prefix_not_
+        found etc.) — the caller only forwards 200 bodies."""
+        sp = urlsplit(url)
+        conn = http.client.HTTPConnection(
+            sp.hostname, sp.port, timeout=max(0.05, timeout)
+        )
+        try:
+            conn.request(
+                "POST", "/pages/export",
+                body=json.dumps(
+                    {"prompt_tokens": list(prompt_tokens)}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise ReplicaUnreachable(
+                classify_unreachable(e), sent=True
+            ) from e
+        finally:
+            conn.close()
+
+    def push_pages(
+        self, url: str, frame: bytes, timeout: float
+    ) -> tuple[int, dict]:
+        """POST /pages on ``url`` with one binary page frame →
+        (status, JSON payload). The receiver validates before
+        installing (serve/disagg.py), so a torn transfer surfaces as
+        its 400 reason here, never as garbage pages there."""
+        sp = urlsplit(url)
+        conn = http.client.HTTPConnection(
+            sp.hostname, sp.port, timeout=max(0.05, timeout)
+        )
+        try:
+            conn.request(
+                "POST", "/pages", body=frame,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        except (OSError, http.client.HTTPException, ValueError) as e:
+            raise ReplicaUnreachable(
+                classify_unreachable(e), sent=True
+            ) from e
+        finally:
+            conn.close()
+
 
 # ---------------------------------------------------------------------
 # Replica view
@@ -288,9 +352,20 @@ class Replica:
     in-process routers use this class.
     """
 
-    def __init__(self, index: int, url: Optional[str] = None):
+    def __init__(
+        self,
+        index: int,
+        url: Optional[str] = None,
+        *,
+        role: str = ROLE_HYBRID,
+    ):
+        if role not in ROLES:
+            raise ValueError(
+                f"replica role must be one of {ROLES}, got {role!r}"
+            )
         self.index = int(index)
         self.url = url
+        self.role = role
         self.state = STARTING if url is None else HEALTHY
         self.breaker = CircuitBreaker()
         self.proc: Optional[subprocess.Popen] = None
@@ -317,6 +392,11 @@ class Replica:
             "index": self.index,
             "url": self.url,
             "state": self.state,
+            # Role rides only on disaggregated fleets: a roleless
+            # fleet's snapshots stay byte-identical to PR 13's.
+            **(
+                {"role": self.role} if self.role != ROLE_HYBRID else {}
+            ),
             "inflight": self.inflight,
             "queue_depth": self.queue_depth,
             "restarts": self.restarts,
@@ -385,6 +465,25 @@ class RouterConfig:
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 2.0
     trace_seed: int = 0  # fleet-level trace-id space
+    # ---- disaggregated serving (PR 16; all default-off) -------------
+    # Two-stage dispatch: prompts whose page-aligned length reaches
+    # ``prefill_cutoff_tokens`` run chunked prefill to completion on a
+    # role=prefill replica, the prefilled pages migrate over POST
+    # /pages, and the decode runs on a decode-capable replica. Any
+    # stage failing just degrades to a normal dispatch (the decode
+    # replica prefills locally — replay from the prompt, never a torn
+    # page set).
+    disagg: bool = False
+    prefill_cutoff_tokens: int = 64
+    # Fleet-global prefix tier: the affinity hash made AUTHORITATIVE —
+    # a directory (leading-page hash → last replica to serve it) lets
+    # the router pull a prefix's pages from their owner to wherever
+    # the request actually lands, so churned/restarted replicas re-warm
+    # from the fleet instead of re-prefilling.
+    directory: bool = False
+    # Per-migration transport budget (export + install are two HTTP
+    # round-trips of raw KV bytes).
+    migration_timeout_s: float = 10.0
 
 
 class Router:
@@ -433,16 +532,47 @@ class Router:
         self.hedge_wins_total = 0
         self.no_replica_total = 0
         self.deadline_exceeded_total = 0
+        # ---- disaggregation state (PR 16) ---------------------------
+        # Role-aware dispatch engages when ANY replica carries a
+        # non-hybrid role (a prefill-only replica must never serve
+        # end-user /generate traffic, disagg flag or not).
+        self._role_aware = self.config.disagg or any(
+            r.role != ROLE_HYBRID for r in self.replicas
+        )
+        # The fleet-global prefix directory: leading-page affinity
+        # hash → index of the replica that last SERVED that prefix
+        # (authoritative owner of its pages). Survives replica
+        # restarts by construction — it lives here, not in them.
+        self._prefix_dir: dict[int, int] = {}
+        self.prefill_handoffs_total = 0
+        self.migrations_total = 0
+        self.migration_failures_total = 0
+        self.pages_migrated_total = 0
+        self.directory_pulls_total = 0
+        self.directory_pull_hits_total = 0
+        self.migration_seconds = StatSummary()
 
     # ---- selection ---------------------------------------------------
 
-    def _eligible(self, exclude: set[int]) -> list[Replica]:
+    def _capable(self, r: Replica, need: Optional[str]) -> bool:
+        """Can ``r`` take traffic of class ``need``? Hybrids take
+        everything; None (a roleless fleet) disables the filter."""
+        return (
+            need is None
+            or r.role == ROLE_HYBRID
+            or r.role == need
+        )
+
+    def _eligible(
+        self, exclude: set[int], need: Optional[str] = None
+    ) -> list[Replica]:
         return [
             r
             for r in self.replicas
             if r.state == HEALTHY
             and r.breaker.allow_traffic()
             and r.index not in exclude
+            and self._capable(r, need)
         ]
 
     def _saturated(self, r: Replica) -> bool:
@@ -450,14 +580,22 @@ class Router:
         return r.inflight >= slots + self.config.saturation_depth
 
     def _select(
-        self, prompt: Sequence[int], exclude: set[int]
+        self,
+        prompt: Sequence[int],
+        exclude: set[int],
+        need: Optional[str] = None,
     ) -> Optional[Replica]:
         """Affinity-preferred, least-loaded otherwise. Call under the
         lock. The preferred index is ``key % len(replicas)`` over the
         FIXED replica list, so it survives restarts (replica N's
         replacement inherits N's affinity and re-warms the same
-        prefixes)."""
-        elig = self._eligible(exclude)
+        prefixes). On a role-aware fleet, /generate selection defaults
+        to decode-capable replicas (decode | hybrid) — prefill-tier
+        replicas only ever see the router's own prefill-stage
+        dispatches."""
+        if need is None and self._role_aware:
+            need = ROLE_DECODE
+        elig = self._eligible(exclude, need)
         if not elig:
             return None
         if not self.config.affinity:
@@ -513,6 +651,22 @@ class Router:
             "hedged": False,
             "hedge_won": False,
         }
+        # Disaggregated staging (PR 16), OUTSIDE the retry loop and
+        # best-effort by design: a long prompt prefills on the prefill
+        # tier and its pages migrate to a decode replica; a prefix the
+        # directory locates on another replica is pulled to where this
+        # request will land. Every failure inside degrades to the
+        # plain dispatch below — the decode replica then prefills from
+        # the prompt itself (the replay-from-prompt guarantee; a
+        # mid-migration death never leaves a torn page set because the
+        # receiver installs atomically or not at all).
+        dir_key = (
+            affinity_key(prompt, self.config.affinity_page)
+            if self.config.directory
+            else 0
+        )
+        if self._role_aware or self.config.directory:
+            self._stage_pages(prompt, body, deadline, dir_key)
         exclude: set[int] = set()  # failed THIS request
         backoff_i = 0
         idle_rounds = 0  # rounds with NO eligible replica at all
@@ -609,6 +763,13 @@ class Router:
                     winner, status, payload, digest, exclude
                 )
                 if handled is not None:
+                    if dir_key and handled[0] == 200:
+                        # The directory learns from COMPLETIONS: the
+                        # serving replica now holds the prompt's
+                        # published pages (release() indexed them at
+                        # retire) and becomes the prefix's owner.
+                        with self._lock:
+                            self._prefix_dir[dir_key] = winner.index
                     return handled
             if digest["attempts"] > self.config.retry_max:
                 if saturated_retry_after is not None and not hard_failure:
@@ -643,6 +804,161 @@ class Router:
                 self.retries_total += 1
             self._backoff(backoff_i, deadline - self._clock())
             backoff_i += 1
+
+    # ---- disaggregated staging (PR 16) -------------------------------
+
+    def _stage_pages(
+        self,
+        prompt: Sequence[int],
+        body: dict,
+        deadline: float,
+        dir_key: int,
+    ) -> None:
+        """Best-effort page placement BEFORE the dispatch race: the
+        prefill-tier handoff for long prompts, then (when that did not
+        already move the pages) the prefix-directory pull. Never
+        raises, never blocks past the migration budget; on any failure
+        the plain dispatch below simply prefills locally."""
+        if not prompt:
+            return
+        with self._lock:
+            target = self._select(prompt, set())
+        if target is None:
+            return
+        staged = False
+        if self.config.disagg:
+            from ddp_tpu.serve.scheduler import classify_prompt
+
+            cls = classify_prompt(
+                len(prompt),
+                self.config.affinity_page,
+                cutoff_tokens=self.config.prefill_cutoff_tokens,
+            )
+            if cls == ROLE_PREFILL:
+                with self._lock:
+                    tier = [
+                        r
+                        for r in self._eligible(set(), ROLE_PREFILL)
+                        if r.role == ROLE_PREFILL
+                    ]
+                    src = (
+                        min(tier, key=lambda r: (r.load, r.index))
+                        if tier
+                        else None
+                    )
+                if src is not None and src.index != target.index:
+                    staged = self._prefill_handoff(
+                        src, target, prompt, body, deadline
+                    )
+        if staged or not dir_key:
+            return
+        with self._lock:
+            owner_i = self._prefix_dir.get(dir_key)
+            owner = (
+                self.replicas[owner_i]
+                if owner_i is not None
+                and owner_i != target.index
+                else None
+            )
+            pull = (
+                owner is not None
+                and owner.state == HEALTHY
+                and owner.breaker.allow_traffic()
+            )
+        if pull:
+            with self._lock:
+                self.directory_pulls_total += 1
+            if self._migrate(owner, target, prompt, deadline):
+                with self._lock:
+                    self.directory_pull_hits_total += 1
+
+    def _prefill_handoff(
+        self,
+        src: Replica,
+        target: Replica,
+        prompt: Sequence[int],
+        body: dict,
+        deadline: float,
+    ) -> bool:
+        """Stage one: run the prompt to prefill completion on the
+        prefill tier (max_new_tokens=1 — the chunk programs ingest the
+        whole prompt; the single sampled token is discarded), which
+        publishes its full pages into ``src``'s radix index at retire;
+        stage two migrates them to ``target``. One attempt, budget
+        bounded — the robust RETRY path is the plain dispatch this
+        degrades into, not a second handoff."""
+        remaining = deadline - self._clock()
+        if remaining <= 0.05:
+            return False
+        b = dict(body)
+        b["max_new_tokens"] = 1
+        b["timeout"] = round(remaining, 3)
+        call = self.transport.start(
+            src.url, "/generate", b, remaining + 2.0
+        )
+        with self._lock:
+            src.inflight += 1
+        try:
+            status, _payload = call.run()
+        except ReplicaUnreachable as e:
+            self._note_failure(src, e)
+            return False
+        finally:
+            with self._lock:
+                src.inflight -= 1
+        if status != 200:
+            return False
+        src.breaker.record_success()
+        with self._lock:
+            self.prefill_handoffs_total += 1
+        return self._migrate(src, target, prompt, deadline)
+
+    def _migrate(
+        self,
+        src: Replica,
+        dst: Replica,
+        prompt: Sequence[int],
+        deadline: float,
+    ) -> bool:
+        """Move the prompt's cached prefix pages ``src`` → ``dst``
+        (export, then push; two HTTP round-trips of raw KV bytes) →
+        True on an installed prefix. Counts pages and latency; all
+        failures (owner lost the prefix, pool full, transport death)
+        are just a False — the request replays from the prompt."""
+        t0 = self._clock()
+        budget = min(
+            self.config.migration_timeout_s, deadline - t0
+        )
+        if budget <= 0.05:
+            return False
+        status = 0  # stage marker: != 200 until the export succeeded
+        try:
+            status, raw = self.transport.fetch_pages(
+                src.url, prompt, budget
+            )
+            if status != 200:
+                with self._lock:
+                    self.migration_failures_total += 1
+                return False
+            status, payload = self.transport.push_pages(
+                dst.url, raw, budget
+            )
+        except ReplicaUnreachable as e:
+            self._note_failure(src if status != 200 else dst, e)
+            with self._lock:
+                self.migration_failures_total += 1
+            return False
+        if status != 200:
+            with self._lock:
+                self.migration_failures_total += 1
+            return False
+        with self._lock:
+            self.migrations_total += 1
+            self.pages_migrated_total += int(
+                payload.get("copied_pages", 0)
+            )
+            self.migration_seconds.add(self._clock() - t0)
+        return True
 
     def _handle_response(
         self,
@@ -845,6 +1161,34 @@ class Router:
                 "hedge_wins_total": self.hedge_wins_total,
                 "no_replica_total": self.no_replica_total,
                 "deadline_exceeded_total": self.deadline_exceeded_total,
+                # Disaggregation block: ABSENT on classic fleets, so
+                # every downstream surface (fleet_poll records,
+                # /metricsz gauges, health_report triage) stays
+                # byte-identical when the feature is off.
+                **(
+                    {
+                        "replica_roles": {
+                            str(r.index): r.role
+                            for r in self.replicas
+                        },
+                        "prefill_handoffs_total":
+                            self.prefill_handoffs_total,
+                        "migrations_total": self.migrations_total,
+                        "migration_failures_total":
+                            self.migration_failures_total,
+                        "pages_migrated_total":
+                            self.pages_migrated_total,
+                        "directory_pulls_total":
+                            self.directory_pulls_total,
+                        "directory_pull_hits_total":
+                            self.directory_pull_hits_total,
+                        "directory_size": len(self._prefix_dir),
+                        "migration_seconds":
+                            self.migration_seconds.snapshot(ndigits=6),
+                    }
+                    if self._role_aware or self.config.directory
+                    else {}
+                ),
                 "replica_states": [r.snapshot() for r in self.replicas],
             }
 
@@ -897,9 +1241,16 @@ class ReplicaManager:
         transport: Optional[HttpTransport] = None,
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
+        roles: Optional[Sequence[str]] = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"need at least 1 replica, got {n_replicas}")
+        if roles is not None and len(roles) != n_replicas:
+            raise ValueError(
+                f"{len(roles)} roles for {n_replicas} replicas — the "
+                "role list must name every replica"
+            )
+        self.roles = list(roles) if roles is not None else None
         self.serve_args = list(serve_args)
         self.script = script or _serve_script()
         self.python = python
@@ -912,7 +1263,16 @@ class ReplicaManager:
         self.transport = transport or HttpTransport()
         self._clock = clock
         self.metrics = metrics
-        self.replicas = [Replica(i) for i in range(n_replicas)]
+        self.replicas = [
+            Replica(
+                i,
+                role=(
+                    self.roles[i] if self.roles is not None
+                    else ROLE_HYBRID
+                ),
+            )
+            for i in range(n_replicas)
+        ]
         self.restarts_total = 0
         self.rolling_restarts_total = 0
         self.chaos_kills = 0
@@ -981,6 +1341,15 @@ class ReplicaManager:
             self.python,
             self.script,
             *self.serve_args,
+            # Role is per-replica (the one spot the shared CLI tail
+            # differs): the replica advertises it on /healthz and
+            # /statusz, and a restarted replica KEEPS its role — the
+            # tier topology survives churn.
+            *(
+                ["--role", rep.role]
+                if self.roles is not None
+                else []
+            ),
             "--host",
             "127.0.0.1",
             "--port",
@@ -1291,6 +1660,18 @@ class ReplicaManager:
                 "hedges_total", "hedge_wins_total",
             ):
                 snap[k] = rs[k]
+            # Disaggregation counters ride only when the router runs
+            # role-aware/directory dispatch (they are absent from
+            # state() otherwise) — existing fleet_poll consumers (and
+            # the health_report goldens) see byte-identical records.
+            for k in (
+                "prefill_handoffs_total", "migrations_total",
+                "migration_failures_total", "pages_migrated_total",
+                "directory_pulls_total", "directory_pull_hits_total",
+                "directory_size", "migration_seconds",
+            ):
+                if k in rs:
+                    snap[k] = rs[k]
         self.metrics.write("fleet_poll", **snap)
 
     # Set by attach_router (the poll record wants router counters too).

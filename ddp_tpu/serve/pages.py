@@ -116,6 +116,10 @@ class PrefixCache:
         self.hit_tokens = 0
         self.prompt_tokens = 0
         self.evicted_pages = 0
+        # Pages installed from ANOTHER replica's pool (PrefixCache
+        # .adopt — the disaggregation/prefix-tier migration path);
+        # 0 on every non-disaggregated engine.
+        self.adopted_pages = 0
 
     # ---- derived state (the /statusz + /metricsz gauges) ------------
 
@@ -293,6 +297,64 @@ class PrefixCache:
             self.miss_requests += 1
         return matched + new, n_hit
 
+    def adopt(
+        self, tokens
+    ) -> Optional[tuple[list[int], list[tuple[int, int]]]]:
+        """Host an EXTERNALLY-prefilled prefix (disaggregation's
+        install path, PR 16): → (page ids spelling the whole prefix
+        path, [(page ordinal, page id)] for the pages that are NEW
+        here — the K/V bytes the caller must copy into the pool), or
+        None when the pool cannot host the missing pages even after
+        LRU eviction (the caller skips the install; the request just
+        prefills locally).
+
+        Only FULL pages adopt (``len(tokens) // page_size`` — the
+        same publish rule as :meth:`release`). Pages already on the
+        path are kept (their bytes are identical by the trie-path
+        property — same tokens, same positions, same K/V) and only
+        touched for LRU; the allocation pins the existing path first,
+        so eviction pressure can never free the prefix being extended.
+        New pages enter the index CACHED at refcount 0 — exactly the
+        state :meth:`release` leaves published pages in — so the next
+        local :meth:`acquire` maps them as an ordinary prefix hit.
+        Adoption counts toward no hit/miss counters: it is supply,
+        not demand.
+        """
+        keys = self._chunks(tokens)
+        node = self._root
+        have: list[int] = []
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            self._touch(child)
+            have.append(child.page_id)
+            node = child
+        missing = keys[len(have):]
+        if not missing:
+            return have, []
+        for pid in have:  # pin the existing path across the alloc
+            self._map(pid)
+        new = self._alloc(len(missing))
+        for pid in have:
+            self._unmap(pid)
+        if new is None:
+            return None
+        pids = list(have)
+        fill: list[tuple[int, int]] = []
+        for ordinal, (key, pid) in enumerate(
+            zip(missing, new), start=len(have)
+        ):
+            child = _Node(key=key, page_id=pid, parent=node)
+            node.children[key] = child
+            self._node_of[pid] = child
+            self._cached[pid] = None  # published, evictable
+            node = child
+            pids.append(pid)
+            fill.append((ordinal, pid))
+        self.adopted_pages += len(fill)
+        return pids, fill
+
     def release(
         self, tokens, page_ids: list[int], prefilled_tokens: int
     ) -> None:
@@ -337,6 +399,13 @@ class PrefixCache:
             "prefix_misses": self.miss_requests,
             "prefix_hit_rate": None if hr is None else round(hr, 4),
             "evicted_pages": self.evicted_pages,
+            # Absent until a migration installs pages: the pre-disagg
+            # stats surface stays byte-identical (PR-16 convention).
+            **(
+                {"adopted_pages": self.adopted_pages}
+                if self.adopted_pages
+                else {}
+            ),
         }
 
     def check_invariants(self) -> None:

@@ -63,6 +63,33 @@ def prev_pow2(n: int) -> int:
     return p
 
 
+def classify_prompt(
+    prompt_len: int, page_size: int, *, cutoff_tokens: int
+) -> str:
+    """Disaggregated-dispatch classification (PR 16) → "prefill" |
+    "decode".
+
+    Long prompts are the requests whose prefill steals step budget
+    from every co-located decode lane, so they route through the
+    prefill tier; short prompts prefill in one or two chunks and go
+    straight to a decode replica. The cutoff is compared against the
+    prompt's PAGE-ALIGNED length: only full pages ever migrate
+    (serve/pages.release publishes full pages only), so a prompt
+    whose page-aligned length is below the cutoff would ship fewer
+    pages than the threshold promises. ``cutoff_tokens <= 0`` sends
+    everything to the decode tier (disaggregation by role only, no
+    length split). Pure — the router calls it, tests pin it.
+    """
+    if cutoff_tokens <= 0:
+        return "decode"
+    aligned = (
+        (prompt_len // page_size) * page_size
+        if page_size > 0
+        else prompt_len
+    )
+    return "prefill" if aligned >= cutoff_tokens else "decode"
+
+
 @dataclass
 class Request:
     """One admitted generate request."""

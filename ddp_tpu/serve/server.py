@@ -77,7 +77,14 @@ class LMServer:
         host: str = "127.0.0.1",
         port: int = 0,
         drain_retry_after: float = 5.0,
+        role: Optional[str] = None,
     ):
+        # Disaggregated-serving role (PR 16): "prefill" | "decode" |
+        # "hybrid" advertised on /healthz and /statusz so the fleet
+        # router and aggregator can group by tier. None (the default,
+        # and the only value single-replica setups ever see) keeps
+        # every surface byte-identical to the pre-disagg server.
+        self.role = role
         self.engine = engine
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -258,6 +265,14 @@ class LMServer:
             "ttft_s": round(done.ttft, 4) if done.ttft is not None
             else None,
             "decode_tokens_per_s": round(done.decode_tokens_per_s, 2),
+            # Paged engines only (absent otherwise): how many prompt
+            # tokens were served from cached pages — the signal a
+            # disaggregated fleet's migration really landed (PR 16).
+            **(
+                {"prefix_hit_tokens": done.prefix_hit_tokens}
+                if done.prefix_hit_tokens is not None
+                else {}
+            ),
         }
 
     def snapshot(self, route: str) -> Optional[dict | str]:
@@ -270,6 +285,7 @@ class LMServer:
                     "active": self.engine.active,
                     "queue_depth": self.engine.scheduler.depth,
                     "draining": self.draining,
+                    **({"role": self.role} if self.role else {}),
                     **(
                         {"engine_error": self._engine_error}
                         if self._engine_error
@@ -304,10 +320,57 @@ class LMServer:
                 return {
                     "ok": self._engine_error is None,
                     "draining": self.draining,
+                    **({"role": self.role} if self.role else {}),
                     "stats": self.engine.stats(include_states=True),
                     "trace": self.engine.tracer.snapshot(limit=512),
                 }
         return None
+
+    # ---- disaggregated serving: the /pages transfer plane (PR 16) ---
+
+    def pages_export(self, body: dict) -> tuple[int, "dict | bytes"]:
+        """POST /pages/export: {"prompt_tokens": [...]} → the longest
+        cached prefix of that prompt as one binary page frame
+        (serve/disagg.py), or 404 when no full page of it is cached
+        here (prefix_not_found — the puller falls back to a local
+        prefill). 409 on non-paged engines: a fleet whose members
+        disagree about paging is a config error worth naming."""
+        try:
+            prompt = [int(t) for t in body["prompt_tokens"]]
+        except (KeyError, TypeError, ValueError):
+            return 400, {"error": "body needs prompt_tokens (list[int])"}
+        if not self.engine.paged:
+            return 409, {"error": "not_paged"}
+        with self._lock:
+            buf = self.engine.export_prefix(prompt)
+        if buf is None:
+            return 404, {"error": "prefix_not_found"}
+        return 200, buf
+
+    def pages_install(self, raw: bytes) -> tuple[int, dict]:
+        """POST /pages: one binary page frame → validate, adopt into
+        the radix index, copy the missing pages into the pool
+        (engine.install_prefix). A frame that fails validation gets a
+        400 with the named reason and NOTHING is installed — the
+        torn-page-set guarantee. A pool that cannot host the pages
+        answers 409 pool_exhausted (the sender just skips the
+        migration; the request replays from the prompt)."""
+        from ddp_tpu.serve.disagg import PageWireError, decode_pages
+
+        if self._engine_error is not None:
+            return 500, {"error": f"engine failed: {self._engine_error}"}
+        try:
+            frame = decode_pages(raw)
+        except PageWireError as e:
+            return 400, {"error": e.reason, "detail": str(e)}
+        try:
+            with self._lock:
+                res = self.engine.install_prefix(frame)
+        except PageWireError as e:
+            return 400, {"error": e.reason, "detail": str(e)}
+        if res is None:
+            return 409, {"error": "pool_exhausted"}
+        return 200, {"installed": True, **res}
 
     def requestz(self, query: str) -> tuple[int, dict]:
         """GET /requestz[?id=...] → (status, payload): one request's
@@ -387,7 +450,15 @@ def _make_handler(server: LMServer):
                 self._send(status, payload)
 
         def do_POST(self):  # noqa: N802
-            if self.path != "/generate":
+            if self.path == "/pages":
+                # Binary page frame (serve/disagg.py), NOT JSON — the
+                # payload is raw K/V bytes with its own header + CRC.
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n)
+                status, payload = server.pages_install(raw)
+                self._send(status, payload)
+                return
+            if self.path not in ("/generate", "/pages/export"):
                 self._send(404, {"error": f"no route {self.path}"})
                 return
             try:
@@ -397,6 +468,21 @@ def _make_handler(server: LMServer):
                     raise ValueError("body must be a JSON object")
             except (ValueError, TypeError) as e:
                 self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            if self.path == "/pages/export":
+                status, payload = server.pages_export(body)
+                if isinstance(payload, bytes):
+                    self.send_response(status)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(payload))
+                    )
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self._send(status, payload)
                 return
             status, payload = server.submit_and_wait(body)
             headers = None

@@ -23,6 +23,11 @@ replica gets its own ``--port``). The frontend exposes:
   POST /rollz      rolling restart: drain -> wait -> restart ->
                    re-admit, one replica at a time, zero dropped
 
+``--roles prefill,decode,decode`` splits the fleet into a
+disaggregated prefill/decode topology and ``--directory`` turns on the
+fleet-global prefix tier — both ride the replicas' POST /pages
+transfer plane (docs/SERVING.md "Disaggregated serving").
+
 ``--chaos "kill:replica1@request8"`` arms fleet drills
 (runtime/chaos.py grammar) fired on the router's dispatch counter.
 SIGTERM drains the FLEET: the frontend stops admitting (503 +
@@ -94,6 +99,34 @@ def main() -> None:
         "match the replicas' --page_size)",
     )
     p.add_argument(
+        "--roles", default=None,
+        help="comma-separated per-replica roles (prefill|decode|"
+        "hybrid), e.g. 'prefill,decode,decode' — must name every "
+        "replica. Long prompts prefill on the prefill tier, then the "
+        "KV pages migrate to a decode replica over POST /pages "
+        "(docs/SERVING.md 'Disaggregated serving'). Default: every "
+        "replica hybrid, identical to the classic fleet",
+    )
+    p.add_argument(
+        "--prefill_cutoff", type=int, default=64,
+        help="disagg length classifier: prompts with at least this "
+        "many page-aligned tokens go to the prefill tier (only "
+        "meaningful with --roles)",
+    )
+    p.add_argument(
+        "--directory", action="store_true",
+        help="fleet-global prefix tier: the router remembers which "
+        "replica owns each leading-page prefix and has a missing "
+        "replica PULL those pages over /pages instead of "
+        "re-prefilling (generalizes prefix affinity across churn)",
+    )
+    p.add_argument(
+        "--migration_timeout", type=float, default=10.0,
+        help="budget for one page migration (export + push); on "
+        "expiry the router skips the migration and the target "
+        "prefills locally — never a torn page set",
+    )
+    p.add_argument(
         "--chaos", default=None,
         help="fleet drills, e.g. 'kill:replica1@request8,"
         "stall:replica0@request4:2.5s' — fired on the router's "
@@ -125,6 +158,8 @@ def main() -> None:
         )
 
     from ddp_tpu.serve.fleet import (
+        ROLE_HYBRID,
+        ROLES,
         FleetChaos,
         FleetServer,
         ReplicaManager,
@@ -133,6 +168,19 @@ def main() -> None:
     )
     from ddp_tpu.utils.metrics import MetricsWriter
 
+    roles = None
+    if args.roles:
+        roles = [r.strip() for r in args.roles.split(",")]
+        bad = [r for r in roles if r not in ROLES]
+        if bad:
+            raise SystemExit(
+                f"unknown role(s) {bad}; pick from {list(ROLES)}"
+            )
+        if len(roles) != args.replicas:
+            raise SystemExit(
+                f"--roles names {len(roles)} replicas but "
+                f"--replicas is {args.replicas}"
+            )
     metrics = MetricsWriter(args.metrics_file)
     manager = ReplicaManager(
         args.replicas,
@@ -142,6 +190,7 @@ def main() -> None:
         restart_backoff=args.restart_backoff,
         poll_interval=args.poll_interval,
         metrics=metrics,
+        roles=roles,
     )
     config = RouterConfig(
         retry_max=args.retry_max,
@@ -151,6 +200,10 @@ def main() -> None:
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown,
         trace_seed=int.from_bytes(os.urandom(8), "little"),
+        disagg=bool(roles) and any(r != ROLE_HYBRID for r in roles),
+        prefill_cutoff_tokens=args.prefill_cutoff,
+        directory=args.directory,
+        migration_timeout_s=args.migration_timeout,
     )
     stop_event = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop_event.set())
@@ -179,6 +232,11 @@ def main() -> None:
                         "all_healthy": healthy,
                         "hedge_after": args.hedge_after,
                         "affinity_page": args.affinity_page,
+                        **({"roles": roles} if roles else {}),
+                        **(
+                            {"directory": True}
+                            if args.directory else {}
+                        ),
                         **(
                             {"chaos": args.chaos} if args.chaos else {}
                         ),

@@ -373,6 +373,28 @@ def build_report(records: list[dict]) -> str:
             f"; restarts {f.get('restarts_total', 0)}"
             f", rolling {f.get('rolling_restarts_total', 0)}"
         )
+        # Disaggregation triage (PR 16): the migration counters ride
+        # the poll record only when the router runs role-aware or
+        # directory dispatch — classic fleet streams (and their
+        # goldens) carry no key and print no line.
+        if "migrations_total" in f or "directory_pulls_total" in f:
+            pulls = f.get("directory_pulls_total", 0)
+            hits = f.get("directory_pull_hits_total", 0)
+            ms = f.get("migration_seconds") or {}
+            lines.append(
+                f"fleet disagg  : {f.get('migrations_total', 0)} "
+                f"migration(s) ({f.get('pages_migrated_total', 0)} "
+                f"pages, {f.get('migration_failures_total', 0)} "
+                f"failed)"
+                f", prefill handoffs {f.get('prefill_handoffs_total', 0)}"
+                f"; directory pulls {hits}/{pulls} hit"
+                + (
+                    f"; migrate p50 {_fmt(ms.get('p50'), 4)}s"
+                    f" p95 {_fmt(ms.get('p95'), 4)}s"
+                    if ms.get("count")
+                    else ""
+                )
+            )
 
     sentry = [h for h in health if h.get("detector") != "nonfinite"]
     if sentry:

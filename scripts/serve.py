@@ -172,6 +172,18 @@ def main() -> None:
         "the target)",
     )
     p.add_argument(
+        "--role", default=None,
+        choices=["prefill", "decode", "hybrid"],
+        help="disaggregated-serving role (docs/SERVING.md): 'prefill' "
+        "replicas take long prompts and ship the prefilled KV pages "
+        "to a decode replica over POST /pages; 'decode' replicas "
+        "receive pages and run the steady decode batch; 'hybrid' "
+        "(and the default, no role at all) is the classic co-located "
+        "engine. The role is advertised on /healthz + /statusz for "
+        "the fleet router — the engine itself is identical; the "
+        "ROUTER enforces who gets which traffic",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -314,7 +326,9 @@ def main() -> None:
     stop_event = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop_event.set())
     try:
-        with LMServer(engine, host=args.host, port=args.port) as server:
+        with LMServer(
+            engine, host=args.host, port=args.port, role=args.role
+        ) as server:
             print(
                 json.dumps(
                     {
@@ -342,6 +356,7 @@ def main() -> None:
                             else {}
                         ),
                         "build_info": build_info(),
+                        **({"role": args.role} if args.role else {}),
                         "reqtrace": bool(args.reqtrace),
                         **({"slo": args.slo} if args.slo else {}),
                     }
