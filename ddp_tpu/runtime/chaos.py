@@ -47,6 +47,17 @@ Spec grammar (comma-separated events; see docs/ROBUSTNESS.md)::
                                   events never fire inside a trainer —
                                   ``ChaosEngine`` skips them; the
                                   fleet manager owns their firing.
+    kill:stage<K>@step<N>         MPMD pipeline drills (parallel/
+    stall:stage<K>@step<N>:<S>s   mpmd.py): SIGKILL stage K's process
+                                  before its step-N dispatch (the
+                                  mid-epoch death the supervisor must
+                                  restart from the stage-sliced
+                                  checkpoint) or sleep it S seconds
+                                  (a straggling stage the bubble
+                                  accounting should attribute). Stage
+                                  events fire only inside an engine
+                                  armed with ``stage=K`` — a trainer
+                                  rank or an SPMD run never owns one.
 
 "Step N" means the global optimizer-step counter (which survives
 restarts via the checkpoint), checked at the step boundary before the
@@ -92,6 +103,15 @@ _REPLICA_RE = re.compile(
     r"@request(?P<request>\d+)"
     r"(?::(?P<seconds>\d+(?:\.\d+)?)s)?$"
 )
+# MPMD pipeline drills (parallel/mpmd.py): the trigger point is the
+# pipeline's optimizer-step counter, but the victim is a STAGE process
+# — step-only (an MPMD run has no epoch clock).
+_STAGE_RE = re.compile(
+    r"^(?P<kind>kill|stall)"
+    r":stage(?P<stage>\d+)"
+    r"@step(?P<at>\d+)"
+    r"(?::(?P<seconds>\d+(?:\.\d+)?)s)?$"
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +132,10 @@ class ChaosEvent:
     # (serve/fleet.py), never to a trainer rank.
     replica: int | None = None
     request: int | None = None
+    # MPMD drills: ``stage`` + ``step``. A stage event belongs to one
+    # pipeline-stage process (parallel/mpmd.py), never to a trainer
+    # rank or an SPMD run.
+    stage: int | None = None
 
     @property
     def token(self) -> str:
@@ -120,6 +144,11 @@ class ChaosEvent:
             return "ckpt_corrupt:latest"
         if self.replica is not None:
             base = f"{self.kind}:replica{self.replica}@request{self.request}"
+            if self.kind == "stall":
+                base += f":{self.seconds:g}s"
+            return base
+        if self.stage is not None:
+            base = f"{self.kind}:stage{self.stage}@step{self.step}"
             if self.kind == "stall":
                 base += f":{self.seconds:g}s"
             return base
@@ -213,6 +242,32 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
                 )
             )
             continue
+        m = _STAGE_RE.match(token)
+        if m:
+            kind = m.group("kind")
+            seconds = m.group("seconds")
+            if kind == "stall":
+                if seconds is None:
+                    raise ValueError(
+                        f"chaos stage stall needs a duration: {token!r}"
+                    )
+                if float(seconds) <= 0:
+                    raise ValueError(
+                        f"chaos stall duration must be > 0: {token!r}"
+                    )
+            elif seconds is not None:
+                raise ValueError(
+                    f"chaos stage kill takes no duration: {token!r}"
+                )
+            events.append(
+                ChaosEvent(
+                    kind=kind,
+                    stage=int(m.group("stage")),
+                    step=int(m.group("at")),
+                    seconds=float(seconds) if seconds else 0.0,
+                )
+            )
+            continue
         raise ValueError(
             f"bad chaos event {token!r}; grammar: "
             "kill:rank<R>@step<N>|epoch<N>, "
@@ -220,7 +275,8 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
             "shrink:rank<R>@step<N>|epoch<N>, grow:+1@step<N>|epoch<N>, "
             "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest, "
             "kill:replica<R>@request<N>, "
-            "stall:replica<R>@request<N>:<S>s"
+            "stall:replica<R>@request<N>:<S>s, "
+            "kill:stage<K>@step<N>, stall:stage<K>@step<N>:<S>s"
         )
     return tuple(events)
 
@@ -238,6 +294,16 @@ def fleet_events(
     if isinstance(events, str) or events is None:
         events = parse_chaos(events)
     return tuple(e for e in events if e.replica is not None)
+
+
+def stage_events(
+    events: Iterable[ChaosEvent] | str | None,
+) -> tuple[ChaosEvent, ...]:
+    """The stage-scoped subset of a plan — what the MPMD pipeline
+    supervisor (parallel/mpmd.py) owns. Accepts a spec string."""
+    if isinstance(events, str) or events is None:
+        events = parse_chaos(events)
+    return tuple(e for e in events if e.stage is not None)
 
 
 def corrupt_latest_checkpoint(
@@ -305,6 +371,7 @@ class ChaosEngine:
         events: Sequence[ChaosEvent] | str | None,
         *,
         rank: int = 0,
+        stage: int | None = None,
         ledger_path: str | None = None,
         seed: int = 0,
     ):
@@ -312,6 +379,7 @@ class ChaosEngine:
             events = parse_chaos(events)
         self.events = tuple(events)
         self.rank = int(rank)
+        self.stage = None if stage is None else int(stage)
         self.seed = int(seed)
         self._ledger_path = ledger_path
         self._fired: set[str] | None = None  # lazy ledger load
@@ -367,6 +435,11 @@ class ChaosEngine:
             # replica MANAGER's dispatch counter (serve/fleet.py) —
             # a trainer rank never owns one.
             return False
+        if ev.stage is not None:
+            # Stage events (kill:stage<K>@step<N>) belong to one MPMD
+            # stage process; an engine armed without ``stage`` (any
+            # trainer rank, any SPMD run) rejects them outright.
+            return self.stage is not None and ev.stage == self.stage
         if ev.kind in ("ckpt_corrupt", "grow"):
             # one filesystem, one corruptor; one world, one grow
             # requester (any single rank works — rank 0 is the

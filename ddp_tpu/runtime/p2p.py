@@ -1,0 +1,527 @@
+"""Point-to-point activation transport for the MPMD pipeline runtime.
+
+The in-graph pipeline schedules (``parallel/one_f1b.py`` and friends)
+move activations between stages with ``ppermute`` inside ONE XLA
+program — every host compiles every stage. The MPMD runtime
+(``parallel/mpmd.py``) breaks the pipeline into one OS process per
+stage, so activations and activation-cotangents must cross process
+boundaries instead. This module is that boundary: the PR-16 ``DPKV``
+wire discipline (serve/disagg.py) applied to activation tensors, plus
+a small TCP transport the stage runners drive from host code.
+
+Two layers, deliberately separate:
+
+* **Wire format** (``encode_msg`` / ``decode_msg``): pure bytes <->
+  numpy, no sockets, no JAX. Versioned, CRC-checked, length-prefixed::
+
+      magic   4s   b"ACTV"
+      version u16  WIRE_VERSION
+      flags   u16  reserved, 0
+      crc     u32  CRC32 over everything AFTER this field
+      hlen    u32  header length in bytes
+      header  hlen bytes of UTF-8 JSON
+      frames  per header["frames"]: u32 length + raw bytes each
+
+  The JSON header carries the message kind (``act`` / ``cot`` /
+  ``sync_up`` / ``sync_down`` / ``hello``), the step and microbatch
+  ids, and the dtype/shape contract for every tensor frame.
+  Validation order is pinned exactly like DPKV's — magic, version,
+  CRC, header schema, frame shapes, trailing bytes — and every
+  rejection carries a machine-readable ``reason``.
+
+* **Transport** (``Listener`` / ``Conn`` / ``Channel``): u32
+  length-prefixed payloads over a TCP socket between neighbor stages.
+  ``Channel.recv`` takes the EXPECTED (kind, step, microbatch) —
+  1F1B over a FIFO byte stream makes the arrival sequence exact, so
+  any deviation is a protocol bug and raises ``out_of_order`` rather
+  than silently training on the wrong microbatch.
+
+Everything here is host code: the blocking send/recv loops run
+between jitted per-stage programs, never inside them.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+MAGIC = b"ACTV"
+WIRE_VERSION = 1
+
+# reason codes, in rough order of how early decoding fails
+BAD_MAGIC = "bad_magic"
+VERSION_SKEW = "version_skew"
+TRUNCATED = "truncated"
+CRC_MISMATCH = "crc_mismatch"
+HEADER_INVALID = "header_invalid"
+SHAPE_MISMATCH = "shape_mismatch"
+OUT_OF_ORDER = "out_of_order"  # channel-level: valid bytes, wrong slot
+
+_PREFIX = struct.Struct("<4sHHII")  # magic, version, flags, crc, hlen
+_FLEN = struct.Struct("<I")
+
+# Message kinds the pipeline speaks. ``act`` flows downstream (stage k
+# -> k+1), ``cot`` upstream, the ``sync_*`` pair relays the tied-embed
+# gradients + step scalars along the chain once per step, and
+# ``hello`` opens a connection (generation fencing).
+KIND_ACT = "act"
+KIND_COT = "cot"
+KIND_SYNC_UP = "sync_up"
+KIND_SYNC_DOWN = "sync_down"
+KIND_HELLO = "hello"
+
+_KINDS = (KIND_ACT, KIND_COT, KIND_SYNC_UP, KIND_SYNC_DOWN, KIND_HELLO)
+
+NO_MICROBATCH = -1  # microbatch id for sync/hello messages
+
+
+def _np_dtypes() -> Dict[str, np.dtype]:
+    """Wire dtype names -> numpy dtypes (bf16 via ml_dtypes, which
+    ships with jax — imported lazily so the wire layer stays usable
+    before a stage process pins its JAX platform env)."""
+    import ml_dtypes
+
+    return {
+        "fp32": np.dtype(np.float32),
+        "bf16": np.dtype(ml_dtypes.bfloat16),
+        "f16": np.dtype(np.float16),
+        "int32": np.dtype(np.int32),
+    }
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    for name, cand in _np_dtypes().items():
+        if dt == cand:
+            return name
+    raise ValueError(f"unsupported wire dtype {dt!r}")
+
+
+class P2PWireError(ValueError):
+    """An activation payload that must NOT be consumed.
+
+    ``reason`` is one of ``bad_magic`` / ``version_skew`` /
+    ``truncated`` / ``crc_mismatch`` / ``header_invalid`` /
+    ``shape_mismatch`` / ``out_of_order`` — the named rejection the
+    hardening tests pin. Raised before any byte reaches a stage
+    program.
+    """
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+
+
+class PeerGone(RuntimeError):
+    """The neighbor stage hung up (or never answered): the step
+
+    cannot complete and the runner must wait for the supervisor's
+    re-placement decision instead of retrying into a dead socket."""
+
+
+class Aborted(RuntimeError):
+    """The supervisor raised the abort flag (halt/reconfigure) while
+
+    this runner was blocked in transport I/O."""
+
+
+@dataclass
+class TensorMsg:
+    """One decoded p2p message: kind + (step, microbatch) identity +
+    named tensor frames + a small JSON ``meta`` side-channel."""
+
+    kind: str
+    step: int
+    microbatch: int
+    arrays: Dict[str, np.ndarray]
+    meta: dict = field(default_factory=dict)
+
+
+def encode_msg(
+    kind: str,
+    step: int,
+    microbatch: int,
+    arrays: Dict[str, np.ndarray],
+    *,
+    meta: Optional[dict] = None,
+) -> bytes:
+    """Named tensors -> one self-validating binary payload.
+
+    ``arrays`` values must be fp32/bf16/f16/int32 numpy arrays
+    (zero-size arrays are legal — an empty microbatch still has an
+    identity on the wire). Frame order is the dict's insertion order
+    and is part of the contract the receiver re-derives from the
+    header.
+    """
+    if kind not in _KINDS:
+        raise ValueError(f"unknown message kind {kind!r}")
+    if microbatch < NO_MICROBATCH:
+        raise ValueError(f"bad microbatch id {microbatch}")
+    frames = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        frames.append((str(name), _dtype_name(arr.dtype), arr))
+    header = {
+        "kind": kind,
+        "step": int(step),
+        "microbatch": int(microbatch),
+        "meta": dict(meta or {}),
+        "frames": [
+            {"name": n, "dtype": d, "shape": [int(s) for s in a.shape]}
+            for n, d, a in frames
+        ],
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    body = bytearray()
+    body += struct.pack("<I", len(hbytes))
+    body += hbytes
+    for _, _, arr in frames:
+        raw = arr.tobytes()
+        body += _FLEN.pack(len(raw))
+        body += raw
+    crc = zlib.crc32(bytes(body)) & 0xFFFFFFFF
+    return (
+        MAGIC
+        + struct.pack("<HH", WIRE_VERSION, 0)
+        + struct.pack("<I", crc)
+        + bytes(body)
+    )
+
+
+def decode_msg(buf: bytes) -> TensorMsg:
+    """One payload -> :class:`TensorMsg`, or :class:`P2PWireError` —
+    nothing half-decoded ever escapes.
+
+    Validation order matters and is pinned by the hardening tests:
+    magic, version, CRC (over header AND frames — a flipped bit
+    anywhere fails here), then header schema, then frame shapes,
+    then the no-trailing-bytes check.
+    """
+    if len(buf) < _PREFIX.size:
+        raise P2PWireError(
+            TRUNCATED, f"{len(buf)} bytes < {_PREFIX.size}-byte prefix"
+        )
+    magic, version, _flags, crc, hlen = _PREFIX.unpack_from(buf, 0)
+    if magic != MAGIC:
+        raise P2PWireError(BAD_MAGIC, repr(magic))
+    if version != WIRE_VERSION:
+        raise P2PWireError(
+            VERSION_SKEW,
+            f"payload v{version}, this build speaks v{WIRE_VERSION}",
+        )
+    body = buf[12:]  # everything the CRC covers (hlen field included)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise P2PWireError(CRC_MISMATCH)
+    off = 4  # past the hlen u32 (re-read from the CRC-checked body)
+    (hlen,) = struct.unpack_from("<I", body, 0)
+    if off + hlen > len(body):
+        raise P2PWireError(
+            TRUNCATED, f"header wants {hlen} bytes past the payload"
+        )
+    try:
+        header = json.loads(body[off : off + hlen].decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise P2PWireError(HEADER_INVALID, str(e)) from e
+    off += hlen
+    try:
+        kind = header["kind"]
+        step = int(header["step"])
+        microbatch = int(header["microbatch"])
+        meta = dict(header.get("meta", {}))
+        frame_specs = [
+            (str(f["name"]), str(f["dtype"]),
+             tuple(int(s) for s in f["shape"]))
+            for f in header["frames"]
+        ]
+    except (KeyError, TypeError, ValueError) as e:
+        raise P2PWireError(HEADER_INVALID, str(e)) from e
+    if kind not in _KINDS:
+        raise P2PWireError(HEADER_INVALID, f"unknown kind {kind!r}")
+    if step < 0 or microbatch < NO_MICROBATCH:
+        raise P2PWireError(
+            HEADER_INVALID, f"bad ids step={step} mb={microbatch}"
+        )
+    dtypes = _np_dtypes()
+    for name, dname, shape in frame_specs:
+        if dname not in dtypes:
+            raise P2PWireError(
+                HEADER_INVALID, f"unknown dtype {dname!r}"
+            )
+        if any(s < 0 for s in shape):
+            raise P2PWireError(
+                HEADER_INVALID, f"negative dim in {name}: {shape}"
+            )
+    arrays: Dict[str, np.ndarray] = {}
+    for name, dname, shape in frame_specs:
+        if off + _FLEN.size > len(body):
+            raise P2PWireError(TRUNCATED, f"no length for frame {name}")
+        (flen,) = _FLEN.unpack_from(body, off)
+        off += _FLEN.size
+        if off + flen > len(body):
+            raise P2PWireError(
+                TRUNCATED, f"frame {name} wants {flen} bytes"
+            )
+        np_dtype = dtypes[dname]
+        count = int(np.prod(shape)) if shape else 1
+        expected = count * np_dtype.itemsize
+        if flen != expected:
+            raise P2PWireError(
+                SHAPE_MISMATCH,
+                f"frame {name}: {flen} bytes != {expected} for "
+                f"{shape} {dname}",
+            )
+        arrays[name] = np.frombuffer(
+            body, dtype=np_dtype, count=count, offset=off
+        ).reshape(shape)
+        off += flen
+    if off != len(body):
+        raise P2PWireError(
+            TRUNCATED, f"{len(body) - off} trailing bytes"
+        )
+    return TensorMsg(
+        kind=kind,
+        step=step,
+        microbatch=microbatch,
+        arrays=arrays,
+        meta=meta,
+    )
+
+
+# --------------------------------------------------------------------
+# Transport: u32 length-prefixed payloads over TCP. Host code only.
+# --------------------------------------------------------------------
+
+_MAX_PAYLOAD = 1 << 31  # sanity cap on a length prefix
+_POLL_S = 0.1  # socket timeout granularity for abort checks
+
+_CLOSE = object()  # sender-queue sentinel
+
+
+def _recv_exact(
+    sock: socket.socket,
+    n: int,
+    *,
+    abort: Optional[threading.Event],
+    deadline: Optional[float],
+) -> bytes:
+    """Read exactly ``n`` bytes, polling the abort flag between
+    socket timeouts. Raises :class:`Aborted` / :class:`PeerGone`."""
+    chunks = []
+    got = 0
+    while got < n:
+        if abort is not None and abort.is_set():
+            raise Aborted("abort flag raised during recv")
+        if deadline is not None and time.monotonic() > deadline:
+            raise PeerGone(f"recv timed out with {got}/{n} bytes")
+        try:
+            chunk = sock.recv(min(n - got, 1 << 20))
+        except socket.timeout:
+            continue
+        except OSError as e:
+            raise PeerGone(f"recv failed: {e}") from e
+        if not chunk:
+            raise PeerGone(f"peer closed with {got}/{n} bytes read")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+class Conn:
+    """One established neighbor link.
+
+    Sends are queued to a background thread (the 1F1B schedule wants
+    a stage to fire its activation downstream and immediately start
+    the next slot, not block on the peer's recv pace); receives are
+    blocking with abort/timeout polling.
+    """
+
+    def __init__(self, sock: socket.socket):
+        sock.settimeout(_POLL_S)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._sock = sock
+        self._q: "queue.Queue" = queue.Queue()
+        self._send_err: Optional[BaseException] = None
+        self._closed = False
+        self._sender = threading.Thread(
+            target=self._drain, name="p2p-send", daemon=True
+        )
+        self._sender.start()
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _CLOSE:
+                return
+            try:
+                self._sock.sendall(_FLEN.pack(len(item)) + item)
+            except OSError as e:
+                self._send_err = PeerGone(f"send failed: {e}")
+                return
+
+    def send_bytes(self, payload: bytes) -> None:
+        if self._send_err is not None:
+            raise self._send_err
+        if self._closed:
+            raise PeerGone("send on closed conn")
+        if len(payload) >= _MAX_PAYLOAD:
+            raise ValueError(f"payload too large: {len(payload)}")
+        self._q.put(bytes(payload))
+
+    def recv_bytes(
+        self,
+        *,
+        abort: Optional[threading.Event] = None,
+        timeout: Optional[float] = None,
+    ) -> bytes:
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        raw = _recv_exact(
+            self._sock, _FLEN.size, abort=abort, deadline=deadline
+        )
+        (n,) = _FLEN.unpack(raw)
+        if n >= _MAX_PAYLOAD:
+            raise PeerGone(f"insane length prefix {n}")
+        return _recv_exact(self._sock, n, abort=abort, deadline=deadline)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(_CLOSE)
+        self._sender.join(timeout=5.0)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class Listener:
+    """A stage's inbound endpoint. Bind once (port 0 -> ephemeral),
+    re-``accept`` per supervisor generation."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(8)
+        self._srv.settimeout(_POLL_S)
+        self.port = int(self._srv.getsockname()[1])
+
+    def accept(
+        self,
+        *,
+        abort: Optional[threading.Event] = None,
+        timeout: Optional[float] = None,
+    ) -> Conn:
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
+        while True:
+            if abort is not None and abort.is_set():
+                raise Aborted("abort flag raised during accept")
+            if deadline is not None and time.monotonic() > deadline:
+                raise PeerGone("accept timed out")
+            try:
+                sock, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError as e:
+                raise PeerGone(f"accept failed: {e}") from e
+            return Conn(sock)
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def dial(
+    host: str,
+    port: int,
+    *,
+    abort: Optional[threading.Event] = None,
+    timeout: float = 30.0,
+) -> Conn:
+    """Connect to a neighbor's :class:`Listener`, retrying until it
+    answers (stage processes come up in arbitrary order)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        if abort is not None and abort.is_set():
+            raise Aborted("abort flag raised during dial")
+        if time.monotonic() > deadline:
+            raise PeerGone(f"dial {host}:{port} timed out")
+        try:
+            sock = socket.create_connection((host, port), timeout=2.0)
+            return Conn(sock)
+        except OSError:
+            time.sleep(0.05)
+
+
+class Channel:
+    """The typed layer the stage runners speak: encode on send,
+    decode + identity check on recv.
+
+    ``recv`` rejects a structurally VALID message that is not the one
+    the schedule expects next — with one FIFO TCP stream per neighbor
+    and a deterministic 1F1B timetable on both ends, the expected
+    (kind, step, microbatch) sequence is exact, so a mismatch means a
+    protocol bug (or a stale message from a dead generation) and the
+    tensors must not be consumed.
+    """
+
+    def __init__(self, conn: Conn):
+        self._conn = conn
+
+    def send(
+        self,
+        kind: str,
+        step: int,
+        microbatch: int,
+        arrays: Dict[str, np.ndarray],
+        *,
+        meta: Optional[dict] = None,
+    ) -> None:
+        self._conn.send_bytes(
+            encode_msg(kind, step, microbatch, arrays, meta=meta)
+        )
+
+    def recv(
+        self,
+        kind: str,
+        step: int,
+        microbatch: int,
+        *,
+        abort: Optional[threading.Event] = None,
+        timeout: Optional[float] = None,
+    ) -> TensorMsg:
+        msg = decode_msg(
+            self._conn.recv_bytes(abort=abort, timeout=timeout)
+        )
+        if (msg.kind, msg.step, msg.microbatch) != (
+            kind,
+            int(step),
+            int(microbatch),
+        ):
+            raise P2PWireError(
+                OUT_OF_ORDER,
+                f"got ({msg.kind}, step {msg.step}, mb "
+                f"{msg.microbatch}), expected ({kind}, step {step}, "
+                f"mb {microbatch})",
+            )
+        return msg
+
+    def close(self) -> None:
+        self._conn.close()

@@ -583,11 +583,13 @@ class Trainer:
             # recorded global batch — what a step MEANS — survives.
             if self.pipe_mode:
                 raise ValueError(
-                    "--elastic excludes the pipeline family for now: "
+                    "--elastic excludes the in-graph pipeline family: "
                     "stage params rest per-device, so a resize would "
-                    "need stage re-placement, not a reshard (the MPMD "
-                    "runtime is the upgrade path) — drop --elastic or "
-                    "use a non-pipe model"
+                    "need stage re-placement, not a reshard. For an "
+                    "elastic pipeline use the MPMD runtime (python -m "
+                    "ddp_tpu.parallel.mpmd) — one process per stage, "
+                    "per-stage restart and checkpoint-sliced resume — "
+                    "or drop --elastic"
                 )
             from ddp_tpu.runtime.mesh import live_world_spec
 

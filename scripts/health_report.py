@@ -396,6 +396,54 @@ def build_report(records: list[dict]) -> str:
                 )
             )
 
+    # MPMD pipeline triage (parallel/mpmd.py): stage-tagged step
+    # records plus the supervisor's mpmd_run/mpmd_restart stamps.
+    # Gated on those markers, so SPMD trainer and serve streams (and
+    # every existing golden) stay byte-identical.
+    mpmd_steps = [r for r in steps if r.get("stage") is not None]
+    mpmd_runs = [r for r in records if r.get("kind") == "mpmd_run"]
+    mpmd_restarts = [
+        r for r in records if r.get("kind") == "mpmd_restart"
+    ]
+    if mpmd_steps or mpmd_runs or mpmd_restarts:
+        stage_ids = sorted({int(r["stage"]) for r in mpmd_steps})
+        if mpmd_runs and mpmd_runs[-1].get("stages"):
+            n_stages = int(mpmd_runs[-1]["stages"])
+        else:
+            n_stages = len(stage_ids)
+        lead = [
+            r
+            for r in mpmd_steps
+            if stage_ids
+            and r.get("stage") == stage_ids[0]
+            and r.get("loss") is not None
+        ]
+        lead.sort(key=lambda r: r.get("step", 0))
+        traj = (
+            f"loss {_fmt(lead[0]['loss'])} -> {_fmt(lead[-1]['loss'])}"
+            if lead
+            else "loss ?"
+        )
+        bubbles = [
+            r["bubble_s"] / r["wall_s"]
+            for r in mpmd_steps
+            if r.get("bubble_s") is not None and r.get("wall_s")
+        ]
+        bub = (
+            f", bubble {_fmt(100.0 * sum(bubbles) / len(bubbles), 1)}%"
+            if bubbles
+            else ""
+        )
+        n_restarts = (
+            mpmd_runs[-1].get("restarts")
+            if mpmd_runs and mpmd_runs[-1].get("restarts") is not None
+            else len(mpmd_restarts)
+        )
+        lines.append(
+            f"mpmd          : {n_stages} stage(s), {traj}"
+            f"{bub}, {n_restarts} restart(s)"
+        )
+
     sentry = [h for h in health if h.get("detector") != "nonfinite"]
     if sentry:
         by_det: dict[str, int] = {}

@@ -171,3 +171,43 @@ def paged_demand_pages(prompt_len, budget, page_size, total_len):
     # reached only from the engine's host loop, never from a jit root
     need = min(total_len, prompt_len + budget)
     return -(-need // page_size)
+
+
+# ---- MPMD p2p host-loop patterns (parallel/mpmd.py + runtime/p2p) ---
+# The stage runner's schedule loop lives ENTIRELY on the host: it
+# np.asarray()s a jitted program's output to put it on the wire and
+# jnp.asarray()s the peer's bytes back before the next dispatch.
+# Those materialisations ARE the design (the activation leaves the
+# process), so none of this may read as a jit-reachable sync even
+# though the functions it dispatches are jit roots.
+
+
+def mpmd_send_activation(chan, fwd, params, x_mb, step, microbatch):
+    # dispatch the stage program, then ship the result downstream —
+    # the host round-trip is the transfer itself, not a stall
+    act = fwd(params, x_mb)
+    chan.send(
+        "act", step, microbatch, {"x": np.asarray(act)}
+    )
+    return act
+
+
+def mpmd_recv_cotangent(chan, step, microbatch, abort, timeout):
+    # block on the upstream peer (timed for the p2p_wait ledger),
+    # then commit to a device array so the persistent-arg jit cache
+    # signature stays stable across generations
+    msg = chan.recv("cot", step, microbatch, abort=abort, timeout=timeout)
+    return jnp.asarray(msg.arrays["g"])
+
+
+def mpmd_sync_relay(up, down, loss_sum, sq, step):
+    # the scalar sync relay: host floats in, host floats out — the
+    # per-step loss/grad-norm exchange between stage processes
+    msg = up.recv("sync_up", step, -1, timeout=None)
+    total = float(np.asarray(loss_sum)) + float(msg.arrays["loss"][0])
+    down.send(
+        "sync_up", step, -1,
+        {"loss": np.asarray([total], np.float32),
+         "sq": np.asarray(msg.arrays["sq"]) + np.float32(sq)},
+    )
+    return total

@@ -44,13 +44,28 @@ def test_chaos_spec_roundtrip_property():
     for _ in range(200):
         events = []
         for _ in range(int(rng.integers(1, 6))):
-            kind = ("kill", "sigterm", "stall", "ckpt_corrupt")[
-                int(rng.integers(0, 4))
-            ]
+            kind = (
+                "kill", "sigterm", "stall", "ckpt_corrupt",
+                "stage_kill", "stage_stall",
+            )[int(rng.integers(0, 6))]
             at = int(rng.integers(0, 10_000))
             by_step = bool(rng.integers(0, 2))
             if kind == "ckpt_corrupt":
                 events.append(ChaosEvent(kind="ckpt_corrupt"))
+            elif kind.startswith("stage_"):
+                # MPMD drills: stage victim, step-only trigger
+                events.append(
+                    ChaosEvent(
+                        kind=kind[len("stage_"):],
+                        stage=int(rng.integers(0, 8)),
+                        step=at,
+                        seconds=(
+                            round(float(rng.integers(1, 400)) / 10, 1)
+                            if kind == "stage_stall"
+                            else 0.0
+                        ),
+                    )
+                )
             elif kind == "stall":
                 events.append(
                     ChaosEvent(
@@ -97,6 +112,50 @@ def test_chaos_spec_parses_documented_example_and_rejects_garbage():
     ):
         with pytest.raises(ValueError):
             parse_chaos(bad)
+
+
+def test_chaos_stage_grammar_and_ownership():
+    """MPMD stage events (ISSUE 17): grammar round-trip, rejection of
+    malformed tokens, and the ownership rule — a stage event belongs
+    to ONE armed stage engine and to nothing else (no trainer rank,
+    no SPMD run, no differently-numbered stage)."""
+    from ddp_tpu.runtime.chaos import stage_events
+
+    ev = parse_chaos("kill:stage1@step3,stall:stage0@step5:2.5s")
+    assert ev[0] == ChaosEvent(kind="kill", stage=1, step=3)
+    assert ev[1] == ChaosEvent(
+        kind="stall", stage=0, step=5, seconds=2.5
+    )
+    assert format_chaos(ev) == "kill:stage1@step3,stall:stage0@step5:2.5s"
+    # the stage-scoped filter (what the MPMD supervisor arms) keeps
+    # stage events only, and accepts a raw spec string
+    mixed = "kill:rank0@step2,kill:stage1@step3,ckpt_corrupt:latest"
+    assert stage_events(mixed) == (
+        ChaosEvent(kind="kill", stage=1, step=3),
+    )
+    for bad in (
+        "kill:stage1@epoch2",      # step-only clock
+        "stall:stage1@step3",      # stall needs a duration
+        "stall:stage1@step3:0s",   # zero duration
+        "kill:stage1@step3:2s",    # kill takes none
+        "sigterm:stage1@step3",    # only kill/stall exist for stages
+    ):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+    # ownership: only the engine armed with the matching stage owns it
+    stage_ev = parse_chaos("kill:stage1@step3")
+    unowned = ChaosEngine(stage_ev, rank=0)  # any SPMD/trainer engine
+    assert not unowned._mine(stage_ev[0])
+    wrong = ChaosEngine(stage_ev, stage=0)
+    assert not wrong._mine(stage_ev[0])
+    owner = ChaosEngine(stage_ev, stage=1)
+    assert owner._mine(stage_ev[0])
+    # a stage engine never claims rank-scoped events (global events
+    # like ckpt_corrupt can't reach it: the supervisor arms stages
+    # with the stage_events() filter, asserted above)
+    other = parse_chaos("kill:rank1@step3,kill:replica0@request2")
+    assert not owner._mine(other[0])
+    assert not owner._mine(other[1])
 
 
 def test_chaos_ledger_fires_once_across_engines(tmp_path):

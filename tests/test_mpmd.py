@@ -1,0 +1,521 @@
+"""MPMD pipeline runtime (ISSUE 17): ACTV wire format, p2p
+transport, per-stage process schedule, restart/elasticity.
+
+Acceptance pins:
+
+1. **Wire format** — fp32/bf16 round-trips including a zero-size
+   microbatch, the FULL named-reason corruption matrix in pinned
+   validation order, out-of-order rejection at the channel layer
+   (fast tier — bytes and sockets, no JAX).
+2. **Schedule correctness** — a 2-stage multi-process run matches the
+   in-graph SPMD 1F1B loss/grad-norm/accuracy trajectory at identical
+   seeds, with each stage's compile seconds BELOW the SPMD control's
+   single program (slow tier — real spawned stage processes).
+3. **Per-stage restart** — ``kill:stage1@step<N>`` completes with
+   exactly one classified restart and final-metrics parity vs the
+   uninjected trajectory (slow tier).
+4. **Composition** — grad accumulation matches a dense in-process
+   reference; stage-sliced checkpoints resume a partial run to the
+   uninterrupted trajectory (slow tier).
+"""
+
+import functools
+import json
+import os
+import struct
+import sys
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from ddp_tpu.parallel.mpmd import (
+    MPMDConfig,
+    batch_for_step,
+    train_mpmd,
+)
+from ddp_tpu.runtime import p2p
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- wire format (fast tier) ----------------------------------------
+
+
+def test_wire_roundtrip_dtypes_and_shapes():
+    import ml_dtypes
+
+    arrays = {
+        "act": np.arange(4 * 16 * 8, dtype=np.float32).reshape(4, 16, 8),
+        "bf": np.linspace(-2, 2, 64).astype(ml_dtypes.bfloat16).reshape(
+            8, 8
+        ),
+        "half": np.ones((3, 5), np.float16),
+        "tok": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "empty": np.zeros((0, 4), np.float32),  # zero-size microbatch
+        "scalar": np.float32(3.25),
+    }
+    buf = encode = p2p.encode_msg(
+        p2p.KIND_ACT, 7, 2, arrays, meta={"generation": 3}
+    )
+    msg = p2p.decode_msg(buf)
+    assert (msg.kind, msg.step, msg.microbatch) == (p2p.KIND_ACT, 7, 2)
+    assert msg.meta == {"generation": 3}
+    assert list(msg.arrays) == list(arrays)  # frame order is contract
+    for name, arr in arrays.items():
+        got = msg.arrays[name]
+        # 0-d scalars ride the wire as [1] (ascontiguousarray); every
+        # real shape is preserved exactly
+        want = np.ascontiguousarray(arr)
+        assert got.dtype == want.dtype, name
+        assert got.shape == want.shape, name
+        np.testing.assert_array_equal(got, want)
+    # encoding is deterministic (the CRC covers a canonical layout)
+    assert p2p.encode_msg(
+        p2p.KIND_ACT, 7, 2, arrays, meta={"generation": 3}
+    ) == encode
+
+
+def test_wire_rejects_unsupported_dtype_and_bad_kind():
+    with pytest.raises(ValueError):
+        p2p.encode_msg(p2p.KIND_ACT, 0, 0, {"x": np.zeros(2, np.float64)})
+    with pytest.raises(ValueError):
+        p2p.encode_msg("activations", 0, 0, {})
+    with pytest.raises(ValueError):
+        p2p.encode_msg(p2p.KIND_ACT, 0, -2, {})
+
+
+def _rebuild(body: bytes, *, version: int = p2p.WIRE_VERSION) -> bytes:
+    """Re-seal a (possibly tampered) body with a VALID CRC, so the
+    corruption under test is reached instead of tripping the CRC."""
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return p2p.MAGIC + struct.pack("<HHI", version, 0, crc) + body
+
+
+def _tamper_header(buf: bytes, mutate) -> bytes:
+    body = bytearray(buf[12:])
+    (hlen,) = struct.unpack_from("<I", body, 0)
+    header = json.loads(bytes(body[4 : 4 + hlen]).decode())
+    mutate(header)
+    hbytes = json.dumps(header, separators=(",", ":")).encode()
+    new = bytearray()
+    new += struct.pack("<I", len(hbytes))
+    new += hbytes
+    new += body[4 + hlen :]
+    return _rebuild(bytes(new))
+
+
+def test_wire_rejects_each_named_reason():
+    """The full corruption matrix, one assertion per named reason, in
+    the pinned validation order (magic before version before CRC
+    before header before shapes before trailing)."""
+    good = p2p.encode_msg(
+        p2p.KIND_ACT, 1, 0, {"x": np.ones((2, 3), np.float32)}
+    )
+
+    def reason(buf: bytes) -> str:
+        with pytest.raises(p2p.P2PWireError) as ei:
+            p2p.decode_msg(buf)
+        return ei.value.reason
+
+    # bad_magic — first check, wins even over a mangled version
+    assert reason(b"XKV!" + good[4:]) == p2p.BAD_MAGIC
+    # version_skew — checked before the CRC (no need to re-seal)
+    skew = good[:4] + struct.pack("<H", p2p.WIRE_VERSION + 1) + good[6:]
+    assert reason(skew) == p2p.VERSION_SKEW
+    # truncated — shorter than the fixed prefix
+    assert reason(good[:10]) == p2p.TRUNCATED
+    # crc_mismatch — one flipped bit anywhere in the body
+    flipped = bytearray(good)
+    flipped[-1] ^= 0x40
+    assert reason(bytes(flipped)) == p2p.CRC_MISMATCH
+    # ... and the CRC check precedes header validation: the same flip
+    # inside the header region still reports crc_mismatch
+    hflip = bytearray(good)
+    hflip[20] ^= 0x01
+    assert reason(bytes(hflip)) == p2p.CRC_MISMATCH
+    # header_invalid — valid CRC, garbage JSON
+    body = bytearray(good[12:])
+    (hlen,) = struct.unpack_from("<I", body, 0)
+    body[4 : 4 + hlen] = b"{" * hlen
+    assert reason(_rebuild(bytes(body))) == p2p.HEADER_INVALID
+    # header_invalid — unknown kind / unknown dtype / negative dim /
+    # bad ids (schema checks after the JSON parses)
+    assert (
+        reason(_tamper_header(good, lambda h: h.update(kind="bogus")))
+        == p2p.HEADER_INVALID
+    )
+    assert (
+        reason(
+            _tamper_header(
+                good, lambda h: h["frames"][0].update(dtype="fp64")
+            )
+        )
+        == p2p.HEADER_INVALID
+    )
+    assert (
+        reason(
+            _tamper_header(
+                good, lambda h: h["frames"][0].update(shape=[-2, 3])
+            )
+        )
+        == p2p.HEADER_INVALID
+    )
+    assert (
+        reason(_tamper_header(good, lambda h: h.update(step=-4)))
+        == p2p.HEADER_INVALID
+    )
+    # shape_mismatch — header promises more elements than the frame
+    assert (
+        reason(
+            _tamper_header(
+                good, lambda h: h["frames"][0].update(shape=[2, 4])
+            )
+        )
+        == p2p.SHAPE_MISMATCH
+    )
+    # truncated — trailing bytes after the last frame (re-sealed CRC,
+    # so only the framing check can catch it)
+    assert reason(_rebuild(good[12:] + b"\x00\x00")) == p2p.TRUNCATED
+
+
+def test_channel_out_of_order_rejected():
+    """A structurally VALID message in the wrong schedule slot is
+    refused at the channel layer — 1F1B over FIFO TCP makes the
+    expected (kind, step, microbatch) sequence exact."""
+    lst = p2p.Listener()
+    got = {}
+
+    def server():
+        ch = p2p.Channel(lst.accept(timeout=10))
+        try:
+            try:
+                ch.recv(p2p.KIND_ACT, 0, 1, timeout=10)
+            except p2p.P2PWireError as e:
+                got["reason"] = e.reason
+        finally:
+            ch.close()
+
+    t = threading.Thread(target=server)
+    t.start()
+    ch = p2p.Channel(p2p.dial("127.0.0.1", lst.port, timeout=10))
+    # the receiver expects microbatch 1; send microbatch 0
+    ch.send(p2p.KIND_ACT, 0, 0, {"x": np.zeros((2, 2), np.float32)})
+    t.join(timeout=15)
+    ch.close()
+    lst.close()
+    assert got.get("reason") == p2p.OUT_OF_ORDER
+
+
+# ---- stage partition + data determinism (fast tier) -----------------
+
+
+def test_stage_param_slices_partition_the_model():
+    """Stage slices are disjoint except the DELIBERATE tied-embed
+    mirror on the last stage, and each stage's block equals its row of
+    the full seeded init — two processes derive identical partitions
+    with no handshake."""
+    import jax
+
+    from ddp_tpu.models.pipeline_lm import init_pipe_lm
+    from ddp_tpu.parallel.mpmd import _pipe_cfg, stage_param_slice
+
+    cfg = MPMDConfig(num_stages=3)
+    full = init_pipe_lm(_pipe_cfg(cfg), seed=cfg.seed)
+    parts = [stage_param_slice(cfg, k) for k in range(3)]
+    assert set(parts[0]) == {"stage", "front"}
+    assert set(parts[1]) == {"stage"}
+    assert set(parts[2]) == {"stage", "back", "embed"}
+    for k, part in enumerate(parts):
+        expect = jax.tree.map(lambda p: p[k], full.stages)
+        for got, want in zip(
+            jax.tree.leaves(part["stage"]), jax.tree.leaves(expect)
+        ):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    np.testing.assert_array_equal(
+        np.asarray(parts[0]["front"]["embed"]),
+        np.asarray(full.front["embed"]),
+    )
+    # the head mirror starts as an exact copy of the canonical embed
+    np.testing.assert_array_equal(
+        np.asarray(parts[2]["embed"]),
+        np.asarray(parts[0]["front"]["embed"]),
+    )
+
+
+def test_batch_for_step_deterministic_and_distinct():
+    cfg = MPMDConfig()
+    a = batch_for_step(cfg, 3, 0)
+    assert a.shape == (cfg.batch_size, cfg.seq_len)
+    assert a.dtype == np.int32
+    np.testing.assert_array_equal(a, batch_for_step(cfg, 3, 0))
+    assert not np.array_equal(a, batch_for_step(cfg, 4, 0))
+    assert not np.array_equal(a, batch_for_step(cfg, 3, 1))
+    assert not np.array_equal(
+        a, batch_for_step(MPMDConfig(seed=1), 3, 0)
+    )
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        MPMDConfig(num_stages=1)
+    with pytest.raises(ValueError):
+        MPMDConfig(batch_size=6, num_microbatches=4)
+    with pytest.raises(ValueError):
+        MPMDConfig(optimizer="lamb")  # not per-leaf — needs a sync
+
+
+# ---- triage surfacing (fast tier) -----------------------------------
+
+
+def test_health_report_mpmd_line_gated(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import health_report
+
+    path = tmp_path / "m.jsonl"
+    recs = [
+        {"kind": "mpmd_run_start", "stages": 2, "steps": 4},
+        {"kind": "step", "step": 0, "stage": 0, "loss": 4.2,
+         "wall_s": 0.1, "bubble_s": 0.02},
+        {"kind": "step", "step": 0, "stage": 1, "loss": 4.2,
+         "wall_s": 0.1, "bubble_s": 0.02},
+        {"kind": "mpmd_restart", "stage": 1,
+         "exit_reason": "killed by SIGKILL", "resume_step": 1},
+        {"kind": "step", "step": 1, "stage": 0, "loss": 4.0,
+         "wall_s": 0.1, "bubble_s": 0.03},
+        {"kind": "mpmd_run", "stages": 2, "steps": 4, "restarts": 1},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    report = health_report.build_report(
+        health_report.load_records(str(path))
+    )
+    assert (
+        "mpmd          : 2 stage(s), loss 4.2000 -> 4.0000, "
+        "bubble 23.3%, 1 restart(s)" in report
+    )
+    # absent markers → absent line: plain SPMD streams (and every
+    # existing golden) stay byte-identical
+    path.write_text(
+        json.dumps({"kind": "step", "step": 0, "loss": 4.2}) + "\n"
+    )
+    assert "mpmd " not in health_report.build_report(
+        health_report.load_records(str(path))
+    )
+
+
+# ---- the runtime itself (slow tier — real stage processes) ----------
+
+
+_PARITY_CFG = dict(steps=6, restart_backoff_s=0.05)
+
+
+@functools.lru_cache(maxsize=4)
+def _control(**overrides):
+    """The in-graph SPMD 1F1B trajectory for a config — computed
+    in-process (the pytest process has 8 emulated devices) and cached
+    across the slow tests that pin against it."""
+    from ddp_tpu.parallel.mpmd import run_spmd_control
+
+    return run_spmd_control(MPMDConfig(**dict(_PARITY_CFG, **overrides)))
+
+
+def _stage0_steps(metrics_path):
+    recs = []
+    with open(metrics_path) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("kind") == "step" and r.get("stage") == 0:
+                recs.append(r)
+    return sorted(recs, key=lambda r: r["step"])
+
+
+@pytest.mark.slow
+def test_mpmd_matches_spmd_1f1b(tmp_path):
+    """2-stage acceptance pin: loss/grad-norm/accuracy trajectory
+    parity at identical seeds, and the per-stage compile ledger
+    strictly below the SPMD single-program control's."""
+    cfg = MPMDConfig(**_PARITY_CFG)
+    metrics = str(tmp_path / "m.jsonl")
+    result = train_mpmd(
+        cfg, str(tmp_path / "run"), metrics, timeout_s=300
+    )
+    assert result["restarts"] == 0
+    ctl = _control()
+    steps = _stage0_steps(metrics)
+    assert [r["step"] for r in steps] == list(range(cfg.steps))
+    np.testing.assert_allclose(
+        [r["loss"] for r in steps], ctl["losses"], rtol=0, atol=5e-5
+    )
+    np.testing.assert_allclose(
+        [r["grad_norm"] for r in steps],
+        ctl["grad_norms"],
+        rtol=0,
+        atol=5e-5,
+    )
+    # one miscounted token would show as 1/denom ~ 8e-3; 1e-6 is an
+    # exact-count pin with room for the float32 division
+    np.testing.assert_allclose(
+        [r["accuracy"] for r in steps],
+        ctl["accuracies"],
+        rtol=0,
+        atol=1e-6,
+    )
+    # each stage compiled 1/K of the model: EVERY stage's ledger is
+    # smaller than the whole-model program, and the control really was
+    # one program
+    assert ctl["compiled_programs"] == 1
+    for k, final in result["final"].items():
+        assert final["compile_s"] < ctl["compile_s"], (
+            f"stage {k} compile {final['compile_s']:.2f}s >= SPMD "
+            f"{ctl['compile_s']:.2f}s"
+        )
+        assert os.path.exists(
+            str(tmp_path / "run" / f"stage{k}_xprof.json")
+        )
+    # per-stage step records carry the bubble/p2p attribution fields
+    for r in steps:
+        for key in ("bubble_s", "p2p_wait_s", "wall_s"):
+            assert key in r, key
+
+
+@pytest.mark.slow
+def test_mpmd_three_stage_relay_matches_control(tmp_path):
+    """3 stages exercises the mid-stage path (activation relay both
+    directions plus the sync_up/sync_down forwarding). M=6/B=12
+    because the in-graph control's sharded stream needs M % S == 0
+    (the MPMD runtime itself has no such constraint)."""
+    shape = dict(num_stages=3, num_microbatches=6, batch_size=12)
+    cfg = MPMDConfig(**shape, **_PARITY_CFG)
+    metrics = str(tmp_path / "m.jsonl")
+    result = train_mpmd(
+        cfg, str(tmp_path / "run"), metrics, timeout_s=300
+    )
+    assert result["restarts"] == 0
+    ctl = _control(**shape)
+    np.testing.assert_allclose(
+        [r["loss"] for r in _stage0_steps(metrics)],
+        ctl["losses"],
+        rtol=0,
+        atol=5e-5,
+    )
+    # every stage reports the same relayed scalars
+    finals = result["final"]
+    assert len(finals) == 3
+    assert len({round(f["loss"], 5) for f in finals.values()}) == 1
+
+
+@pytest.mark.slow
+def test_mpmd_kill_drill_single_restart_parity(tmp_path):
+    """SIGKILL stage 1 mid-run: the supervisor classifies the exit,
+    restarts exactly once, survivors roll back to the common resume
+    step, and the final metrics land on the uninjected trajectory."""
+    cfg = MPMDConfig(chaos="kill:stage1@step3", **_PARITY_CFG)
+    metrics = str(tmp_path / "m.jsonl")
+    result = train_mpmd(
+        cfg, str(tmp_path / "run"), metrics, timeout_s=300
+    )
+    assert result["restarts"] == 1
+    (entry,) = result["restart_log"]
+    assert entry["stage"] == 1
+    assert "SIGKILL" in entry["exit"]
+    assert entry["resume_step"] <= 3
+    ctl = _control()
+    assert abs(result["loss"] - ctl["losses"][-1]) < 5e-5
+    assert abs(result["grad_norm"] - ctl["grad_norms"][-1]) < 5e-5
+    assert abs(result["accuracy"] - ctl["accuracies"][-1]) < 1e-6
+    # the metrics stream carries the classified restart stamp
+    with open(metrics) as f:
+        restarts = [
+            json.loads(l) for l in f
+            if '"mpmd_restart"' in l
+        ]
+    assert len(restarts) == 1 and restarts[0]["stage"] == 1
+
+
+@pytest.mark.slow
+def test_mpmd_checkpoint_resume_continues_exactly(tmp_path):
+    """Stage-sliced checkpoints: a 3-step run then a steps=6 rerun in
+    the same workdir resumes at step 3 (no replay of finished work)
+    and lands on the uninterrupted trajectory."""
+    metrics = str(tmp_path / "m.jsonl")
+    first = train_mpmd(
+        MPMDConfig(steps=3), str(tmp_path / "run"), timeout_s=300
+    )
+    assert first["steps"] == 3 and first["restarts"] == 0
+    result = train_mpmd(
+        MPMDConfig(**_PARITY_CFG),
+        str(tmp_path / "run"),
+        metrics,
+        timeout_s=300,
+    )
+    steps = _stage0_steps(metrics)
+    assert [r["step"] for r in steps] == [3, 4, 5]  # resumed, not replayed
+    ctl = _control()
+    np.testing.assert_allclose(
+        [r["loss"] for r in steps], ctl["losses"][3:], rtol=0, atol=5e-5
+    )
+    assert abs(result["loss"] - ctl["losses"][-1]) < 5e-5
+
+
+@pytest.mark.slow
+def test_mpmd_grad_accum_matches_dense_reference(tmp_path):
+    """Gradient accumulation composes: an accum=2 MPMD run equals a
+    dense single-device reference that sums per-chunk loss over the
+    SAME deterministic batches and applies the identical update."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.models.pipeline_lm import (
+        _loss_fn_factory,
+        init_pipe_lm,
+        sequential_apply,
+    )
+    from ddp_tpu.parallel.mpmd import _pipe_cfg
+
+    cfg = MPMDConfig(steps=3, grad_accum_steps=2)
+    metrics = str(tmp_path / "m.jsonl")
+    result = train_mpmd(
+        cfg, str(tmp_path / "run"), metrics, timeout_s=300
+    )
+    assert result["restarts"] == 0
+
+    pcfg = _pipe_cfg(cfg)
+    loss_fn = _loss_fn_factory(pcfg)
+    params = init_pipe_lm(pcfg, seed=cfg.seed)
+    opt = optax.sgd(cfg.lr)
+    opt_state = opt.init(params)
+    denom = cfg.grad_accum_steps * cfg.batch_size * (cfg.seq_len - 1)
+
+    def total_loss(p, chunks):
+        s = jnp.float32(0.0)
+        for tok in chunks:
+            logits = sequential_apply(pcfg, p, tok)
+            l, _ = loss_fn(logits, tok)
+            s = s + l
+        return s
+
+    grad_fn = jax.jit(jax.value_and_grad(total_loss))
+    ref_losses, ref_gnorms = [], []
+    for step in range(cfg.steps):
+        chunks = jnp.stack(
+            [
+                jnp.asarray(batch_for_step(cfg, step, a))
+                for a in range(cfg.grad_accum_steps)
+            ]
+        )
+        loss_sum, grads = grad_fn(params, chunks)
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        ref_gnorms.append(float(optax.global_norm(grads)))
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        ref_losses.append(float(loss_sum) / denom)
+
+    steps = _stage0_steps(metrics)
+    np.testing.assert_allclose(
+        [r["loss"] for r in steps], ref_losses, rtol=0, atol=5e-5
+    )
+    np.testing.assert_allclose(
+        [r["grad_norm"] for r in steps], ref_gnorms, rtol=0, atol=5e-5
+    )
