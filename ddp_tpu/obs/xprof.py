@@ -352,6 +352,9 @@ class ProgramProfile:
     shape_diff: Optional[str] = None  # vs the label's previous compile
     fallback: bool = False  # observe-only (no AOT introspection)
     calls: int = 0
+    # Host-side facts XLA can't see (e.g. the effective Pallas block_k
+    # after divisor fallback), attached via :meth:`Xprof.annotate`.
+    notes: dict = field(default_factory=dict)
 
     def record(self) -> dict:
         out = {
@@ -360,6 +363,8 @@ class ProgramProfile:
             "compile_time_s": round(self.compile_time_s, 4),
             "calls": self.calls,
         }
+        if self.notes:
+            out["notes"] = dict(self.notes)
         if self.flops is not None:
             out["flops"] = self.flops
         if self.bytes_accessed is not None:
@@ -517,6 +522,8 @@ class Xprof:
         self._total_compile_s = 0.0
         # Last signature per label, for shape_diff on recompile.
         self._last_sig: dict[str, str] = {}
+        # Per-label annotation dicts (see :meth:`annotate`).
+        self._notes: dict[str, dict] = {}
         self._events: deque = deque(maxlen=self.MAX_EVENTS)
         self.event_seq = 0
 
@@ -528,6 +535,27 @@ class Xprof:
         if not self.enabled:
             return fn
         return _Instrumented(self, fn, label)
+
+    def annotate(self, label: str, **fields) -> None:
+        """Attach host-known facts to a label's ledger entries.
+
+        XLA's introspection can't see decisions made before lowering —
+        the effective Pallas ``block_k`` after divisor fallback, a
+        host-resolved bucket width, a dtype chosen by a knob. Callers
+        record them here; the fields ride every subsequent (and, for
+        robustness, every already-ledgered) compile record under a
+        ``notes`` key, so the tuner and humans read one surface. No-op
+        when disabled — the free-when-disabled contract holds.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            merged = dict(self._notes.get(label, {}))
+            merged.update(fields)
+            self._notes[label] = merged
+            for p in self._ledger:
+                if p.label == label:
+                    p.notes.update(fields)
 
     def _record_compile(
         self, inst: _Instrumented, args: tuple, dt: float, *, compiled
@@ -555,6 +583,7 @@ class Xprof:
                 shape_diff=shape_diff(prev, sig) if prev is not None else None,
                 fallback=compiled is None,
                 calls=1,
+                notes=dict(self._notes.get(inst.label, {})),
             )
             self._ledger.append(profile)
             self._program_count += 1

@@ -146,11 +146,26 @@ def decode_attention_reference(q, k, v, pos, k_scale=None, v_scale=None):
 # ---- the Pallas kernel ----------------------------------------------
 
 
-def _pick_block_k(L: int, block_k: int) -> int:
+def pick_block_k(L: int, block_k: int) -> int:
+    """Effective KV block for a length-``L`` lane.
+
+    The grid needs ``block_k | L``. When the requested size doesn't
+    divide, fall back to the **largest divisor of L ≤ requested** —
+    never to ``L`` itself (a single full-length block would defeat the
+    ``pl.when`` dead-block skip that makes young lanes O(pos)). Worst
+    case (prime ``L``) degrades to 1-wide blocks, which is still
+    banded; the tuner and the xprof ledger surface the effective value
+    so a pathological ``L`` is visible, not silent.
+    """
     block_k = min(block_k, L)
-    if L % block_k:
-        block_k = L
+    while L % block_k:
+        block_k -= 1
     return block_k
+
+
+# Pre-rename private spelling; kept so external callers (and the
+# tuner's site-version hash) have one canonical name to import.
+_pick_block_k = pick_block_k
 
 
 def flash_decode_attention(
@@ -175,7 +190,7 @@ def flash_decode_attention(
     S, H, Dh = q.shape
     L, H_kv = k.shape[1], k.shape[2]
     G = H // H_kv
-    block_k = _pick_block_k(L, block_k)
+    block_k = pick_block_k(L, block_k)
     quantized = k.dtype == jnp.int8
     # One grid row per (slot, kv-head): q regrouped kv-head-major
     # (exactly the engine's qg = q.reshape(S, H_kv, G, Dh) grouping),

@@ -211,6 +211,187 @@ def drain_eta_s(
     return max(1, depth) / rate
 
 
+def resolve_engine_knobs(
+    spec: LMSpec,
+    *,
+    slots: int = 4,
+    prefill_len: Optional[int] = None,
+    prefill_chunk: Optional[int] = None,
+    min_bucket: Optional[int] = None,
+    step_token_budget: Optional[int] = None,
+    decode_attn: str = "auto",
+    kv_dtype: str = "fp32",
+    page_size: int = 0,
+    kv_pages: Optional[int] = None,
+    spec_tokens: int = 0,
+    draft_spec: Optional[LMSpec] = None,
+    has_draft_params: bool = False,
+) -> dict:
+    """Validate + resolve the engine's knob surface — the SINGLE rule
+    set for what configurations are constructible.
+
+    ``ServeEngine.__init__`` consumes this verbatim, and the autotuner
+    (``ddp_tpu.tune``) uses it as the validity predicate for proposed
+    configs: a candidate is proposable iff this returns — so the tuner
+    can never propose a config the CLI would reject, by construction
+    rather than by a parallel re-implementation of the rules. Raises
+    the same ``ValueError`` messages the engine always raised; returns
+    the resolved values (defaults filled, pow2 snapping and caps
+    applied) the engine assigns.
+    """
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    prefill_len = prefill_len or max(1, spec.total_len // 2)
+    if not 0 < prefill_len <= spec.total_len - 1:
+        raise ValueError(
+            f"prefill_len {prefill_len} must leave room to decode "
+            f"inside total_len {spec.total_len}"
+        )
+    # Decode-attention impl (ops/decode.py): resolved ONCE, like
+    # best_attention — the flash-decode Pallas kernel on TPU, the
+    # bit-identical jnp reference elsewhere; "flash" forces the
+    # kernel (interpret mode off-TPU: how CPU tests pin token
+    # identity).
+    if decode_attn not in ("auto", "flash", "reference"):
+        raise ValueError(
+            f"decode_attn must be auto|flash|reference, got "
+            f"{decode_attn!r}"
+        )
+    if decode_attn == "auto":
+        decode_attn = (
+            "flash"
+            if jax.devices()[0].platform == "tpu"
+            else "reference"
+        )
+    if kv_dtype not in ("fp32", "int8"):
+        raise ValueError(
+            f"kv_dtype must be fp32|int8, got {kv_dtype!r}"
+        )
+    if kv_pages is not None and not page_size:
+        raise ValueError(
+            "--kv_pages needs --page_size (the page pool only "
+            "exists in paged mode)"
+        )
+    paged = bool(page_size)
+    page_size = int(page_size)
+    lane_pages = resolved_kv_pages = None
+    if paged:
+        if page_size < 1 or (page_size & (page_size - 1)):
+            raise ValueError(
+                f"--page_size must be a power of two, got "
+                f"{page_size}"
+            )
+        if spec.total_len % page_size:
+            raise ValueError(
+                f"--page_size {page_size} must divide the model's "
+                f"total_len {spec.total_len}: a partial tail page "
+                "would break the page-granular tail-chunk "
+                "invariant (every chunk write maps through whole "
+                "pages)"
+            )
+        lane_pages = spec.total_len // page_size
+        resolved_kv_pages = int(
+            kv_pages
+            if kv_pages is not None
+            # Capacity-neutral default: the pool holds exactly the
+            # fixed-lane layout's lines (+ the scratch page), so
+            # any sharing is pure headroom.
+            else slots * lane_pages + 1
+        )
+        if resolved_kv_pages < lane_pages + 1:
+            raise ValueError(
+                f"--kv_pages {resolved_kv_pages} cannot hold one "
+                f"full-context lane: needs >= total_len/"
+                f"--page_size + 1 scratch = {lane_pages + 1}"
+                " (a maximal request could never bind — permanent "
+                "queue head starvation)"
+            )
+    if spec_tokens:
+        if spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens must be >= 1, got {spec_tokens}"
+            )
+        if draft_spec is None or not has_draft_params:
+            raise ValueError(
+                "speculative decoding needs draft_spec AND "
+                "draft_params alongside spec_tokens"
+            )
+        if draft_spec.vocab_size != spec.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_spec.vocab_size} != target "
+                f"vocab {spec.vocab_size}"
+            )
+        if draft_spec.total_len != spec.total_len:
+            raise ValueError(
+                f"draft total_len {draft_spec.total_len} != target "
+                f"total_len {spec.total_len} (the caches track the "
+                "same positions)"
+            )
+        if spec_tokens >= spec.total_len - prefill_len:
+            raise ValueError(
+                f"spec_tokens {spec_tokens} leaves no decode room "
+                f"past prefill_len {prefill_len} in total_len "
+                f"{spec.total_len}"
+            )
+    spec_tokens = int(spec_tokens)
+    # Admission context ceiling: the verify round's K-1 reserve
+    # comes off the budget check, never the cache geometry.
+    ctx_len = spec.total_len - max(0, spec_tokens - 1)
+    # Decode-path tokens dispatched per running lane per step: 1
+    # plain, K under speculation (the verify round processes K
+    # positions per lane — plan_chunks accounts them all).
+    tokens_per_decode = max(1, spec_tokens)
+    chunk = next_pow2(
+        prefill_chunk
+        if prefill_chunk
+        else min(next_pow2(prefill_len), 64)
+    )
+    # A chunk's write region [start, start + width) must fit the
+    # cache at start = 0 — cap at the largest pow2 <= total_len.
+    chunk = min(chunk, prev_pow2(spec.total_len))
+    # The smallest bucket must fit the cache at ANY admissible
+    # start (max start = prefill_len - 1, so the space floor is
+    # total_len - prefill_len + 1 >= 2): a wider bucket's pad
+    # overhang would make dynamic_update_slice clamp the write
+    # start and silently shift the chunk over live cache lines.
+    min_bucket = min(
+        chunk,
+        next_pow2(min_bucket) if min_bucket else min(8, chunk),
+        prev_pow2(spec.total_len - prefill_len + 1),
+    )
+    step_token_budget = (
+        step_token_budget
+        if step_token_budget
+        else chunk + slots * tokens_per_decode
+    )
+    if step_token_budget < min_bucket + slots * tokens_per_decode:
+        # Below this floor the prefill head can starve forever
+        # while lanes decode (the budget never fits even the
+        # smallest bucket after decode tokens are accounted).
+        raise ValueError(
+            f"step_token_budget {step_token_budget} cannot "
+            f"sustain prefill progress: needs >= min_bucket "
+            f"({min_bucket}) + slots ({slots}) x decode tokens "
+            f"per lane ({tokens_per_decode})"
+        )
+    return {
+        "slots": slots,
+        "prefill_len": prefill_len,
+        "decode_attn": decode_attn,
+        "kv_dtype": kv_dtype,
+        "paged": paged,
+        "page_size": page_size,
+        "lane_pages": lane_pages,
+        "kv_pages": resolved_kv_pages,
+        "spec_tokens": spec_tokens,
+        "ctx_len": ctx_len,
+        "tokens_per_decode": tokens_per_decode,
+        "chunk": chunk,
+        "min_bucket": min_bucket,
+        "step_token_budget": step_token_budget,
+    }
+
+
 class ServeEngine:
     """Fixed-slot continuous-batching engine for one causal LM.
 
@@ -271,80 +452,39 @@ class ServeEngine:
         slo=None,
         recorder=None,
     ):
-        if slots < 1:
-            raise ValueError(f"slots must be >= 1, got {slots}")
-        prefill_len = prefill_len or max(1, spec.total_len // 2)
-        if not 0 < prefill_len <= spec.total_len - 1:
-            raise ValueError(
-                f"prefill_len {prefill_len} must leave room to decode "
-                f"inside total_len {spec.total_len}"
-            )
-        # Decode-attention impl (ops/decode.py): resolved ONCE, like
-        # best_attention — the flash-decode Pallas kernel on TPU, the
-        # bit-identical jnp reference elsewhere; "flash" forces the
-        # kernel (interpret mode off-TPU: how CPU tests pin token
-        # identity).
-        if decode_attn not in ("auto", "flash", "reference"):
-            raise ValueError(
-                f"decode_attn must be auto|flash|reference, got "
-                f"{decode_attn!r}"
-            )
-        if decode_attn == "auto":
-            decode_attn = (
-                "flash"
-                if jax.devices()[0].platform == "tpu"
-                else "reference"
-            )
-        self.decode_attn = decode_attn
-        if kv_dtype not in ("fp32", "int8"):
-            raise ValueError(
-                f"kv_dtype must be fp32|int8, got {kv_dtype!r}"
-            )
-        self.kv_dtype = kv_dtype
+        # The whole knob surface validates + resolves through the
+        # module-level resolver — the same rule set the autotuner's
+        # validity predicates call, so tuner and CLI can never
+        # disagree about what constructs.
+        knobs = resolve_engine_knobs(
+            spec,
+            slots=slots,
+            prefill_len=prefill_len,
+            prefill_chunk=prefill_chunk,
+            min_bucket=min_bucket,
+            step_token_budget=step_token_budget,
+            decode_attn=decode_attn,
+            kv_dtype=kv_dtype,
+            page_size=page_size,
+            kv_pages=kv_pages,
+            spec_tokens=spec_tokens,
+            draft_spec=draft_spec,
+            has_draft_params=draft_params is not None,
+        )
+        prefill_len = knobs["prefill_len"]
+        self.decode_attn = knobs["decode_attn"]
+        self.kv_dtype = knobs["kv_dtype"]
         # Paged KV + radix prefix reuse (PR 12, serve/pages.py):
         # --page_size > 0 flips the cache to the page-pool layout
         # (PagedSlotCache) and admission to free-page accounting.
         # 0 (the default) is the fixed-lane control — byte-identical
         # transfer shapes, compile counts and /metricsz exposition to
         # the pre-paging engine.
-        if kv_pages is not None and not page_size:
-            raise ValueError(
-                "--kv_pages needs --page_size (the page pool only "
-                "exists in paged mode)"
-            )
-        self.paged = bool(page_size)
-        self.page_size = int(page_size)
+        self.paged = knobs["paged"]
+        self.page_size = knobs["page_size"]
         if self.paged:
-            if page_size < 1 or (page_size & (page_size - 1)):
-                raise ValueError(
-                    f"--page_size must be a power of two, got "
-                    f"{page_size}"
-                )
-            if spec.total_len % page_size:
-                raise ValueError(
-                    f"--page_size {page_size} must divide the model's "
-                    f"total_len {spec.total_len}: a partial tail page "
-                    "would break the page-granular tail-chunk "
-                    "invariant (every chunk write maps through whole "
-                    "pages)"
-                )
-            self._lane_pages = spec.total_len // page_size
-            self.kv_pages = int(
-                kv_pages
-                if kv_pages is not None
-                # Capacity-neutral default: the pool holds exactly the
-                # fixed-lane layout's lines (+ the scratch page), so
-                # any sharing is pure headroom.
-                else slots * self._lane_pages + 1
-            )
-            if self.kv_pages < self._lane_pages + 1:
-                raise ValueError(
-                    f"--kv_pages {self.kv_pages} cannot hold one "
-                    f"full-context lane: needs >= total_len/"
-                    f"--page_size + 1 scratch = {self._lane_pages + 1}"
-                    " (a maximal request could never bind — permanent "
-                    "queue head starvation)"
-                )
+            self._lane_pages = knobs["lane_pages"]
+            self.kv_pages = knobs["kv_pages"]
         # Speculative decoding: a draft LM proposes spec_tokens greedy
         # continuations per lane; the target verifies them in ONE
         # batched step (models/generate.slot_verify_step). The verify
@@ -352,61 +492,13 @@ class ServeEngine:
         # reserves K-1 cache lines (a lane one round short of budget
         # may overshoot its context by up to K-2 positions — reserved
         # rather than clamp-shifted over live lines).
-        if spec_tokens:
-            if spec_tokens < 1:
-                raise ValueError(
-                    f"spec_tokens must be >= 1, got {spec_tokens}"
-                )
-            if draft_spec is None or draft_params is None:
-                raise ValueError(
-                    "speculative decoding needs draft_spec AND "
-                    "draft_params alongside spec_tokens"
-                )
-            if draft_spec.vocab_size != spec.vocab_size:
-                raise ValueError(
-                    f"draft vocab {draft_spec.vocab_size} != target "
-                    f"vocab {spec.vocab_size}"
-                )
-            if draft_spec.total_len != spec.total_len:
-                raise ValueError(
-                    f"draft total_len {draft_spec.total_len} != target "
-                    f"total_len {spec.total_len} (the caches track the "
-                    "same positions)"
-                )
-            if spec_tokens >= spec.total_len - prefill_len:
-                raise ValueError(
-                    f"spec_tokens {spec_tokens} leaves no decode room "
-                    f"past prefill_len {prefill_len} in total_len "
-                    f"{spec.total_len}"
-                )
-        self.spec_tokens = int(spec_tokens)
+        self.spec_tokens = knobs["spec_tokens"]
         self.draft_spec = draft_spec
         self.draft_params = draft_params
-        # Admission context ceiling: the verify round's K-1 reserve
-        # comes off the budget check, never the cache geometry.
-        ctx_len = spec.total_len - max(0, self.spec_tokens - 1)
-        # Decode-path tokens dispatched per running lane per step: 1
-        # plain, K under speculation (the verify round processes K
-        # positions per lane — plan_chunks accounts them all).
-        tokens_per_decode = max(1, self.spec_tokens)
-        chunk = next_pow2(
-            prefill_chunk
-            if prefill_chunk
-            else min(next_pow2(prefill_len), 64)
-        )
-        # A chunk's write region [start, start + width) must fit the
-        # cache at start = 0 — cap at the largest pow2 <= total_len.
-        chunk = min(chunk, prev_pow2(spec.total_len))
-        # The smallest bucket must fit the cache at ANY admissible
-        # start (max start = prefill_len - 1, so the space floor is
-        # total_len - prefill_len + 1 >= 2): a wider bucket's pad
-        # overhang would make dynamic_update_slice clamp the write
-        # start and silently shift the chunk over live cache lines.
-        min_bucket = min(
-            chunk,
-            next_pow2(min_bucket) if min_bucket else min(8, chunk),
-            prev_pow2(spec.total_len - prefill_len + 1),
-        )
+        ctx_len = knobs["ctx_len"]
+        tokens_per_decode = knobs["tokens_per_decode"]
+        chunk = knobs["chunk"]
+        min_bucket = knobs["min_bucket"]
         self.spec = spec
         self.params = params
         self.num_slots = slots
@@ -415,21 +507,7 @@ class ServeEngine:
         self.min_bucket = min_bucket
         self._ctx_len = ctx_len
         self._tokens_per_decode = tokens_per_decode
-        self.step_token_budget = (
-            step_token_budget
-            if step_token_budget
-            else chunk + slots * tokens_per_decode
-        )
-        if self.step_token_budget < min_bucket + slots * tokens_per_decode:
-            # Below this floor the prefill head can starve forever
-            # while lanes decode (the budget never fits even the
-            # smallest bucket after decode tokens are accounted).
-            raise ValueError(
-                f"step_token_budget {self.step_token_budget} cannot "
-                f"sustain prefill progress: needs >= min_bucket "
-                f"({min_bucket}) + slots ({slots}) x decode tokens "
-                f"per lane ({tokens_per_decode})"
-            )
+        self.step_token_budget = knobs["step_token_budget"]
         self.clock = clock
         self.metrics = metrics or MetricsWriter(None)
         # Span tracing (ddp_tpu.obs): chunk/decode device work plus the
@@ -628,6 +706,22 @@ class ServeEngine:
             # kernel path from the jnp path.
             "serve.flash_decode" if impl == "flash" else "serve.decode",
         )
+        if impl == "flash":
+            # The kernel snaps block_k to the largest divisor of the
+            # lane length (ops/decode.pick_block_k) — a host-side
+            # decision XLA introspection can't see. Ledger it so the
+            # tuner and humans read the EFFECTIVE block, not the
+            # requested default. Paged lanes gather [pages ·
+            # page_size] keys and block on the page itself.
+            from ddp_tpu.ops.decode import pick_block_k
+
+            requested = self.page_size if self.paged else 128
+            lane_len = spec.total_len
+            self._xprof.annotate(
+                "serve.flash_decode",
+                block_k_requested=requested,
+                block_k=pick_block_k(lane_len, requested),
+            )
         if self.spec_tokens:
             dspec = draft_spec
             # Draft-side machinery: its OWN cache (the draft tracks

@@ -139,6 +139,11 @@ class TrainConfig:
     # the moments) stay full precision — only the forward sees
     # bf16-rounded params, so rounding never compounds across steps.
     zero_gather_dtype: str = "fp32"  # fp32 | bf16
+    # Tuning cache (ddp_tpu.tune): auto = load tuning_cache.json
+    # beside checkpoint_dir and fill zero knobs left at defaults from
+    # the cached winner (explicit flags always win); off = never
+    # touch it; a path = that cache file.
+    tuned: str = "auto"
     # Rematerialize block activations in the backward (jax.checkpoint):
     # HBM for FLOPs. Supported by the block-structured families
     # (resnet*, vit*, vit_moe*); simple_cnn has no block stack to remat.
@@ -376,6 +381,14 @@ class TrainConfig:
             "update exact (fp32 = bit-identical default)",
         )
         p.add_argument(
+            "--tuned", default=cls.tuned, metavar="auto|off|PATH",
+            help="tuning cache (ddp_tpu.tune, scripts/autotune.py): "
+            "'auto' loads tuning_cache.json beside --checkpoint_dir "
+            "and fills zero knobs left at their defaults from the "
+            "cached winner for this model shape — explicit flags "
+            "always win; 'off' disables; a path loads that file",
+        )
+        p.add_argument(
             "--mesh_dcn", type=int, default=cls.mesh_dcn,
             help="pod slices on the outermost dcn axis: the zero step "
             "goes hierarchical (reduce-scatter within a slice over "
@@ -512,6 +525,26 @@ class TrainConfig:
         kwargs.pop("list_datasets", None)
         return cls(**kwargs)
 
+    @staticmethod
+    def scan_explicit_flags(argv=None) -> frozenset:
+        """Which flags the user ACTUALLY typed (vs defaulted): the
+        tuning cache's precedence rule is explicit-flag-beats-cache,
+        and argparse alone can't distinguish ``--zero_bucket_mb 4``
+        from the 4.0 default. Callers attach the result as a plain
+        attribute — not a field — so ``dataclasses.asdict()``
+        (flight-recorder context, restart argv round-trips) is
+        unchanged."""
+        import sys
+
+        raw = list(sys.argv[1:]) if argv is None else list(argv)
+        explicit = set()
+        for tok in raw:
+            if tok.startswith("--"):
+                explicit.add(tok[2:].split("=", 1)[0].replace("-", "_"))
+        return frozenset(explicit)
+
     @classmethod
     def from_args(cls, argv=None) -> "TrainConfig":
-        return cls.from_namespace(cls.parser().parse_args(argv))
+        cfg = cls.from_namespace(cls.parser().parse_args(argv))
+        cfg.explicit_flags = cls.scan_explicit_flags(argv)
+        return cfg

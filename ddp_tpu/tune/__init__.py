@@ -1,0 +1,74 @@
+"""Self-tuning performance: the measure→tune→load loop (ROADMAP 5).
+
+Four stages, one module each:
+
+- :mod:`ddp_tpu.tune.space` — declarative per-site knob grids whose
+  validity predicates ARE the engines' own construction validation
+  (``resolve_engine_knobs`` / ``build_layout``): the tuner can never
+  propose a config the CLI would reject.
+- :mod:`ddp_tpu.tune.costmodel` — XLA-priced dominance pruning
+  through ``Xprof.instrument``'s ``lower().compile()`` path; pruned
+  fraction reported, unpriceable knobs never pruned.
+- :mod:`ddp_tpu.tune.measure` — wall-clock for the survivors with
+  bench.py's harness (step p50/p99, compile-budget assert, transfer
+  guard armed, token streams captured for the identity check).
+- :mod:`ddp_tpu.tune.cache` — ``tuning_cache.json`` beside the
+  checkpoints, keyed model-shape × platform × backend × device-kind
+  × site-version; explicit CLI flags always beat cache entries;
+  trainer / serve / fleet load it by default (``--tuned auto``).
+
+``scripts/autotune.py`` is the CLI; :mod:`ddp_tpu.tune.tuner` the
+orchestration.
+"""
+
+from ddp_tpu.tune.cache import (
+    SITE_VERSIONS,
+    TuningCache,
+    apply_tuned,
+    cache_key,
+    default_cache_path,
+    env_signature,
+    model_signature,
+    resolve_cache,
+    train_signature,
+)
+from ddp_tpu.tune.costmodel import (
+    CostEntry,
+    ProgramCoster,
+    dominates,
+    prune_dominated,
+)
+from ddp_tpu.tune.measure import canonical_trace, measure_serve
+from ddp_tpu.tune.space import (
+    Candidate,
+    SpaceReport,
+    decode_block_space,
+    serve_space,
+    zero_space,
+)
+from ddp_tpu.tune.tuner import tune_serve, tune_zero
+
+__all__ = [
+    "SITE_VERSIONS",
+    "TuningCache",
+    "apply_tuned",
+    "cache_key",
+    "default_cache_path",
+    "env_signature",
+    "model_signature",
+    "resolve_cache",
+    "train_signature",
+    "CostEntry",
+    "ProgramCoster",
+    "dominates",
+    "prune_dominated",
+    "canonical_trace",
+    "measure_serve",
+    "Candidate",
+    "SpaceReport",
+    "decode_block_space",
+    "serve_space",
+    "zero_space",
+    "tune_serve",
+    "tune_zero",
+]

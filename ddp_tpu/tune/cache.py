@@ -1,0 +1,212 @@
+"""Persistent tuning cache — the "load by default" half of the loop.
+
+``tuning_cache.json`` lives beside the checkpoint dir and maps a
+**cache key** to the winning knob config plus its provenance (how it
+was chosen: measured p50s, pruned fraction, search wall-clock). The
+key is built like the AOT signature cache's:
+
+    site | model-shape signature | platform | backend | device kind | vN
+
+so a cache tuned on one (model shape, hardware) pair can never leak
+onto another: change the model dims, the backend, or the device kind
+and the lookup misses — the caller falls back to defaults, exactly
+today's behavior. ``vN`` is the **knob-site version**: bump
+``SITE_VERSIONS[site]`` whenever a site's knob semantics change
+(renamed knob, different validity floor) and every stale entry
+invalidates itself.
+
+Precedence is fixed and tested: **explicit CLI flags > cache entry >
+built-in default** (:func:`apply_tuned` implements it for every
+caller — serve, fleet, trainer — so the rule can't drift per
+surface). A cache hit costs zero search; ``--tuned off`` never opens
+the file.
+
+Writes are atomic (tmp + ``os.replace``, the checkpoint idiom) and
+last-writer-wins per key — concurrent tuners on different shapes
+merge, same shape overwrites.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+# Bump a site's version whenever its knob semantics change; old cache
+# entries for that site then miss and re-tune instead of silently
+# applying values with a different meaning.
+SITE_VERSIONS = {
+    "serve": 1,
+    "zero": 1,
+    "decode_block": 1,
+    "fleet": 1,
+}
+
+CACHE_BASENAME = "tuning_cache.json"
+
+
+def default_cache_path(checkpoint_dir: str) -> str:
+    """``tuning_cache.json`` beside the checkpoints — tuned configs
+    travel with the weights they were tuned for."""
+    return os.path.join(checkpoint_dir, CACHE_BASENAME)
+
+
+def env_signature() -> tuple[str, str, str]:
+    """(platform, backend, device_kind) of the default device — the
+    hardware half of the cache key."""
+    import jax
+
+    d = jax.devices()[0]
+    return (
+        d.platform,
+        jax.default_backend(),
+        str(getattr(d, "device_kind", "unknown")),
+    )
+
+
+def model_signature(spec) -> str:
+    """Shape signature of an ``LMSpec`` — every field that changes the
+    compiled program set or the knob optimum."""
+    return (
+        f"lm:v{spec.vocab_size}:l{spec.total_len}:d{spec.d_model}"
+        f":dep{spec.depth}:h{spec.num_heads}"
+        f":kv{getattr(spec, 'num_kv_heads', 0)}"
+        f":e{getattr(spec, 'num_experts', 0)}"
+    )
+
+
+def train_signature(config) -> str:
+    """Shape signature of a trainer config (the zero site's key)."""
+    return (
+        f"train:{config.model}:dim{config.model_dim}"
+        f":dep{config.model_depth}:h{config.num_heads}"
+        f":seq{config.seq_len}:v{config.vocab_size}"
+    )
+
+
+def cache_key(
+    site: str,
+    model_sig: str,
+    *,
+    platform: Optional[str] = None,
+    backend: Optional[str] = None,
+    device_kind: Optional[str] = None,
+) -> str:
+    """The full lookup key; env fields default to the live process's.
+
+    Keyed exactly like the AOT signature cache: any change to the
+    shape OR the hardware is a different key, and a site-version bump
+    orphans every old entry.
+    """
+    if platform is None or backend is None or device_kind is None:
+        p, b, k = env_signature()
+        platform = platform or p
+        backend = backend or b
+        device_kind = device_kind or k
+    version = SITE_VERSIONS.get(site, 1)
+    return f"{site}|{model_sig}|{platform}|{backend}|{device_kind}|v{version}"
+
+
+class TuningCache:
+    """The on-disk key→entry map. Missing/corrupt files read as empty
+    (a broken cache degrades to defaults, never to a crash)."""
+
+    SCHEMA = 1
+
+    def __init__(self, path: str):
+        self.path = path
+        self.entries: dict[str, dict] = {}
+        self.load()
+
+    def load(self) -> None:
+        self.entries = {}
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and doc.get("schema") == self.SCHEMA:
+                ent = doc.get("entries")
+                if isinstance(ent, dict):
+                    self.entries = ent
+        except (OSError, ValueError):
+            pass
+
+    def lookup(self, key: str) -> Optional[dict]:
+        """The stored entry ({config, provenance}) or None."""
+        ent = self.entries.get(key)
+        if isinstance(ent, dict) and isinstance(ent.get("config"), dict):
+            return ent
+        return None
+
+    def store(
+        self,
+        key: str,
+        config: dict,
+        *,
+        provenance: Optional[dict] = None,
+    ) -> None:
+        self.entries[key] = {
+            "config": dict(config),
+            "provenance": dict(provenance or {}),
+            "written_at": time.time(),
+        }
+
+    def save(self) -> None:
+        """Atomic write-through (tmp + ``os.replace``): a reader never
+        sees a torn file, a crash never corrupts the old one."""
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        doc = {"schema": self.SCHEMA, "entries": self.entries}
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        os.replace(tmp, self.path)
+
+
+def resolve_cache(tuned: str, checkpoint_dir: Optional[str]) -> Optional[TuningCache]:
+    """``--tuned auto|off|<path>`` → an open cache or None.
+
+    ``off`` (or ``auto`` with no checkpoint dir) returns None without
+    touching the filesystem — the byte-identical-to-today path. An
+    explicit path opens even when the file doesn't exist yet (the
+    tuner writes through it).
+    """
+    if tuned == "off":
+        return None
+    if tuned == "auto":
+        if not checkpoint_dir:
+            return None
+        return TuningCache(default_cache_path(checkpoint_dir))
+    return TuningCache(tuned)
+
+
+def apply_tuned(
+    current: dict[str, Any],
+    entry_config: dict[str, Any],
+    *,
+    explicit: set[str] | frozenset[str] = frozenset(),
+) -> tuple[dict[str, Any], dict[str, Any], list[str]]:
+    """Merge a cache entry under the fixed precedence rule.
+
+    ``current`` maps knob name → the caller's present value; a knob is
+    filled from the cache only when it is NOT in ``explicit`` (the
+    names the user set on the command line — explicit flags always
+    win). Returns ``(merged, applied, overridden)``: the merged knob
+    dict, the subset actually taken from the cache, and the cached
+    knobs the user overrode — both land in the provenance record so a
+    tuned run is distinguishable from a default one in every triage
+    surface.
+    """
+    merged = dict(current)
+    applied: dict[str, Any] = {}
+    overridden: list[str] = []
+    for name, value in entry_config.items():
+        if name not in merged:
+            continue  # a knob this surface doesn't own
+        if name in explicit:
+            overridden.append(name)
+            continue
+        merged[name] = value
+        applied[name] = value
+    return merged, applied, sorted(overridden)

@@ -1,0 +1,347 @@
+"""Cost-model pruning: pay XLA introspection, not wall-clock.
+
+The tuner's middle stage. Each priceable candidate gets a
+:class:`CostEntry` — XLA-counted FLOPs, bytes-accessed, and peak temp
+bytes for the program mix the candidate would actually run — and
+**dominated** entries (another candidate at least as good on every
+priced axis and strictly better on one) are discarded before any
+measurement. The pruned fraction is reported, never a silent cap.
+
+Pricing goes through ``Xprof.instrument``'s existing
+``lower().compile()`` path (:class:`ProgramCoster`), so the numbers
+are the SAME ledger numbers every other surface reads — one compile
+per distinct program shape, memoized, shared across all candidates
+that use it. Serve candidates multiply those per-width program costs
+by a **host-side chunk-plan simulation** driven by the real
+``Scheduler.chunk_width`` (no scheduler re-implementation to drift)
+over a canonical prompt trace.
+
+Honesty rules:
+
+- A knob the model can't price (spec γ: acceptance-rate-dependent;
+  paged layouts: reuse-dependent) yields an entry with **no priced
+  axes** — such entries are never pruned and always graduate to
+  measurement. Dominance only ever prunes on information the model
+  actually has.
+- The zero site is priced analytically by the strategy's own
+  ``zero_comm_bytes`` (per-replica collective payload) plus the
+  layout's padding overhead — the same model
+  ``tests/test_zero.py`` cross-checks against parsed HLO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ddp_tpu.tune.space import SpaceReport
+
+
+@dataclass
+class CostEntry:
+    """One candidate's priced axes (None = model has no information)."""
+
+    key: str
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    memory_bytes: Optional[float] = None
+    detail: dict = field(default_factory=dict)
+
+    @property
+    def priced(self) -> bool:
+        return any(
+            v is not None
+            for v in (self.flops, self.bytes_accessed, self.memory_bytes)
+        )
+
+
+_AXES = ("flops", "bytes_accessed", "memory_bytes")
+
+
+def dominates(a: CostEntry, b: CostEntry) -> bool:
+    """True when ``a`` makes ``b`` not worth measuring.
+
+    ``a`` must be at least as good on EVERY axis ``b`` has
+    information on (an axis ``a`` can't price blocks the claim) and
+    strictly better on at least one. Entries with no priced axes are
+    never dominated — the model must not prune what it cannot see.
+    """
+    strict = False
+    compared = False
+    for ax in _AXES:
+        bv = getattr(b, ax)
+        if bv is None:
+            continue
+        av = getattr(a, ax)
+        if av is None or av > bv:
+            return False
+        compared = True
+        if av < bv:
+            strict = True
+    return compared and strict
+
+
+def prune_dominated(
+    entries: list[CostEntry],
+) -> tuple[list[CostEntry], list[CostEntry]]:
+    """(survivors, pruned) under pairwise dominance — order-stable."""
+    survivors: list[CostEntry] = []
+    pruned: list[CostEntry] = []
+    for e in entries:
+        if any(dominates(o, e) for o in entries if o is not e):
+            pruned.append(e)
+        else:
+            survivors.append(e)
+    return survivors, pruned
+
+
+class ProgramCoster:
+    """Prices programs through the xprof compile ledger, memoized.
+
+    ``price(label, fn, *args)`` routes ``fn`` (a jit wrapper) through
+    ``Xprof.instrument`` — the exact ``lower().compile()`` +
+    ``cost_analysis()``/``memory_analysis()`` path PR 9 built — and
+    returns the ledger entry's numbers. One compile per distinct
+    (label, signature); every candidate sharing a program shape
+    shares the price.
+    """
+
+    def __init__(self, xprof=None):
+        from ddp_tpu.obs.xprof import Xprof
+
+        self.xprof = xprof if xprof is not None else Xprof(enabled=True)
+        self._inst: dict[str, Callable] = {}
+        self._memo: dict[tuple, dict] = {}
+
+    def price(self, label: str, fn: Callable, *args) -> dict:
+        from ddp_tpu.obs.xprof import shape_signature
+
+        key = (label, shape_signature(args))
+        hit = self._memo.get(key)
+        if hit is not None:
+            return hit
+        inst = self._inst.get(label)
+        if inst is None:
+            inst = self.xprof.instrument(fn, label)
+            self._inst[label] = inst
+        inst(*args)  # compile (ledgered) + one dispatch
+        rec = next(
+            r
+            for r in reversed(self.xprof.ledger_records())
+            if r["label"] == label
+        )
+        mem = rec.get("memory", {})
+        out = {
+            "flops": rec.get("flops"),
+            "bytes_accessed": rec.get("bytes_accessed"),
+            "memory_bytes": (
+                mem.get("temp_bytes", 0) + mem.get("output_bytes", 0)
+            )
+            or None,
+            "compiles": 1,
+        }
+        self._memo[key] = out
+        return out
+
+
+# ---- serve site -----------------------------------------------------
+
+
+def plan_chunk_counts(
+    resolved: dict,
+    prompt_lens: list[int],
+    *,
+    slots: int,
+    prefill_len: int,
+    total_len: int,
+) -> dict[int, int]:
+    """Chunk-program invocations per width for one candidate, via the
+    REAL scheduler's ``chunk_width`` over the canonical trace.
+
+    Steady state assumed: each step's prefill budget is the token
+    budget minus a full complement of decoding lanes (the
+    conservative case — prefill is slowest exactly when decode is
+    saturated)."""
+    from ddp_tpu.serve.scheduler import Scheduler
+
+    sch = Scheduler(
+        max_queue=len(prompt_lens) + 1,
+        prefill_len=prefill_len,
+        total_len=total_len,
+        chunk=resolved["chunk"],
+        min_bucket=resolved["min_bucket"],
+        token_budget=resolved["step_token_budget"],
+    )
+    per_step_budget = max(
+        resolved["min_bucket"],
+        resolved["step_token_budget"]
+        - slots * resolved["tokens_per_decode"],
+    )
+    counts: dict[int, int] = {}
+    for plen in prompt_lens:
+        start, remaining = 0, plen
+        while remaining > 0:
+            w = sch.chunk_width(start, remaining, per_step_budget)
+            if w is None:  # pragma: no cover — validation floor holds
+                break
+            counts[w] = counts.get(w, 0) + 1
+            consumed = min(w, remaining)
+            start += consumed
+            remaining -= consumed
+    return counts
+
+
+def price_serve_candidates(
+    spec,
+    params,
+    report: SpaceReport,
+    *,
+    slots: int,
+    prompt_lens: list[int],
+    new_tokens: int,
+    coster: Optional[ProgramCoster] = None,
+) -> tuple[list[CostEntry], dict]:
+    """CostEntry per candidate; γ/paged candidates come back unpriced
+    (measure-only) by the honesty rule above.
+
+    The chunk program is proxied by the LM forward at ``[1, width]``
+    (the chunk's dominant work; the cache-write epilogue is
+    width-independent), the decode program by the forward at
+    ``[slots, 1]`` — both priced once per distinct shape and shared
+    across every candidate.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddp_tpu.models.lm import dense_lm_apply
+
+    coster = coster or ProgramCoster()
+    fwd = jax.jit(functools.partial(dense_lm_apply, spec))
+    width_price: dict[int, dict] = {}
+
+    def price_width(w: int) -> dict:
+        if w not in width_price:
+            width_price[w] = coster.price(
+                "tune.chunk_forward", fwd, params,
+                jnp.zeros((1, w), jnp.int32),
+            )
+        return width_price[w]
+
+    decode_price = coster.price(
+        "tune.decode_forward", fwd, params,
+        jnp.zeros((slots, 1), jnp.int32),
+    )
+    total_new = new_tokens * len(prompt_lens)
+    entries: list[CostEntry] = []
+    for cand in report.candidates:
+        eff = report.resolved[cand.key()]
+        if eff.get("spec_tokens") or eff.get("page_size"):
+            entries.append(
+                CostEntry(key=cand.key(), detail={"measure_only": True})
+            )
+            continue
+        counts = plan_chunk_counts(
+            eff,
+            prompt_lens,
+            slots=slots,
+            prefill_len=max(prompt_lens),
+            total_len=spec.total_len,
+        )
+        flops = bytes_acc = 0.0
+        mem = 0.0
+        known = True
+        for w, n in counts.items():
+            p = price_width(w)
+            if p["flops"] is None or p["bytes_accessed"] is None:
+                known = False
+                break
+            flops += n * p["flops"]
+            bytes_acc += n * p["bytes_accessed"]
+            mem = max(mem, p["memory_bytes"] or 0)
+        # Decode work is candidate-independent on this subspace but
+        # keeps the totals in real units.
+        decode_steps = -(-total_new // max(1, min(slots, len(prompt_lens))))
+        if known and decode_price["flops"] is not None:
+            flops += decode_steps * decode_price["flops"]
+            bytes_acc += decode_steps * (decode_price["bytes_accessed"] or 0)
+            mem = max(mem, decode_price["memory_bytes"] or 0)
+        entries.append(
+            CostEntry(
+                key=cand.key(),
+                flops=flops if known else None,
+                bytes_accessed=bytes_acc if known else None,
+                memory_bytes=mem if known and mem else None,
+                detail={
+                    "chunk_counts": {str(k): v for k, v in counts.items()},
+                    "decode_steps": decode_steps,
+                },
+            )
+        )
+    meta = {
+        "priced_widths": sorted(width_price),
+        "compiles": len(width_price) + 1,
+    }
+    return entries, meta
+
+
+# ---- zero site ------------------------------------------------------
+
+
+def price_zero_candidates(
+    params,
+    world: int,
+    report: SpaceReport,
+    *,
+    dcn: int = 1,
+    grad_accum_steps: int = 1,
+) -> list[CostEntry]:
+    """Analytic pricing via the strategy's own ``zero_comm_bytes``:
+    bytes = per-step collective payload, memory = bucket padding
+    overhead. FLOPs are knob-independent here (same update math), so
+    that axis stays unpriced."""
+    import jax.numpy as jnp
+
+    from ddp_tpu.parallel.zero import build_layout, zero_comm_bytes
+
+    entries: list[CostEntry] = []
+    for cand in report.candidates:
+        knobs = cand.knobs
+        layout = build_layout(
+            params, world, bucket_mb=knobs["zero_bucket_mb"]
+        )
+        gd = (
+            jnp.bfloat16
+            if knobs["zero_gather_dtype"] == "bf16"
+            else jnp.float32
+        )
+        comm = zero_comm_bytes(
+            layout,
+            world,
+            grad_accum_steps=grad_accum_steps,
+            dcn=dcn,
+            gather_dtype=gd,
+            hier=bool(knobs.get("hier")),
+        )
+        total = comm.get("total")
+        if total is None:
+            total = sum(
+                v for v in comm.values() if isinstance(v, (int, float))
+            )
+        entries.append(
+            CostEntry(
+                key=cand.key(),
+                bytes_accessed=float(total),
+                memory_bytes=float(layout.padded_total * 4),
+                detail={
+                    "buckets": len(layout.buckets),
+                    "comm": {
+                        k: v
+                        for k, v in comm.items()
+                        if isinstance(v, (int, float))
+                    },
+                },
+            )
+        )
+    return entries

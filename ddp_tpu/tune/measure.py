@@ -1,0 +1,164 @@
+"""Measurement stage: time the cost-model survivors for real.
+
+The arbiter. Each surviving candidate is run through the SAME
+harness bench.py's serve benches use — build the engine, ``warmup()``
+under the compile-budget assert, drive a deterministic greedy
+traffic trace, read step-latency p50/p99 off the engine's own
+``StatSummary`` — **with the transfer guard armed**
+(``sanitize=True``: the runtime sanitizer around the steady-state
+decode dispatch) and with every candidate's token streams captured,
+so a tuned config is correctness-checked in the same run that times
+it: the tuner asserts each candidate's streams are identical to the
+default config's before it may win. Tuning changes speed, never
+results.
+
+The zero site's wall-clock is the bucketed pack/unpack round-trip —
+the host+dispatch overhead that scales with bucket count (the
+collective cost is priced analytically upstream; on a single-host
+CPU a timed collective would be a dishonest null anyway).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ddp_tpu.utils.metrics import StatSummary
+
+
+def canonical_trace(
+    *,
+    vocab_size: int,
+    prefill_len: int,
+    requests: int = 6,
+    new_tokens: int = 8,
+    seed: int = 0,
+) -> list[dict]:
+    """Deterministic greedy traffic: prompt lengths sweep the bucket
+    range (1 → prefill_len) so every candidate's bucket geometry is
+    exercised, including the edges where a bad min_bucket would
+    show."""
+    import random
+
+    rng = random.Random(seed)
+    out = []
+    for i in range(requests):
+        # Even coverage of short/medium/full prompts, deterministic.
+        plen = max(1, round((i + 1) * prefill_len / requests))
+        out.append(
+            {
+                "prompt": [rng.randrange(vocab_size) for _ in range(plen)],
+                "max_new_tokens": new_tokens,
+                "seed": i,
+            }
+        )
+    return out
+
+
+def measure_serve(
+    spec,
+    params,
+    knobs: dict[str, Any],
+    *,
+    trace: list[dict],
+    slots: int = 4,
+    prefill_len: Optional[int] = None,
+    draft_spec=None,
+    draft_params=None,
+    sanitize: bool = True,
+) -> dict:
+    """Build + time one engine config → {p50, p99, wall_s, tokens,
+    compile_programs}.
+
+    ``tokens`` maps rid → the completed stream (the identity surface);
+    the compile-budget and no-steady-state-recompile asserts are the
+    bench harness's, verbatim — a candidate that recompiles mid-run
+    is a broken candidate, not a slow one.
+    """
+    from ddp_tpu.serve.engine import ServeEngine
+
+    eng = ServeEngine(
+        spec,
+        params,
+        slots=slots,
+        prefill_len=prefill_len,
+        prefill_chunk=knobs.get("prefill_chunk"),
+        min_bucket=knobs.get("min_bucket"),
+        step_token_budget=knobs.get("step_token_budget"),
+        page_size=knobs.get("page_size", 0) or 0,
+        kv_pages=knobs.get("kv_pages"),
+        spec_tokens=knobs.get("spec_tokens", 0) or 0,
+        draft_spec=draft_spec if knobs.get("spec_tokens") else None,
+        draft_params=draft_params if knobs.get("spec_tokens") else None,
+        sanitize=sanitize,
+        trace_seed=0,
+    )
+    counts = eng.warmup()
+    assert sum(counts.values()) <= eng.compile_budget(), (
+        f"candidate {knobs} exceeded the compile budget: {counts}"
+    )
+    for r in trace:
+        adm = eng.submit(
+            r["prompt"], r["max_new_tokens"], seed=r.get("seed", 0)
+        )
+        assert adm.accepted, (
+            f"canonical trace rejected under {knobs}: {adm.reason}"
+        )
+    if eng.pending:
+        eng.step()  # settle: first step pays dispatch warm-up
+    eng.step_latency = StatSummary()
+    t0 = time.perf_counter()
+    while eng.pending:
+        eng.step()
+    wall = time.perf_counter() - t0
+    assert eng.compile_counts() == counts, (
+        f"candidate {knobs} recompiled in steady state: "
+        f"{eng.compile_counts()} vs {counts}"
+    )
+    tokens = {
+        rid: list(c.tokens) for rid, c in sorted(eng._completed.items())
+    }
+    return {
+        "p50": eng.step_latency.percentile(50),
+        "p99": eng.step_latency.percentile(99),
+        "steps": eng.step_latency.count,
+        "wall_s": wall,
+        "tokens": tokens,
+        "compile_programs": sum(counts.values()),
+    }
+
+
+def measure_zero_pack(
+    params,
+    world: int,
+    bucket_mb: float,
+    *,
+    iters: int = 5,
+) -> dict:
+    """Wall-clock of the bucketed flatten→unflatten round-trip.
+
+    The bucket_mb knob's host-visible cost: more buckets = more
+    per-bucket dispatches and pad/slice work. Collective time is NOT
+    in here (priced analytically; single-host CPU collectives are a
+    null) — honest about what a CPU can measure.
+    """
+    import jax
+
+    from ddp_tpu.parallel import zero as zmod
+
+    layout = zmod.build_layout(params, world, bucket_mb=bucket_mb)
+    leaves = jax.tree_util.tree_leaves(params)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        buckets = zmod._flatten_buckets(layout, leaves)
+        out = zmod._unflatten_buckets(layout, buckets, leaves)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {
+        "p50": times[len(times) // 2],
+        "best": times[0],
+        "buckets": len(layout.buckets),
+        "iters": iters,
+    }

@@ -1,0 +1,249 @@
+"""The tuner: enumerate → cost-prune → measure → persist.
+
+One function per site, each returning a JSON-ready report with the
+full accounting (proposed / rejected / aliased / pruned fraction /
+measured count / search wall-clock) so downstream surfaces (bench
+records, ``scripts/autotune.py`` output, docs) never have to guess
+what the search did. A warm cache short-circuits the whole pipeline:
+``cache_hit=True, measured=0`` — zero search cost, the property
+bench.py's ``tune`` entry asserts.
+
+Winner selection is measured, not modeled: the default config is
+ALWAYS in the measured set, and the winner is the measured-p50
+argmin — so the tuned config is **no worse than the default by
+construction** (equality when the default wins), and every measured
+candidate's token streams must equal the default's before it is
+eligible (identity asserted in :func:`tune_serve`, the
+speed-not-results contract).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ddp_tpu.tune import cache as tcache
+from ddp_tpu.tune import costmodel, measure, space
+
+
+def _report_base(site: str, key: str) -> dict:
+    return {
+        "site": site,
+        "key": key,
+        "cache_hit": False,
+        "measured": 0,
+        "search_wall_s": 0.0,
+    }
+
+
+def _cache_hit_report(site: str, key: str, ent: dict) -> dict:
+    rep = _report_base(site, key)
+    rep.update(
+        cache_hit=True,
+        config=dict(ent["config"]),
+        provenance=dict(ent.get("provenance", {})),
+    )
+    return rep
+
+
+def tune_serve(
+    spec,
+    params,
+    *,
+    cache: Optional[tcache.TuningCache] = None,
+    slots: int = 4,
+    prefill_len: Optional[int] = None,
+    draft_spec=None,
+    draft_params=None,
+    spec_tokens_grid: tuple[int, ...] = (0,),
+    page_sizes: tuple[int, ...] = (0,),
+    trace: Optional[list[dict]] = None,
+    max_measure: int = 4,
+    force: bool = False,
+) -> dict:
+    """Tune the serve scheduler site for one (model shape, hardware).
+
+    ``max_measure`` bounds wall-clock (survivors beyond it, ordered by
+    modeled cost, are deferred) — the deferral is REPORTED
+    (``measure_deferred``), never silent.
+    """
+    key = tcache.cache_key("serve", tcache.model_signature(spec))
+    if cache is not None and not force:
+        ent = cache.lookup(key)
+        if ent is not None:
+            return _cache_hit_report("serve", key, ent)
+    t0 = time.perf_counter()
+    rep = _report_base("serve", key)
+    sp = space.serve_space(
+        spec,
+        slots=slots,
+        prefill_len=prefill_len,
+        spec_tokens=spec_tokens_grid,
+        page_sizes=page_sizes,
+        draft_spec=draft_spec,
+    )
+    rep.update(proposed=sp.proposed, rejected=sp.rejected, aliased=sp.aliased)
+    resolved_pl = prefill_len or max(1, spec.total_len // 2)
+    if trace is None:
+        trace = measure.canonical_trace(
+            vocab_size=spec.vocab_size, prefill_len=resolved_pl
+        )
+    prompt_lens = [len(r["prompt"]) for r in trace]
+    new_tokens = max(r["max_new_tokens"] for r in trace)
+    entries, price_meta = costmodel.price_serve_candidates(
+        spec,
+        params,
+        sp,
+        slots=slots,
+        prompt_lens=prompt_lens,
+        new_tokens=new_tokens,
+    )
+    survivors, pruned = costmodel.prune_dominated(entries)
+    rep.update(
+        priced=sum(1 for e in entries if e.priced),
+        pruned=len(pruned),
+        pruned_fraction=(
+            round(len(pruned) / len(entries), 4) if entries else 0.0
+        ),
+        cost_compiles=price_meta["compiles"],
+    )
+    # Measured set: the default config first (the baseline every
+    # candidate must beat AND match token-for-token), then survivors
+    # in modeled-cost order up to the budget.
+    by_key = {c.key(): c for c in sp.candidates}
+    order = sorted(
+        survivors,
+        key=lambda e: (
+            e.flops if e.flops is not None else float("inf"),
+            e.key,
+        ),
+    )
+    deferred = max(0, len(order) - max_measure)
+    if deferred:
+        rep["measure_deferred"] = deferred
+    order = order[:max_measure]
+    default = measure.measure_serve(
+        spec,
+        params,
+        {},
+        trace=trace,
+        slots=slots,
+        prefill_len=prefill_len,
+        draft_spec=draft_spec,
+        draft_params=draft_params,
+    )
+    measured: dict[str, dict] = {"default": default}
+    for e in order:
+        cand = by_key[e.key]
+        m = measure.measure_serve(
+            spec,
+            params,
+            cand.knobs,
+            trace=trace,
+            slots=slots,
+            prefill_len=prefill_len,
+            draft_spec=draft_spec,
+            draft_params=draft_params,
+        )
+        assert m["tokens"] == default["tokens"], (
+            f"candidate {cand.knobs} changed token streams — tuning "
+            "must change speed, never results"
+        )
+        measured[e.key] = m
+    rep["measured"] = len(measured)
+    winner_key = min(measured, key=lambda k: measured[k]["p50"])
+    winner_knobs = (
+        {} if winner_key == "default" else by_key[winner_key].knobs
+    )
+    rep.update(
+        default_p50=default["p50"],
+        tuned_p50=measured[winner_key]["p50"],
+        winner=winner_key,
+        config=winner_knobs,
+        search_wall_s=round(time.perf_counter() - t0, 3),
+    )
+    if cache is not None:
+        cache.store(
+            key,
+            winner_knobs,
+            provenance={
+                "winner": winner_key,
+                "default_p50": default["p50"],
+                "tuned_p50": measured[winner_key]["p50"],
+                "pruned_fraction": rep["pruned_fraction"],
+                "measured": rep["measured"],
+                "search_wall_s": rep["search_wall_s"],
+            },
+        )
+        cache.save()
+    return rep
+
+
+def tune_zero(
+    params,
+    world: int,
+    *,
+    cache: Optional[tcache.TuningCache] = None,
+    model_sig: str,
+    dcn: int = 1,
+    grad_accum_steps: int = 1,
+    force: bool = False,
+) -> dict:
+    """Tune the zero site: analytic comm pricing + measured
+    pack/unpack round-trip (the honest CPU wall-clock).
+
+    Winner: fewest collective bytes among survivors, pack-p50
+    tie-break — on-fabric bytes dominate; the pack cost separates
+    bucket counts at equal bytes.
+    """
+    key = tcache.cache_key("zero", model_sig)
+    if cache is not None and not force:
+        ent = cache.lookup(key)
+        if ent is not None:
+            return _cache_hit_report("zero", key, ent)
+    t0 = time.perf_counter()
+    rep = _report_base("zero", key)
+    sp = space.zero_space(params, world, dcn=dcn)
+    rep.update(proposed=sp.proposed, rejected=sp.rejected, aliased=sp.aliased)
+    entries = costmodel.price_zero_candidates(
+        params, world, sp, dcn=dcn, grad_accum_steps=grad_accum_steps
+    )
+    survivors, pruned = costmodel.prune_dominated(entries)
+    rep.update(
+        priced=sum(1 for e in entries if e.priced),
+        pruned=len(pruned),
+        pruned_fraction=(
+            round(len(pruned) / len(entries), 4) if entries else 0.0
+        ),
+    )
+    by_key = {c.key(): c for c in sp.candidates}
+    pack_memo: dict[float, dict] = {}
+    scored = []
+    for e in survivors:
+        mb = by_key[e.key].knobs["zero_bucket_mb"]
+        if mb not in pack_memo:
+            pack_memo[mb] = measure.measure_zero_pack(params, world, mb)
+        scored.append((e.bytes_accessed, pack_memo[mb]["p50"], e.key))
+    rep["measured"] = len(pack_memo)
+    scored.sort()
+    winner_key = scored[0][2]
+    winner = by_key[winner_key].knobs
+    rep.update(
+        winner=winner_key,
+        config=dict(winner),
+        pack_p50={str(k): round(v["p50"], 6) for k, v in pack_memo.items()},
+        search_wall_s=round(time.perf_counter() - t0, 3),
+    )
+    if cache is not None:
+        cache.store(
+            key,
+            winner,
+            provenance={
+                "winner": winner_key,
+                "pruned_fraction": rep["pruned_fraction"],
+                "measured": rep["measured"],
+                "search_wall_s": rep["search_wall_s"],
+            },
+        )
+        cache.save()
+    return rep

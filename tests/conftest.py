@@ -170,6 +170,9 @@ _SMOKE_PATTERNS = (
     "test_fleet.py::TestHedging::"
     "test_first_completion_wins_and_loser_cancelled",
     "test_fleet.py::test_render_fleet_gauges_lint_clean",
+    # autotuner (ISSUE 18): the warm-cache-is-free pin — a seeded
+    # cache answers with zero engines built and zero programs priced
+    "test_tune.py::test_cache_hit_is_pure",
     # one real trainer e2e (the priciest smoke entry, ~1 min compile)
     "test_e2e.py::TestEndToEnd::test_train_checkpoints_and_resumes",
 )
@@ -377,6 +380,13 @@ _SLOW_PATTERNS = (
     "test_paged.py::TestTokenIdentity",
     "test_paged.py::TestTransfersAndCompiles::test_no_recompilation_after_warmup",
     "test_paged.py::TestConstructionValidation::test_spec_engine_allocates_reserve_pages",
+    # autotuner (ISSUE 18): the cold search builds 3-4 engines
+    # (~19 s), the engine-vs-engine identity pin builds 2 (~10 s),
+    # the trainer load-path e2e trains a real zero epoch (~6 s);
+    # the space/cost/cache/precedence pins stay in tier-1.
+    "test_tune.py::test_tune_serve_end_to_end",
+    "test_tune.py::test_measured_tokens_identical_across_bucket_edges",
+    "test_tune.py::test_trainer_loads_zero_cache_by_default",
 )
 
 
