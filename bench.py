@@ -1879,6 +1879,133 @@ def run_serve_fleet_bench(
             "tier is not re-warming restarted replicas"
         )
 
+        # Phase 7: fleet-wide distributed tracing (ISSUE 19) — a
+        # FRESH 3-process disagg fleet launched with trace_dir, so
+        # every replica exports its request spans on shutdown and the
+        # router records a span per hop. The merged router+replica
+        # trace dirs must reconstruct ONE causally-valid timeline per
+        # request under a single trace id, prefill handoff and
+        # /pages migration hops included (the acceptance gate).
+        import glob as _glob
+        import subprocess
+        import sys as _sys
+
+        from ddp_tpu.obs.reqtrace import (
+            reconstruct_fleet,
+            validate_fleet_timeline,
+        )
+        from ddp_tpu.obs.tracer import Tracer
+
+        trace_root = os.path.join(workdir, "fleet_trace")
+        tmgr = ReplicaManager(
+            n_replicas,
+            [
+                "--init_demo",
+                "--slots", str(slots),
+                "--page_size", str(page_size),
+                "--vocab_size", str(vocab),
+                "--seq_len", str(seq_len),
+            ],
+            workdir=os.path.join(workdir, "trace_fleet"),
+            max_restarts=1,
+            restart_backoff=0.2,
+            roles=[ROLE_PREFILL, ROLE_DECODE, ROLE_DECODE],
+            trace_dir=trace_root,
+        )
+        fleet_tracer = Tracer(enabled=True)
+        tprobe = make_prompts(707)[::per_group]
+        try:
+            tmgr.start()
+            assert tmgr.wait_healthy(420), (
+                "trace fleet never became healthy"
+            )
+            trouter = tmgr.attach_router(
+                Router(
+                    tmgr.replicas,
+                    RouterConfig(
+                        affinity=True,
+                        affinity_page=page_size,
+                        disagg=True,
+                        prefill_cutoff_tokens=cutoff,
+                        trace_seed=707,
+                    ),
+                    tracer=fleet_tracer,
+                )
+            )
+            traced = []
+            for p in tprobe:
+                status, payload = trouter.dispatch(
+                    {"prompt_tokens": p, "max_new_tokens": new_tokens}
+                )
+                assert status == 200, payload
+                traced.append(payload["router"])
+            # Per-hop seconds on the router digest — queue/dispatch on
+            # every request, handoff/migrate on at least one.
+            for d in traced:
+                hops = d.get("hops") or {}
+                assert "queue_s" in hops and "dispatch_s" in hops, d
+            migrated_digests = [
+                d for d in traced if "migrate_s" in d.get("hops", {})
+            ]
+            assert migrated_digests, (
+                "trace phase never migrated pages — no migration hop "
+                "to validate"
+            )
+            tstate = trouter.state()
+            assert (
+                tstate.get("trace_propagated_total") == len(tprobe)
+            ), tstate
+            assert "dispatch" in (tstate.get("hop_seconds") or {}), (
+                tstate
+            )
+        finally:
+            # Graceful drain, not the default 0.1s SIGKILL: each
+            # replica exports its trace file on the SIGTERM path, and
+            # a killed process exports nothing.
+            tmgr.stop(drain_timeout=60)
+        fleet_tracer.export_to_dir(os.path.join(trace_root, "router"))
+        trace_dirs = [os.path.join(trace_root, "router")] + sorted(
+            _glob.glob(os.path.join(trace_root, "replica*"))
+        )
+        assert len(trace_dirs) == n_replicas + 1, trace_dirs
+        merged_path = os.path.join(trace_root, "merged.trace.json")
+        proc = subprocess.run(
+            [
+                _sys.executable,
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "scripts", "trace_merge.py",
+                ),
+                *trace_dirs, "-o", merged_path,
+            ],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+        with open(merged_path) as f:
+            merged_doc = json.load(f)
+        fleet_side = merged_doc["ddp_tpu"].get("fleet") or {}
+        assert fleet_side.get("count") == len(tprobe), fleet_side
+        assert fleet_side.get("causal_ok") == len(tprobe), fleet_side
+        assert fleet_side.get("migrated", 0) >= 1, fleet_side
+        # The single-trace-id gate, re-derived from raw events: the
+        # migrated request's router hop chain and its replica
+        # admit→retire timeline reconstruct under ONE id and pass
+        # causal validation (dispatch before admit, export before
+        # install, exactly one winning decode path).
+        fleet_map = reconstruct_fleet(merged_doc["traceEvents"])
+        mig_tid = migrated_digests[0]["trace_id"]
+        assert mig_tid in fleet_map, (mig_tid, sorted(fleet_map))
+        mig_summary = validate_fleet_timeline(fleet_map[mig_tid])
+        assert mig_summary["migrated"], mig_summary
+        fleet_trace = {
+            "requests": len(tprobe),
+            "causal_ok": fleet_side["causal_ok"],
+            "migrated": fleet_side["migrated"],
+            "hop_p99_s": fleet_side.get("hop_p99_s"),
+            "validated_trace_id": mig_tid,
+            "winner_replica": mig_summary["winner_replica"],
+        }
+
         # The headline assert: affinity must beat random dispatch on
         # per-replica prefix-hit rate — the reason the router hashes
         # prompts at all. A routing fact, not a timing fact.
@@ -1911,6 +2038,7 @@ def run_serve_fleet_bench(
             },
             churn_affinity_only=churn_affinity,
             churn_directory=churn_directory,
+            fleet_trace=fleet_trace,
             n_replicas=n_replicas,
             slots=slots,
             page_size=page_size,
@@ -3360,6 +3488,28 @@ def _finalize(record: dict) -> dict:
             + " | TPU backend unreachable this capture; last_tpu is the "
             "most recent driver/builder-verified on-chip record"
         ).lstrip(" |")
+        # Staleness alarm (ISSUE 19 satellite): a CPU-fallback capture
+        # leaning on an LKG more than a week old is quietly comparing
+        # against history — say so LOUDLY on stderr and in the record
+        # itself, so a long outage can't masquerade as a fresh
+        # on-chip trajectory point.
+        try:
+            import datetime
+
+            captured = datetime.date.fromisoformat(lkg.get("captured"))
+            age = (datetime.date.today() - captured).days
+            if age > 7 and record.get("cpu_fallback"):
+                record["last_tpu_stale_days"] = age
+                print(
+                    f"bench: WARNING — BENCH_LKG.json is {age} days "
+                    f"old (captured {captured.isoformat()}) and this "
+                    "capture is a CPU fallback; the embedded last_tpu "
+                    "numbers are STALE, not a current on-chip "
+                    "measurement",
+                    file=sys.stderr,
+                )
+        except (TypeError, ValueError):
+            pass  # undated LKG — the embed above still carries it
     except (OSError, ValueError, KeyError):
         pass  # no LKG on disk — nothing to carry
     return record

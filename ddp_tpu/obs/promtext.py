@@ -597,6 +597,32 @@ def render_fleet(
             snap.get("migration_seconds"),
             help="one migration's export + push wall time",
         )
+    # Fleet-tracing series (PR 19): keys absent from an untraced
+    # router's state(), so the exposition stays byte-identical with
+    # tracing off (the same gate the disaggregation block rides).
+    b.add(
+        "ddp_tpu_fleet_trace_propagated_total",
+        snap.get("trace_propagated_total"),
+        metric_type="counter",
+        help="completed requests whose serving replica adopted the "
+        "router's trace context (echoed the trace id back)",
+    )
+    b.add(
+        "ddp_tpu_fleet_trace_orphaned_total",
+        snap.get("trace_orphaned_total"),
+        metric_type="counter",
+        help="completed requests whose replica did NOT echo the "
+        "router's trace id — its timeline is orphaned from the hops",
+    )
+    for kind, hop_snap in sorted(
+        (snap.get("hop_seconds") or {}).items()
+    ):
+        b.summary(
+            "ddp_tpu_fleet_hop_seconds", hop_snap,
+            labels={"hop": kind},
+            help="per-hop router latency (dispatch, prefill_handoff, "
+            "migrate, breaker_wait, ...) by hop kind",
+        )
     _render_build_info(b, snap.get("build_info"), "ddp_tpu_build_info")
     return b.render()
 

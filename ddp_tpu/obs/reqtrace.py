@@ -50,6 +50,23 @@ RETIRE = "req.retire"
 
 ASYNC_CAT = "request"
 
+# Fleet hop taxonomy (docs/OBSERVABILITY.md "Fleet-wide tracing").
+# Router-side spans live on their own async category: hop spans start
+# BEFORE the replica's admit, so they cannot share the "request"
+# category whose validator bounds every event inside admit..retire.
+HOP_CAT = "hop"
+HOP_DISPATCH = "hop.dispatch"
+HOP_RETRY = "hop.retry"
+HOP_HEDGE = "hop.hedge"
+HOP_BREAKER_WAIT = "hop.breaker_wait"
+HOP_HANDOFF = "hop.prefill_handoff"
+HOP_MIGRATE = "hop.migrate"
+HOP_MIGRATE_EXPORT = "hop.migrate_export"
+HOP_MIGRATE_INSTALL = "hop.migrate_install"
+
+# MPMD per-step spans (parallel/mpmd.py) — same mechanism, third cat.
+STEP_CAT = "step"
+
 # Bound on retired timelines kept for /requestz (per engine) — a
 # week-long serving process must not grow a timeline per request
 # forever, same discipline as the tracer ring.
@@ -80,6 +97,57 @@ def format_trace_id(trace_id: int) -> str:
     return f"0x{int(trace_id) & 0xFFFFFFFFFFFFFFFF:016x}"
 
 
+def derive_span_id(trace_id: int, salt: int) -> int:
+    """A span id under ``trace_id`` (one per router attempt / hop).
+
+    Deterministic in (trace_id, salt) for the same reason
+    :func:`derive_trace_id` is; never zero (0 = "no parent")."""
+    return splitmix64(
+        (int(trace_id) & 0xFFFFFFFFFFFFFFFF) ^ ((int(salt) << 1) | 1)
+    ) or 1
+
+
+def encode_trace_context(
+    trace_id: int, span_id: int, parent_span_id: int = 0
+) -> str:
+    """One-line traceparent-style context: ``00-<trace>-<span>-<parent>``.
+
+    64-bit ids in fixed 16-hex (the house trace-id width), version
+    pinned to ``00``. This single line is what rides the /generate and
+    /pages/export JSON bodies, the DPKV migration header, and the ACTV
+    p2p ``meta`` — the receiver's parent is this line's ``span`` field.
+    """
+    return "00-{:016x}-{:016x}-{:016x}".format(
+        int(trace_id) & 0xFFFFFFFFFFFFFFFF,
+        int(span_id) & 0xFFFFFFFFFFFFFFFF,
+        int(parent_span_id) & 0xFFFFFFFFFFFFFFFF,
+    )
+
+
+def parse_trace_context(line) -> Optional[tuple]:
+    """Parse a trace-context line into ``(trace_id, span_id, parent)``.
+
+    Returns ``None`` on ANY malformation (wrong type, field count,
+    version, width, non-hex, zero trace id) — never raises. A peer
+    sending garbage must cost the receiver one counter bump
+    (``trace_orphaned``), not a crash or a rejected request.
+    """
+    if not isinstance(line, str):
+        return None
+    parts = line.strip().split("-")
+    if len(parts) != 4 or parts[0] != "00":
+        return None
+    if any(len(p) != 16 for p in parts[1:]):
+        return None
+    try:
+        trace_id, span_id, parent = (int(p, 16) for p in parts[1:])
+    except ValueError:
+        return None
+    if trace_id == 0:
+        return None
+    return trace_id, span_id, parent
+
+
 class RequestTrace:
     """One request's event record, hung off the engine's bookkeeping.
 
@@ -92,12 +160,16 @@ class RequestTrace:
     __slots__ = (
         "rid", "trace_id", "events", "admit_t", "bind_t", "retire_t",
         "decode_t0", "decode_end", "decode_steps", "decode_tokens",
-        "chunks", "spec_rounds", "reason", "emitted",
+        "chunks", "spec_rounds", "reason", "emitted", "parent",
     )
 
-    def __init__(self, rid: int, trace_id: int, admit_t: float):
+    def __init__(
+        self, rid: int, trace_id: int, admit_t: float,
+        parent: Optional[str] = None,
+    ):
         self.rid = rid
         self.trace_id = int(trace_id)
+        self.parent = parent  # hex span id of the router attempt
         self.admit_t = admit_t  # perf_counter domain
         self.bind_t: Optional[float] = None
         self.retire_t: Optional[float] = None
@@ -170,6 +242,7 @@ class RequestTrace:
         end = self.retire_t if self.retire_t is not None else self.admit_t
         out: dict[str, Any] = {
             "trace_id": format_trace_id(self.trace_id),
+            **({"parent": self.parent} if self.parent else {}),
             "queue_s": round(
                 (self.bind_t if self.bind_t is not None else end)
                 - self.admit_t, 6,
@@ -232,15 +305,25 @@ class RequestTrace:
             return
         aid = format_trace_id(self.trace_id)
         end = self.retire_t if self.retire_t is not None else self.admit_t
+
+        def _args(a):
+            # An adopted request stamps its router-attempt parent span
+            # onto EVERY event so a merged fleet document can tell the
+            # hedge winner's decode path from the cancelled loser's —
+            # both hang off the same trace id. Absent when not adopted.
+            if self.parent is None:
+                return a
+            return {**(a or {}), "parent": self.parent}
+
         tracer.async_complete(
             REQUEST_SPAN, self.admit_t, end - self.admit_t, aid,
-            {"rid": self.rid, "reason": self.reason},
+            _args({"rid": self.rid, "reason": self.reason}),
         )
         for name, t0, dur, args in self.events:
             if dur > 0.0:
-                tracer.async_complete(name, t0, dur, aid, args)
+                tracer.async_complete(name, t0, dur, aid, _args(args))
             else:
-                tracer.async_instant(name, t0, aid, args)
+                tracer.async_instant(name, t0, aid, _args(args))
         self.emitted = True
 
 
@@ -256,8 +339,10 @@ class RequestTracer:
         self._live: dict[int, RequestTrace] = {}
         self._retired: "OrderedDict[int, RequestTrace]" = OrderedDict()
 
-    def admit(self, rid: int, trace_id: int) -> RequestTrace:
-        t = RequestTrace(rid, trace_id, self.clock())
+    def admit(
+        self, rid: int, trace_id: int, parent: Optional[str] = None
+    ) -> RequestTrace:
+        t = RequestTrace(rid, trace_id, self.clock(), parent=parent)
         self._live[rid] = t
         return t
 
@@ -331,22 +416,29 @@ class RequestTracer:
 # ---- reconstruction from exported traces -----------------------------
 
 
-def reconstruct_requests(events: list[dict]) -> dict[str, list[dict]]:
+def reconstruct_requests(
+    events: list[dict], cat: str = ASYNC_CAT
+) -> dict[str, list[dict]]:
     """Group a trace document's async request events by trace id.
 
     Input is ``traceEvents`` (one rank's file or a merged document);
     output maps hex trace id → that request's events as
     ``{"name", "ph", "ts", "dur"?, "args"?}`` sorted by (ts, begin-
     before-end). ``b``/``e`` pairs are folded into one entry carrying
-    ``dur`` (matched per (id, name) as a stack, the nestable-async
-    contract); unmatched begins surface with ``dur: None`` so a torn
-    ring still reconstructs partially instead of raising.
+    ``dur`` (matched per (pid, id, name) as a stack, the nestable-
+    async contract — pid scopes the fold so two PROCESSES emitting
+    the same span name under one trace id, a hedge winner and its
+    cancelled loser, never cross-pair in a merged document);
+    unmatched begins surface with ``dur: None`` so a torn ring still
+    reconstructs partially instead of raising. ``cat`` selects the
+    async category — "request" (default, the engine's lifecycle
+    events), "hop" (router spans), or "step" (MPMD stages).
     """
     by_id: dict[str, list[dict]] = {}
     open_spans: dict[tuple, list[dict]] = {}
     order = {"b": 0, "n": 1, "e": 2}
     for ev in sorted(
-        (e for e in events if e.get("cat") == ASYNC_CAT
+        (e for e in events if e.get("cat") == cat
          and e.get("ph") in ("b", "e", "n")),
         key=lambda e: (e.get("ts", 0), order.get(e.get("ph"), 3)),
     ):
@@ -364,9 +456,11 @@ def reconstruct_requests(events: list[dict]) -> dict[str, list[dict]]:
                 **({"args": ev["args"]} if ev.get("args") else {}),
             }
             by_id.setdefault(aid, []).append(entry)
-            open_spans.setdefault((aid, ev["name"]), []).append(entry)
+            open_spans.setdefault(
+                (ev.get("pid"), aid, ev["name"]), []
+            ).append(entry)
         else:  # "e"
-            stack = open_spans.get((aid, ev["name"]))
+            stack = open_spans.get((ev.get("pid"), aid, ev["name"]))
             if stack:
                 entry = stack.pop()
                 entry["dur"] = round(ev["ts"] - entry["ts"], 3)
@@ -452,4 +546,113 @@ def validate_request_timeline(timeline: list[dict]) -> dict:
         "spec_rounds": len(named.get(SPEC_ROUND, [])),
         "queue_us": queue["dur"] if queue else None,
         "total_us": round(t_retire - t_admit, 3),
+    }
+
+
+# ---- fleet reconstruction (router hops + N replica timelines) --------
+
+
+def reconstruct_fleet(events: list[dict]) -> dict[str, dict]:
+    """Join router hop spans and replica request events per trace id.
+
+    Input is a MERGED document's ``traceEvents`` (the router's trace
+    dir plus every replica's); output maps hex trace id →
+    ``{"hops": [...], "request": [...]}`` where each list is the
+    :func:`reconstruct_requests` shape for that category. Ids with
+    hops but no request events (an orphaned dispatch — the replica
+    never adopted, or its ring was lost to a SIGKILL) still appear so
+    the validator can name what is missing.
+    """
+    hops = reconstruct_requests(events, cat=HOP_CAT)
+    reqs = reconstruct_requests(events, cat=ASYNC_CAT)
+    return {
+        aid: {"hops": hops[aid], "request": reqs.get(aid, [])}
+        for aid in sorted(hops)
+    }
+
+
+def validate_fleet_timeline(fleet: dict) -> dict:
+    """Causal check for ONE request's cross-process fleet timeline.
+
+    ``fleet`` is one value of :func:`reconstruct_fleet`. Raises
+    ``ValueError`` naming the violated invariant; returns a summary on
+    success. The invariants are the router↔replica contract:
+
+    - at least one ``hop.dispatch`` span, exactly ONE marked winner;
+    - exactly one replica admit whose ``parent`` is the winning
+      dispatch's span id (hedge losers and pre-replay attempts may
+      add more admits under the same trace id — they must NOT win);
+    - the winning dispatch begins before that admit (cross-process
+      clocks: both sides anchor perf_counter to time.time, so a
+      generous epsilon absorbs the anchoring jitter — the real gap is
+      a full HTTP round trip);
+    - every migration export ends before its paired install begins,
+      and prefill handoff/migration staging precede the winning
+      dispatch (router-local clock, tight epsilon);
+    - the winning replica's own admit→retire timeline passes
+      :func:`validate_request_timeline`.
+    """
+    eps_local = 1.0       # µs — one process's own clock
+    eps_cross = 5000.0    # µs — router vs replica perf anchoring
+    hops = fleet.get("hops") or []
+    request = fleet.get("request") or []
+    dispatches = [h for h in hops if h["name"] == HOP_DISPATCH]
+    if not dispatches:
+        raise ValueError("no hop.dispatch span")
+    winners = [
+        d for d in dispatches if (d.get("args") or {}).get("winner")
+    ]
+    if len(winners) != 1:
+        raise ValueError(
+            f"expected exactly one winning dispatch, saw {len(winners)}"
+        )
+    winner = winners[0]
+    wspan = (winner.get("args") or {}).get("span")
+    if not wspan:
+        raise ValueError("winning dispatch carries no span id")
+    admits = [e for e in request if e["name"] == ADMIT]
+    if not admits:
+        raise ValueError("no replica admit for this trace id")
+    won_admits = [
+        a for a in admits if (a.get("args") or {}).get("parent") == wspan
+    ]
+    if len(won_admits) != 1:
+        raise ValueError(
+            "expected exactly one admit adopted from the winning "
+            f"dispatch, saw {len(won_admits)}"
+        )
+    if winner["ts"] - eps_cross > won_admits[0]["ts"]:
+        raise ValueError("router dispatch follows replica admit")
+    exports = [h for h in hops if h["name"] == HOP_MIGRATE_EXPORT]
+    installs = [h for h in hops if h["name"] == HOP_MIGRATE_INSTALL]
+    for ex, ins in zip(exports, installs):
+        end = ex["ts"] + (ex["dur"] or 0.0)
+        if end - eps_local > ins["ts"]:
+            raise ValueError("migration install precedes its export")
+    for h in hops:
+        if h["name"] in (HOP_HANDOFF, HOP_MIGRATE):
+            if h["ts"] - eps_local > winner["ts"]:
+                raise ValueError(
+                    f"{h['name']} follows the winning dispatch"
+                )
+    # Exactly one winning decode path: every event of the winning
+    # attempt carries the winner's parent span id.
+    winning = [
+        e for e in request
+        if (e.get("args") or {}).get("parent") == wspan
+    ]
+    req_summary = validate_request_timeline(winning)
+    hop_seconds = {}
+    for h in hops:
+        if h.get("ph") == "X" and h.get("dur") is not None:
+            hop_seconds[h["name"]] = round(
+                hop_seconds.get(h["name"], 0.0) + h["dur"] / 1e6, 6
+            )
+    return {
+        "winner_replica": (winner.get("args") or {}).get("replica"),
+        "attempts": len(dispatches),
+        "hedged": any(h["name"] == HOP_HEDGE for h in hops),
+        "migrated": bool(exports),
+        "hop_seconds": hop_seconds,
+        "request": req_summary,
     }

@@ -62,6 +62,14 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ddp_tpu.obs.reqtrace import (
+    STEP_CAT,
+    derive_span_id,
+    derive_trace_id,
+    encode_trace_context,
+    format_trace_id,
+    parse_trace_context,
+)
 from ddp_tpu.runtime import p2p
 from ddp_tpu.runtime.chaos import ChaosEngine, stage_events
 from ddp_tpu.runtime.launch import classify_exit
@@ -480,8 +488,32 @@ class StageRunner:
         self.up: Optional[p2p.Channel] = None
         self.down: Optional[p2p.Channel] = None
         self._p2p_wait = 0.0
+        # Per-step fleet-trace state (PR 19): stage 0 mints one trace
+        # context per optimizer step and every ACT send carries it in
+        # the wire ``meta``; downstream stages adopt from their first
+        # recv of the step, so all S stages' spans share one async
+        # track. (tid, own span, parent span) or None (not adopted /
+        # tracing off — the None path sends byte-identical frames).
+        self._step_trace: Optional[tuple] = None
+        self._trace_seed = 0
+        self._ext: Dict[str, tuple] = {}  # phase extents for spans
 
     # ---- plumbing ----------------------------------------------------
+
+    def _mark(self, key: str, t0: float, t1: float) -> None:
+        e = self._ext.get(key)
+        self._ext[key] = (
+            (min(t0, e[0]), max(t1, e[1])) if e else (t0, t1)
+        )
+
+    def _trace_meta(self) -> Optional[dict]:
+        """The ACT sends' wire ``meta`` — the step's context line when
+        this stage holds one, else None (``meta=None`` serializes as
+        the empty dict every untraced frame already carries)."""
+        st = self._step_trace
+        if st is None or not self.tracer.enabled:
+            return None
+        return {"trace": encode_trace_context(st[0], st[1], st[2])}
 
     def _recv(self, ch: p2p.Channel, kind: str, step: int, mb: int):
         t0 = time.perf_counter()
@@ -494,7 +526,19 @@ class StageRunner:
                 abort=self.ctrl.abort,
                 timeout=self.cfg.io_timeout_s,
             )
-        self._p2p_wait += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self._p2p_wait += t1 - t0
+        self._mark("p2p_wait", t0, t1)
+        if self.tracer.enabled and self._step_trace is None:
+            # Adopt the upstream step context: our own span is salted
+            # with the stage index, the sender's span becomes parent.
+            ctx = parse_trace_context(
+                (getattr(msg, "meta", None) or {}).get("trace")
+            )
+            if ctx is not None:
+                self._step_trace = (
+                    ctx[0], derive_span_id(ctx[0], self.k), ctx[1]
+                )
         return msg
 
     def _open_links(self, endpoints: Dict[str, int], gen: int) -> None:
@@ -560,6 +604,12 @@ class StageRunner:
         tr = self.tracer
         t_start = time.perf_counter()
         self._p2p_wait = 0.0
+        self._ext = {}
+        if tr.enabled and first:
+            tid = derive_trace_id(self._trace_seed, step)
+            self._step_trace = (tid, derive_span_id(tid, 0), 0)
+        elif not first:
+            self._step_trace = None  # adopted from the first recv
         fwd_s = bwd_s = upd_s = 0.0
         acc: dict = {}
         loss_sum = 0.0
@@ -597,8 +647,13 @@ class StageRunner:
                                     mbs[m],
                                 )
                             )
-                            fwd_s += time.perf_counter() - t0
-                        self.down.send(p2p.KIND_ACT, ws, m, {"x": y})
+                            t1 = time.perf_counter()
+                            fwd_s += t1 - t0
+                            self._mark("fwd", t0, t1)
+                        self.down.send(
+                            p2p.KIND_ACT, ws, m, {"x": y},
+                            meta=self._trace_meta(),
+                        )
                     elif not last:
                         x = self._recv(
                             self.up, p2p.KIND_ACT, ws, m
@@ -612,8 +667,13 @@ class StageRunner:
                             y = np.asarray(
                                 self.progs.fwd(self.part["stage"], x)
                             )
-                            fwd_s += time.perf_counter() - t0
-                        self.down.send(p2p.KIND_ACT, ws, m, {"x": y})
+                            t1 = time.perf_counter()
+                            fwd_s += t1 - t0
+                            self._mark("fwd", t0, t1)
+                        self.down.send(
+                            p2p.KIND_ACT, ws, m, {"x": y},
+                            meta=self._trace_meta(),
+                        )
                     else:
                         # last stage's forward slot is recv+stash only
                         # — the bwd vjp recomputes the stage with the
@@ -637,7 +697,9 @@ class StageRunner:
                                 self.part["stage"], lp, x, mbs[m]
                             )
                             gx = np.asarray(gx)
-                            bwd_s += time.perf_counter() - t0
+                            t1 = time.perf_counter()
+                            bwd_s += t1 - t0
+                            self._mark("bwd", t0, t1)
                         self.up.send(p2p.KIND_COT, ws, m, {"g": gx})
                         loss_sum += float(loss)
                         correct += float(corr)
@@ -660,7 +722,9 @@ class StageRunner:
                                 g,
                             )
                             jax.block_until_ready(gs)
-                            bwd_s += time.perf_counter() - t0
+                            t1 = time.perf_counter()
+                            bwd_s += t1 - t0
+                            self._mark("bwd", t0, t1)
                         add("stage", gs)
                         add("front", gf)
                     else:
@@ -677,7 +741,9 @@ class StageRunner:
                                 self.part["stage"], x, g
                             )
                             gx = np.asarray(gx)
-                            bwd_s += time.perf_counter() - t0
+                            t1 = time.perf_counter()
+                            bwd_s += t1 - t0
+                            self._mark("bwd", t0, t1)
                         self.up.send(p2p.KIND_COT, ws, m, {"g": gx})
                         add("stage", gs)
             if stash:
@@ -801,6 +867,70 @@ class StageRunner:
             p2p_wait_s=round(self._p2p_wait, 6),
             bubble_s=round(max(0.0, wall - fwd_s - bwd_s - upd_s), 6),
         )
+        self._emit_step_trace(step, t_start, wall, fwd_s, bwd_s, upd_s)
+
+    def _emit_step_trace(
+        self,
+        step: int,
+        t_start: float,
+        wall: float,
+        fwd_s: float,
+        bwd_s: float,
+        upd_s: float,
+    ) -> None:
+        """The step's async spans on its fleet trace id (cat="step"):
+        the MPMD analogue of the serve-side request timeline. One
+        umbrella ``mpmd.step`` span per stage plus fwd/bwd/p2p-wait
+        phase spans (extent = first..last occurrence of the phase,
+        ``busy_s`` = the actual compute inside it) and a bubble
+        instant. Non-zero ``parent`` names the upstream stage's span —
+        how the merged trace hangs stage k's work off stage k-1's.
+        Skipped entirely when this stage never adopted a context
+        (tracing off, or a pre-trace upstream peer)."""
+        tr = self.tracer
+        st = self._step_trace
+        if not tr.enabled or st is None:
+            return
+        aid = format_trace_id(st[0])
+        base = {
+            "stage": self.k,
+            "step": step,
+            "span": f"{st[1]:016x}",
+            **({"parent": f"{st[2]:016x}"} if st[2] else {}),
+        }
+        tr.async_complete(
+            "mpmd.step", t_start, wall, aid,
+            {
+                **base,
+                "fwd_s": round(fwd_s, 6),
+                "bwd_s": round(bwd_s, 6),
+                "update_s": round(upd_s, 6),
+                "p2p_wait_s": round(self._p2p_wait, 6),
+            },
+            cat=STEP_CAT,
+        )
+        for name, key, busy in (
+            ("mpmd.fwd", "fwd", fwd_s),
+            ("mpmd.bwd", "bwd", bwd_s),
+            ("mpmd.p2p_wait", "p2p_wait", self._p2p_wait),
+        ):
+            ext = self._ext.get(key)
+            if ext is not None:
+                tr.async_complete(
+                    name, ext[0], ext[1] - ext[0], aid,
+                    {**base, "busy_s": round(busy, 6)},
+                    cat=STEP_CAT,
+                )
+        tr.async_instant(
+            "mpmd.bubble", t_start + wall, aid,
+            {
+                **base,
+                "seconds": round(
+                    max(0.0, wall - fwd_s - bwd_s - upd_s), 6
+                ),
+            },
+            cat=STEP_CAT,
+        )
 
     # ---- lifecycle ---------------------------------------------------
 
@@ -820,6 +950,9 @@ class StageRunner:
         k = self.k
         install_from_env(process_id=k)
         self.tracer = get_tracer()
+        # Stage 0's per-step trace-id space: urandom-seeded so two
+        # runs (or a restarted stage 0) never collide in a merged doc.
+        self._trace_seed = int.from_bytes(os.urandom(8), "little")
         self.mw = MetricsWriter(self.metrics_path)
         self.sched = schedule_1f1b(cfg.num_stages, cfg.num_microbatches)
         self.xprof = Xprof(enabled=True)

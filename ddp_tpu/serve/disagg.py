@@ -110,6 +110,11 @@ class PageFrame:
     table_row: list[int] = field(default_factory=list)
     positions: int = 0
     sampling: dict = field(default_factory=dict)
+    # Fleet trace context ("00-<trace>-<span>-<parent>", reqtrace
+    # format) — None unless the exporting router threaded one through;
+    # the header key is entirely absent in that case, so a tracing-off
+    # fleet's wire bytes are identical to pre-trace builds.
+    trace: Optional[str] = None
 
     @property
     def n_pages(self) -> int:
@@ -127,6 +132,7 @@ def encode_pages(
     table_row=(),
     positions: int = 0,
     sampling: Optional[dict] = None,
+    trace: Optional[str] = None,
 ) -> bytes:
     """Page arrays -> one self-validating binary payload.
 
@@ -177,6 +183,7 @@ def encode_pages(
         "table_row": [int(p) for p in table_row],
         "positions": int(positions),
         "sampling": dict(sampling or {}),
+        **({"trace": str(trace)} if trace is not None else {}),
         "frames": [name for name, _ in frames],
     }
     hbytes = json.dumps(header, separators=(",", ":")).encode()
@@ -244,6 +251,9 @@ def decode_pages(buf: bytes) -> PageFrame:
         table_row = [int(p) for p in header.get("table_row", [])]
         positions = int(header.get("positions", 0))
         sampling = dict(header.get("sampling", {}))
+        trace = header.get("trace")
+        if trace is not None:
+            trace = str(trace)
         frame_names = list(header["frames"])
     except (KeyError, TypeError, ValueError) as e:
         raise PageWireError(HEADER_INVALID, str(e)) from e
@@ -305,4 +315,5 @@ def decode_pages(buf: bytes) -> PageFrame:
         table_row=table_row,
         positions=positions,
         sampling=sampling,
+        trace=trace,
     )

@@ -560,6 +560,13 @@ class ServeEngine:
         self._reqtrace = (
             RequestTracer(keep=reqtrace_keep) if reqtrace else None
         )
+        # Fleet trace adoption accounting (ISSUE 19): valid inbound
+        # contexts adopted vs malformed ones orphaned (counted, never
+        # fatal); router-staged hop seconds parked per rid until the
+        # completion's serve_request record picks them up.
+        self.trace_propagated = 0
+        self.trace_orphaned = 0
+        self._request_hops: dict[int, dict] = {}
         # SLO engine (obs/slo.py): observes every retired request;
         # breach transitions land in the metrics stream AND the flight
         # recorder (the PR-4 post-mortem ring) before any caller hook.
@@ -785,8 +792,31 @@ class ServeEngine:
         top_p: float = 1.0,
         seed: int = 0,
         timeout: Optional[float] = None,
+        trace: Optional[str] = None,
+        hops: Optional[dict] = None,
     ) -> Admission:
-        """Admission-checked enqueue; rejections carry a reason."""
+        """Admission-checked enqueue; rejections carry a reason.
+
+        ``trace`` is an inbound fleet trace-context line (the router's
+        ``00-<trace>-<span>-<parent>``): a VALID one is adopted — the
+        request's trace id becomes the router's, its parent span the
+        router attempt's — and counted ``trace_propagated``; a
+        present-but-malformed one is counted ``trace_orphaned`` and
+        the engine mints locally, exactly as if nothing arrived (a
+        peer's garbage must never reject a request). ``hops`` is the
+        router's staging hop seconds (queue/handoff/migrate), stamped
+        onto this request's ``serve_request`` record so one record
+        answers "which hop paid".
+        """
+        from ddp_tpu.obs.reqtrace import parse_trace_context
+
+        adopted = None
+        if trace is not None:
+            adopted = parse_trace_context(trace)
+            if adopted is None:
+                self.trace_orphaned += 1
+            else:
+                self.trace_propagated += 1
         adm = self.scheduler.submit(
             prompt,
             max_new_tokens,
@@ -794,6 +824,7 @@ class ServeEngine:
             top_p=top_p,
             seed=seed,
             timeout=timeout,
+            trace_id=adopted[0] if adopted else None,
         )
         if not adm.accepted:
             self.reject_counts[adm.reason] = (
@@ -804,11 +835,19 @@ class ServeEngine:
                 reason=adm.reason,
                 queue_depth=self.scheduler.depth,
             )
-        elif self._reqtrace is not None:
+            return adm
+        if hops:
+            self._request_hops[adm.request.rid] = dict(hops)
+        if self._reqtrace is not None:
             # The admit event: the request's 64-bit trace id exists
-            # from this point on (assigned by the scheduler), and the
-            # submit call is already a host-side touch point.
-            self._reqtrace.admit(adm.request.rid, adm.request.trace_id)
+            # from this point on (assigned by the scheduler — or
+            # adopted from the router), and the submit call is already
+            # a host-side touch point.
+            self._reqtrace.admit(
+                adm.request.rid,
+                adm.request.trace_id,
+                parent=f"{adopted[1]:016x}" if adopted else None,
+            )
         return adm
 
     def result(self, rid: int) -> Optional[Completion]:
@@ -946,7 +985,7 @@ class ServeEngine:
 
     # ---- disaggregated serving: page export/install (PR 16) ---------
 
-    def export_prefix(self, tokens) -> Optional[bytes]:
+    def export_prefix(self, tokens, trace=None) -> Optional[bytes]:
         """Ship a cached prefix's raw pages (serve/disagg.py wire
         format): the longest indexed prefix of ``tokens``, at page
         granularity, K/V bytes (plus per-page scales on int8 pools)
@@ -985,6 +1024,10 @@ class ServeEngine:
             ),
             table_row=pids,
             positions=len(covered),
+            # Fleet trace context threaded by the router: rides the
+            # DPKV header so the install side of the migration sees
+            # the same trace id (absent-key byte-identical when off).
+            trace=trace,
         )
 
     def install_prefix(self, frame) -> Optional[dict]:
@@ -1158,6 +1201,19 @@ class ServeEngine:
                     "reqtrace": {
                         "live": self._reqtrace.live_count,
                         "retained": self._reqtrace.retired_count,
+                        # Fleet adoption counters render only once a
+                        # trace context has actually arrived, so a
+                        # classic single-process engine's stats stay
+                        # byte-identical.
+                        **(
+                            {
+                                "propagated": self.trace_propagated,
+                                "orphaned": self.trace_orphaned,
+                            }
+                            if self.trace_propagated
+                            or self.trace_orphaned
+                            else {}
+                        ),
                     }
                 }
                 if self._reqtrace is not None
@@ -1850,6 +1906,18 @@ class ServeEngine:
         # TTFT hit-vs-miss split reads this).
         if c.prefix_hit_tokens is not None:
             fields["prefix_hit_tokens"] = c.prefix_hit_tokens
+        # Per-hop seconds (ISSUE 19): only requests the router staged
+        # with a fleet trace carry the key — the router's queue/
+        # handoff/migrate seconds joined with this engine's own
+        # queue/decode split, so ONE record attributes the whole TTFT.
+        router_hops = self._request_hops.pop(c.rid, None)
+        if router_hops is not None:
+            hops = dict(router_hops)
+            if c.trace is not None:
+                for k in ("queue_s", "prefill_s", "decode_s"):
+                    if c.trace.get(k) is not None:
+                        hops[f"engine_{k}"] = c.trace[k]
+            fields["hops"] = hops
         self.metrics.write("serve_request", **fields)
         # Feed the SLO engine from the same retirement: the SLIs are
         # host floats already in hand, and availability counts every

@@ -49,6 +49,7 @@ work lives in the replica processes.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import http.client
 import itertools
@@ -67,7 +68,18 @@ from urllib.parse import urlsplit
 
 from ddp_tpu.obs.aggregate import classify_unreachable
 from ddp_tpu.obs.reqtrace import (
+    HOP_BREAKER_WAIT,
+    HOP_CAT,
+    HOP_DISPATCH,
+    HOP_HANDOFF,
+    HOP_HEDGE,
+    HOP_MIGRATE,
+    HOP_MIGRATE_EXPORT,
+    HOP_MIGRATE_INSTALL,
+    HOP_RETRY,
+    derive_span_id,
     derive_trace_id,
+    encode_trace_context,
     format_trace_id,
     splitmix64,
 )
@@ -284,12 +296,20 @@ class HttpTransport:
     # ---- the /pages transfer plane (PR 16) --------------------------
 
     def fetch_pages(
-        self, url: str, prompt_tokens: Sequence[int], timeout: float
+        self,
+        url: str,
+        prompt_tokens: Sequence[int],
+        timeout: float,
+        *,
+        trace: Optional[str] = None,
     ) -> tuple[int, bytes]:
         """POST /pages/export on ``url`` → (status, raw body): the
         owner's longest cached prefix of the prompt as one binary
         page frame (200), or its JSON error body (404 prefix_not_
-        found etc.) — the caller only forwards 200 bodies."""
+        found etc.) — the caller only forwards 200 bodies. ``trace``
+        (the router's hop context line) rides the request body and is
+        embedded in the exported DPKV header, so the frame itself
+        names the migration that moved it."""
         sp = urlsplit(url)
         conn = http.client.HTTPConnection(
             sp.hostname, sp.port, timeout=max(0.05, timeout)
@@ -298,7 +318,10 @@ class HttpTransport:
             conn.request(
                 "POST", "/pages/export",
                 body=json.dumps(
-                    {"prompt_tokens": list(prompt_tokens)}
+                    {
+                        "prompt_tokens": list(prompt_tokens),
+                        **({"trace": trace} if trace is not None else {}),
+                    }
                 ).encode(),
                 headers={"Content-Type": "application/json"},
             )
@@ -504,6 +527,7 @@ class Router:
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
         on_dispatch: Optional[Callable[[int], None]] = None,
+        tracer=None,
     ):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -511,6 +535,14 @@ class Router:
         self.config = config or RouterConfig()
         self.transport = transport or HttpTransport()
         self._clock = clock
+        # Fleet tracing (obs/tracer.Tracer or None): when enabled the
+        # router stamps a trace-context line into every hop it makes
+        # (dispatch, prefill handoff, /pages migration) and emits its
+        # own cat="hop" spans on the request's trace id — the fleet
+        # half of the causal timeline the replicas' reqtrace emits the
+        # engine half of. None (the default) keeps every dispatch body
+        # and record byte-identical to the untraced router.
+        self.tracer = tracer
         self._rng = rng or random.Random(self.config.trace_seed)
         # Chaos hook: called with the global dispatch ordinal BEFORE
         # the attempt goes out — `kill:replica<R>@request<N>` fires
@@ -551,6 +583,23 @@ class Router:
         self.directory_pulls_total = 0
         self.directory_pull_hits_total = 0
         self.migration_seconds = StatSummary()
+        # ---- fleet tracing state (PR 19) ----------------------------
+        # propagated = the serving replica echoed our trace id back
+        # (it adopted the context); orphaned = it completed without
+        # the echo (old replica, or it judged our context malformed).
+        self.trace_propagated_total = 0
+        self.trace_orphaned_total = 0
+        # Per-hop-kind latency summaries ("dispatch", "migrate", ...)
+        # for /metricsz, and the bounded /requestz ring of recent
+        # per-request hop chains (trace id hex → digest + hop list).
+        self.hop_seconds: dict[str, StatSummary] = {}
+        self._recent: collections.OrderedDict = collections.OrderedDict()
+
+    REQUESTZ_RING = 512  # recent requests kept for /requestz?id=
+
+    @property
+    def _tracing(self) -> bool:
+        return self.tracer is not None and self.tracer.enabled
 
     # ---- selection ---------------------------------------------------
 
@@ -651,6 +700,7 @@ class Router:
             "hedged": False,
             "hedge_won": False,
         }
+        tctx = self._tctx(trace_id, digest["trace_id"])
         # Disaggregated staging (PR 16), OUTSIDE the retry loop and
         # best-effort by design: a long prompt prefills on the prefill
         # tier and its pages migrate to a decode replica; a prefix the
@@ -666,7 +716,7 @@ class Router:
             else 0
         )
         if self._role_aware or self.config.directory:
-            self._stage_pages(prompt, body, deadline, dir_key)
+            self._stage_pages(prompt, body, deadline, dir_key, tctx)
         exclude: set[int] = set()  # failed THIS request
         backoff_i = 0
         idle_rounds = 0  # rounds with NO eligible replica at all
@@ -679,7 +729,7 @@ class Router:
             remaining = deadline - self._clock()
             if remaining <= 0:
                 return self._finish(
-                    504, {"error": "deadline_exceeded"}, digest,
+                    504, {"error": "deadline_exceeded"}, digest, tctx,
                 )
             with self._lock:
                 first = self._select(prompt, exclude)
@@ -721,17 +771,28 @@ class Router:
                             ),
                         },
                         digest,
+                        tctx,
                     )
                 # Every currently-eligible replica failed this
                 # request: forget the exclusions after a backoff beat
                 # — one of them (or a restart) may have recovered.
                 exclude = set()
+                t0p = time.perf_counter()
                 self._backoff(backoff_i, remaining)
+                if tctx is not None:
+                    # The stall is itself a hop: a request that spent
+                    # 300ms waiting out open breakers should show that
+                    # wait on its timeline, not an unexplained gap.
+                    self._hop(
+                        tctx, HOP_BREAKER_WAIT, t0p,
+                        time.perf_counter() - t0p,
+                        {"round": idle_rounds},
+                    )
                 backoff_i += 1
                 continue
             digest["attempts"] += 1
             winner, status, payload, hedged, hedge_won, failures = (
-                self._race(first, prompt, body, deadline, exclude)
+                self._race(first, prompt, body, deadline, exclude, tctx)
             )
             if hedged:
                 digest["hedged"] = True
@@ -760,7 +821,7 @@ class Router:
                             saturated_retry_after or 0.0, float(ra)
                         )
                 handled = self._handle_response(
-                    winner, status, payload, digest, exclude
+                    winner, status, payload, digest, exclude, tctx
                 )
                 if handled is not None:
                     if dir_key and handled[0] == 200:
@@ -787,6 +848,7 @@ class Router:
                             ),
                         },
                         digest,
+                        tctx,
                     )
                 return self._finish(
                     502,
@@ -799,9 +861,14 @@ class Router:
                         ),
                     },
                     digest,
+                    tctx,
                 )
             with self._lock:
                 self.retries_total += 1
+            if tctx is not None:
+                self._hop_instant(
+                    tctx, HOP_RETRY, {"attempt": digest["attempts"]}
+                )
             self._backoff(backoff_i, deadline - self._clock())
             backoff_i += 1
 
@@ -813,6 +880,7 @@ class Router:
         body: dict,
         deadline: float,
         dir_key: int,
+        tctx: Optional[dict] = None,
     ) -> None:
         """Best-effort page placement BEFORE the dispatch race: the
         prefill-tier handoff for long prompts, then (when that did not
@@ -848,7 +916,7 @@ class Router:
                     )
                 if src is not None and src.index != target.index:
                     staged = self._prefill_handoff(
-                        src, target, prompt, body, deadline
+                        src, target, prompt, body, deadline, tctx
                     )
         if staged or not dir_key:
             return
@@ -868,7 +936,7 @@ class Router:
         if pull:
             with self._lock:
                 self.directory_pulls_total += 1
-            if self._migrate(owner, target, prompt, deadline):
+            if self._migrate(owner, target, prompt, deadline, tctx):
                 with self._lock:
                     self.directory_pull_hits_total += 1
 
@@ -879,6 +947,7 @@ class Router:
         prompt: Sequence[int],
         body: dict,
         deadline: float,
+        tctx: Optional[dict] = None,
     ) -> bool:
         """Stage one: run the prompt to prefill completion on the
         prefill tier (max_new_tokens=1 — the chunk programs ingest the
@@ -893,6 +962,15 @@ class Router:
         b = dict(body)
         b["max_new_tokens"] = 1
         b["timeout"] = round(remaining, 3)
+        span16 = None
+        if tctx is not None:
+            # The prefill replica adopts this hop's context, so its
+            # own admit→chunks→retire timeline hangs off the SAME
+            # trace id as the decode that follows it.
+            span, line = self._span_ctx(tctx)
+            span16 = f"{span:016x}"
+            b["trace"] = line
+        t0p = time.perf_counter()
         call = self.transport.start(
             src.url, "/generate", b, remaining + 2.0
         )
@@ -911,7 +989,19 @@ class Router:
         src.breaker.record_success()
         with self._lock:
             self.prefill_handoffs_total += 1
-        return self._migrate(src, target, prompt, deadline)
+        if tctx is not None:
+            dur = time.perf_counter() - t0p
+            tctx["hops"]["handoff_s"] = round(dur, 6)
+            self._hop(
+                tctx, HOP_HANDOFF, t0p, dur,
+                {
+                    "src": src.index,
+                    "dst": target.index,
+                    "span": span16,
+                    "tokens": len(prompt),
+                },
+            )
+        return self._migrate(src, target, prompt, deadline, tctx)
 
     def _migrate(
         self,
@@ -919,6 +1009,7 @@ class Router:
         dst: Replica,
         prompt: Sequence[int],
         deadline: float,
+        tctx: Optional[dict] = None,
     ) -> bool:
         """Move the prompt's cached prefix pages ``src`` → ``dst``
         (export, then push; two HTTP round-trips of raw KV bytes) →
@@ -931,18 +1022,32 @@ class Router:
         )
         if budget <= 0.05:
             return False
+        mctx = None
+        if tctx is not None:
+            _span, mctx = self._span_ctx(tctx)
+        t0p = time.perf_counter()
         status = 0  # stage marker: != 200 until the export succeeded
         try:
-            status, raw = self.transport.fetch_pages(
-                src.url, prompt, budget
+            ex0 = time.perf_counter()
+            # Positional call when untraced: injected fake transports
+            # predate the ``trace`` kwarg and must keep working.
+            status, raw = (
+                self.transport.fetch_pages(
+                    src.url, prompt, budget, trace=mctx
+                )
+                if mctx is not None
+                else self.transport.fetch_pages(src.url, prompt, budget)
             )
+            ex1 = time.perf_counter()
             if status != 200:
                 with self._lock:
                     self.migration_failures_total += 1
                 return False
+            in0 = time.perf_counter()
             status, payload = self.transport.push_pages(
                 dst.url, raw, budget
             )
+            in1 = time.perf_counter()
         except ReplicaUnreachable as e:
             self._note_failure(src if status != 200 else dst, e)
             with self._lock:
@@ -952,12 +1057,31 @@ class Router:
             with self._lock:
                 self.migration_failures_total += 1
             return False
+        copied = int(payload.get("copied_pages", 0))
         with self._lock:
             self.migrations_total += 1
-            self.pages_migrated_total += int(
-                payload.get("copied_pages", 0)
-            )
+            self.pages_migrated_total += copied
             self.migration_seconds.add(self._clock() - t0)
+        if tctx is not None:
+            self._hop(
+                tctx, HOP_MIGRATE_EXPORT, ex0, ex1 - ex0,
+                {"src": src.index, "bytes": len(raw)},
+            )
+            self._hop(
+                tctx, HOP_MIGRATE_INSTALL, in0, in1 - in0,
+                {"dst": dst.index, "pages": copied},
+            )
+            dur = time.perf_counter() - t0p
+            tctx["hops"]["migrate_s"] = round(dur, 6)
+            self._hop(
+                tctx, HOP_MIGRATE, t0p, dur,
+                {
+                    "src": src.index,
+                    "dst": dst.index,
+                    "bytes": len(raw),
+                    "pages": copied,
+                },
+            )
         return True
 
     def _handle_response(
@@ -967,13 +1091,38 @@ class Router:
         payload: dict,
         digest: dict,
         exclude: set[int],
+        tctx: Optional[dict] = None,
     ) -> Optional[tuple[int, dict]]:
         """An HTTP response arrived: deliver it, or turn replica-local
         backpressure/drain into a routed retry. Returns None to keep
         retrying."""
+        # The dispatch span closes here, where finality is decided:
+        # winner=True marks THE attempt whose response the client got
+        # (a 200 — the fleet-timeline validator requires exactly one),
+        # everything re-routed below closes as a loser with its status.
+        last = tctx.pop("last", None) if tctx is not None else None
+
+        def _span(won: bool) -> None:
+            if last is None:
+                return
+            dur = last["t1"] - last["t0"]
+            if won:
+                tctx["hops"]["dispatch_s"] = round(dur, 6)
+            self._hop(
+                tctx, HOP_DISPATCH, last["t0"], dur,
+                {
+                    "attempt": last["attempt"],
+                    "replica": last["replica"],
+                    "span": last["span"],
+                    "winner": won,
+                    "status": status,
+                },
+            )
+
         if status == 500:
             # engine failed: the process answers HTTP but cannot
             # serve. Count toward the breaker and re-route.
+            _span(False)
             rep.breaker.record_failure()
             exclude.add(rep.index)
             return None
@@ -982,6 +1131,7 @@ class Router:
             # The replica started draining between our poll and this
             # dispatch: update the router's view and re-route — drain
             # is honored fleet-wide, not surfaced to the client.
+            _span(False)
             with self._lock:
                 rep.state = DRAINING
             exclude.add(rep.index)
@@ -991,19 +1141,40 @@ class Router:
             # is full, another may not be — retry elsewhere now, only
             # backing off when everyone is full (the retry loop's
             # no-eligible path).
+            _span(False)
             exclude.add(rep.index)
             return None
+        _span(status == 200)
+        if tctx is not None and status == 200:
+            # The replica echoes our trace id back iff it adopted the
+            # context we sent — the propagation health signal.
+            with self._lock:
+                if payload.get("trace_id") == digest["trace_id"]:
+                    self.trace_propagated_total += 1
+                else:
+                    self.trace_orphaned_total += 1
         digest["replica"] = rep.index
         with self._lock:
             self.completed_total += 1
-        return self._finish(status, payload, digest)
+        return self._finish(status, payload, digest, tctx)
 
     def _finish(
-        self, status: int, payload: dict, digest: dict
+        self,
+        status: int,
+        payload: dict,
+        digest: dict,
+        tctx: Optional[dict] = None,
     ) -> tuple[int, dict]:
         if status == 504:
             with self._lock:
                 self.deadline_exceeded_total += 1
+        if tctx is not None:
+            # Per-hop seconds on the digest (and so on the response's
+            # ``router`` block), and the /requestz ring entry — every
+            # exit path lands here, success or not.
+            if tctx["hops"]:
+                digest["hops"] = dict(tctx["hops"])
+            self._store_recent(tctx, digest)
         payload = dict(payload)
         payload["router"] = digest
         return status, payload
@@ -1028,6 +1199,81 @@ class Router:
         else:
             rep.breaker.record_failure()
 
+    # ---- fleet tracing (PR 19) ---------------------------------------
+
+    def _tctx(self, trace_id: int, aid: str) -> Optional[dict]:
+        """Per-request tracing context, or None when tracing is off
+        (the None path is the byte-identical untraced router). ``n``
+        salts one span id per hop; ``hops`` stages the per-hop seconds
+        that ride ``body["hops"]`` into the replica's serve_request
+        record; ``spans`` is the /requestz hop chain."""
+        if not self._tracing:
+            return None
+        return {
+            "tid": trace_id,
+            "aid": aid,
+            "n": 0,
+            "t0": time.perf_counter(),
+            "hops": {},
+            "spans": [],
+        }
+
+    def _span_ctx(self, tctx: dict) -> tuple[int, str]:
+        """Mint the next span under this request's trace → (span id,
+        context line for the outgoing hop's body/header)."""
+        span = derive_span_id(tctx["tid"], tctx["n"])
+        tctx["n"] += 1
+        return span, encode_trace_context(tctx["tid"], span, 0)
+
+    def _hop(
+        self, tctx: dict, name: str, t0_perf: float, dur_s: float,
+        args: dict,
+    ) -> None:
+        """One finished router hop, on all three surfaces at once: a
+        ``cat="hop"`` async span under the request's trace id (the
+        merged fleet timeline's router half), the per-kind seconds
+        summary (/metricsz), and the /requestz ring's hop chain."""
+        self.tracer.async_complete(
+            name, t0_perf, dur_s, tctx["aid"], args, cat=HOP_CAT
+        )
+        with self._lock:
+            self.hop_seconds.setdefault(
+                name.partition(".")[2], StatSummary()
+            ).add(dur_s)
+        tctx["spans"].append(
+            {"name": name, "dur_s": round(dur_s, 6), "args": args}
+        )
+
+    def _hop_instant(self, tctx: dict, name: str, args: dict) -> None:
+        self.tracer.async_instant(
+            name, time.perf_counter(), tctx["aid"], args, cat=HOP_CAT
+        )
+        tctx["spans"].append({"name": name, "args": args})
+
+    def _store_recent(self, tctx: dict, digest: dict) -> None:
+        with self._lock:
+            self._recent[digest["trace_id"]] = {
+                "digest": digest,
+                "hops": list(tctx["spans"]),
+            }
+            while len(self._recent) > self.REQUESTZ_RING:
+                self._recent.popitem(last=False)
+
+    def requestz(self, trace_id: str) -> Optional[dict]:
+        """One recent request's router-side view (the /requestz ring):
+        final digest + the hop chain, keyed by hex trace id."""
+        with self._lock:
+            entry = self._recent.get(str(trace_id))
+            if entry is None:
+                return None
+            return {
+                "trace_id": str(trace_id),
+                "router": {
+                    "digest": dict(entry["digest"]),
+                    "hops": [dict(h) for h in entry["hops"]],
+                },
+            }
+
     # ---- the race: one attempt, optionally hedged --------------------
 
     def _race(
@@ -1037,6 +1283,7 @@ class Router:
         body: dict,
         deadline: float,
         exclude: set[int],
+        tctx: Optional[dict] = None,
     ):
         """Run one attempt; if it straggles past ``hedge_after_s``,
         duplicate it to a second replica — FIRST COMPLETION WINS, the
@@ -1048,6 +1295,7 @@ class Router:
         accounting)."""
         results: _queue.Queue = _queue.Queue()
         calls: dict[int, object] = {}
+        att: dict[int, dict] = {}  # replica index → this attempt's span
 
         def _run(rep: Replica, call) -> None:
             try:
@@ -1066,6 +1314,26 @@ class Router:
             # eviction enforces the same deadline we are racing, so a
             # doomed request dies in ITS queue, not on our socket.
             b["timeout"] = round(remaining, 3)
+            t0p = time.perf_counter()
+            if tctx is not None:
+                # Each attempt is its own span under the request's
+                # trace: the replica adopts this context at admission,
+                # so its engine timeline's ``parent`` names exactly
+                # which attempt produced it — how the merged fleet
+                # trace tells a hedge winner's decode from the loser's.
+                tctx["hops"].setdefault(
+                    "queue_s", round(t0p - tctx["t0"], 6)
+                )
+                span, line = self._span_ctx(tctx)
+                b["trace"] = line
+                if tctx["hops"]:
+                    b["hops"] = dict(tctx["hops"])
+                att[rep.index] = {
+                    "span": f"{span:016x}",
+                    "attempt": tctx["n"],
+                    "replica": rep.index,
+                    "t0": t0p,
+                }
             call = self.transport.start(
                 rep.url, "/generate", b, remaining + 2.0
             )
@@ -1075,6 +1343,19 @@ class Router:
             threading.Thread(
                 target=_run, args=(rep, call), daemon=True
             ).start()
+
+        def _cancel_span(idx: int) -> None:
+            """Close a cancelled attempt's span at cancellation time —
+            the loser's thread dies without delivering a result."""
+            a = att.pop(idx, None)
+            if a is None:
+                return
+            now_p = time.perf_counter()
+            self._hop(
+                tctx, HOP_DISPATCH, a["t0"], now_p - a["t0"],
+                {**{k: a[k] for k in ("attempt", "replica", "span")},
+                 "winner": False, "cancelled": True},
+            )
 
         _launch(first)
         outstanding = {first.index: first}
@@ -1099,6 +1380,10 @@ class Router:
                     )
                 if second is not None:
                     hedged = True
+                    if tctx is not None:
+                        self._hop_instant(
+                            tctx, HOP_HEDGE, {"replica": second.index}
+                        )
                     _launch(second)
                     outstanding[second.index] = second
                 hedge_at = None  # one hedge per dispatch, fired or not
@@ -1115,6 +1400,8 @@ class Router:
                     # loop (which re-checks the deadline) decide.
                     for idx in list(outstanding):
                         calls[idx].cancel()
+                        if tctx is not None:
+                            _cancel_span(idx)
                         del outstanding[idx]
                     return None, None, None, hedged, False, failures
                 continue
@@ -1122,11 +1409,35 @@ class Router:
             if err is not None:
                 if not err.cancelled:
                     failures.append((rep, err))
+                    if tctx is not None:
+                        a = att.pop(rep.index, None)
+                        if a is not None:
+                            now_p = time.perf_counter()
+                            self._hop(
+                                tctx, HOP_DISPATCH, a["t0"],
+                                now_p - a["t0"],
+                                {
+                                    "attempt": a["attempt"],
+                                    "replica": a["replica"],
+                                    "span": a["span"],
+                                    "winner": False,
+                                    "error": err.kind,
+                                },
+                            )
                 continue
             # First completion wins: cancel the rest.
             for idx in outstanding:
                 calls[idx].cancel()
+                if tctx is not None:
+                    _cancel_span(idx)
             hedge_won = hedged and rep.index != first.index
+            if tctx is not None:
+                a = att.pop(rep.index, None)
+                if a is not None:
+                    # Held open until _handle_response decides whether
+                    # this response is final — the span closes there,
+                    # marked winner or loser.
+                    tctx["last"] = {**a, "t1": time.perf_counter()}
             return rep, status, payload, hedged, hedge_won, failures
         return None, None, None, hedged, False, failures
 
@@ -1189,6 +1500,24 @@ class Router:
                     if self._role_aware or self.config.directory
                     else {}
                 ),
+                # Fleet-tracing block: ABSENT unless a tracer is
+                # attached and enabled — the untraced router's state()
+                # (and every surface built from it) stays byte-
+                # identical to PR 18's.
+                **(
+                    {
+                        "trace_propagated_total":
+                            self.trace_propagated_total,
+                        "trace_orphaned_total":
+                            self.trace_orphaned_total,
+                        "hop_seconds": {
+                            k: s.snapshot(ndigits=6)
+                            for k, s in sorted(self.hop_seconds.items())
+                        },
+                    }
+                    if self._tracing
+                    else {}
+                ),
                 "replica_states": [r.snapshot() for r in self.replicas],
             }
 
@@ -1242,6 +1571,7 @@ class ReplicaManager:
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
         roles: Optional[Sequence[str]] = None,
+        trace_dir: Optional[str] = None,
     ):
         if n_replicas < 1:
             raise ValueError(f"need at least 1 replica, got {n_replicas}")
@@ -1263,6 +1593,10 @@ class ReplicaManager:
         self.transport = transport or HttpTransport()
         self._clock = clock
         self.metrics = metrics
+        # Fleet tracing: each replica exports its Perfetto trace (and
+        # runs --reqtrace) under trace_dir/replica<i>; None (default)
+        # spawns byte-identical argv to the untraced manager.
+        self.trace_dir = trace_dir
         self.replicas = [
             Replica(
                 i,
@@ -1348,6 +1682,28 @@ class ReplicaManager:
             *(
                 ["--role", rep.role]
                 if self.roles is not None
+                else []
+            ),
+            # After serve_args, so the manager's per-replica trace dir
+            # wins (argparse last-wins) and a restarted replica keeps
+            # exporting to ITS directory — the merged fleet timeline
+            # survives churn.
+            *(
+                [
+                    "--trace_dir",
+                    os.path.join(
+                        self.trace_dir, f"replica{rep.index}"
+                    ),
+                    "--reqtrace",
+                    # Distinct tracer pid per replica (router is 0):
+                    # the merged fleet document pairs b/e spans per
+                    # (pid, trace id, name), so two replicas serving
+                    # the same trace id (hedge winner + cancelled
+                    # loser) must never share a pid.
+                    "--trace_rank",
+                    str(rep.index + 1),
+                ]
+                if self.trace_dir is not None
                 else []
             ),
             "--host",
@@ -1744,6 +2100,10 @@ class FleetServer:
                          health with the timeout/refused distinction)
       GET  /metricsz   → linted ``ddp_tpu_fleet_*`` gauges
                          (obs/promtext.render_fleet)
+      GET  /requestz?id=0xTRACEID
+                       → one recent request's fleet hop chain
+                         (router digest + hop spans, joined with the
+                         serving replica's proxied engine timeline)
       POST /rollz      → rolling restart (drain → wait → restart →
                          re-admit, one replica at a time), in the
                          background; the response acknowledges start
@@ -1794,12 +2154,14 @@ class FleetServer:
                 )
 
             def do_GET(self):  # noqa: N802
-                route = self.path.partition("?")[0]
+                route, _, query = self.path.partition("?")
                 if route == "/healthz":
                     payload = server.healthz()
                     self._send(
                         200 if payload["ok"] else 503, payload
                     )
+                elif route == "/requestz":
+                    self._send(*server.requestz(query))
                 elif route == "/statusz":
                     self._send(200, server.statusz())
                 elif route == "/metricsz":
@@ -1906,6 +2268,34 @@ class FleetServer:
             "replicas_draining": rs["replicas_draining"],
             "replicas_dead": rs["replicas_dead"],
         }
+
+    def requestz(self, query: str) -> tuple[int, dict]:
+        """GET /requestz?id=0x...: ONE recent request's assembled
+        fleet hop chain — the router's digest + hop spans from its
+        /requestz ring, joined with the serving replica's own
+        /requestz engine timeline (proxied live; ``null`` when that
+        replica evicted the entry or died — the router half still
+        answers)."""
+        from urllib.parse import parse_qs
+
+        tid = (parse_qs(query).get("id") or [""])[0]
+        entry = self.router.requestz(tid)
+        if entry is None:
+            return 404, {"error": f"no recent request {tid!r}"}
+        replica = None
+        idx = entry["router"]["digest"].get("replica")
+        if idx is not None and 0 <= int(idx) < len(self.router.replicas):
+            rep = self.router.replicas[int(idx)]
+            if rep.url is not None:
+                try:
+                    replica = self.manager.transport.get_json(
+                        rep.url,
+                        f"/requestz?id={tid}",
+                        self.manager.probe_timeout,
+                    )
+                except ReplicaUnreachable:
+                    replica = None
+        return 200, {**entry, "replica": replica}
 
     def statusz(self) -> dict:
         """Router + manager state, plus the obs/aggregate.py fleet

@@ -160,8 +160,15 @@ class Scheduler:
         top_p: float = 1.0,
         seed: int = 0,
         timeout: Optional[float] = None,
+        trace_id: Optional[int] = None,
     ) -> Admission:
-        """Validate + enqueue → Admission (never raises on bad input)."""
+        """Validate + enqueue → Admission (never raises on bad input).
+
+        ``trace_id`` overrides the locally-derived id with one ADOPTED
+        from an inbound fleet trace context (the router's), so the
+        replica's whole timeline hangs off the router's span instead
+        of a freshly-minted id; None/0 keeps the local derivation.
+        """
         try:
             prompt = [int(t) for t in prompt]
         except (TypeError, ValueError):
@@ -208,7 +215,11 @@ class Scheduler:
             seed=int(seed),
             deadline=None if timeout is None else now + float(timeout),
             submitted=now,
-            trace_id=derive_trace_id(self.trace_seed, rid),
+            trace_id=(
+                int(trace_id)
+                if trace_id
+                else derive_trace_id(self.trace_seed, rid)
+            ),
         )
         self._queue.append(req)
         return Admission(True, request=req)

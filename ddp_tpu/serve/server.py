@@ -196,6 +196,13 @@ class LMServer:
             top_p = float(body.get("top_p", 1.0))
             seed = int(body.get("seed", 0))
             timeout = float(body["timeout"]) if "timeout" in body else None
+            # Fleet trace context + staged hop seconds (ISSUE 19):
+            # optional, router-injected; a malformed context is the
+            # engine's orphan-counting problem, never a 400.
+            trace = body.get("trace")
+            hops = body.get("hops")
+            if hops is not None and not isinstance(hops, dict):
+                hops = None
         except (KeyError, TypeError, ValueError):
             return 400, {
                 "error": "body needs prompt_tokens (list[int]) and "
@@ -220,6 +227,8 @@ class LMServer:
                 top_p=top_p,
                 seed=seed,
                 timeout=timeout,
+                trace=trace,
+                hops=hops,
             )
         if not adm.accepted:
             # Only queue_full is transient (retry-after-backoff
@@ -273,7 +282,25 @@ class LMServer:
                 if done.prefix_hit_tokens is not None
                 else {}
             ),
+            # Adoption echo (ISSUE 19): present ONLY when the request
+            # carried a VALID inbound trace context — the router reads
+            # it to count propagated-vs-orphaned. Requests without a
+            # context (every pre-fleet-tracing client) see the exact
+            # pre-PR payload.
+            **(self._trace_echo(body.get("trace"))),
         }
+
+    @staticmethod
+    def _trace_echo(trace) -> dict:
+        from ddp_tpu.obs.reqtrace import (
+            format_trace_id,
+            parse_trace_context,
+        )
+
+        ctx = parse_trace_context(trace) if trace is not None else None
+        return (
+            {"trace_id": format_trace_id(ctx[0])} if ctx else {}
+        )
 
     def snapshot(self, route: str) -> Optional[dict | str]:
         """Route → JSON-ready dict, Prometheus text (str), or None."""
@@ -341,8 +368,14 @@ class LMServer:
             return 400, {"error": "body needs prompt_tokens (list[int])"}
         if not self.engine.paged:
             return 409, {"error": "not_paged"}
+        # Optional fleet trace context: rides into the DPKV header so
+        # the migration's install side sees the same trace id (absent
+        # in the body → absent in the header → pre-PR wire bytes).
+        trace = body.get("trace")
+        if not isinstance(trace, str):
+            trace = None
         with self._lock:
-            buf = self.engine.export_prefix(prompt)
+            buf = self.engine.export_prefix(prompt, trace=trace)
         if buf is None:
             return 404, {"error": "prefix_not_found"}
         return 200, buf
@@ -370,7 +403,14 @@ class LMServer:
             return 400, {"error": e.reason, "detail": str(e)}
         if res is None:
             return 409, {"error": "pool_exhausted"}
-        return 200, {"installed": True, **res}
+        # Echo the frame's trace context (gated on its presence, like
+        # /generate's echo) so the pushing router can confirm the
+        # context survived the DPKV round trip.
+        return 200, {
+            "installed": True,
+            **res,
+            **self._trace_echo(frame.trace),
+        }
 
     def requestz(self, query: str) -> tuple[int, dict]:
         """GET /requestz[?id=...] → (status, payload): one request's

@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Fleet-wide distributed tracing (ISSUE 19 / docs/OBSERVABILITY.md
+# "Fleet-wide tracing"): a 3-replica disaggregated fleet run with
+# --trace_dir, so the router records a span per dispatch/handoff/
+# migration hop and every replica exports its request timelines.
+# After the drain, scripts/trace_merge.py stitches the router dir +
+# three replica dirs into ONE causally-validated fleet timeline per
+# request, /requestz-style hop chains come back per trace id, and
+# health_report prints the one-line fleet-trace triage. Green on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example28}
+rm -rf "$WORK" && mkdir -p "$WORK"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+# 1. The traced disagg fleet: long prompts prefill on replica 0 and
+#    migrate to the decode tier, so the merged timelines carry
+#    hop.prefill_handoff and hop.migrate spans, not just dispatches.
+python scripts/fleet.py --replicas 3 --port 8090 \
+    --roles prefill,decode,decode \
+    --prefill_cutoff 16 --affinity_page 8 \
+    --trace_dir "$WORK/trace" \
+    --workdir "$WORK" --metrics_file "$WORK/fleet.jsonl" \
+    -- --init_demo --slots 2 --page_size 8 \
+       --vocab_size 128 --seq_len 64 \
+    >"$WORK/fleet.log" 2>&1 &
+FLEET_PID=$!
+trap 'kill $FLEET_PID 2>/dev/null || true' EXIT
+for _ in $(seq 180); do
+    curl -sf localhost:8090/healthz >/dev/null 2>&1 && break
+    sleep 1
+done
+echo "--- fleet up (trace_dir on the startup line)"
+grep -o '"trace_dir": "[^"]*"' "$WORK/fleet.log" || true
+
+# 2. Traffic: long prompts (handoff + migration) and short ones.
+#    Every 200 carries per-hop seconds on its router digest; the
+#    fleet front door serves the hop chain back by trace id.
+TID=$(python - <<'EOF'
+import json
+import urllib.request
+
+tid = None
+for i in range(5):
+    n = 24 if i % 2 == 0 else 8
+    body = json.dumps({
+        "prompt_tokens": [(5 * i + j) % 128 for j in range(n)],
+        "max_new_tokens": 6,
+    }).encode()
+    with urllib.request.urlopen(
+        urllib.request.Request(
+            "http://localhost:8090/generate", data=body
+        ), timeout=300,
+    ) as r:
+        out = json.load(r)
+    assert out["status"] == "complete", out
+    hops = out["router"]["hops"]
+    assert "queue_s" in hops and "dispatch_s" in hops, hops
+    if "migrate_s" in hops:
+        tid = out["router"]["trace_id"]
+assert tid is not None, "no request migrated"
+print(tid)
+EOF
+)
+echo "--- /requestz hop chain for the migrated request $TID"
+curl -s "localhost:8090/requestz?id=$TID" | python -c '
+import json, sys
+d = json.load(sys.stdin)
+print(json.dumps({
+    "trace_id": d["trace_id"],
+    "hops": [h["name"] for h in d["router"]["hops"]],
+    "digest_hops": d["router"]["digest"]["hops"],
+}, indent=1))
+assert any("migrate" in h["name"] for h in d["router"]["hops"])'
+echo "--- /metricsz (fleet trace gauges)"
+curl -s localhost:8090/metricsz | grep -E \
+    "fleet_trace_(propagated|orphaned)_total|fleet_hop_seconds\{.*dispatch" \
+    | head -4
+
+# 3. Drain: replicas export their request timelines on SIGTERM, the
+#    router exports its hop spans after the members stop.
+kill -TERM $FLEET_PID
+wait $FLEET_PID 2>/dev/null || true
+ls "$WORK"/trace/*/
+
+# 4. Merge router + replica dirs into one fleet timeline and
+#    causally validate every request; --metrics_file appends the
+#    fleet_trace triage record, --request prints one hop chain.
+echo "--- trace_merge (fleet sidecar)"
+python scripts/trace_merge.py "$WORK"/trace/router "$WORK"/trace/replica* \
+    -o "$WORK/trace/merged.trace.json" \
+    --metrics_file "$WORK/fleet.jsonl" \
+    --request "$TID" | python -c '
+import json, sys
+merge = json.loads(sys.stdin.readline())
+fleet = merge["fleet"]
+print(json.dumps(fleet, indent=1))
+assert fleet["count"] == 5 and fleet["causal_ok"] == 5, fleet
+assert fleet["migrated"] >= 1, fleet
+req = json.loads(sys.stdin.readline())
+assert req["fleet_summary"]["migrated"], req["fleet_summary"]
+print("migrated request validates:", req["fleet_summary"]["request"])'
+
+# 5. The one-line triage the merged record feeds.
+echo "--- health_report (fleet trace triage)"
+python scripts/health_report.py "$WORK/fleet.jsonl" | grep "fleet trace"
+
+echo "example 28 OK"

@@ -138,6 +138,15 @@ def main() -> None:
         "prints the fleet triage lines from them)",
     )
     p.add_argument(
+        "--trace_dir", default=None,
+        help="fleet-wide distributed tracing: the router records a "
+        "span per dispatch/retry/hedge/migration hop and exports to "
+        "TRACE_DIR/router on drain; every replica runs with "
+        "--trace_dir TRACE_DIR/replicaN --reqtrace so "
+        "scripts/trace_merge.py can stitch one causal timeline per "
+        "request across the fleet (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
         "--drain_timeout", type=float, default=30.0,
         help="SIGTERM: stop admitting at the frontend, then give "
         "replicas this long to finish lanes before the kill",
@@ -242,7 +251,13 @@ def main() -> None:
         poll_interval=args.poll_interval,
         metrics=metrics,
         roles=roles,
+        trace_dir=args.trace_dir,
     )
+    tracer = None
+    if args.trace_dir:
+        from ddp_tpu.obs.tracer import Tracer
+
+        tracer = Tracer(enabled=True)
     config = RouterConfig(
         retry_max=args.retry_max,
         retry_backoff_s=args.retry_backoff,
@@ -266,6 +281,7 @@ def main() -> None:
                 manager.replicas,
                 config,
                 on_dispatch=chaos.on_dispatch if chaos else None,
+                tracer=tracer,
             )
         )
         healthy = manager.wait_healthy()
@@ -291,6 +307,10 @@ def main() -> None:
                         **(
                             {"chaos": args.chaos} if args.chaos else {}
                         ),
+                        **(
+                            {"trace_dir": args.trace_dir}
+                            if args.trace_dir else {}
+                        ),
                         **({"tuning": tuning} if tuning else {}),
                     }
                 ),
@@ -309,6 +329,20 @@ def main() -> None:
             )
     finally:
         manager.stop(drain_timeout=args.drain_timeout)
+        # Router trace exports after the members stop so the drain's
+        # final hop spans (503s, cancelled hedges) are in the file;
+        # an unwritable dir must not mask the metrics close below.
+        if tracer is not None:
+            try:
+                path = tracer.export_to_dir(
+                    os.path.join(args.trace_dir, "router")
+                )
+                print(json.dumps({"router_trace": path}), flush=True)
+            except OSError as e:
+                print(
+                    json.dumps({"router_trace_error": str(e)}),
+                    file=sys.stderr, flush=True,
+                )
         metrics.close()
 
 

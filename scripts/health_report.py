@@ -396,6 +396,31 @@ def build_report(records: list[dict]) -> str:
                 )
             )
 
+    # Fleet-trace triage (PR 19): trace_merge.py --metrics_file stamps
+    # one cumulative fleet_trace record per merge, so the LAST one is
+    # the freshest fleet reconstruction — requests stitched across
+    # router + replica trace dirs, how many survived causal
+    # validation, and which hop is the tail's bottleneck. Gated on
+    # record presence so every existing golden stays byte-identical.
+    fleet_traces = [r for r in records if r.get("kind") == "fleet_trace"]
+    if fleet_traces:
+        ft = fleet_traces[-1]
+        n = ft.get("requests", 0)
+        ok = ft.get("causal_ok", 0)
+        frac = (ok / n) if n else 0.0
+        line = (
+            f"fleet trace   : {n} request(s) reconstructed, "
+            f"{ok} causal-ok ({_fmt(100.0 * frac, 1)}%)"
+            f"; hedged {ft.get('hedged', 0)}"
+            f", migrated {ft.get('migrated', 0)}"
+        )
+        if ft.get("worst_hop"):
+            line += (
+                f"; worst hop {ft['worst_hop']} "
+                f"p99 {_fmt(ft.get('worst_hop_p99_s'), 4)}s"
+            )
+        lines.append(line)
+
     # MPMD pipeline triage (parallel/mpmd.py): stage-tagged step
     # records plus the supervisor's mpmd_run/mpmd_restart stamps.
     # Gated on those markers, so SPMD trainer and serve streams (and

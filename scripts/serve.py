@@ -87,6 +87,14 @@ def main() -> None:
         help="bounded trace memory: keep the last N events",
     )
     p.add_argument(
+        "--trace_rank", type=int, default=0,
+        help="tracer process id: names the exported file "
+        "(trace_rank{N}.trace.json) and scopes span pairing in a "
+        "merged fleet document — the fleet manager assigns each "
+        "replica a distinct rank so scripts/trace_merge.py never "
+        "cross-pairs two replicas' spans under one trace id",
+    )
+    p.add_argument(
         "--drain_timeout", type=float, default=30.0,
         help="SIGTERM graceful drain: stop admitting (503 + "
         "Retry-After), let running lanes finish up to this many "
@@ -348,6 +356,7 @@ def main() -> None:
     tracer = Tracer(
         enabled=bool(args.trace_dir),
         ring_events=args.trace_ring_events,
+        process_id=args.trace_rank,
     )
     # SLO engine + flight recorder (ISSUE 11): objectives evaluated
     # live inside the serving process; breach events land in the

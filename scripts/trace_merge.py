@@ -30,11 +30,73 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddp_tpu.obs.reqtrace import (  # noqa: E402
+    reconstruct_fleet,
     reconstruct_requests,
+    validate_fleet_timeline,
     validate_request_timeline,
 )
 from ddp_tpu.obs.tracer import validate_trace_file  # noqa: E402
 from ddp_tpu.utils.metrics import StatSummary  # noqa: E402
+
+
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile of a non-empty list (q in [0, 1])."""
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def summarize_fleet(events: list[dict]) -> dict:
+    """Cross-replica fleet sidecar: every trace id with router hop
+    spans, causally validated against its replica timeline(s).
+
+    Empty dict when the merge has no hop events — a non-fleet merge's
+    document (and the classic ``requests`` sidecar) stays
+    byte-identical. Per-hop latencies aggregate across requests so
+    the triage line (scripts/health_report.py) can name the worst
+    hop by p99 without re-reading the events.
+    """
+    fleet_map = reconstruct_fleet(events)
+    if not fleet_map:
+        return {}
+    causal_ok = 0
+    hedged = migrated = 0
+    hop_vals: dict[str, list[float]] = {}
+    problems: list[str] = []
+    for tid, f in fleet_map.items():
+        for h in f["hops"]:
+            if h.get("ph") == "X" and h.get("dur") is not None:
+                hop_vals.setdefault(h["name"], []).append(
+                    h["dur"] / 1e6
+                )
+        try:
+            summary = validate_fleet_timeline(f)
+        except ValueError as e:
+            if len(problems) < 8:
+                problems.append(f"{tid}: {e}")
+            continue
+        causal_ok += 1
+        hedged += 1 if summary["hedged"] else 0
+        migrated += 1 if summary["migrated"] else 0
+    hop_p99 = {
+        name: round(_percentile(vals, 0.99), 6)
+        for name, vals in sorted(hop_vals.items())
+    }
+    worst = (
+        max(hop_p99.items(), key=lambda kv: kv[1]) if hop_p99 else None
+    )
+    return {
+        "count": len(fleet_map),
+        "causal_ok": causal_ok,
+        "hedged": hedged,
+        "migrated": migrated,
+        "hop_p99_s": hop_p99,
+        **(
+            {"worst_hop": {"name": worst[0], "p99_s": worst[1]}}
+            if worst is not None
+            else {}
+        ),
+        **({"problems": problems} if problems else {}),
+    }
 
 
 def expand_inputs(paths: list[str], output: str | None = None) -> list[str]:
@@ -129,6 +191,11 @@ def merge_traces(paths: list[str]) -> dict:
             "by_reason": by_reason,
             **({"problems": problems} if problems else {}),
         }
+    # Fleet timelines (PR 19): router hop spans (cat "hop") joined
+    # with the replica request timelines they dispatched — present
+    # only when the merge actually contains a router's trace, so a
+    # single-process merge's document is unchanged.
+    fleet = summarize_fleet(events)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -138,6 +205,7 @@ def merge_traces(paths: list[str]) -> dict:
             "dropped_events": dropped,
             **({"counters": counters} if counters else {}),
             **({"requests": requests} if requests else {}),
+            **({"fleet": fleet} if fleet else {}),
             "span_summaries": {
                 n: s.to_state() for n, s in merged_summaries.items()
             },
@@ -159,7 +227,14 @@ def main(argv=None) -> None:
     p.add_argument(
         "--request", default=None, metavar="ID",
         help="also print one request's reconstructed timeline (hex "
-        "trace id, e.g. 0x63cb...) from the merged events",
+        "trace id, e.g. 0x63cb...) from the merged events; on a "
+        "fleet merge this includes the router hop chain",
+    )
+    p.add_argument(
+        "--metrics_file", default=None, metavar="PATH",
+        help="append one kind=fleet_trace JSONL record (requests "
+        "reconstructed, causal_ok, worst hop by p99) when the merge "
+        "contains fleet hop spans — the health_report triage source",
     )
     args = p.parse_args(argv)
 
@@ -190,18 +265,66 @@ def main(argv=None) -> None:
                     if "requests" in merged["ddp_tpu"]
                     else {}
                 ),
+                **(
+                    {"fleet": merged["ddp_tpu"]["fleet"]}
+                    if "fleet" in merged["ddp_tpu"]
+                    else {}
+                ),
             }
         )
     )
+    fleet = merged["ddp_tpu"].get("fleet")
+    if args.metrics_file and fleet:
+        from ddp_tpu.utils.metrics import MetricsWriter
+
+        mw = MetricsWriter(args.metrics_file)
+        mw.write(
+            "fleet_trace",
+            requests=fleet["count"],
+            causal_ok=fleet["causal_ok"],
+            hedged=fleet["hedged"],
+            migrated=fleet["migrated"],
+            **(
+                {
+                    "worst_hop": fleet["worst_hop"]["name"],
+                    "worst_hop_p99_s": fleet["worst_hop"]["p99_s"],
+                }
+                if "worst_hop" in fleet
+                else {}
+            ),
+        )
+        mw.close()
     if args.request:
         timelines = reconstruct_requests(merged["traceEvents"])
+        fleet_map = reconstruct_fleet(merged["traceEvents"])
         timeline = timelines.get(args.request)
-        if timeline is None:
+        entry = fleet_map.get(args.request)
+        if timeline is None and entry is None:
             raise SystemExit(
                 f"{args.request}: no such request in the merged trace "
                 f"(known ids: {sorted(timelines)[:8]}...)"
             )
-        print(json.dumps({"request": args.request, "events": timeline}))
+        if entry is not None:
+            # A fleet request: the router hop chain leads, the
+            # replica timeline(s) follow, plus the causal verdict.
+            try:
+                verdict = {"fleet_summary": validate_fleet_timeline(entry)}
+            except ValueError as e:
+                verdict = {"fleet_error": str(e)}
+            print(
+                json.dumps(
+                    {
+                        "request": args.request,
+                        "hops": entry["hops"],
+                        "events": entry["request"],
+                        **verdict,
+                    }
+                )
+            )
+        else:
+            print(
+                json.dumps({"request": args.request, "events": timeline})
+            )
 
 
 if __name__ == "__main__":
