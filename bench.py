@@ -2065,6 +2065,308 @@ def run_serve_fleet_bench(
     return record
 
 
+def run_serve_reload_bench(
+    *,
+    n_replicas: int = 3,
+    slots: int = 4,
+    inflight: int = 8,
+    new_tokens: int = 16,
+) -> dict:
+    """Model lifecycle (ISSUE 20): verified atomic hot-swap vs the
+    only pre-lifecycle upgrade path (``/rollz`` process churn), plus
+    the streaming-restore TTFT claim — all against REAL
+    ``scripts/serve.py`` subprocesses.
+
+    1. **in-flight across a swap** — a burst straddles a single
+       replica's ``POST /reload`` to a different checkpoint: EVERY
+       request completes (zero dropped, ASSERTED — admission pauses,
+       it never sheds) and the swap's verify/load/swap timings come
+       from the reload response itself.
+    2. **cold vs streaming TTFT** — the same non-trivial checkpoint
+       served twice from fresh processes: spawn → first generated
+       token, cold (restore THEN warmup, serial) vs
+       ``--streaming_restore`` (restore I/O behind the XLA warmup
+       compiles). Streaming MUST reach its first token sooner
+       (ASSERTED: the overlap is min(restore, warmup) of real work,
+       not a timing coin-flip).
+    3. **fleet swap vs ``/rollz``** — the same 3-replica fleet
+       upgraded both ways: ``reload_fleet`` (in-place swaps, zero
+       process churn — respawns == 0 ASSERTED, every replica
+       converges on the target version, ASSERTED) must beat a
+       rolling restart's wall-clock (ASSERTED: a swap restores one
+       checkpoint; a roll pays full process + jax + warmup per
+       replica).
+    """
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    import jax.numpy as jnp
+    import optax
+
+    from ddp_tpu.models.lm import LMSpec, init_lm
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.serve.fleet import ReplicaManager
+    from ddp_tpu.serve.lifecycle import model_version_token
+    from ddp_tpu.train.checkpoint import CheckpointManager, save_lm_spec
+
+    env = _env_fields()
+    record: dict = {"metric": "serve_reload_swap_vs_roll", **env}
+    workdir = tempfile.mkdtemp(prefix="ddp_tpu_reload_bench_")
+    serve_py = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts", "serve.py"
+    )
+
+    def save_ckpt(directory, spec, seed):
+        params = init_lm(spec, seed=seed)
+        tx = optax.sgd(0.01)
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=tx.init(params), model_state={},
+        )
+        mgr = CheckpointManager(directory, async_save=False)
+        mgr.save(0, state)
+        mgr.close()
+        save_lm_spec(directory, spec)
+
+    def post(url, path, body, timeout=120.0):
+        req = urllib.request.Request(
+            url + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def spawn_serve(ckpt, port, extra=()):
+        """scripts/serve.py subprocess → (proc, url, ready_s, lines).
+
+        ``lines`` keeps draining stdout in the background so the
+        streaming milestone JSON is parseable after the fact."""
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [
+                sys.executable, serve_py,
+                "--checkpoint_dir", ckpt,
+                "--slots", str(slots),
+                "--port", str(port),
+                *extra,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            cwd=os.path.dirname(serve_py) + "/..",
+        )
+        ready = [None]
+        lines: list[dict] = []
+        started = threading.Event()
+
+        def drain():
+            for line in proc.stdout:
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                lines.append(obj)
+                if "serving" in obj:
+                    ready[0] = time.perf_counter() - t0
+                    started.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert started.wait(600), "serve.py never printed startup JSON"
+        return proc, f"http://127.0.0.1:{port}", t0, ready[0], lines
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    # The engine-logic spec (phases 1+3): minutes-cheap on CPU.
+    small = LMSpec(
+        vocab_size=512, total_len=128, d_model=128, depth=2, num_heads=4,
+    )
+    ckpt_a = os.path.join(workdir, "ckpt_a")
+    ckpt_b = os.path.join(workdir, "ckpt_b")
+    save_ckpt(ckpt_a, small, seed=0)
+    save_ckpt(ckpt_b, small, seed=1)
+
+    # Phase 1: in-flight burst straddling a single-replica hot-swap.
+    proc, url, _, _, _ = spawn_serve(ckpt_a, free_port())
+    try:
+        results: list[tuple[int, dict]] = []
+        lock = threading.Lock()
+
+        def one(i):
+            status, payload = post(
+                url, "/generate",
+                {
+                    "prompt_tokens": [(7 * i + j) % 512 for j in range(16)],
+                    "max_new_tokens": new_tokens,
+                },
+            )
+            with lock:
+                results.append((status, payload))
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(inflight)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)  # land the reload mid-burst
+        status, payload = post(
+            url, "/reload", {"checkpoint_dir": ckpt_b}
+        )
+        for t in threads:
+            t.join()
+        assert status == 200 and payload.get("reloaded"), payload
+        assert payload["model_version"] == model_version_token(ckpt_b, 0)
+        completed = sum(1 for s, _ in results if s == 200)
+        assert completed == inflight, (
+            f"swap dropped {inflight - completed}/{inflight} "
+            f"in-flight requests"
+        )
+        record["inflight_across_swap"] = {
+            "submitted": inflight,
+            "completed": completed,
+            "completion_rate": 1.0,
+            "verify_s": payload.get("verify_s"),
+            "load_s": payload.get("load_s"),
+            "swap_s": payload.get("swap_s"),
+        }
+    finally:
+        proc.kill()
+        proc.wait()
+
+    # Phase 2: cold vs streaming TTFT on a checkpoint whose restore
+    # is real work (tens of MB), from fresh processes — both pay the
+    # same interpreter + jax import; the delta is the overlap.
+    big = LMSpec(
+        vocab_size=4096, total_len=160, d_model=512, depth=6,
+        num_heads=8,
+    )
+    ckpt_big = os.path.join(workdir, "ckpt_big")
+    save_ckpt(ckpt_big, big, seed=0)
+    ttft = {}
+    for mode, extra in [
+        ("cold", ()),
+        ("streaming", ("--streaming_restore", "--stream_layers", "1")),
+    ]:
+        proc, url, t0, ready_s, lines = spawn_serve(
+            ckpt_big, free_port(), extra
+        )
+        try:
+            status, payload = post(
+                url, "/generate",
+                {"prompt_tokens": [1, 2, 3, 4], "max_new_tokens": 1},
+                timeout=600.0,
+            )
+            first_token_s = time.perf_counter() - t0
+            assert status == 200 and payload.get("tokens"), payload
+            ttft[mode] = {
+                "ready_s": round(ready_s, 3),
+                "first_token_s": round(first_token_s, 3),
+            }
+            if mode == "streaming":
+                ms = [ln for ln in lines if ln.get("streamed")]
+                if ms:
+                    ttft[mode]["admission_ready_s"] = round(
+                        ms[-1]["admission_ready_s"], 3
+                    )
+                    ttft[mode]["complete_s"] = round(
+                        ms[-1]["complete_s"], 3
+                    )
+        finally:
+            proc.kill()
+            proc.wait()
+    assert (
+        ttft["streaming"]["first_token_s"] < ttft["cold"]["first_token_s"]
+    ), f"streaming restore won nothing: {ttft}"
+    record["ttft"] = ttft
+
+    # Phase 3: the same fleet upgraded both ways — in-place swaps
+    # (zero process churn) vs the PR-13 rolling restart.
+    mgr = ReplicaManager(
+        n_replicas,
+        [
+            "--checkpoint_dir", ckpt_a,
+            "--slots", str(slots),
+        ],
+        workdir=os.path.join(workdir, "fleet"),
+        max_restarts=2,
+        restart_backoff=0.2,
+    )
+    try:
+        mgr.start()
+        assert mgr.wait_healthy(420), "fleet never became healthy"
+        restarts_before = mgr.restarts_total
+        t0 = time.perf_counter()
+        out = mgr.reload_fleet(ckpt_b)
+        swap_wall = time.perf_counter() - t0
+        assert out["ok"], out
+        assert out["respawns"] == 0, out
+        assert mgr.restarts_total == restarts_before, (
+            "hot-swap respawned a process"
+        )
+        target = model_version_token(ckpt_b, 0)
+        # /healthz advertises the serving version via the poll loop —
+        # give it a couple of poll intervals to observe the swap.
+        deadline = time.monotonic() + 30
+        versions = {r.model_version for r in mgr.replicas}
+        while versions != {target} and time.monotonic() < deadline:
+            time.sleep(0.25)
+            versions = {r.model_version for r in mgr.replicas}
+        assert versions == {target}, (
+            f"fleet did not converge: {versions} != {{{target}}}"
+        )
+        t0 = time.perf_counter()
+        roll = mgr.rolling_restart()
+        roll_wall = time.perf_counter() - t0
+        assert roll.get("ok", True), roll
+        assert swap_wall < roll_wall, (
+            f"hot-swap ({swap_wall:.2f}s) not faster than /rollz "
+            f"({roll_wall:.2f}s)"
+        )
+        record["fleet_upgrade"] = {
+            "replicas": n_replicas,
+            "swap_wall_s": round(swap_wall, 3),
+            "roll_wall_s": round(roll_wall, 3),
+            "speedup": round(roll_wall / swap_wall, 2),
+            "swap_respawns": 0,
+            "converged_version": target,
+        }
+    finally:
+        mgr.stop()
+
+    _assert_provenance(env)
+    record.update(
+        **(
+            {
+                "null_result_note":
+                    "CPU capture: wall-clocks are not chip numbers, "
+                    "but zero-dropped, zero-respawn, version "
+                    "convergence and the swap<roll / streaming<cold "
+                    "orderings are platform-free facts"
+            }
+            if env["cpu_fallback"]
+            else {}
+        ),
+    )
+    return record
+
+
 def run_loader_bench(
     *, n: int = 4096, side: int = 96, batch: int = 256, epochs: int = 3
 ) -> dict:
@@ -3252,6 +3554,9 @@ def _run_extra_benches() -> None:
         # migration token identity (asserted), and the prefix
         # directory beating affinity-only under churn (asserted).
         ("serve_fleet", run_serve_fleet_bench),
+        # Model lifecycle (ISSUE 20): hot-swap vs rolling restart,
+        # in-flight-across-swap completion, cold vs streaming TTFT.
+        ("serve_reload", run_serve_reload_bench),
         ("loader", run_loader_bench),
     ]:
         try:
@@ -3537,6 +3842,12 @@ if __name__ == "__main__":
         # supervisor/worker spawns this with 2 virtual CPU devices
         # when the backend has only one).
         print(json.dumps(_zero_bench_impl()), flush=True)
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "serve_reload":
+        # Model-lifecycle entry (ISSUE 20): in-flight-across-swap
+        # completion, cold-vs-streaming TTFT, fleet swap vs /rollz
+        # wall-clock — orderings asserted, one JSON line out.
+        print(json.dumps(run_serve_reload_bench()), flush=True)
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "tune":
         # Autotuner entry (ISSUE 18): pruned fraction, search

@@ -190,6 +190,12 @@ def _endpoint_row(view: dict) -> dict:
     # stays byte-identical.
     if "role" in statusz:
         row["role"] = statusz["role"]
+    # Model-lifecycle replicas advertise their serving version in the
+    # stats lifecycle block; versionless replicas carry no key and
+    # the row stays byte-identical.
+    version = (stats.get("lifecycle") or {}).get("model_version")
+    if version is not None:
+        row["model_version"] = version
     for key in ("active", "slots", "queue_depth", "tokens_total"):
         if key in stats:
             row[key] = stats[key]
@@ -287,6 +293,14 @@ def merge_fleet(views: list[dict]) -> dict:
             g["tokens_per_s"] + row.get("tokens_per_s", 0.0), 2
         )
         g["queue_depth"] += int(row.get("queue_depth") or 0)
+    # Version rollup (lifecycle PR), present only when some endpoint
+    # advertises one: the merged-fleet convergence observable — one
+    # entry while converged, two mid-roll.
+    model_versions: dict[str, int] = {}
+    for row in rows:
+        v = row.get("model_version")
+        if v is not None:
+            model_versions[v] = model_versions.get(v, 0) + 1
     return {
         "endpoints": rows,
         "healthy": sum(1 for r in rows if r["ok"]),
@@ -302,6 +316,11 @@ def merge_fleet(views: list[dict]) -> dict:
             },
         },
         **({"by_role": by_role} if by_role else {}),
+        **(
+            {"model_versions": dict(sorted(model_versions.items()))}
+            if model_versions
+            else {}
+        ),
         **({"slo_worst": worst} if worst else {}),
     }
 

@@ -423,6 +423,27 @@ def render_serve(
     b.add("ddp_tpu_serve_productive_seconds_total", gp.get("productive_s"),
           metric_type="counter")
     b.add("ddp_tpu_serve_goodput", gp.get("goodput"))
+    # Model-lifecycle block (hot-swap tentpole): absent until the
+    # engine carries a model version or has swapped/rolled back, so a
+    # pre-lifecycle exposition stays byte-identical.
+    lc = stats.get("lifecycle") or {}
+    b.add(
+        "ddp_tpu_serve_reloads_total", lc.get("reloads_total"),
+        metric_type="counter",
+        help="verified hot-swaps committed (install_params)",
+    )
+    b.add(
+        "ddp_tpu_serve_rollbacks_total", lc.get("rollbacks_total"),
+        metric_type="counter",
+        help="mid-swap failures rolled back to the previous weights",
+    )
+    if lc.get("model_version"):
+        b.add(
+            "ddp_tpu_serve_model_info", 1,
+            labels={"version": str(lc["model_version"])},
+            help="serving model version (checkpoint@epoch), value "
+            "always 1",
+        )
     # Compiled-program introspection (obs/xprof.py, engine xprof=...):
     # absent keys render nothing, so an xprof-less engine's exposition
     # stays byte-identical.
@@ -535,6 +556,23 @@ def render_fleet(
         help="completed fleet-wide rolling restarts (drain -> wait "
         "-> restart -> re-admit, one replica at a time)",
     )
+    # Model-lifecycle series: absent until a fleet reload ran / any
+    # replica advertises a version (the gated-state convention).
+    b.add(
+        "ddp_tpu_fleet_reloads_total", snap.get("fleet_reloads_total"),
+        metric_type="counter",
+        help="completed fleet-wide verified hot-swaps (/reloadz: one "
+        "member /reload at a time, zero process churn)",
+    )
+    for version, count in sorted(
+        (snap.get("model_versions") or {}).items()
+    ):
+        b.add(
+            "ddp_tpu_fleet_model_version", count,
+            labels={"version": str(version)},
+            help="replicas serving each model version (one series "
+            "while converged, two mid-roll)",
+        )
     # Disaggregation series (PR 16): every key below is ABSENT from a
     # classic router's state(), so PromBuilder renders nothing and the
     # exposition stays byte-identical when the feature is off.

@@ -146,6 +146,42 @@ def parse_slo(spec: str) -> list[Objective]:
     return objectives
 
 
+def parse_model_slos(spec: str) -> dict:
+    """Multi-model SLO spec → {model_name_or_None: clause string}.
+
+    ``;``-separated groups, each optionally prefixed ``name:`` —
+    e.g. ``"ttft_p99<0.5s;draft:ttft_p99<0.2s,availability>0.99"``
+    gives the default model its own objectives and the registered
+    model ``draft`` another set (per-model SLO engines, per-model burn
+    gauges). The bare form (no ``;``, no prefix) parses to
+    ``{None: spec}`` — every pre-lifecycle ``--slo`` value is
+    unchanged. Each group's clause string is validated by
+    ``parse_slo`` here, so a typo in any group fails at the CLI.
+    """
+    out: dict = {}
+    for group in str(spec).split(";"):
+        group = group.strip()
+        if not group:
+            continue
+        name: Optional[str] = None
+        head, sep, tail = group.partition(":")
+        # A ":" only introduces a model name when the head looks like
+        # one (an objective clause can't contain ":").
+        if sep and re.fullmatch(r"[A-Za-z0-9_.-]+", head.strip()):
+            name = head.strip()
+            group = tail.strip()
+        if name in out:
+            raise ValueError(
+                f"duplicate SLO group for "
+                f"{'the default model' if name is None else name!r}"
+            )
+        parse_slo(group)  # validate now, fail at the CLI
+        out[name] = group
+    if not out:
+        raise ValueError(f"empty SLO spec {spec!r}")
+    return out
+
+
 def _percentile(values: list[float], q: float) -> Optional[float]:
     if not values:
         return None

@@ -47,6 +47,18 @@ Spec grammar (comma-separated events; see docs/ROBUSTNESS.md)::
                                   events never fire inside a trainer —
                                   ``ChaosEngine`` skips them; the
                                   fleet manager owns their firing.
+    kill:replica<R>@reload        LIFECYCLE drills (serve/lifecycle.py
+    ckpt_corrupt:reload           + serve/fleet.py /reloadz): SIGKILL
+                                  replica R while ITS hot-swap is in
+                                  flight (the mid-swap death the
+                                  manager must restart on the
+                                  PREVIOUS checkpoint), or corrupt the
+                                  INCOMING checkpoint at the start of
+                                  a reload so verification must
+                                  reject-and-rollback while the old
+                                  model keeps serving. Reload events
+                                  never fire inside a trainer; the
+                                  fleet reload loop owns their firing.
     kill:stage<K>@step<N>         MPMD pipeline drills (parallel/
     stall:stage<K>@step<N>:<S>s   mpmd.py): SIGKILL stage K's process
                                   before its step-N dispatch (the
@@ -103,6 +115,13 @@ _REPLICA_RE = re.compile(
     r"@request(?P<request>\d+)"
     r"(?::(?P<seconds>\d+(?:\.\d+)?)s)?$"
 )
+# Lifecycle drills (serve/lifecycle.py): the trigger point is a model
+# hot-swap, not a request ordinal — kill the replica whose swap is in
+# flight, or corrupt the checkpoint the swap is about to install.
+_REPLICA_RELOAD_RE = re.compile(
+    r"^kill:replica(?P<replica>\d+)@reload$"
+)
+_CORRUPT_RELOAD_RE = re.compile(r"^ckpt_corrupt:reload$")
 # MPMD pipeline drills (parallel/mpmd.py): the trigger point is the
 # pipeline's optimizer-step counter, but the victim is a STAGE process
 # — step-only (an MPMD run has no epoch clock).
@@ -136,13 +155,24 @@ class ChaosEvent:
     # pipeline-stage process (parallel/mpmd.py), never to a trainer
     # rank or an SPMD run.
     stage: int | None = None
+    # Lifecycle drills: the trigger point is a model hot-swap instead
+    # of a request ordinal / step counter. ``kill:replica<R>@reload``
+    # sets replica+reload; ``ckpt_corrupt:reload`` sets reload alone
+    # (the victim is the INCOMING checkpoint, wherever the reload
+    # points). Owned by the fleet reload loop, never a trainer.
+    reload: bool = False
 
     @property
     def token(self) -> str:
         """Canonical spec token (the ledger id; format/parse round-trip)."""
         if self.kind == "ckpt_corrupt":
-            return "ckpt_corrupt:latest"
+            return (
+                "ckpt_corrupt:reload" if self.reload
+                else "ckpt_corrupt:latest"
+            )
         if self.replica is not None:
+            if self.reload:
+                return f"kill:replica{self.replica}@reload"
             base = f"{self.kind}:replica{self.replica}@request{self.request}"
             if self.kind == "stall":
                 base += f":{self.seconds:g}s"
@@ -216,6 +246,19 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
         if _CORRUPT_RE.match(token):
             events.append(ChaosEvent(kind="ckpt_corrupt"))
             continue
+        if _CORRUPT_RELOAD_RE.match(token):
+            events.append(ChaosEvent(kind="ckpt_corrupt", reload=True))
+            continue
+        m = _REPLICA_RELOAD_RE.match(token)
+        if m:
+            events.append(
+                ChaosEvent(
+                    kind="kill",
+                    replica=int(m.group("replica")),
+                    reload=True,
+                )
+            )
+            continue
         m = _REPLICA_RE.match(token)
         if m:
             kind = m.group("kind")
@@ -276,6 +319,7 @@ def parse_chaos(spec: str | None) -> tuple[ChaosEvent, ...]:
             "stall:input@step<N>|epoch<N>:<S>s, ckpt_corrupt:latest, "
             "kill:replica<R>@request<N>, "
             "stall:replica<R>@request<N>:<S>s, "
+            "kill:replica<R>@reload, ckpt_corrupt:reload, "
             "kill:stage<K>@step<N>, stall:stage<K>@step<N>:<S>s"
         )
     return tuple(events)
@@ -294,6 +338,16 @@ def fleet_events(
     if isinstance(events, str) or events is None:
         events = parse_chaos(events)
     return tuple(e for e in events if e.replica is not None)
+
+
+def reload_events(
+    events: Iterable[ChaosEvent] | str | None,
+) -> tuple[ChaosEvent, ...]:
+    """The reload-scoped subset of a plan — what the fleet's hot-swap
+    loop (serve/fleet.py /reloadz) owns. Accepts a spec string."""
+    if isinstance(events, str) or events is None:
+        events = parse_chaos(events)
+    return tuple(e for e in events if e.reload)
 
 
 def stage_events(
@@ -430,6 +484,13 @@ class ChaosEngine:
     # ---- trigger points ----------------------------------------------
 
     def _mine(self, ev: ChaosEvent) -> bool:
+        if ev.reload:
+            # Lifecycle events (kill:replica<R>@reload,
+            # ckpt_corrupt:reload) fire from the fleet's hot-swap loop
+            # — a trainer rank never owns one (in particular rank 0's
+            # on_start must NOT corrupt a checkpoint that only a
+            # reload is supposed to see corrupted).
+            return False
         if ev.replica is not None:
             # Fleet events (kill:replica<R>@request<N>) fire from the
             # replica MANAGER's dispatch counter (serve/fleet.py) —

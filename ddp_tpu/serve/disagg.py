@@ -64,6 +64,7 @@ TRUNCATED = "truncated"
 CRC_MISMATCH = "crc_mismatch"
 HEADER_INVALID = "header_invalid"
 SHAPE_MISMATCH = "shape_mismatch"
+MODEL_SKEW = "model_skew"  # raised at install time, not decode time
 
 _PREFIX = struct.Struct("<4sHHII")  # magic, version, flags, crc, hlen
 _FLEN = struct.Struct("<I")
@@ -76,8 +77,10 @@ class PageWireError(ValueError):
 
     ``reason`` is one of ``bad_magic`` / ``version_skew`` /
     ``truncated`` / ``crc_mismatch`` / ``header_invalid`` /
-    ``shape_mismatch`` — the receiver's 400 body and the named error
-    the hardening tests pin. Raised before any byte touches a cache.
+    ``shape_mismatch`` / ``model_skew`` — the receiver's 400 body and
+    the named error the hardening tests pin. Raised before any byte
+    touches a cache (``model_skew`` at install time, once the
+    receiver's serving version is known).
     """
 
     def __init__(self, reason: str, detail: str = ""):
@@ -115,6 +118,12 @@ class PageFrame:
     # the header key is entirely absent in that case, so a tracing-off
     # fleet's wire bytes are identical to pre-trace builds.
     trace: Optional[str] = None
+    # Serving model identity of the EXPORTER (lifecycle version token,
+    # e.g. "ckpt_b@epoch0"). During a fleet /reloadz roll, replicas
+    # briefly serve different versions; KV computed under one model is
+    # garbage under another, so the install side refuses skewed frames
+    # by name (``model_skew``). Same absent-key gate as ``trace``.
+    model_version: Optional[str] = None
 
     @property
     def n_pages(self) -> int:
@@ -133,6 +142,7 @@ def encode_pages(
     positions: int = 0,
     sampling: Optional[dict] = None,
     trace: Optional[str] = None,
+    model_version: Optional[str] = None,
 ) -> bytes:
     """Page arrays -> one self-validating binary payload.
 
@@ -184,6 +194,10 @@ def encode_pages(
         "positions": int(positions),
         "sampling": dict(sampling or {}),
         **({"trace": str(trace)} if trace is not None else {}),
+        **(
+            {"model_version": str(model_version)}
+            if model_version is not None else {}
+        ),
         "frames": [name for name, _ in frames],
     }
     hbytes = json.dumps(header, separators=(",", ":")).encode()
@@ -254,6 +268,9 @@ def decode_pages(buf: bytes) -> PageFrame:
         trace = header.get("trace")
         if trace is not None:
             trace = str(trace)
+        model_version = header.get("model_version")
+        if model_version is not None:
+            model_version = str(model_version)
         frame_names = list(header["frames"])
     except (KeyError, TypeError, ValueError) as e:
         raise PageWireError(HEADER_INVALID, str(e)) from e
@@ -316,4 +333,5 @@ def decode_pages(buf: bytes) -> PageFrame:
         positions=positions,
         sampling=sampling,
         trace=trace,
+        model_version=model_version,
     )

@@ -451,6 +451,7 @@ class ServeEngine:
         trace_seed: Optional[int] = None,
         slo=None,
         recorder=None,
+        model_version: Optional[str] = None,
     ):
         # The whole knob surface validates + resolves through the
         # module-level resolver — the same rule set the autotuner's
@@ -501,6 +502,15 @@ class ServeEngine:
         min_bucket = knobs["min_bucket"]
         self.spec = spec
         self.params = params
+        # Model lifecycle (serve/lifecycle.py): the serving version
+        # label (None keeps every surface byte-identical to the
+        # pre-lifecycle engine), hot-swap counters, and the admission
+        # pause that drains lanes to the swap barrier WITHOUT dropping
+        # queued or newly-submitted work.
+        self.model_version = model_version
+        self.reloads_total = 0
+        self.rollbacks_total = 0
+        self._admission_paused = False
         self.num_slots = slots
         self.prefill_len = prefill_len
         self.prefill_chunk = chunk
@@ -794,6 +804,7 @@ class ServeEngine:
         timeout: Optional[float] = None,
         trace: Optional[str] = None,
         hops: Optional[dict] = None,
+        model: Optional[str] = None,
     ) -> Admission:
         """Admission-checked enqueue; rejections carry a reason.
 
@@ -825,6 +836,7 @@ class ServeEngine:
             seed=seed,
             timeout=timeout,
             trace_id=adopted[0] if adopted else None,
+            model=model,
         )
         if not adm.accepted:
             self.reject_counts[adm.reason] = (
@@ -863,6 +875,13 @@ class ServeEngine:
 
     @property
     def pending(self) -> bool:
+        # Admission-paused (hot-swap barrier): queued work is not
+        # steppable — only running lanes keep the loop hot, so a
+        # paused engine with an empty batch idles instead of spinning
+        # empty steps (and their serve_step records) while the swap's
+        # host-side load runs.
+        if self._admission_paused:
+            return self.active > 0
         return self.active > 0 or self.scheduler.depth > 0
 
     def compile_counts(self) -> dict[str, int]:
@@ -953,6 +972,81 @@ class ServeEngine:
         jax.block_until_ready(self._toks)
         return self.compile_counts()
 
+    # ---- model lifecycle (serve/lifecycle.py) -----------------------
+
+    def pause_admission(self) -> None:
+        """Stop BINDING queued requests to lanes (step() skips the
+        admit phase) while running lanes decode to completion — the
+        drain-to-a-barrier half of a hot-swap. Unlike the server's
+        drain (503s new work to a replacement process), paused
+        admission keeps accepting submissions into the queue: nothing
+        is dropped across a swap, requests just wait it out."""
+        self._admission_paused = True
+
+    def resume_admission(self) -> None:
+        self._admission_paused = False
+
+    def install_params(
+        self,
+        params: Any,
+        *,
+        model_version: Optional[str] = None,
+        invalidate_prefix: bool = False,
+    ) -> None:
+        """Atomically swap the serving weights in place.
+
+        Requires a drained engine (``active == 0`` — pause admission
+        and let the lanes retire first). The compiled program set is
+        untouched: params are a per-dispatch argument (only the cache
+        is donated), so a same-shaped tree swaps with ZERO
+        recompilation — which is also why the tree must match the
+        serving one exactly (structure, shapes, dtypes); a skew here
+        raises before any state changes and the caller rolls back.
+        The old leaves are released by reference drop, never
+        ``.delete()``d — callers legitimately install the same tree
+        they are serving (the token-identity drill) or hold the old
+        tree for rollback.
+
+        ``invalidate_prefix`` flushes the radix prefix index and page
+        table (paged engines): cached K/V was computed under the OLD
+        weights, so any version change must drop it; a same-version
+        reinstall keeps the cache (and token identity) intact.
+        """
+        if self.active:
+            raise RuntimeError(
+                "install_params() requires a drained engine "
+                f"({self.active} lanes still bound — pause admission "
+                "and wait for retirement)"
+            )
+        old_leaves, old_def = jax.tree.flatten(self.params)
+        new_leaves, new_def = jax.tree.flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                "spec_skew: incoming parameter tree structure differs "
+                "from the serving tree"
+            )
+        for old, new in zip(old_leaves, new_leaves):
+            if (
+                tuple(old.shape) != tuple(new.shape)
+                or old.dtype != new.dtype
+            ):
+                raise ValueError(
+                    f"spec_skew: leaf {tuple(new.shape)}/{new.dtype} "
+                    f"!= serving {tuple(old.shape)}/{old.dtype}"
+                )
+        self.params = params
+        if model_version is not None:
+            self.model_version = model_version
+        self.reloads_total += 1
+        if invalidate_prefix and self.paged:
+            # No lane owns pages at the barrier, so every mapped page
+            # belongs to the (now stale) prefix index: rebuild it and
+            # zero the host table — exactly the startup state, with
+            # the pool's garbage bytes unreferenced until re-written.
+            self._prefix = PrefixCache(self.kv_pages, self.page_size)
+            self._table_np[:] = 0
+            self._table_dirty = True
+
     def cache_bytes_per_slot(self) -> int:
         """KV-cache HBM per decode lane, scales included — the number
         int8 quantization halves (better: int8 rows + one fp32 scale
@@ -1028,6 +1122,10 @@ class ServeEngine:
             # DPKV header so the install side of the migration sees
             # the same trace id (absent-key byte-identical when off).
             trace=trace,
+            # Serving identity: lets the install side refuse pages
+            # computed under a different model mid-/reloadz (absent on
+            # version-less engines — pre-lifecycle wire bytes).
+            model_version=self.model_version,
         )
 
     def install_prefix(self, frame) -> Optional[dict]:
@@ -1043,18 +1141,35 @@ class ServeEngine:
         Raises serve/disagg.PageWireError(shape_mismatch) when the
         frame's geometry or dtype disagrees with this engine — a
         fleet mixing engine configs must fail loudly, not dequantize
-        garbage. Installed pages enter the index CACHED, so the next
+        garbage — and PageWireError(model_skew) when both sides carry
+        a lifecycle version and they differ: during a one-at-a-time
+        /reloadz roll the fleet briefly serves two model versions, and
+        KV prefilled under the other one must not be adopted here. Installed pages enter the index CACHED, so the next
         local admission maps them as an ordinary prefix hit — the
         decode stream is then the same continuation-program replay a
         local hit takes, which is what makes migrated streams
         token-identical to a hybrid replica (pinned by
         tests/test_disagg.py).
         """
-        from ddp_tpu.serve.disagg import SHAPE_MISMATCH, PageWireError
+        from ddp_tpu.serve.disagg import (
+            MODEL_SKEW,
+            SHAPE_MISMATCH,
+            PageWireError,
+        )
 
         if not self.paged:
             raise PageWireError(
                 SHAPE_MISMATCH, "this engine is not paged (--page_size)"
+            )
+        if (
+            frame.model_version is not None
+            and self.model_version is not None
+            and frame.model_version != self.model_version
+        ):
+            raise PageWireError(
+                MODEL_SKEW,
+                f"frame from {frame.model_version}, this replica "
+                f"serves {self.model_version}",
             )
         quant = self._cache.quantized()
         depth, _, ps, h_kv, d_head = self._cache.k.shape
@@ -1187,6 +1302,26 @@ class ServeEngine:
             **(
                 {"paged": self.page_stats()} if self.paged else {}
             ),
+            # Model lifecycle (serve/lifecycle.py): absent until a
+            # version label is set or a swap/rollback has happened —
+            # a pre-lifecycle engine's stats stay byte-identical.
+            **(
+                {
+                    "lifecycle": {
+                        **(
+                            {"model_version": self.model_version}
+                            if self.model_version is not None
+                            else {}
+                        ),
+                        "reloads_total": self.reloads_total,
+                        "rollbacks_total": self.rollbacks_total,
+                    }
+                }
+                if self.model_version is not None
+                or self.reloads_total
+                or self.rollbacks_total
+                else {}
+            ),
             # SLO + request-trace state render only when configured:
             # with both off the /metricsz exposition stays
             # byte-identical to the pre-SLO engine's (the PR-2/PR-9
@@ -1314,6 +1449,11 @@ class ServeEngine:
             evictions += 1
 
         for slot in self._slots:
+            # Admission pause (hot-swap barrier): running lanes keep
+            # decoding above; queue heads stay queued until the swap
+            # commits or rolls back.
+            if self._admission_paused:
+                break
             if not slot.free or self.scheduler.depth == 0:
                 continue
             req = self.scheduler.next_request()
@@ -1906,6 +2046,12 @@ class ServeEngine:
         # TTFT hit-vs-miss split reads this).
         if c.prefix_hit_tokens is not None:
             fields["prefix_hit_tokens"] = c.prefix_hit_tokens
+        # Which model served this request — only engines with a
+        # version label carry it (pre-lifecycle streams unchanged);
+        # stamped at retirement, so a request that straddled a swap is
+        # attributed to the version that finished it.
+        if self.model_version is not None:
+            fields["model_version"] = self.model_version
         # Per-hop seconds (ISSUE 19): only requests the router staged
         # with a fleet trace carry the key — the router's queue/
         # handoff/migrate seconds joined with this engine's own

@@ -83,7 +83,11 @@ from ddp_tpu.obs.reqtrace import (
     format_trace_id,
     splitmix64,
 )
-from ddp_tpu.runtime.chaos import ChaosEvent, fleet_events
+from ddp_tpu.runtime.chaos import (
+    ChaosEvent,
+    fleet_events,
+    reload_events,
+)
 from ddp_tpu.runtime.launch import classify_exit, free_port
 from ddp_tpu.utils.metrics import StatSummary
 
@@ -403,6 +407,13 @@ class Replica:
         self.queue_depth = 0
         self.started_at: Optional[float] = None
         self.refused_probes = 0  # consecutive, on a non-STARTING replica
+        # Model-lifecycle view (refreshed from /healthz): the serving
+        # model version plus any registered named models. None/() on
+        # pre-lifecycle replicas — and the gate the router uses so a
+        # ``model=`` request never lands on a replica that does not
+        # (yet) serve that model.
+        self.model_version: Optional[str] = None
+        self.models: tuple[str, ...] = ()
 
     @property
     def load(self) -> int:
@@ -426,6 +437,16 @@ class Replica:
             "breaker": self.breaker.snapshot(),
             **(
                 {"last_exit": self.last_exit} if self.last_exit else {}
+            ),
+            # Lifecycle keys ride only once a versioned model is
+            # advertised — versionless snapshots stay byte-identical.
+            **(
+                {"model_version": self.model_version}
+                if self.model_version is not None
+                else {}
+            ),
+            **(
+                {"models": list(self.models)} if self.models else {}
             ),
         }
 
@@ -612,8 +633,24 @@ class Router:
             or r.role == need
         )
 
+    def _serves_model(self, r: Replica, model: Optional[str]) -> bool:
+        """Model gating (lifecycle PR): a ``model=`` request only goes
+        to a replica that advertises it — a registered named model, or
+        the serving model VERSION itself, which is how a mid-roll
+        fleet keeps version-pinned requests off not-yet-swapped
+        replicas. None (every pre-lifecycle request) disables the
+        filter."""
+        return (
+            model is None
+            or model in r.models
+            or model == r.model_version
+        )
+
     def _eligible(
-        self, exclude: set[int], need: Optional[str] = None
+        self,
+        exclude: set[int],
+        need: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> list[Replica]:
         return [
             r
@@ -622,6 +659,7 @@ class Router:
             and r.breaker.allow_traffic()
             and r.index not in exclude
             and self._capable(r, need)
+            and self._serves_model(r, model)
         ]
 
     def _saturated(self, r: Replica) -> bool:
@@ -633,6 +671,7 @@ class Router:
         prompt: Sequence[int],
         exclude: set[int],
         need: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> Optional[Replica]:
         """Affinity-preferred, least-loaded otherwise. Call under the
         lock. The preferred index is ``key % len(replicas)`` over the
@@ -644,7 +683,7 @@ class Router:
         dispatches."""
         if need is None and self._role_aware:
             need = ROLE_DECODE
-        elig = self._eligible(exclude, need)
+        elig = self._eligible(exclude, need, model)
         if not elig:
             return None
         if not self.config.affinity:
@@ -666,6 +705,12 @@ class Router:
         """POST /generate through the robustness envelope →
         (http_status, payload-with-router-digest)."""
         prompt = body.get("prompt_tokens") or []
+        # Multi-model routing label: selection only considers replicas
+        # that advertise this model (name or version); the replica's
+        # own /generate handler re-validates it.
+        model = body.get("model")
+        if not isinstance(model, str):
+            model = None
         try:
             timeout = (
                 float(body["timeout"]) if body.get("timeout") is not None
@@ -732,7 +777,7 @@ class Router:
                     504, {"error": "deadline_exceeded"}, digest, tctx,
                 )
             with self._lock:
-                first = self._select(prompt, exclude)
+                first = self._select(prompt, exclude, model=model)
             if first is None:
                 # Idle rounds spend the same budget as failed
                 # attempts: a fleet of open breakers must converge on
@@ -792,7 +837,10 @@ class Router:
                 continue
             digest["attempts"] += 1
             winner, status, payload, hedged, hedge_won, failures = (
-                self._race(first, prompt, body, deadline, exclude, tctx)
+                self._race(
+                    first, prompt, body, deadline, exclude, tctx,
+                    model=model,
+                )
             )
             if hedged:
                 digest["hedged"] = True
@@ -1284,6 +1332,7 @@ class Router:
         deadline: float,
         exclude: set[int],
         tctx: Optional[dict] = None,
+        model: Optional[str] = None,
     ):
         """Run one attempt; if it straggles past ``hedge_after_s``,
         duplicate it to a second replica — FIRST COMPLETION WINS, the
@@ -1377,6 +1426,7 @@ class Router:
                     second = self._select(
                         prompt,
                         exclude | set(outstanding),
+                        model=model,
                     )
                 if second is not None:
                     hedged = True
@@ -1472,6 +1522,34 @@ class Router:
                 "hedge_wins_total": self.hedge_wins_total,
                 "no_replica_total": self.no_replica_total,
                 "deadline_exceeded_total": self.deadline_exceeded_total,
+                # Lifecycle block: version → replica count, ABSENT
+                # until any replica advertises one — the reload loop's
+                # convergence check ("fleet serves exactly one
+                # version") and /healthz read it; versionless fleets
+                # stay byte-identical.
+                **(
+                    {
+                        "model_versions": {
+                            v: sum(
+                                1
+                                for r in self.replicas
+                                if r.model_version == v
+                            )
+                            for v in sorted(
+                                {
+                                    r.model_version
+                                    for r in self.replicas
+                                    if r.model_version is not None
+                                }
+                            )
+                        }
+                    }
+                    if any(
+                        r.model_version is not None
+                        for r in self.replicas
+                    )
+                    else {}
+                ),
                 # Disaggregation block: ABSENT on classic fleets, so
                 # every downstream surface (fleet_poll records,
                 # /metricsz gauges, health_report triage) stays
@@ -1609,6 +1687,7 @@ class ReplicaManager:
         ]
         self.restarts_total = 0
         self.rolling_restarts_total = 0
+        self.fleet_reloads_total = 0
         self.chaos_kills = 0
         self.chaos_stalls = 0
         self._logs: dict[int, object] = {}
@@ -1837,6 +1916,166 @@ class ReplicaManager:
         self.rolling_restarts_total += 1
         return {"ok": True, "replicas": report}
 
+    # ---- verified hot-swap (serve/lifecycle.py, zero churn) ----------
+
+    def pin_checkpoint(
+        self, directory: str, epoch: Optional[int] = None
+    ) -> None:
+        """Re-point the spawn argv at a checkpoint: every FUTURE
+        respawn (crash recovery, rolling restart) starts on it.
+        ``reload_fleet`` calls this only after the FIRST replica
+        commits a verified swap — until then the swap target is not
+        trusted, and a replica dying mid-swap restarts on its
+        PREVIOUS checkpoint."""
+        with self._lock:
+            args = list(self.serve_args)
+            for flag in ("--checkpoint_dir", "--epoch"):
+                while flag in args:
+                    i = args.index(flag)
+                    del args[i : i + 2]
+            args += ["--checkpoint_dir", directory]
+            if epoch is not None:
+                args += ["--epoch", str(int(epoch))]
+            self.serve_args = args
+
+    def _wait_replica_healthy(
+        self, rep: Replica, timeout: float
+    ) -> bool:
+        deadline = self._clock() + timeout
+        while self._clock() < deadline:
+            if (
+                rep.state == HEALTHY
+                and rep.proc is not None
+                and rep.proc.poll() is None
+            ):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def reload_fleet(
+        self,
+        directory: str,
+        *,
+        epoch: Optional[int] = None,
+        drain_timeout: float = 30.0,
+        reload_timeout: float = 300.0,
+        healthy_timeout: float = 180.0,
+        chaos: Optional["FleetChaos"] = None,
+    ) -> dict:
+        """Fleet-wide verified hot-swap, ONE replica at a time — the
+        zero-churn upgrade ``/rollz`` cannot be.
+
+        Each replica gets ``POST /reload`` (verify → load → barrier →
+        swap → rollback, serve/server.py): no DRAINING, no SIGTERM, no
+        respawn — requests in flight complete on the old weights and
+        the next dispatch sees the new ones. Per replica the budget is
+        two tries: a replica that dies mid-swap (the SIGKILL drill) is
+        respawned by the poll loop on its PINNED checkpoint — the OLD
+        one until the first successful commit trusts the target
+        (``pin_checkpoint``) — and the reload is re-issued once it is
+        healthy again. A NAMED verification rejection (manifest
+        missing, CRC mismatch, spec skew) or a swap failure aborts the
+        roll immediately: the fleet keeps serving the old version,
+        converged. Returns {"ok", "version", "respawns", "replicas"}.
+        """
+        report: list[dict] = []
+        respawns_before = self.restarts_total
+        target_version: Optional[str] = None
+        pinned = False
+        for rep in self.replicas:
+            entry: dict = {"replica": rep.index, "ok": False}
+            report.append(entry)
+            for attempt in (1, 2):
+                entry["attempts"] = attempt
+                if not self._wait_replica_healthy(rep, healthy_timeout):
+                    entry["error"] = "never_healthy"
+                    return {
+                        "ok": False,
+                        "version": target_version,
+                        "respawns": self.restarts_total
+                        - respawns_before,
+                        "replicas": report,
+                    }
+                if (
+                    target_version is not None
+                    and rep.model_version == target_version
+                ):
+                    # Died AFTER committing (or after the pin): its
+                    # respawn already serves the target — /healthz
+                    # says so, nothing to re-issue.
+                    entry["ok"] = True
+                    entry["model_version"] = target_version
+                    break
+                if chaos is not None:
+                    # Arms the kill:replica<R>@reload drill — a racing
+                    # SIGKILL that lands while this reload is mid-
+                    # flight.
+                    chaos.on_replica_reload(rep.index)
+                body = {
+                    "checkpoint_dir": directory,
+                    "drain_timeout": drain_timeout,
+                }
+                if epoch is not None:
+                    body["epoch"] = int(epoch)
+                try:
+                    status, payload = self.transport.start(
+                        rep.url, "/reload", body, reload_timeout
+                    ).run()
+                except ReplicaUnreachable:
+                    # The process died mid-swap. Its device state died
+                    # with it — nothing torn survives; the poll loop
+                    # respawns it on the pinned checkpoint and the
+                    # next attempt re-checks /healthz.
+                    entry["died_mid_swap"] = True
+                    logger.warning(
+                        "fleet reload: replica %d died mid-swap "
+                        "(attempt %d)", rep.index, attempt,
+                    )
+                    continue
+                if status == 200:
+                    entry["ok"] = True
+                    entry["model_version"] = payload.get("model_version")
+                    entry["swap_s"] = payload.get("swap_s")
+                    target_version = payload.get("model_version")
+                    if not pinned:
+                        self.pin_checkpoint(
+                            directory, payload.get("epoch")
+                        )
+                        pinned = True
+                    break
+                # Named rejection (409) or load/swap failure (500/503):
+                # the replica rolled back or never started — abort the
+                # roll, the whole fleet stays on the old version.
+                entry["error"] = payload.get("error")
+                if payload.get("detail"):
+                    entry["detail"] = payload["detail"]
+                logger.warning(
+                    "fleet reload: replica %d rejected the target "
+                    "(%s) — aborting the roll", rep.index,
+                    entry["error"],
+                )
+                return {
+                    "ok": False,
+                    "aborted": entry["error"],
+                    "version": target_version,
+                    "respawns": self.restarts_total - respawns_before,
+                    "replicas": report,
+                }
+            if not entry["ok"]:
+                return {
+                    "ok": False,
+                    "version": target_version,
+                    "respawns": self.restarts_total - respawns_before,
+                    "replicas": report,
+                }
+        self.fleet_reloads_total += 1
+        return {
+            "ok": True,
+            "version": target_version,
+            "respawns": self.restarts_total - respawns_before,
+            "replicas": report,
+        }
+
     # ---- supervision -------------------------------------------------
 
     def _poll_loop(self) -> None:
@@ -1875,6 +2114,16 @@ class ReplicaManager:
             rep.slots = health.get("slots", rep.slots)
             rep.active = int(health.get("active") or 0)
             rep.queue_depth = int(health.get("queue_depth") or 0)
+            # Lifecycle advertisement: which model version (and which
+            # named models) this replica serves RIGHT NOW — what keeps
+            # the router's model gate and the reload loop's
+            # convergence check current across swaps and respawns.
+            mv = health.get("model_version")
+            rep.model_version = mv if isinstance(mv, str) else None
+            models = health.get("models")
+            rep.models = (
+                tuple(sorted(models)) if isinstance(models, dict) else ()
+            )
             if not ok:
                 # Answers HTTP but reports sick (engine loop died):
                 # breaker-open it like a timeout series would.
@@ -1985,6 +2234,13 @@ class ReplicaManager:
             "chaos_kills": self.chaos_kills,
             "chaos_stalls": self.chaos_stalls,
             "max_restarts": self.max_restarts,
+            # Gated on use: pre-lifecycle fleets' state (and every
+            # record/gauge built from it) stays byte-identical.
+            **(
+                {"fleet_reloads_total": self.fleet_reloads_total}
+                if self.fleet_reloads_total
+                else {}
+            ),
         }
 
     def _write_poll_record(self) -> None:
@@ -2048,9 +2304,12 @@ class ReplicaManager:
 
 class FleetChaos:
     """Fires ``kill:replica<R>@request<N>`` / ``stall:...`` events on
-    the router's dispatch counter. In-memory once-latch (a fleet
-    frontend doesn't restart mid-drill the way a trainer does, so no
-    ledger file); wire via ``Router(on_dispatch=chaos.on_dispatch)``.
+    the router's dispatch counter, and the lifecycle drills
+    (``kill:replica<R>@reload`` / ``ckpt_corrupt:reload``) from the
+    fleet reload loop's hooks. In-memory once-latch (a fleet frontend
+    doesn't restart mid-drill the way a trainer does, so no ledger
+    file); wire via ``Router(on_dispatch=chaos.on_dispatch)`` and
+    ``FleetServer(chaos=...)``.
     """
 
     def __init__(
@@ -2059,6 +2318,7 @@ class FleetChaos:
         manager: ReplicaManager,
     ):
         self.events = fleet_events(events)
+        self.reloads = reload_events(events)
         self.manager = manager
         self._fired: set[str] = set()
         for ev in self.events:
@@ -2070,7 +2330,7 @@ class FleetChaos:
 
     @property
     def enabled(self) -> bool:
-        return bool(self.events)
+        return bool(self.events) or bool(self.reloads)
 
     def on_dispatch(self, ordinal: int) -> None:
         for ev in self.events:
@@ -2080,6 +2340,46 @@ class FleetChaos:
                     self.manager.kill_replica(ev.replica)
                 else:
                     self.manager.stall_replica(ev.replica, ev.seconds)
+
+    def on_reload_start(self, directory: str) -> None:
+        """``ckpt_corrupt:reload``: corrupt the INCOMING checkpoint
+        before the roll's first verify runs — the drill that proves a
+        reload rejects-and-keeps-serving (named CRC reason, old model
+        untouched) instead of installing garbage."""
+        from ddp_tpu.runtime.chaos import corrupt_latest_checkpoint
+
+        for ev in self.reloads:
+            if (
+                ev.kind == "ckpt_corrupt"
+                and ev.token not in self._fired
+            ):
+                self._fired.add(ev.token)
+                logger.warning(
+                    "fleet chaos: corrupting reload target %s",
+                    directory,
+                )
+                corrupt_latest_checkpoint(directory)
+
+    def on_replica_reload(self, index: int) -> None:
+        """``kill:replica<R>@reload``: SIGKILL replica R from a racing
+        thread shortly after its reload is issued, so the kill lands
+        mid-swap (during the verify/load/drain window) — the drill
+        behind the "a torn model never survives a death" guarantee."""
+        for ev in self.reloads:
+            if (
+                ev.kind == "kill"
+                and ev.replica == index
+                and ev.token not in self._fired
+            ):
+                self._fired.add(ev.token)
+
+                def _kill() -> None:
+                    time.sleep(0.05)
+                    self.manager.kill_replica(index)
+
+                threading.Thread(
+                    target=_kill, name="chaos-reload-kill", daemon=True
+                ).start()
 
 
 # ---------------------------------------------------------------------
@@ -2107,6 +2407,12 @@ class FleetServer:
       POST /rollz      → rolling restart (drain → wait → restart →
                          re-admit, one replica at a time), in the
                          background; the response acknowledges start
+      POST /reloadz    → fleet-wide verified hot-swap
+                         {"checkpoint_dir": D, "epoch"?}: one replica
+                         at a time through each member's POST /reload
+                         — zero process churn, zero dropped requests;
+                         backgrounded like /rollz, progress under
+                         /statusz ``reload``
 
     Draining the FLEET (SIGTERM path): stop admitting here (503 +
     Retry-After, the single-replica contract), then drain members.
@@ -2120,15 +2426,22 @@ class FleetServer:
         host: str = "127.0.0.1",
         port: int = 0,
         drain_retry_after: float = 5.0,
+        chaos: Optional[FleetChaos] = None,
     ):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.manager = manager
         self.router = router
         self.drain_retry_after = float(drain_retry_after)
+        # Reload-scoped chaos (kill:replica<R>@reload /
+        # ckpt_corrupt:reload) fires from the /reloadz path; None —
+        # every non-drill fleet — changes nothing.
+        self.chaos = chaos
         self._draining = threading.Event()
         self._roll_thread: Optional[threading.Thread] = None
         self._roll_state: dict = {"running": False}
+        self._reload_thread: Optional[threading.Thread] = None
+        self._reload_state: dict = {"running": False}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -2179,6 +2492,21 @@ class FleetServer:
                 route = self.path.partition("?")[0]
                 if route == "/rollz":
                     self._send(*server.start_roll())
+                    return
+                if route == "/reloadz":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError(
+                                "body must be a JSON object"
+                            )
+                    except (ValueError, TypeError) as e:
+                        self._send(
+                            400, {"error": f"bad JSON body: {e}"}
+                        )
+                        return
+                    self._send(*server.start_reload(body))
                     return
                 if route != "/generate":
                     self._send(
@@ -2267,6 +2595,14 @@ class FleetServer:
             "replicas_healthy": rs["replicas_healthy"],
             "replicas_draining": rs["replicas_draining"],
             "replicas_dead": rs["replicas_dead"],
+            # version → replica count, present only once members
+            # advertise versions: one key while converged, two
+            # mid-roll — the drill's convergence assertion reads this.
+            **(
+                {"model_versions": rs["model_versions"]}
+                if "model_versions" in rs
+                else {}
+            ),
         }
 
     def requestz(self, query: str) -> tuple[int, dict]:
@@ -2333,6 +2669,14 @@ class FleetServer:
             "router": self.router.state(),
             "manager": self.manager.state(),
             "roll": dict(self._roll_state),
+            # Fleet-reload progress, present only once a /reloadz ran
+            # (pre-lifecycle /statusz stays byte-identical).
+            **(
+                {"reload": dict(self._reload_state)}
+                if self._reload_state.get("running")
+                or len(self._reload_state) > 1
+                else {}
+            ),
             "fleet": merge_fleet([v for v in views if v is not None]),
             "build_info": build_info(),
         }
@@ -2367,3 +2711,43 @@ class FleetServer:
         )
         self._roll_thread.start()
         return 202, {"rolling": True}
+
+    def start_reload(self, body: dict) -> tuple[int, dict]:
+        """POST /reloadz: kick the fleet-wide verified hot-swap in the
+        background (one member /reload at a time — a fleet's worth of
+        drains and restores outlives any sane HTTP socket); /statusz
+        ``reload`` tracks progress and the final report."""
+        directory = body.get("checkpoint_dir")
+        if not isinstance(directory, str) or not directory:
+            return 400, {"error": "body needs checkpoint_dir (str)"}
+        try:
+            epoch = (
+                int(body["epoch"]) if body.get("epoch") is not None
+                else None
+            )
+        except (TypeError, ValueError):
+            return 400, {"error": "epoch must be an int"}
+        if (
+            self._reload_thread is not None
+            and self._reload_thread.is_alive()
+        ):
+            return 409, {"error": "fleet reload already running"}
+        if self._roll_thread is not None and self._roll_thread.is_alive():
+            return 409, {"error": "rolling restart running"}
+        if self.chaos is not None:
+            # The corrupt-target drill fires BEFORE the first verify —
+            # the roll must reject it and leave every replica serving.
+            self.chaos.on_reload_start(directory)
+
+        def _reload() -> None:
+            self._reload_state = {"running": True}
+            result = self.manager.reload_fleet(
+                directory, epoch=epoch, chaos=self.chaos
+            )
+            self._reload_state = {"running": False, **result}
+
+        self._reload_thread = threading.Thread(
+            target=_reload, name="fleet-reload", daemon=True
+        )
+        self._reload_thread.start()
+        return 202, {"reloading": True}

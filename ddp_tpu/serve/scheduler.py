@@ -108,6 +108,12 @@ class Request:
     # Perfetto trace and /requestz. 0 = unassigned (bare schedulers
     # constructed without a trace seed in tests).
     trace_id: int = 0
+    # Multi-model routing label (serve/lifecycle.py): which registered
+    # model this request named (``model=`` in the body). None — every
+    # pre-lifecycle client — means the default model; the server
+    # routes on it, and per-model engines each run their own scheduler
+    # so slot/page accounting stays per-model by construction.
+    model: Optional[str] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -161,6 +167,7 @@ class Scheduler:
         seed: int = 0,
         timeout: Optional[float] = None,
         trace_id: Optional[int] = None,
+        model: Optional[str] = None,
     ) -> Admission:
         """Validate + enqueue → Admission (never raises on bad input).
 
@@ -220,6 +227,7 @@ class Scheduler:
                 if trace_id
                 else derive_trace_id(self.trace_seed, rid)
             ),
+            model=model,
         )
         self._queue.append(req)
         return Admission(True, request=req)

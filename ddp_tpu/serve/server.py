@@ -7,7 +7,9 @@ one device program's state). No web framework: ``http.server`` is in
 every container this repo targets, and the API is three routes:
 
   POST /generate   {"prompt_tokens": [...], "max_new_tokens": N,
-                    "temperature"?, "top_p"?, "seed"?, "timeout"?}
+                    "temperature"?, "top_p"?, "seed"?, "timeout"?,
+                    "model"?: registered model name (multi-model
+                    serving; absent → the default model)}
                    → 200 {"rid", "status", "tokens", "ttft_s", ...}
                    → 429 {"error": "queue_full"} + ``Retry-After``
                      (the measured queue-drain ETA) on backpressure
@@ -33,6 +35,19 @@ every container this repo targets, and the API is three routes:
                     occupancy, rejects, SLO burn gauges, build info,
                     goodput — obs/promtext.py), so runs are
                     scrapeable without parsing JSONL
+  POST /reload     {"checkpoint_dir": D, "epoch"?, "model"?,
+                    "drain_timeout"?} — verified atomic hot-swap
+                    (serve/lifecycle.py): verify the incoming
+                    checkpoint (manifest CRCs + spec) BEFORE touching
+                    device state, restore host-side while the old
+                    model keeps serving, drain lanes to a barrier,
+                    swap under the lock, roll back on any failure.
+                   → 200 {"reloaded", "model_version", ...}
+                   → 409 {"error": "manifest_missing" |
+                     "crc_mismatch" | "spec_skew", "detail"} — named
+                     rejections, old model untouched
+                   → 500 load_failed / swap_failed (rolled_back)
+                   → 503 swap_drain_timeout (lanes never retired)
   GET  /requestz?id=RID|0xTRACEID
                    → 200 one request's full lifecycle timeline
                     (admit → queue → prefill chunks → spec rounds →
@@ -78,6 +93,7 @@ class LMServer:
         port: int = 0,
         drain_retry_after: float = 5.0,
         role: Optional[str] = None,
+        models: Optional[dict] = None,
     ):
         # Disaggregated-serving role (PR 16): "prefill" | "decode" |
         # "hybrid" advertised on /healthz and /statusz so the fleet
@@ -86,6 +102,13 @@ class LMServer:
         # every surface byte-identical to the pre-disagg server.
         self.role = role
         self.engine = engine
+        # Multi-model serving (lifecycle tentpole): extra NAMED
+        # engines, each with its own scheduler/slots/pages — per-model
+        # accounting by construction. ``model=`` in a /generate body
+        # routes here; absent routes to the default engine. Empty
+        # (every pre-lifecycle setup) keeps all surfaces byte-
+        # identical: no ``models`` key anywhere.
+        self.models: dict = dict(models or {})
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -94,6 +117,10 @@ class LMServer:
         # retries after this many seconds.
         self.drain_retry_after = float(drain_retry_after)
         self._engine_error: Optional[str] = None
+        # One reload at a time (non-blocking: a second POST /reload
+        # while one runs answers 409 reload_in_progress rather than
+        # queueing swaps).
+        self._reload_lock = threading.Lock()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.host = host
@@ -146,12 +173,12 @@ class LMServer:
         deadline = time.monotonic() + max(0.0, timeout)
         while time.monotonic() < deadline:
             with self._lock:
-                idle = not self.engine.pending
+                idle = not any(e.pending for e in self._engines())
             if idle or self._engine_error is not None:
                 return True
             time.sleep(poll)
         with self._lock:
-            return not self.engine.pending
+            return not any(e.pending for e in self._engines())
 
     def __enter__(self) -> "LMServer":
         return self.start()
@@ -165,17 +192,26 @@ class LMServer:
 
     # ---- engine driving ---------------------------------------------
 
+    def _engines(self):
+        """Every engine this server drives: default first, then the
+        named multi-model extras in registration order."""
+        return [self.engine, *self.models.values()]
+
     def _engine_loop(self) -> None:
         # An exception escaping step() (device OOM, runtime error) must
         # not kill this daemon thread SILENTLY: waiters would poll
         # forever and /healthz would keep answering ok. Record it, flip
-        # health, and fail in-flight requests fast instead.
+        # health, and fail in-flight requests fast instead. Multi-model
+        # engines step round-robin under the one lock — the device is
+        # one program's state at a time either way.
         try:
             while not self._stop.is_set():
-                with self._lock:
-                    busy = self.engine.pending
-                    if busy:
-                        self.engine.step()
+                busy = False
+                for eng in self._engines():
+                    with self._lock:
+                        if eng.pending:
+                            busy = True
+                            eng.step()
                 if not busy:
                     time.sleep(_IDLE_SLEEP_S)
         except Exception as e:  # noqa: BLE001 — terminal, reported
@@ -209,6 +245,22 @@ class LMServer:
                 "max_new_tokens (int); temperature/top_p/seed/timeout "
                 "must be numeric"
             }
+        # Multi-model routing: a named model goes to its own engine
+        # (own scheduler/slots/pages); absent → the default engine. An
+        # unknown name is a permanent client error, and the answer
+        # lists what IS registered — misrouting to the default model
+        # would silently serve the wrong weights.
+        model = body.get("model")
+        if model is not None:
+            if model not in self.models:
+                return 400, {
+                    "error": "unknown_model",
+                    "model": model,
+                    "models": sorted(self.models),
+                }
+            engine = self.models[model]
+        else:
+            engine = self.engine
         if self._engine_error is not None:
             return 500, {"error": f"engine failed: {self._engine_error}"}
         if self._draining.is_set():
@@ -220,7 +272,7 @@ class LMServer:
                 "retry_after_s": self.drain_retry_after,
             }
         with self._lock:
-            adm = self.engine.submit(
+            adm = engine.submit(
                 prompt,
                 max_new,
                 temperature=temperature,
@@ -229,6 +281,7 @@ class LMServer:
                 timeout=timeout,
                 trace=trace,
                 hops=hops,
+                model=model,
             )
         if not adm.accepted:
             # Only queue_full is transient (retry-after-backoff
@@ -242,7 +295,7 @@ class LMServer:
                 # the static drain hint before any retire window
                 # exists. In the JSON too, for in-process callers.
                 with self._lock:
-                    eta = self.engine.queue_drain_eta_s()
+                    eta = engine.queue_drain_eta_s()
                 retry_after = (
                     min(60.0, max(1.0, eta))
                     if eta is not None
@@ -256,7 +309,7 @@ class LMServer:
         rid = adm.request.rid
         while True:
             with self._lock:
-                done = self.engine.pop_result(rid)
+                done = engine.pop_result(rid)
             if done is not None:
                 break
             if self._engine_error is not None:
@@ -282,6 +335,16 @@ class LMServer:
                 if done.prefix_hit_tokens is not None
                 else {}
             ),
+            # Which model version served this request (absent on
+            # engines that never loaded a versioned checkpoint — the
+            # pre-lifecycle payload is byte-identical). The swap
+            # drills read it to prove zero requests ever saw a torn
+            # model.
+            **(
+                {"model_version": engine.model_version}
+                if engine.model_version is not None
+                else {}
+            ),
             # Adoption echo (ISSUE 19): present ONLY when the request
             # carried a VALID inbound trace context — the router reads
             # it to count propagated-vs-orphaned. Requests without a
@@ -302,6 +365,178 @@ class LMServer:
             {"trace_id": format_trace_id(ctx[0])} if ctx else {}
         )
 
+    # ---- verified atomic hot-swap (serve/lifecycle.py) --------------
+
+    def reload_model(
+        self, body: dict, *, poll: float = 0.005
+    ) -> tuple[int, dict]:
+        """The POST /reload implementation → (http_status, payload).
+
+        verify → load → drain-to-barrier → swap → (rollback), with the
+        old model serving until the instant of the swap and again
+        after any failure — a reload can be slow, but it can never be
+        torn. Verification and the host-side restore run OUTSIDE the
+        engine lock (requests keep flowing); only the final barrier +
+        pointer swap hold it, and only once ``active == 0``.
+        """
+        directory = body.get("checkpoint_dir")
+        if not isinstance(directory, str) or not directory:
+            return 400, {"error": "body needs checkpoint_dir (str)"}
+        try:
+            epoch = (
+                int(body["epoch"]) if body.get("epoch") is not None
+                else None
+            )
+            drain_timeout = float(body.get("drain_timeout", 30.0))
+        except (TypeError, ValueError):
+            return 400, {"error": "epoch/drain_timeout must be numeric"}
+        model = body.get("model")
+        if model is not None:
+            if model not in self.models:
+                return 400, {
+                    "error": "unknown_model",
+                    "model": model,
+                    "models": sorted(self.models),
+                }
+            engine = self.models[model]
+        else:
+            engine = self.engine
+        if self._engine_error is not None:
+            return 500, {"error": f"engine failed: {self._engine_error}"}
+        if not self._reload_lock.acquire(blocking=False):
+            return 409, {"error": "reload_in_progress"}
+        try:
+            return self._reload_locked(
+                engine, directory, epoch, drain_timeout, poll
+            )
+        finally:
+            self._reload_lock.release()
+
+    def _reload_locked(
+        self, engine, directory, epoch, drain_timeout, poll
+    ) -> tuple[int, dict]:
+        from ddp_tpu.serve import lifecycle as lc
+
+        def record(outcome: str, **fields) -> None:
+            engine.metrics.write(
+                "serve_reload", outcome=outcome, directory=directory,
+                **fields,
+            )
+
+        # Stage 1 — verify, host-side, before anything else: manifest
+        # present, CRCs intact, spec exactly the serving spec. A
+        # rejection names its reason and device state was never
+        # touched.
+        t0 = time.monotonic()
+        try:
+            target = lc.verify_reload_target(
+                directory,
+                epoch=epoch,
+                current_spec=engine.spec,
+                num_heads_fallback=engine.spec.num_heads,
+            )
+        except lc.ReloadRejected as e:
+            record("rejected", reason=e.reason)
+            return 409, {"error": e.reason, "detail": e.detail}
+        verify_s = round(time.monotonic() - t0, 4)
+
+        # Stage 2 — restore to host. The old model keeps serving; a
+        # failed read here (I/O error, torn file the manifest missed)
+        # aborts with nothing installed.
+        t0 = time.monotonic()
+        try:
+            new_params = lc.load_reload_target(target)
+        except Exception as e:  # noqa: BLE001 — named in the payload
+            record("load_failed", model_version=target.version)
+            return 500, {
+                "error": "load_failed",
+                "detail": f"{type(e).__name__}: {e}",
+            }
+        load_s = round(time.monotonic() - t0, 4)
+
+        # Stage 3 — drain lanes to the barrier. Admission pauses (new
+        # work queues, NOTHING is dropped) while bound lanes decode to
+        # completion; the swap happens in the same lock hold that
+        # observes active == 0, so no lane can bind in between.
+        t_swap = time.monotonic()
+        with self._lock:
+            engine.pause_admission()
+        deadline = time.monotonic() + max(0.0, drain_timeout)
+        try:
+            while True:
+                with self._lock:
+                    if engine.active == 0:
+                        return self._swap_at_barrier(
+                            engine, target, new_params, t_swap,
+                            verify_s, load_s, record,
+                        )
+                if time.monotonic() > deadline:
+                    record("drain_timeout", model_version=target.version)
+                    return 503, {
+                        "error": "swap_drain_timeout",
+                        "detail": f"lanes still bound after "
+                        f"{drain_timeout}s",
+                    }
+                time.sleep(poll)
+        finally:
+            # Whatever happened — swap, rollback, timeout — the front
+            # door reopens; paused admission must never outlive the
+            # reload that paused it.
+            with self._lock:
+                engine.resume_admission()
+
+    def _swap_at_barrier(
+        self, engine, target, new_params, t_swap, verify_s, load_s,
+        record,
+    ) -> tuple[int, dict]:
+        """Install under the already-held barrier lock hold; roll back
+        to the old references on ANY failure. Caller holds self._lock
+        with ``engine.active == 0``."""
+        previous = engine.model_version
+        invalidate = previous != target.version
+        old_params = engine.params
+        try:
+            engine.install_params(
+                new_params,
+                model_version=target.version,
+                invalidate_prefix=invalidate,
+            )
+        except Exception as e:  # noqa: BLE001 — rolled back, reported
+            engine.params = old_params
+            engine.model_version = previous
+            engine.rollbacks_total += 1
+            record(
+                "swap_failed",
+                model_version=target.version,
+                rolled_back=True,
+            )
+            return 500, {
+                "error": "swap_failed",
+                "rolled_back": True,
+                "detail": f"{type(e).__name__}: {e}",
+            }
+        swap_s = round(time.monotonic() - t_swap, 4)
+        record(
+            "swapped",
+            model_version=target.version,
+            **({"previous_version": previous} if previous else {}),
+            epoch=target.epoch,
+            verify_s=verify_s,
+            load_s=load_s,
+            swap_s=swap_s,
+            invalidated_prefix=invalidate,
+        )
+        return 200, {
+            "reloaded": True,
+            "model_version": target.version,
+            "previous_version": previous,
+            "epoch": target.epoch,
+            "verify_s": verify_s,
+            "load_s": load_s,
+            "swap_s": swap_s,
+            "invalidated_prefix": invalidate,
+        }
+
     def snapshot(self, route: str) -> Optional[dict | str]:
         """Route → JSON-ready dict, Prometheus text (str), or None."""
         if route == "/healthz":
@@ -313,6 +548,35 @@ class LMServer:
                     "queue_depth": self.engine.scheduler.depth,
                     "draining": self.draining,
                     **({"role": self.role} if self.role else {}),
+                    # Serving model version (absent until a versioned
+                    # checkpoint loads): what the fleet's poll loop
+                    # reads so the router never routes a ``model=``
+                    # request to a not-yet-swapped replica, and what
+                    # the reload loop's convergence check compares.
+                    **(
+                        {"model_version": self.engine.model_version}
+                        if self.engine.model_version is not None
+                        else {}
+                    ),
+                    **(
+                        {
+                            "models": {
+                                name: {
+                                    **(
+                                        {"model_version": e.model_version}
+                                        if e.model_version is not None
+                                        else {}
+                                    ),
+                                    "slots": e.num_slots,
+                                    "active": e.active,
+                                    "queue_depth": e.scheduler.depth,
+                                }
+                                for name, e in self.models.items()
+                            }
+                        }
+                        if self.models
+                        else {}
+                    ),
                     **(
                         {"engine_error": self._engine_error}
                         if self._engine_error
@@ -349,6 +613,18 @@ class LMServer:
                     "draining": self.draining,
                     **({"role": self.role} if self.role else {}),
                     "stats": self.engine.stats(include_states=True),
+                    # Named multi-model engines, each with its own full
+                    # stats block (absent when none are registered).
+                    **(
+                        {
+                            "models": {
+                                name: e.stats(include_states=True)
+                                for name, e in self.models.items()
+                            }
+                        }
+                        if self.models
+                        else {}
+                    ),
                     "trace": self.engine.tracer.snapshot(limit=512),
                 }
         return None
@@ -498,7 +774,7 @@ def _make_handler(server: LMServer):
                 status, payload = server.pages_install(raw)
                 self._send(status, payload)
                 return
-            if self.path not in ("/generate", "/pages/export"):
+            if self.path not in ("/generate", "/pages/export", "/reload"):
                 self._send(404, {"error": f"no route {self.path}"})
                 return
             try:
@@ -508,6 +784,10 @@ def _make_handler(server: LMServer):
                     raise ValueError("body must be a JSON object")
             except (ValueError, TypeError) as e:
                 self._send(400, {"error": f"bad JSON body: {e}"})
+                return
+            if self.path == "/reload":
+                status, payload = server.reload_model(body)
+                self._send(status, payload)
                 return
             if self.path == "/pages/export":
                 status, payload = server.pages_export(body)

@@ -34,7 +34,7 @@ import json
 import logging
 import os
 import zlib
-from typing import Any
+from typing import Any, Sequence
 
 import jax
 import numpy as np
@@ -826,6 +826,12 @@ class CheckpointManager:
         """
         meta = dict(self._mgr.item_metadata(epoch))
         wanted = {k: meta[k] for k in keys if k in meta}
+        return self._restore_subtree(epoch, wanted)
+
+    def _restore_subtree(self, epoch: int, wanted: dict) -> dict:
+        """Restore exactly the metadata subtree ``wanted`` (any
+        nesting depth) with single-device shardings — the shared tail
+        of ``read_partial`` and ``read_params_children``."""
         dev = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         abstract = jax.tree.map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=dev),
@@ -859,6 +865,39 @@ class CheckpointManager:
                 transforms={},
             )
         return dict(self._pytree_mgr.restore(epoch, args=args))
+
+    def params_metadata(self, epoch: int):
+        """Shape/dtype metadata of the checkpoint's ``params`` entry —
+        NO tensor data is read. The leaves carry ``.shape``/``.dtype``
+        like arrays do, so ``models/lm.derive_lm_spec`` runs on the
+        metadata tree directly: streaming restore
+        (serve/lifecycle.py) derives the engine spec and starts
+        compiling before a single weight byte arrives."""
+        meta = dict(self._mgr.item_metadata(epoch))
+        if "params" not in meta:
+            raise KeyError(
+                f"checkpoint epoch {epoch} has no params entry"
+            )
+        return meta["params"]
+
+    def read_params_children(
+        self, epoch: int, names: Sequence[str]
+    ) -> dict:
+        """Restore ONLY the named top-level children of ``params``.
+
+        The streaming-restore primitive (serve/lifecycle.py): the
+        embedding + first-K-blocks group restores and opens admission
+        while the deep blocks are still in flight on a second call.
+        Unknown names are skipped (the group splitter works from the
+        same metadata, so a miss means a racing rewrite — the caller's
+        residency check catches it). Returns ``{child: tree}``.
+        """
+        params_meta = self.params_metadata(epoch)
+        sel = {k: params_meta[k] for k in names if k in params_meta}
+        if not sel:
+            return {}
+        restored = self._restore_subtree(epoch, {"params": sel})
+        return dict(restored["params"])
 
     def restore_for_inference(
         self, epoch: int | None = None

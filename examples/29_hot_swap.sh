@@ -1,0 +1,194 @@
+#!/usr/bin/env bash
+# Zero-downtime model lifecycle (ISSUE 20 / docs/SERVING.md "Model
+# lifecycle"): hot-swap a live server between two checkpoints via
+# POST /reload — same-checkpoint swap token-identical, version swap
+# flips /statusz, a corrupted target is rejected BY NAME with device
+# state untouched — then serve both models from one process with
+# per-request routing, and start once more with --streaming_restore
+# to see the admission/complete residency milestones. Green on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+WORK=${WORK:-/tmp/ddp_tpu_example29}
+rm -rf "$WORK" && mkdir -p "$WORK"
+export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+PORT=${PORT:-8095}
+
+# 1. Two checkpoints of the same architecture (a hot-swap target must
+#    match the serving spec exactly), plus a deliberately torn copy.
+python - "$WORK" <<'EOF'
+import shutil
+import sys
+
+import jax.numpy as jnp
+import optax
+
+from ddp_tpu.models.lm import LMSpec, init_lm
+from ddp_tpu.parallel.ddp import TrainState
+from ddp_tpu.runtime.chaos import corrupt_latest_checkpoint
+from ddp_tpu.train.checkpoint import CheckpointManager, save_lm_spec
+
+work = sys.argv[1]
+spec = LMSpec(vocab_size=64, total_len=64, d_model=32, depth=2,
+              num_heads=4)
+for name, seed in (("ckpt_a", 0), ("ckpt_b", 1)):
+    params = init_lm(spec, seed=seed)
+    tx = optax.sgd(0.01)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       opt_state=tx.init(params), model_state={})
+    mgr = CheckpointManager(f"{work}/{name}", async_save=False)
+    mgr.save(0, state)
+    mgr.close()
+    save_lm_spec(f"{work}/{name}", spec)
+shutil.copytree(f"{work}/ckpt_b", f"{work}/ckpt_torn")
+print("tore:", corrupt_latest_checkpoint(f"{work}/ckpt_torn"))
+EOF
+
+# 2. Serve checkpoint A.
+python scripts/serve.py --checkpoint_dir "$WORK/ckpt_a" \
+    --slots 2 --port "$PORT" \
+    --metrics_file "$WORK/serve.jsonl" \
+    >"$WORK/serve.log" 2>&1 &
+SERVE_PID=$!
+trap 'kill $SERVE_PID 2>/dev/null || true' EXIT
+for _ in $(seq 180); do
+    curl -sf "localhost:$PORT/healthz" >/dev/null 2>&1 && break
+    sleep 1
+done
+echo "--- serving $(curl -s localhost:$PORT/healthz | python -c \
+    'import json,sys; print(json.load(sys.stdin)["model_version"])')"
+
+# 3. The swap drills, driven through the HTTP surface.
+python - "$PORT" "$WORK" <<'EOF'
+import json
+import sys
+import urllib.error
+import urllib.request
+
+port, work = sys.argv[1], sys.argv[2]
+base = f"http://localhost:{port}"
+
+
+def post(path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode()
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def ask():
+    status, out = post(
+        "/generate", {"prompt_tokens": [1, 2, 3], "max_new_tokens": 8}
+    )
+    assert status == 200, out
+    return out
+
+
+def statusz_version():
+    with urllib.request.urlopen(base + "/statusz", timeout=30) as r:
+        return json.load(r)["stats"]["lifecycle"]["model_version"]
+
+
+before = ask()
+
+# Same-checkpoint swap: a no-op on numerics, caches kept.
+status, out = post("/reload", {"checkpoint_dir": f"{work}/ckpt_a"})
+assert status == 200 and out["reloaded"], out
+assert out["invalidated_prefix"] is False
+after = ask()
+assert after["tokens"] == before["tokens"], "identity swap moved tokens!"
+print("same-checkpoint swap: token-identical, swap_s =", out["swap_s"])
+
+# Version swap: new weights, caches invalidated, /statusz flips.
+status, out = post("/reload", {"checkpoint_dir": f"{work}/ckpt_b"})
+assert status == 200 and out["reloaded"], out
+assert out["invalidated_prefix"] is True
+assert statusz_version() == out["model_version"]
+print("hot-swapped", out["previous_version"], "->", out["model_version"],
+      f"(verify {out['verify_s']}s, load {out['load_s']}s,"
+      f" swap {out['swap_s']}s)")
+
+# Torn target: rejected BY NAME before any device state is touched.
+held = statusz_version()
+status, out = post("/reload", {"checkpoint_dir": f"{work}/ckpt_torn"})
+assert status == 409 and out["error"] == "crc_mismatch", out
+assert statusz_version() == held
+print("torn target rejected:", out["error"], "— still serving", held)
+EOF
+
+kill $SERVE_PID 2>/dev/null || true
+wait $SERVE_PID 2>/dev/null || true
+echo "--- lifecycle triage (health_report over the serve stream)"
+python scripts/health_report.py "$WORK/serve.jsonl" | grep lifecycle
+
+# 4. Multi-model: both checkpoints from ONE process, per-request
+#    routing, per-model SLOs, and the gated /healthz registry.
+python scripts/serve.py --checkpoint_dir "$WORK/ckpt_a" \
+    --model "alt=$WORK/ckpt_b" \
+    --slo "ttft_p99<30s;alt:ttft_p99<60s" \
+    --slots 2 --port "$PORT" \
+    >"$WORK/serve_mm.log" 2>&1 &
+SERVE_PID=$!
+for _ in $(seq 180); do
+    curl -sf "localhost:$PORT/healthz" >/dev/null 2>&1 && break
+    sleep 1
+done
+python - "$PORT" <<'EOF'
+import json
+import sys
+import urllib.error
+import urllib.request
+
+base = f"http://localhost:{sys.argv[1]}"
+
+
+def post(body):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(body).encode()
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+body = {"prompt_tokens": [1, 2, 3], "max_new_tokens": 8}
+_, default = post(dict(body))
+_, alt = post(dict(body, model="alt"))
+assert default["tokens"] != alt["tokens"], "routing did not switch models"
+print("default ->", default["model_version"])
+print("model=alt ->", alt["model_version"])
+status, out = post(dict(body, model="nope"))
+assert status == 400 and out["error"] == "unknown_model", out
+print("unknown model 400 lists registry:", out["models"])
+with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+    print("healthz models:", json.dumps(json.load(r)["models"]))
+EOF
+
+kill $SERVE_PID 2>/dev/null || true
+wait $SERVE_PID 2>/dev/null || true
+
+# 5. Streaming restore: admission opens at embed + first K blocks;
+#    the full tree installs through the hot-swap path.
+python scripts/serve.py --checkpoint_dir "$WORK/ckpt_b" \
+    --streaming_restore --stream_layers 1 \
+    --slots 2 --port "$PORT" \
+    >"$WORK/serve_stream.log" 2>&1 &
+SERVE_PID=$!
+for _ in $(seq 180); do
+    grep -q '"streamed"' "$WORK/serve_stream.log" 2>/dev/null && break
+    sleep 1
+done
+echo "--- streaming restore milestones"
+grep -o '{"streamed".*}' "$WORK/serve_stream.log"
+curl -s -X POST "localhost:$PORT/generate" \
+    -d '{"prompt_tokens": [1, 2, 3], "max_new_tokens": 4}' \
+    | python -c 'import json,sys; o=json.load(sys.stdin); \
+print("served post-install:", o["status"], o["model_version"])'
+
+echo "OK: hot-swap, named rejection, multi-model routing, streaming restore"

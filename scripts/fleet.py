@@ -286,7 +286,8 @@ def main() -> None:
         )
         healthy = manager.wait_healthy()
         with FleetServer(
-            manager, router, host=args.host, port=args.port
+            manager, router, host=args.host, port=args.port,
+            chaos=chaos,
         ) as server:
             print(
                 json.dumps(

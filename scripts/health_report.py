@@ -396,6 +396,29 @@ def build_report(records: list[dict]) -> str:
                 )
             )
 
+    # Lifecycle triage (PR 20): one serve_reload record per /reload
+    # attempt — swaps, named rejections, rollbacks, and the version
+    # the engine last landed on. Gated on record presence so trainer
+    # and pre-lifecycle serve streams (and their goldens) stay
+    # byte-identical.
+    reloads = [r for r in records if r.get("kind") == "serve_reload"]
+    if reloads:
+        swapped = [r for r in reloads if r.get("outcome") == "swapped"]
+        rejected = [r for r in reloads if r.get("outcome") == "rejected"]
+        rolled = [r for r in reloads if r.get("rolled_back")]
+        line = (
+            f"lifecycle     : {len(swapped)}/{len(reloads)} "
+            f"reload(s) swapped"
+            f", {len(rejected)} rejected"
+            f", {len(rolled)} rolled back"
+        )
+        reasons = sorted({r.get("reason") for r in rejected if r.get("reason")})
+        if reasons:
+            line += f" ({', '.join(reasons)})"
+        if swapped and swapped[-1].get("model_version"):
+            line += f"; now {swapped[-1]['model_version']}"
+        lines.append(line)
+
     # Fleet-trace triage (PR 19): trace_merge.py --metrics_file stamps
     # one cumulative fleet_trace record per merge, so the LAST one is
     # the freshest fleet reconstruction — requests stitched across

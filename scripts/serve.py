@@ -202,6 +202,30 @@ def main() -> None:
         "is stamped on the startup JSON",
     )
     p.add_argument(
+        "--model", action="append", default=None, metavar="NAME=DIR",
+        help="register an EXTRA named model from its own checkpoint "
+        "dir (repeatable): requests carrying model=NAME route to its "
+        "own engine — own scheduler, slots and pages, so per-model "
+        "accounting is structural. POST /reload with model=NAME "
+        "hot-swaps it independently of the default model",
+    )
+    p.add_argument(
+        "--streaming_restore", action="store_true",
+        help="layer-streamed startup (serve/lifecycle.py): restore "
+        "the checkpoint on a background thread in residency order "
+        "while the main thread compiles the program set — admission "
+        "opens once the embedding + first --stream_layers blocks are "
+        "resident (requests queue), the full tree installs through "
+        "the hot-swap path when the deep layers land. Cold = restore "
+        "THEN warmup; streaming = max(restore, warmup)",
+    )
+    p.add_argument(
+        "--stream_layers", type=int, default=1,
+        help="--streaming_restore admission gate: open the front "
+        "door once the embedding + this many leading blocks are "
+        "resident",
+    )
+    p.add_argument(
         "--init_demo", action="store_true",
         help="serve a freshly initialized tiny LM (no checkpoint)",
     )
@@ -222,6 +246,12 @@ def main() -> None:
     from ddp_tpu.serve.server import LMServer
     from ddp_tpu.utils.metrics import MetricsWriter
 
+    # Streaming restore (lifecycle PR): epoch + spec come from
+    # checkpoint METADATA (no tensor read), the weights stream in on a
+    # background thread while warmup compiles over same-shaped init
+    # params, and the real tree installs through the hot-swap path.
+    streaming = None
+    model_version = None
     if args.init_demo:
         spec = LMSpec(
             vocab_size=args.vocab_size, total_len=args.seq_len,
@@ -229,7 +259,29 @@ def main() -> None:
         )
         params = init_lm(spec, seed=0)
         epoch = -1
+    elif args.streaming_restore:
+        from ddp_tpu.serve.lifecycle import StreamingRestore
+
+        try:
+            streaming = StreamingRestore(
+                args.checkpoint_dir,
+                epoch=args.epoch,
+                first_blocks=args.stream_layers,
+                num_heads_fallback=args.num_heads,
+            )
+        except (FileNotFoundError, ValueError, KeyError) as e:
+            raise SystemExit(
+                f"checkpoint in {args.checkpoint_dir}: {e}"
+            )
+        spec = streaming.spec
+        epoch = streaming.epoch
+        model_version = streaming.version
+        # Shape-true zeros, not a random init: warmup only needs the
+        # shapes, and the real weights are already streaming in.
+        params = streaming.placeholder_params()
+        streaming.start()
     else:
+        from ddp_tpu.serve.lifecycle import model_version_token
         from ddp_tpu.train.checkpoint import (
             CheckpointManager,
             derive_spec_with_sidecar,
@@ -247,6 +299,7 @@ def main() -> None:
             raise SystemExit(
                 f"checkpoint in {args.checkpoint_dir}: {e}"
             )
+        model_version = model_version_token(args.checkpoint_dir, epoch)
 
     # Tuning cache (ddp_tpu.tune): fill knobs the command line left
     # at defaults from the cached winner for this (model shape,
@@ -363,9 +416,27 @@ def main() -> None:
     # metrics stream and the recorder ring (dumped on shutdown so a
     # post-mortem sees them even when nobody scraped /metricsz).
     from ddp_tpu.obs.recorder import FlightRecorder, build_info, snapshot_env
-    from ddp_tpu.obs.slo import SLOEngine
+    from ddp_tpu.obs.slo import SLOEngine, parse_model_slos
 
-    slo = SLOEngine(args.slo) if args.slo else None
+    # ``--slo`` may carry per-model groups ("clauses;name:clauses"):
+    # each registered model gets its OWN SLOEngine over its own
+    # engine's observations. The bare single-group form parses to
+    # {None: spec} — pre-lifecycle behavior, byte-identical.
+    try:
+        model_slos = parse_model_slos(args.slo) if args.slo else {}
+    except ValueError as e:
+        raise SystemExit(f"--slo: {e}")
+    for name in model_slos:
+        if name is not None and name not in {
+            m.partition("=")[0] for m in (args.model or [])
+        }:
+            raise SystemExit(
+                f"--slo names model {name!r} but no --model "
+                f"{name}=DIR registers it"
+            )
+    slo = (
+        SLOEngine(model_slos[None]) if model_slos.get(None) else None
+    )
     recorder = FlightRecorder(args.flight_dir)
     recorder.set_context(
         build_info=build_info(), env=snapshot_env(),
@@ -395,12 +466,62 @@ def main() -> None:
         reqtrace=args.reqtrace,
         slo=slo,
         recorder=recorder,
+        model_version=model_version,
     )
+    if streaming is not None:
+        # No lane may bind to init weights: admission stays paused
+        # (requests queue) until the streamed tree installs below.
+        engine.pause_admission()
     if not args.no_warmup:
         # Compile the bounded program set (one chunk program per
         # bucket width + decode) before the first request arrives:
         # first-request TTFT is then a decode step, not an XLA build.
+        # Under --streaming_restore this is exactly the work the
+        # restore I/O overlaps.
         engine.warmup()
+    # Extra named models (--model NAME=DIR): each an independent
+    # engine over its own restored checkpoint — own scheduler, slots
+    # and page pool; ``model=NAME`` requests route to it.
+    models = {}
+    for entry in args.model or []:
+        name, _, mdir = entry.partition("=")
+        if not name or not mdir:
+            raise SystemExit(f"--model wants NAME=DIR, got {entry!r}")
+        if name in models:
+            raise SystemExit(f"--model {name!r} registered twice")
+        from ddp_tpu.serve.lifecycle import model_version_token
+        from ddp_tpu.train.checkpoint import (
+            CheckpointManager,
+            derive_spec_with_sidecar,
+        )
+
+        mmgr = CheckpointManager(mdir)
+        mparams, _, mepoch = mmgr.restore_for_inference(None)
+        mmgr.close()
+        try:
+            mspec = derive_spec_with_sidecar(
+                mdir, mparams, num_heads_fallback=args.num_heads
+            )
+        except ValueError as e:
+            raise SystemExit(f"--model {name}: checkpoint in {mdir}: {e}")
+        models[name] = ServeEngine(
+            mspec,
+            mparams,
+            slots=args.slots,
+            max_queue=args.max_queue,
+            metrics=metrics,
+            kv_dtype=args.kv_dtype,
+            page_size=args.page_size,
+            kv_pages=args.kv_pages,
+            slo=(
+                SLOEngine(model_slos[name])
+                if model_slos.get(name)
+                else None
+            ),
+            model_version=model_version_token(mdir, mepoch),
+        )
+        if not args.no_warmup:
+            models[name].warmup()
     # Graceful drain on SIGTERM (the preemption signal): the handler
     # only sets an event; the main thread wakes, stops admitting
     # (503 + Retry-After), waits for running lanes up to
@@ -414,8 +535,15 @@ def main() -> None:
     signal.signal(signal.SIGTERM, lambda *_: stop_event.set())
     try:
         with LMServer(
-            engine, host=args.host, port=args.port, role=args.role
+            engine, host=args.host, port=args.port, role=args.role,
+            models=models,
         ) as server:
+            if streaming is not None:
+                # The front door opens at the ADMISSION milestone —
+                # embedding + first --stream_layers blocks resident —
+                # not at full residency; queued requests dispatch the
+                # moment the full tree installs below.
+                streaming.wait_admission()
             print(
                 json.dumps(
                     {
@@ -447,10 +575,52 @@ def main() -> None:
                         "reqtrace": bool(args.reqtrace),
                         **({"slo": args.slo} if args.slo else {}),
                         **({"tuning": tuning} if tuning else {}),
+                        **(
+                            {"model_version": model_version}
+                            if model_version
+                            else {}
+                        ),
+                        **(
+                            {"models": sorted(models)} if models else {}
+                        ),
+                        **(
+                            {
+                                "streaming_restore": {
+                                    "admission_ready_s":
+                                        streaming.admission_ready_s,
+                                    "admission_group":
+                                        streaming.admission_group,
+                                }
+                            }
+                            if streaming is not None
+                            else {}
+                        ),
                     }
                 ),
                 flush=True,
             )
+            if streaming is not None:
+                # Full residency → install through the hot-swap path
+                # (same barrier, same validation) and open the lanes.
+                # A failed stream is fatal — serving init weights is
+                # never an option.
+                full = streaming.wait(timeout=600.0)
+                with server._lock:
+                    engine.install_params(
+                        full, model_version=streaming.version
+                    )
+                    engine.resume_admission()
+                print(
+                    json.dumps(
+                        {
+                            "streamed": True,
+                            "admission_ready_s":
+                                streaming.admission_ready_s,
+                            "complete_s": streaming.complete_s,
+                        }
+                    ),
+                    flush=True,
+                )
             try:
                 stop_event.wait()  # serve until SIGTERM (or ctrl-C)
             except KeyboardInterrupt:

@@ -47,10 +47,24 @@ def test_chaos_spec_roundtrip_property():
             kind = (
                 "kill", "sigterm", "stall", "ckpt_corrupt",
                 "stage_kill", "stage_stall",
-            )[int(rng.integers(0, 6))]
+                "reload_kill", "reload_corrupt",
+            )[int(rng.integers(0, 8))]
             at = int(rng.integers(0, 10_000))
             by_step = bool(rng.integers(0, 2))
-            if kind == "ckpt_corrupt":
+            if kind == "reload_kill":
+                # lifecycle drill: SIGKILL replica R mid-hot-swap
+                events.append(
+                    ChaosEvent(
+                        kind="kill",
+                        replica=int(rng.integers(0, 8)),
+                        reload=True,
+                    )
+                )
+            elif kind == "reload_corrupt":
+                events.append(
+                    ChaosEvent(kind="ckpt_corrupt", reload=True)
+                )
+            elif kind == "ckpt_corrupt":
                 events.append(ChaosEvent(kind="ckpt_corrupt"))
             elif kind.startswith("stage_"):
                 # MPMD drills: stage victim, step-only trigger
@@ -156,6 +170,44 @@ def test_chaos_stage_grammar_and_ownership():
     other = parse_chaos("kill:rank1@step3,kill:replica0@request2")
     assert not owner._mine(other[0])
     assert not owner._mine(other[1])
+
+
+def test_chaos_reload_grammar_and_ownership():
+    """Lifecycle events (ISSUE 20): grammar round-trip, rejection of
+    malformed tokens, the reload_events() filter, and the ownership
+    rule — reload events belong to the fleet's hot-swap loop, never
+    to a trainer/stage ChaosEngine."""
+    from ddp_tpu.runtime.chaos import reload_events
+
+    ev = parse_chaos("kill:replica2@reload,ckpt_corrupt:reload")
+    assert ev[0] == ChaosEvent(kind="kill", replica=2, reload=True)
+    assert ev[1] == ChaosEvent(kind="ckpt_corrupt", reload=True)
+    assert format_chaos(ev) == "kill:replica2@reload,ckpt_corrupt:reload"
+    # the reload-scoped filter (what /reloadz arms) keeps reload
+    # events only, and accepts a raw spec string
+    mixed = (
+        "kill:rank0@step2,kill:replica1@request3,"
+        "kill:replica0@reload,ckpt_corrupt:reload,ckpt_corrupt:latest"
+    )
+    assert reload_events(mixed) == (
+        ChaosEvent(kind="kill", replica=0, reload=True),
+        ChaosEvent(kind="ckpt_corrupt", reload=True),
+    )
+    for bad in (
+        "sigterm:replica1@reload",   # only kill exists for reloads
+        "stall:replica1@reload:2s",  # no stall-at-reload
+        "kill:replica@reload",       # replica needs an index
+        "kill:rank1@reload",         # reload kills name replicas
+        "ckpt_corrupt:reload2",      # no reload ordinal exists
+    ):
+        with pytest.raises(ValueError):
+            parse_chaos(bad)
+    # ownership: a trainer engine armed with the full plan must never
+    # claim a reload event (the mid-training corrupt drill fires on
+    # the TRAINER's ledger; a reload corrupt must not)
+    trainer = ChaosEngine(ev, rank=0)
+    assert not trainer._mine(ev[0])
+    assert not trainer._mine(ev[1])
 
 
 def test_chaos_ledger_fires_once_across_engines(tmp_path):

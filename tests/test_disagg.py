@@ -53,6 +53,7 @@ from ddp_tpu.serve.disagg import (
     CRC_MISMATCH,
     HEADER_INVALID,
     MAGIC,
+    MODEL_SKEW,
     PAGE_WIRE_VERSION,
     SHAPE_MISMATCH,
     TRUNCATED,
@@ -391,6 +392,39 @@ class TestMigrationIdentity:
             ServeEngine(
                 SPEC, params, slots=2, prefill_len=16
             ).install_prefix(frame)
+
+    def test_install_rejects_model_version_skew(self, params):
+        """Pages exported mid-/reloadz (ISSUE 20): a frame stamped
+        with another model's lifecycle version is refused BY NAME —
+        KV computed under one model is garbage under another — while
+        version-less frames keep the pre-lifecycle wire bytes and
+        install anywhere."""
+        a = _engine(params, model_version="m@epoch1")
+        a.submit(list(range(8)), 1)
+        a.run()
+        buf = a.export_prefix(list(range(8)))
+        frame = decode_pages(buf)
+        assert frame.model_version == "m@epoch1"
+        with pytest.raises(PageWireError) as e:
+            _engine(params, model_version="m@epoch2").install_prefix(
+                frame
+            )
+        assert e.value.reason == MODEL_SKEW
+        assert "m@epoch1" in str(e.value)
+        # same version (the steady-state fleet) installs fine
+        b = _engine(params, model_version="m@epoch1")
+        assert b.install_prefix(frame)["tokens"] == 8
+        # a version-less exporter writes no header key at all: its
+        # bytes match a pre-lifecycle build and install everywhere
+        c = _engine(params)
+        c.submit(list(range(8)), 1)
+        c.run()
+        legacy = c.export_prefix(list(range(8)))
+        assert b'"model_version"' not in legacy
+        plain = decode_pages(legacy)
+        assert plain.model_version is None
+        d = _engine(params, model_version="m@epoch2")
+        assert d.install_prefix(plain)["tokens"] == 8
 
     def test_export_miss_returns_none(self, params):
         a = _engine(params)

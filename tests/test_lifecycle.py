@@ -1,0 +1,445 @@
+"""Model lifecycle (ISSUE 20): streaming restore, verified atomic
+hot-swap with rollback, multi-model serving.
+
+- **Identity**: a same-checkpoint hot-swap is a no-op on numerics —
+  tokens pinned before vs after for greedy AND seeded sampling, over
+  the fp32 fixed-lane AND the int8 paged cache.
+- **Zero downtime**: requests in flight when ``POST /reload`` lands
+  all complete; admission pauses at the barrier, it never sheds.
+- **Verification**: a corrupt / manifest-less / shape-skewed target is
+  rejected with its NAMED reason before any device state is touched —
+  ``/statusz`` stays on the old version.
+- **Streaming restore**: the admission group (embedding + first K
+  blocks) lands before the deep group; the full tree is leaf-identical
+  to a monolithic restore.
+- **Fleet** (slow tier): a SIGKILL mid-``/reloadz`` drill converges on
+  exactly one model version with zero dropped requests and exactly one
+  respawn on the PINNED checkpoint; a corrupt target aborts the roll
+  with the fleet still converged on the old version.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ddp_tpu.models.lm import LMSpec, init_lm
+from ddp_tpu.serve.engine import ServeEngine
+from ddp_tpu.serve.lifecycle import (
+    REASON_CRC_MISMATCH,
+    REASON_MANIFEST_MISSING,
+    REASON_SPEC_SKEW,
+    ReloadRejected,
+    StreamingRestore,
+    model_version_token,
+    split_param_groups,
+    verify_reload_target,
+)
+from ddp_tpu.serve.server import LMServer
+
+SPEC = LMSpec(vocab_size=37, total_len=32, d_model=32, depth=2, num_heads=4)
+
+
+def save_ckpt(directory, spec, *, seed=0, epoch=0):
+    """A serving-consumable checkpoint: params + manifest + sidecar."""
+    from ddp_tpu.parallel.ddp import TrainState
+    from ddp_tpu.train.checkpoint import CheckpointManager, save_lm_spec
+
+    params = init_lm(spec, seed=seed)
+    tx = optax.sgd(0.01)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=tx.init(params), model_state={},
+    )
+    mgr = CheckpointManager(str(directory), async_save=False)
+    mgr.save(epoch, state)
+    mgr.close()
+    save_lm_spec(str(directory), spec)
+    return params
+
+
+@pytest.fixture(scope="module")
+def ckpt_a(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt_a")
+    save_ckpt(d, SPEC, seed=0)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def ckpt_b(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ckpt_b")
+    save_ckpt(d, SPEC, seed=1)
+    return str(d)
+
+
+class TestHotSwap:
+    @pytest.mark.parametrize(
+        "cache", ["fp32", "int8_paged"], ids=["fp32", "int8-paged"]
+    )
+    def test_same_checkpoint_swap_token_identity(self, ckpt_a, cache):
+        """Reloading the checkpoint the engine already serves must be
+        bit-invisible: same version → caches kept, and every token
+        stream (greedy AND seeded) identical before vs after."""
+        kw = (
+            dict(kv_dtype="int8", page_size=8)
+            if cache == "int8_paged"
+            else {}
+        )
+        params = init_lm(SPEC, seed=0)  # == the ckpt_a values
+        eng = ServeEngine(
+            SPEC, params, slots=2, prefill_len=8,
+            model_version=model_version_token(ckpt_a, 0), **kw,
+        )
+        asks = [
+            ([1, 2, 3], 6, {}),                                # greedy
+            ([2, 7, 4], 5, dict(temperature=0.8, seed=7)),     # seeded
+            ([5, 3, 5], 4, dict(temperature=1.2, top_p=0.9, seed=3)),
+        ]
+
+        def run_all():
+            out = []
+            for prompt, n, sampling in asks:
+                rid = eng.submit(prompt, n, **sampling).request.rid
+                eng.run()
+                out.append(eng.result(rid).tokens)
+            return out
+
+        before = run_all()
+        with LMServer(eng) as srv:
+            status, payload = srv.reload_model(
+                {"checkpoint_dir": ckpt_a}
+            )
+        assert status == 200 and payload["reloaded"], payload
+        assert payload["model_version"] == model_version_token(ckpt_a, 0)
+        # same version: the prefix/radix pages survive the swap
+        assert payload["invalidated_prefix"] is False
+        assert eng.reloads_total == 1
+        assert run_all() == before
+
+    def test_inflight_requests_complete_across_swap(
+        self, ckpt_a, ckpt_b
+    ):
+        """A burst straddling the swap: every request completes (the
+        barrier pauses admission, it never sheds), and the engine
+        comes out serving the NEW version with caches invalidated."""
+        params = init_lm(SPEC, seed=0)
+        eng = ServeEngine(
+            SPEC, params, slots=2, prefill_len=8, max_queue=16,
+            model_version=model_version_token(ckpt_a, 0),
+        )
+        with LMServer(eng) as srv:
+            results = []
+            lock = threading.Lock()
+
+            def client(i):
+                status, payload = srv.submit_and_wait(
+                    {
+                        "prompt_tokens": [(3 * i + j) % 37
+                                          for j in range(1, 6)],
+                        "max_new_tokens": 8,
+                    }
+                )
+                with lock:
+                    results.append((i, status, payload))
+
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # land the reload mid-burst
+            status, payload = srv.reload_model(
+                {"checkpoint_dir": ckpt_b}
+            )
+            for t in threads:
+                t.join()
+            # post-swap responses carry the new version label
+            s_after, p_after = srv.submit_and_wait(
+                {"prompt_tokens": [1, 2], "max_new_tokens": 2}
+            )
+        assert status == 200 and payload["reloaded"], payload
+        new_version = model_version_token(ckpt_b, 0)
+        assert payload["model_version"] == new_version
+        assert payload["previous_version"] == model_version_token(
+            ckpt_a, 0
+        )
+        assert payload["invalidated_prefix"] is True  # version changed
+        assert len(results) == 6
+        for i, s, p in results:
+            assert s == 200 and p["status"] == "complete", (i, s, p)
+        assert eng.model_version == new_version
+        assert s_after == 200
+        assert p_after["model_version"] == new_version
+
+    def test_corrupt_target_rejected_statusz_stays(
+        self, tmp_path, ckpt_a
+    ):
+        """A torn swap target → 409 ``crc_mismatch`` from verification
+        alone: zero installs, zero rollbacks, ``/statusz`` (and the
+        next response) still on the old version."""
+        from ddp_tpu.runtime.chaos import corrupt_latest_checkpoint
+
+        bad = tmp_path / "bad"
+        save_ckpt(bad, SPEC, seed=1)
+        assert corrupt_latest_checkpoint(str(bad)) is not None
+        old = model_version_token(ckpt_a, 0)
+        eng = ServeEngine(
+            SPEC, init_lm(SPEC, seed=0), slots=2, prefill_len=8,
+            model_version=old,
+        )
+        with LMServer(eng) as srv:
+            status, payload = srv.reload_model(
+                {"checkpoint_dir": str(bad)}
+            )
+            assert status == 409, payload
+            assert payload["error"] == REASON_CRC_MISMATCH
+            assert payload["detail"]
+            statusz = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/statusz", timeout=10
+                ).read()
+            )
+            assert statusz["stats"]["lifecycle"]["model_version"] == old
+            assert statusz["stats"]["lifecycle"]["reloads_total"] == 0
+            s, p = srv.submit_and_wait(
+                {"prompt_tokens": [1, 2], "max_new_tokens": 2}
+            )
+            assert s == 200 and p["model_version"] == old
+
+    def test_manifest_missing_and_spec_skew_named(
+        self, tmp_path, ckpt_a
+    ):
+        """The other two named rejections, straight from the verifier:
+        no manifest → no swap (STRICTER than the restore path), and a
+        shape-skewed target names the differing spec fields."""
+        unmanifested = tmp_path / "unmanifested"
+        save_ckpt(unmanifested, SPEC, seed=1)
+        os.remove(str(unmanifested / "epoch_0.manifest.json"))
+        with pytest.raises(ReloadRejected) as e:
+            verify_reload_target(str(unmanifested), current_spec=SPEC)
+        assert e.value.reason == REASON_MANIFEST_MISSING
+
+        skewed = tmp_path / "skewed"
+        save_ckpt(skewed, SPEC._replace(d_model=48), seed=1)
+        with pytest.raises(ReloadRejected) as e:
+            verify_reload_target(str(skewed), current_spec=SPEC)
+        assert e.value.reason == REASON_SPEC_SKEW
+        assert "d_model" in e.value.detail
+        # an empty directory is a missing manifest, not a crash
+        with pytest.raises(ReloadRejected) as e:
+            verify_reload_target(str(tmp_path / "nowhere"))
+        assert e.value.reason == REASON_MANIFEST_MISSING
+        # the happy path verifies without reading tensor data
+        target = verify_reload_target(ckpt_a, current_spec=SPEC)
+        assert target.version == model_version_token(ckpt_a, 0)
+        assert target.spec == SPEC
+
+
+class TestMultiModel:
+    def test_named_model_routing_and_accounting(self, ckpt_a, ckpt_b):
+        """``model=`` routes to the named engine's own weights, slots
+        and pages; unknown names 400 with the registry listed; the
+        gated surfaces (healthz/statusz) advertise the fleet what is
+        served where."""
+        eng = ServeEngine(
+            SPEC, init_lm(SPEC, seed=0), slots=2, prefill_len=8,
+            model_version=model_version_token(ckpt_a, 0),
+        )
+        other = ServeEngine(
+            SPEC, init_lm(SPEC, seed=1), slots=2, prefill_len=8,
+            model_version=model_version_token(ckpt_b, 0),
+        )
+        with LMServer(eng, models={"other": other}) as srv:
+            body = {"prompt_tokens": [1, 2, 3], "max_new_tokens": 5}
+            s_def, p_def = srv.submit_and_wait(dict(body))
+            s_oth, p_oth = srv.submit_and_wait(
+                dict(body, model="other")
+            )
+            assert s_def == 200 and s_oth == 200
+            # different weights, different greedy streams — the proof
+            # the request really ran on the named engine
+            assert p_def["tokens"] != p_oth["tokens"]
+            assert p_oth["model_version"] == model_version_token(
+                ckpt_b, 0
+            )
+            s, p = srv.submit_and_wait(dict(body, model="nope"))
+            assert s == 400 and p["error"] == "unknown_model"
+            assert p["models"] == ["other"]
+            health = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/healthz", timeout=10
+                ).read()
+            )
+            assert health["models"]["other"][
+                "model_version"
+            ] == model_version_token(ckpt_b, 0)
+            statusz = json.loads(
+                urllib.request.urlopen(
+                    srv.url + "/statusz", timeout=10
+                ).read()
+            )
+            # per-model accounting: the named engine's OWN stats block
+            # (completions are popped on delivery — the cumulative
+            # token counter is the durable evidence)
+            assert statusz["models"]["other"]["tokens_total"] >= 5
+        # per-model submission really landed on the other scheduler
+        assert other.stats()["tokens_total"] >= 5
+        assert eng.stats()["tokens_total"] >= 5
+
+
+class TestStreamingRestore:
+    def test_split_param_groups_model_order(self):
+        admission, deep = split_param_groups(
+            ["embed", "pos_embed", "block1", "block2", "block3",
+             "ln_final"],
+            first_blocks=2,
+        )
+        assert admission == ["embed", "pos_embed", "block1", "block2"]
+        assert deep == ["block3", "ln_final"]
+        # unknown children degrade to full-residency gating
+        admission, deep = split_param_groups(["embed", "mystery"])
+        assert admission == ["embed"] and deep == ["mystery"]
+
+    def test_streaming_restore_matches_monolithic(self, ckpt_a):
+        """Admission group lands first (embed + first K blocks), then
+        the deep group; the assembled tree is leaf-identical to a
+        monolithic ``restore_for_inference``."""
+        from ddp_tpu.train.checkpoint import CheckpointManager
+
+        streaming = StreamingRestore(ckpt_a, first_blocks=1)
+        assert streaming.spec == SPEC
+        assert streaming.admission_group == [
+            "embed", "pos_embed", "block1"
+        ]
+        assert streaming.deep_group == ["block2", "ln_final"]
+        streaming.start()
+        assert streaming.wait_admission(120)
+        full = streaming.wait(120)
+        assert streaming.admission_ready_s <= streaming.complete_s
+        assert streaming.version == model_version_token(ckpt_a, 0)
+        mgr = CheckpointManager(ckpt_a)
+        reference, _, _ = mgr.restore_for_inference(None)
+        mgr.close()
+        assert set(full) == set(reference)
+        import jax
+
+        for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(reference)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------
+# Slow tier: the fleet drills
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_reload_sigkill_mid_swap_converges(tmp_path):
+    """3-replica fleet, ``kill:replica1@reload``: the hot-swap roll
+    completes with zero dropped requests, EXACTLY one respawn (on the
+    PINNED checkpoint — the target once replica 0 committed), and the
+    fleet converges on exactly one model version; a corrupt follow-up
+    target aborts with the fleet still converged on that version."""
+    from ddp_tpu.runtime.chaos import corrupt_latest_checkpoint
+    from ddp_tpu.serve.fleet import (
+        FleetChaos,
+        ReplicaManager,
+        Router,
+        RouterConfig,
+    )
+
+    ckpt_a = tmp_path / "a"
+    ckpt_b = tmp_path / "b"
+    save_ckpt(ckpt_a, SPEC, seed=0)
+    save_ckpt(ckpt_b, SPEC, seed=1)
+    n_requests = 10
+    mgr = ReplicaManager(
+        3,
+        ["--checkpoint_dir", str(ckpt_a), "--slots", "2"],
+        workdir=str(tmp_path / "fleet"),
+        max_restarts=2,
+        restart_backoff=0.2,
+    )
+    try:
+        mgr.start()
+        chaos = FleetChaos("kill:replica1@reload", mgr)
+        router = mgr.attach_router(
+            Router(
+                mgr.replicas,
+                RouterConfig(retry_backoff_s=0.02),
+            )
+        )
+        assert mgr.wait_healthy(420), "fleet never became healthy"
+
+        results = []
+        lock = threading.Lock()
+
+        def client(i):
+            status, payload = router.dispatch(
+                {
+                    "prompt_tokens": [(i * 5 + j) % 37
+                                      for j in range(1, 9)],
+                    "max_new_tokens": 12,
+                }
+            )
+            with lock:
+                results.append((i, status, payload))
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_requests)
+        ]
+        for t in threads:
+            t.start()
+        out = mgr.reload_fleet(str(ckpt_b), chaos=chaos)
+        for t in threads:
+            t.join()
+
+        assert out["ok"], out
+        target = model_version_token(str(ckpt_b), 0)
+        assert out["version"] == target
+        assert mgr.chaos_kills == 1, "the drill never fired"
+        assert out["respawns"] == 1, out
+        # zero dropped, zero duplicated
+        assert len(results) == n_requests
+        for i, status, payload in results:
+            assert status == 200, (i, status, payload.get("error"))
+            assert payload["status"] == "complete"
+        tids = [p["router"]["trace_id"] for _, _, p in results]
+        assert len(set(tids)) == n_requests
+        # convergence: every replica's /healthz advertises the target
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if {r.model_version for r in mgr.replicas} == {target}:
+                break
+            time.sleep(0.25)
+        assert {r.model_version for r in mgr.replicas} == {target}
+        assert router.state()["model_versions"] == {target: 3}
+        # the respawned replica is PINNED: its argv now names the
+        # committed target, not the original checkpoint
+        assert str(ckpt_b) in mgr.serve_args
+        assert str(ckpt_a) not in mgr.serve_args
+
+        # corrupt follow-up: the roll aborts on the FIRST replica's
+        # named rejection and the fleet stays converged on `target`
+        ckpt_c = tmp_path / "c"
+        save_ckpt(ckpt_c, SPEC, seed=2)
+        assert corrupt_latest_checkpoint(str(ckpt_c)) is not None
+        out2 = mgr.reload_fleet(str(ckpt_c))
+        assert not out2["ok"]
+        assert out2["aborted"] == REASON_CRC_MISMATCH
+        assert out2["respawns"] == 0
+        assert {r.model_version for r in mgr.replicas} == {target}
+        # still serving: the converged fleet answers after the abort
+        status, payload = router.dispatch(
+            {"prompt_tokens": [1, 2, 3], "max_new_tokens": 4}
+        )
+        assert status == 200 and payload["status"] == "complete"
+    finally:
+        mgr.stop()
